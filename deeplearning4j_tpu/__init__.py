@@ -1,0 +1,27 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the capability
+surface of Deeplearning4j (reference: wis-02/deeplearning4j @ 0.8.1-SNAPSHOT),
+built idiomatically on JAX/XLA: functional layers, jitted train steps,
+pjit/shard_map data parallelism over device meshes, and Pallas kernels on the
+hot paths.
+
+Top-level re-exports cover the most common entry points; subpackages mirror the
+reference's capability areas (see SURVEY.md):
+
+- ``ops``        — tensor-adjacent substrate the reference gets from ND4J:
+                   activations, losses, updaters, weight init, DataSet, normalizers.
+- ``nn``         — configuration system + layers + MultiLayerNetwork/ComputationGraph.
+- ``optimize``   — solvers and training listeners.
+- ``eval``       — Evaluation / RegressionEvaluation / ROC / EvaluationBinary.
+- ``earlystopping`` — early-stopping configs, trainers, savers, terminations.
+- ``datasets``   — dataset iterators (async prefetch, MNIST/Iris fetchers, ...).
+- ``parallel``   — data-parallel training over a jax Mesh (ParallelWrapper analog),
+                   parallel inference, sequence parallelism.
+- ``keras``      — Keras HDF5 model import.
+- ``nlp``        — SequenceVectors/Word2Vec/ParagraphVectors/GloVe + text pipeline.
+- ``graph_embeddings`` — DeepWalk graph embeddings.
+- ``models``     — model zoo (LeNet, ResNet-50, char-RNN).
+- ``utils``      — ModelSerializer (checkpoint zip), ModelGuesser, misc.
+- ``ui``         — training-stats storage + web UI.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,164 @@
+"""Activation function zoo.
+
+Capability parity with the ``IActivation`` implementations the reference
+consumes from ND4J (SURVEY.md §2.9; 25 importers of ``IActivation``) and
+exposes through ``org.deeplearning4j.nn.conf.layers.*.activation(...)``.
+
+TPU-first design: every activation is a pure jax function ``f(x) -> y`` usable
+inside ``jit``; backprop comes from autodiff rather than the reference's
+hand-written ``IActivation.backprop``. Stochastic activations (RReLU) take an
+optional PRNG key and fall back to their deterministic test-mode behaviour
+without one.
+
+Activations are registered by canonical lower-case name so that layer configs
+can be JSON round-tripped the way the reference serializes ``Activation`` enum
+values (nd4j Activation.java).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+ActivationFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+_REGISTRY: Dict[str, ActivationFn] = {}
+
+
+def register_activation(name: str, fn: ActivationFn) -> ActivationFn:
+    _REGISTRY[name.lower()] = fn
+    return fn
+
+
+def get_activation(name) -> ActivationFn:
+    """Resolve an activation by name (or pass a callable through)."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key]
+
+
+def activation_names():
+    return sorted(_REGISTRY)
+
+
+# --- the zoo -----------------------------------------------------------------
+
+def identity(x):
+    return x
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def leakyrelu(x, alpha: float = 0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def softmax(x):
+    # Row softmax over the feature axis, as the reference's OldSoftMax /
+    # Activation.SOFTMAX applies it to [minibatch, nOut] pre-outputs.
+    return jax.nn.softmax(x, axis=-1)
+
+
+def logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def cube(x):
+    return x * x * x
+
+
+def rationaltanh(x):
+    # Rational approximation of tanh (nd4j ActivationRationalTanh):
+    # 1.7159 * tanh_approx(2x/3) with tanh_approx clipped rational form.
+    a = 0.6666667 * x
+    abs_a = jnp.abs(a)
+    approx = jnp.sign(a) * (
+        1.0 - 1.0 / (1.0 + abs_a + a * a + 1.41645 * (a ** 4))
+    )
+    return 1.7159 * approx
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def thresholdedrelu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+def rrelu(x, rng: Optional[jax.Array] = None, lower: float = 1.0 / 8.0,
+          upper: float = 1.0 / 3.0):
+    """Randomized leaky ReLU. With a key: slopes ~ U[lower, upper] (train mode);
+    without: fixed slope (lower+upper)/2 (test mode), matching ActivationRReLU."""
+    if rng is None:
+        alpha = (lower + upper) / 2.0
+        return jnp.where(x >= 0, x, alpha * x)
+    alpha = jax.random.uniform(rng, x.shape, x.dtype, lower, upper)
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+for _name, _fn in [
+    ("identity", identity), ("linear", identity),
+    ("sigmoid", sigmoid), ("tanh", tanh), ("relu", relu),
+    ("leakyrelu", leakyrelu), ("elu", elu), ("selu", selu),
+    ("softmax", softmax), ("logsoftmax", logsoftmax),
+    ("softplus", softplus), ("softsign", softsign),
+    ("hardsigmoid", hardsigmoid), ("hardtanh", hardtanh),
+    ("cube", cube), ("rationaltanh", rationaltanh),
+    ("rectifiedtanh", rectifiedtanh), ("swish", swish), ("gelu", gelu),
+    ("mish", mish), ("thresholdedrelu", thresholdedrelu), ("rrelu", rrelu),
+]:
+    register_activation(_name, _fn)

@@ -1,0 +1,204 @@
+"""Loss function zoo.
+
+Capability parity with the ``ILossFunction``/``LossFunctions`` surface the
+reference consumes from ND4J (SURVEY.md §2.9; 106 importers) — MSE, L1, L2,
+MAE, binary/multiclass cross-entropy, NLL, KL divergence, cosine proximity,
+hinge, squared hinge, Poisson, MAPE, MSLE.
+
+Each loss is a pure function of ``(labels, preoutput, activation, mask)``
+returning the **per-example score array** of shape [minibatch] (the analog of
+``ILossFunction.scoreArray``); ``compute_loss`` reduces it to the scalar score
+(sum over examples, optionally averaged — matching BaseOutputLayer's
+``computeScore(fullNetworkL1, fullNetworkL2, average)``). Gradients come from
+autodiff, so the fused stable forms matter: cross-entropy losses are computed
+from log-probabilities (log_softmax / log_sigmoid) rather than activated
+output, which is also the numerically sound TPU/bf16 choice.
+
+Masks: per-example or per-element mask arrays multiply the per-element score
+before reduction, mirroring ILossFunction's mask handling for variable-length
+time series (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .activations import get_activation
+
+_EPS = 1e-7
+
+LossFn = Callable[..., jnp.ndarray]
+_REGISTRY: Dict[str, LossFn] = {}
+
+
+def register_loss(name: str, fn: LossFn) -> LossFn:
+    _REGISTRY[name.lower()] = fn
+    return fn
+
+
+def get_loss(name) -> LossFn:
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def loss_names():
+    return sorted(_REGISTRY)
+
+
+def _apply_mask(per_elem: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    if mask is None:
+        return per_elem
+    mask = jnp.asarray(mask, per_elem.dtype)
+    while mask.ndim < per_elem.ndim:
+        mask = mask[..., None]
+    return per_elem * mask
+
+
+def _reduce_example(per_elem: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Sum per-element scores over all non-batch axes → [minibatch]."""
+    per_elem = _apply_mask(per_elem, mask)
+    axes = tuple(range(1, per_elem.ndim))
+    return jnp.sum(per_elem, axis=axes) if axes else per_elem
+
+
+# --- the zoo -----------------------------------------------------------------
+# Every loss: (labels, preoutput, activation="identity", mask=None) -> [minibatch]
+
+def mse(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    d = out - labels
+    # Mean over output size, matching LossMSE (= LossL2 / nOut).
+    return _reduce_example(d * d, mask) / labels.shape[-1]
+
+
+def l2(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    d = out - labels
+    return _reduce_example(d * d, mask)
+
+
+def l1(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    return _reduce_example(jnp.abs(out - labels), mask)
+
+
+def mae(labels, preoutput, activation="identity", mask=None):
+    return l1(labels, preoutput, activation, mask) / labels.shape[-1]
+
+
+def xent(labels, preoutput, activation="sigmoid", mask=None):
+    """Binary cross-entropy (LossBinaryXENT). Stable fused form when the
+    activation is sigmoid; falls back to clipped probabilities otherwise."""
+    act = str(activation).lower() if not callable(activation) else None
+    if act == "sigmoid":
+        # -(y*log σ(x) + (1-y)*log(1-σ(x))) = max(x,0) - x*y + log(1+e^{-|x|})
+        x = preoutput
+        per = jnp.maximum(x, 0.0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    else:
+        p = jnp.clip(get_activation(activation)(preoutput), _EPS, 1.0 - _EPS)
+        per = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+    return _reduce_example(per, mask)
+
+
+def mcxent(labels, preoutput, activation="softmax", mask=None):
+    """Multiclass cross-entropy (LossMCXENT). Fused log_softmax when the
+    activation is softmax — the hot classification path."""
+    act = str(activation).lower() if not callable(activation) else None
+    if act == "softmax":
+        logp = jax.nn.log_softmax(preoutput, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(get_activation(activation)(preoutput), _EPS, 1.0))
+    return _reduce_example(-labels * logp, mask)
+
+
+def negativeloglikelihood(labels, preoutput, activation="softmax", mask=None):
+    # LossNegativeLogLikelihood extends LossMCXENT in the reference.
+    return mcxent(labels, preoutput, activation, mask)
+
+
+def kl_divergence(labels, preoutput, activation="softmax", mask=None):
+    p = jnp.clip(get_activation(activation)(preoutput), _EPS, 1.0)
+    y = jnp.clip(labels, _EPS, 1.0)
+    return _reduce_example(labels * (jnp.log(y) - jnp.log(p)), mask)
+
+
+def cosine_proximity(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    if mask is not None:
+        out = _apply_mask(out, mask)
+        labels = _apply_mask(labels, mask)
+    dot = jnp.sum(labels * out, axis=-1)
+    norm = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1)
+    per = -dot / jnp.maximum(norm, _EPS)
+    axes = tuple(range(1, per.ndim))
+    return jnp.sum(per, axis=axes) if axes else per
+
+
+def hinge(labels, preoutput, activation="identity", mask=None):
+    # Labels in {-1, +1} (or {0,1} mapped by caller), per LossHinge.
+    out = get_activation(activation)(preoutput)
+    return _reduce_example(jnp.maximum(0.0, 1.0 - labels * out), mask)
+
+
+def squared_hinge(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    h = jnp.maximum(0.0, 1.0 - labels * out)
+    return _reduce_example(h * h, mask)
+
+
+def poisson(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    return _reduce_example(out - labels * jnp.log(jnp.maximum(out, _EPS)), mask)
+
+
+def mape(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    per = 100.0 * jnp.abs((labels - out) / jnp.maximum(jnp.abs(labels), _EPS))
+    return _reduce_example(per, mask) / labels.shape[-1]
+
+
+def msle(labels, preoutput, activation="identity", mask=None):
+    out = get_activation(activation)(preoutput)
+    d = jnp.log1p(jnp.maximum(out, -1.0 + _EPS)) - jnp.log1p(labels)
+    return _reduce_example(d * d, mask) / labels.shape[-1]
+
+
+for _name, _fn in [
+    ("mse", mse), ("squared_loss", l2), ("l2", l2), ("l1", l1), ("mae", mae),
+    ("mean_absolute_error", mae), ("mean_squared_error", mse),
+    ("xent", xent), ("binary_crossentropy", xent),
+    ("mcxent", mcxent), ("categorical_crossentropy", mcxent),
+    ("negativeloglikelihood", negativeloglikelihood),
+    ("kl_divergence", kl_divergence), ("reconstruction_crossentropy", xent),
+    ("cosine_proximity", cosine_proximity),
+    ("hinge", hinge), ("squared_hinge", squared_hinge),
+    ("poisson", poisson),
+    ("mean_absolute_percentage_error", mape), ("mape", mape),
+    ("mean_squared_logarithmic_error", msle), ("msle", msle),
+]:
+    register_loss(_name, _fn)
+
+
+def compute_loss(name, labels, preoutput, activation="identity", mask=None,
+                 average: bool = True) -> jnp.ndarray:
+    """Scalar network score: per-example scores summed, optionally averaged over
+    the (mask-weighted) example count — BaseOutputLayer.computeScore parity."""
+    per_example = get_loss(name)(labels, preoutput, activation, mask)
+    total = jnp.sum(per_example)
+    if not average:
+        return total
+    if mask is not None and jnp.ndim(mask) >= 2 and mask.shape[:2] == labels.shape[:2] \
+            and jnp.ndim(labels) > 2:
+        # Time-series mask: average over present timesteps, matching how the
+        # reference scores masked RNN output (MaskedReductionUtil).
+        count = jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        count = labels.shape[0]
+    return total / count

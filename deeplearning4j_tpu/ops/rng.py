@@ -1,0 +1,38 @@
+"""PRNG helpers: threaded jax PRNG keys with a DL4J-style integer-seed entry.
+
+The reference seeds a global Nd4j RNG from ``NeuralNetConfiguration.seed``;
+the functional equivalent is an explicit key tree: one root key per network,
+folded per layer-index / per purpose (init vs dropout) / per iteration, so
+every consumer gets an independent stream and the whole thing stays
+
+jit-compatible and reproducible.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(int(seed) & 0x7FFFFFFFFFFFFFFF)
+
+
+def for_layer(key: jax.Array, layer_index: int) -> jax.Array:
+    return jax.random.fold_in(key, layer_index)
+
+
+def for_purpose(key: jax.Array, purpose: str) -> jax.Array:
+    # Stable string hash (don't use Python's salted hash()).
+    h = 2166136261
+    for ch in purpose.encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return jax.random.fold_in(key, h)
+
+
+def for_iteration(key: jax.Array, iteration) -> jax.Array:
+    """Fold in a (possibly traced) iteration counter."""
+    return jax.random.fold_in(key, iteration)
+
+
+def split(key: jax.Array, n: int = 2):
+    return jax.random.split(key, n)

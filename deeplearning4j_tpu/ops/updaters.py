@@ -1,0 +1,224 @@
+"""Per-parameter gradient updaters and learning-rate schedules.
+
+Capability parity with the reference's updater system: the ``Updater`` enum
+(reference nn/conf/Updater.java:9 — SGD, ADAM, ADADELTA, NESTEROVS, ADAGRAD,
+RMSPROP, NONE) whose math lives in ND4J ``GradientUpdater`` implementations
+(consumed at nn/updater/LayerUpdater.java:32), plus the learning-rate decay
+policies of ``LearningRatePolicy`` applied in LayerUpdater.applyLrDecayPolicy
+(LayerUpdater.java:147), and the ``GradientNormalization`` strategies applied
+before the updater.
+
+TPU-first inversion (SURVEY.md §7): the reference mutates gradients in place
+and keeps state in a view array; here each updater is a pair of pure functions
+
+    init(param)                          -> state pytree (same-shape arrays)
+    update(grad, state, lr, iteration)   -> (step, new_state)
+
+with ``new_params = params - step`` applied by the solver — the functional
+equivalent of ``StochasticGradientDescent.stepFunction.step(params, grad)``
+(reference optimize/solvers/StochasticGradientDescent.java:60). Everything is
+jit-compatible; ``iteration`` is a traced scalar so schedules compile into the
+train step instead of triggering retraces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS_DEFAULT = 1e-8
+
+
+@dataclasses.dataclass(frozen=True)
+class Updater:
+    """A per-parameter update rule: pure init/update functions."""
+    name: str
+    init: Callable[[jnp.ndarray], Any]
+    update: Callable[..., Tuple[jnp.ndarray, Any]]
+
+
+def _zeros_like(p):
+    return jnp.zeros_like(p)
+
+
+def make_updater(name, *, momentum: float = 0.9, adam_mean_decay: float = 0.9,
+                 adam_var_decay: float = 0.999, rho: float = 0.95,
+                 rms_decay: float = 0.95, epsilon: float = _EPS_DEFAULT) -> Updater:
+    """Build an updater by reference-enum name with DL4J default hyperparams
+    (NeuralNetConfiguration.Builder field defaults, reference
+    nn/conf/NeuralNetConfiguration.java:495-529)."""
+    key = str(name).lower()
+
+    if key == "sgd":
+        def init(p):
+            return ()
+
+        def update(g, state, lr, iteration):
+            return lr * g, state
+        return Updater("sgd", init, update)
+
+    if key == "none":
+        # NoOpUpdater: gradient passed through unscaled.
+        def init(p):
+            return ()
+
+        def update(g, state, lr, iteration):
+            return g, state
+        return Updater("none", init, update)
+
+    if key == "adam":
+        b1, b2 = adam_mean_decay, adam_var_decay
+
+        def init(p):
+            return {"m": _zeros_like(p), "v": _zeros_like(p)}
+
+        def update(g, state, lr, iteration):
+            t = iteration + 1.0
+            m = b1 * state["m"] + (1.0 - b1) * g
+            v = b2 * state["v"] + (1.0 - b2) * (g * g)
+            alpha = lr * jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            step = alpha * m / (jnp.sqrt(v) + epsilon)
+            return step, {"m": m, "v": v}
+        return Updater("adam", init, update)
+
+    if key == "adamax":
+        b1, b2 = adam_mean_decay, adam_var_decay
+
+        def init(p):
+            return {"m": _zeros_like(p), "u": _zeros_like(p)}
+
+        def update(g, state, lr, iteration):
+            t = iteration + 1.0
+            m = b1 * state["m"] + (1.0 - b1) * g
+            u = jnp.maximum(b2 * state["u"], jnp.abs(g))
+            step = lr / (1.0 - b1 ** t) * m / (u + epsilon)
+            return step, {"m": m, "u": u}
+        return Updater("adamax", init, update)
+
+    if key == "adadelta":
+        def init(p):
+            return {"msg": _zeros_like(p), "msdx": _zeros_like(p)}
+
+        def update(g, state, lr, iteration):
+            msg = rho * state["msg"] + (1.0 - rho) * (g * g)
+            step = g * jnp.sqrt(state["msdx"] + epsilon) / jnp.sqrt(msg + epsilon)
+            msdx = rho * state["msdx"] + (1.0 - rho) * (step * step)
+            return step, {"msg": msg, "msdx": msdx}
+        return Updater("adadelta", init, update)
+
+    if key == "nesterovs":
+        mu = momentum
+
+        def init(p):
+            return {"v": _zeros_like(p)}
+
+        def update(g, state, lr, iteration):
+            v_prev = state["v"]
+            v = mu * v_prev - lr * g
+            # ND4J NesterovsUpdater lookahead form: params -= mu*vPrev - (1+mu)*v
+            step = mu * v_prev - (1.0 + mu) * v
+            return step, {"v": v}
+        return Updater("nesterovs", init, update)
+
+    if key == "adagrad":
+        def init(p):
+            return {"h": _zeros_like(p)}
+
+        def update(g, state, lr, iteration):
+            h = state["h"] + g * g
+            step = lr * g / (jnp.sqrt(h) + epsilon)
+            return step, {"h": h}
+        return Updater("adagrad", init, update)
+
+    if key == "rmsprop":
+        def init(p):
+            return {"e": _zeros_like(p)}
+
+        def update(g, state, lr, iteration):
+            e = rms_decay * state["e"] + (1.0 - rms_decay) * (g * g)
+            step = lr * g / (jnp.sqrt(e + epsilon))
+            return step, {"e": e}
+        return Updater("rmsprop", init, update)
+
+    raise ValueError(f"Unknown updater '{name}'")
+
+
+UPDATER_NAMES = ("sgd", "adam", "adamax", "adadelta", "nesterovs", "adagrad",
+                 "rmsprop", "none")
+
+
+# --- learning-rate decay policies -------------------------------------------
+
+def schedule_lr(base_lr: float, policy: Optional[str], iteration,
+                *, decay_rate: float = 0.0, steps: float = 1.0,
+                power: float = 1.0, max_iterations: float = 1.0,
+                schedule: Optional[Dict[int, float]] = None):
+    """LearningRatePolicy math (reference LayerUpdater.applyLrDecayPolicy,
+    nn/updater/LayerUpdater.java:147). ``iteration`` may be traced.
+
+    Policies: none | exponential | inverse | poly | sigmoid | step | torchstep
+    | schedule (iteration→lr map, applied as a piecewise-constant lookup).
+    """
+    it = jnp.asarray(iteration, jnp.float32)
+    if policy is None or str(policy).lower() in ("none", "fixed"):
+        return jnp.asarray(base_lr, jnp.float32)
+    p = str(policy).lower()
+    if p == "exponential":
+        return base_lr * jnp.power(decay_rate, it)
+    if p == "inverse":
+        return base_lr / jnp.power(1.0 + decay_rate * it, power)
+    if p == "poly":
+        frac = jnp.clip(it / max_iterations, 0.0, 1.0)
+        return base_lr * jnp.power(1.0 - frac, power)
+    if p == "sigmoid":
+        return base_lr / (1.0 + jnp.exp(-decay_rate * (it - steps)))
+    if p == "step":
+        return base_lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if p == "torchstep":
+        return base_lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if p == "schedule":
+        if not schedule:
+            return jnp.asarray(base_lr, jnp.float32)
+        lr = jnp.asarray(base_lr, jnp.float32)
+        for k in sorted(schedule):
+            lr = jnp.where(it >= k, jnp.asarray(schedule[k], jnp.float32), lr)
+        return lr
+    raise ValueError(f"Unknown learning-rate policy '{policy}'")
+
+
+# --- gradient normalization ---------------------------------------------------
+
+def normalize_gradient(grads: Dict[str, jnp.ndarray], strategy: Optional[str],
+                       threshold: float = 1.0) -> Dict[str, jnp.ndarray]:
+    """GradientNormalization strategies (reference
+    nn/conf/GradientNormalization.java), applied per layer over its named
+    parameter gradients before the updater runs."""
+    if strategy is None or str(strategy).lower() == "none":
+        return grads
+    s = str(strategy).lower()
+    leaves = jax.tree_util.tree_leaves(grads)
+    if s == "renormalizel2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale = 1.0 / jnp.maximum(norm, 1e-12)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if s == "renormalizel2perparamtype":
+        return {k: g / jnp.maximum(jnp.linalg.norm(g.reshape(-1)), 1e-12)
+                for k, g in grads.items()}
+    if s == "clipelementwiseabsolutevalue":
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if s == "clipl2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale = jnp.where(norm > threshold, threshold / (norm + 1e-12), 1.0)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if s == "clipl2perparamtype":
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.linalg.norm(g.reshape(-1))
+            scale = jnp.where(norm > threshold, threshold / (norm + 1e-12), 1.0)
+            out[k] = g * scale
+        return out
+    raise ValueError(f"Unknown gradient normalization '{strategy}'")

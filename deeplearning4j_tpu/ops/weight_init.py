@@ -1,0 +1,87 @@
+"""Weight initialization schemes.
+
+Parity with reference nn/weights/WeightInit.java + WeightInitUtil.java
+(SURVEY.md §2.1 Param initializers): DISTRIBUTION, ZERO, ONES, SIGMOID_UNIFORM,
+UNIFORM, XAVIER(+UNIFORM/FAN_IN/LEGACY), RELU(+UNIFORM), plus LECUN for the
+Keras importer. Implemented over jax.random with explicit PRNG keys (the
+functional replacement for Nd4j RNG seeding).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_weights(key: jax.Array, shape: Sequence[int], fan_in: float,
+                 fan_out: float, scheme: str = "xavier",
+                 distribution: Optional[dict] = None,
+                 dtype=jnp.float32) -> jnp.ndarray:
+    """Create a weight array per the named WeightInit scheme."""
+    s = str(scheme).lower()
+    shape = tuple(int(d) for d in shape)
+    fan_in = max(float(fan_in), 1.0)
+    fan_out = max(float(fan_out), 1.0)
+
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "uniform":
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "xavier":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "xavier_uniform":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if s == "xavier_legacy":
+        std = 1.0 / math.sqrt(fan_in + fan_out)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "relu":
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "relu_uniform":
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "lecun_normal":
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "lecun_uniform":
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if s == "normal":
+        std = 1.0 / math.sqrt(fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == "distribution":
+        return _from_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")
+
+
+def _from_distribution(key, shape, dist: dict, dtype) -> jnp.ndarray:
+    """WeightInit.DISTRIBUTION with a Distribution config dict
+    (reference nn/conf/distribution/: Normal/Gaussian, Uniform, Binomial)."""
+    kind = str(dist.get("type", "normal")).lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lower = float(dist.get("lower", -1.0))
+        upper = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, lower, upper)
+    if kind == "binomial":
+        n = int(dist.get("n", 1))
+        p = float(dist.get("p", 0.5))
+        draws = jax.random.bernoulli(key, p, (n,) + tuple(shape))
+        return jnp.sum(draws, axis=0).astype(dtype)
+    raise ValueError(f"Unknown distribution '{kind}'")

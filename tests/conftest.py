@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports,
+so sharding/collective tests exercise real multi-device semantics without TPU
+hardware (the pattern SURVEY.md §4 prescribes: local[n]-Spark analog)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng_np():
+    return np.random.default_rng(12345)
