@@ -1,0 +1,13 @@
+"""Dataset iterators + fetchers (reference deeplearning4j-core datasets/;
+SURVEY.md §2.3)."""
+
+from .iterators import (DataSetIterator, ListDataSetIterator,
+                        ArrayDataSetIterator, AsyncDataSetIterator,
+                        MultipleEpochsIterator, SamplingDataSetIterator,
+                        as_iterator)
+from .mnist import MnistDataSetIterator, IrisDataSetIterator
+
+__all__ = ["DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
+           "AsyncDataSetIterator", "MultipleEpochsIterator",
+           "SamplingDataSetIterator", "as_iterator", "MnistDataSetIterator",
+           "IrisDataSetIterator"]

@@ -1,0 +1,180 @@
+"""DataSet iterators (reference nn datasets/iterator/ — 19 classes,
+SURVEY.md §2.1: AsyncDataSetIterator, MultipleEpochsIterator,
+SamplingDataSetIterator, ExistingDataSetIterator, INDArray-backed iterators).
+
+AsyncDataSetIterator parity: the reference wraps fit()'s iterator in a
+background prefetch thread feeding a blocking queue
+(MultiLayerNetwork.java:986). Here the prefetch thread additionally starts the
+host→device transfer (``jax.device_put``) so the next batch's DMA overlaps the
+current train step — the TPU version of the producer/consumer seam.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base contract (reference DataSetIterator): iterable over DataSet
+    minibatches with reset()."""
+    async_supported = True
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch_size(self) -> int:
+        return 0
+
+    def total_examples(self) -> int:
+        return 0
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a pre-batched list of DataSets (ExistingDataSetIterator)."""
+
+    def __init__(self, batches: Sequence[DataSet]):
+        self._batches = list(batches)
+
+    def __iter__(self):
+        return iter(self._batches)
+
+    def batch_size(self) -> int:
+        return self._batches[0].num_examples() if self._batches else 0
+
+    def total_examples(self) -> int:
+        return sum(b.num_examples() for b in self._batches)
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batch a (features, labels) array pair (INDArrayDataSetIterator
+    analog), optional shuffling each epoch."""
+
+    def __init__(self, features: np.ndarray, labels: Optional[np.ndarray],
+                 batch_size: int = 32, shuffle: bool = False, seed: int = 0,
+                 features_mask: Optional[np.ndarray] = None,
+                 labels_mask: Optional[np.ndarray] = None):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+        self._bs = int(batch_size)
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        order = self._rng.permutation(n) if self._shuffle else np.arange(n)
+        for i in range(0, n, self._bs):
+            idx = order[i:i + self._bs]
+            yield DataSet(
+                self.features[idx],
+                None if self.labels is None else self.labels[idx],
+                None if self.features_mask is None else self.features_mask[idx],
+                None if self.labels_mask is None else self.labels_mask[idx])
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def total_examples(self) -> int:
+        return int(self.features.shape[0])
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference
+    AsyncDataSetIterator; queue depth = ``prefetch``)."""
+    async_supported = False  # don't double-wrap
+
+    def __init__(self, source: DataSetIterator, prefetch: int = 2):
+        self.source = source
+        self.prefetch = max(1, int(prefetch))
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        _END = object()
+        err: List[BaseException] = []
+
+        def producer():
+            try:
+                for ds in self.source:
+                    q.put(ds)
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    def reset(self):
+        self.source.reset()
+
+    def batch_size(self) -> int:
+        return self.source.batch_size()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays the underlying iterator N times (reference
+    MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, source: DataSetIterator):
+        self.epochs = int(epochs)
+        self.source = source
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            for ds in self.source:
+                yield ds
+            self.source.reset()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Samples ``samples_per_epoch`` examples with replacement from a DataSet
+    (reference SamplingDataSetIterator)."""
+
+    def __init__(self, ds: DataSet, batch_size: int, samples_per_epoch: int,
+                 seed: int = 0):
+        self.ds = ds
+        self._bs = int(batch_size)
+        self._total = int(samples_per_epoch)
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        emitted = 0
+        n = self.ds.num_examples()
+        while emitted < self._total:
+            take = min(self._bs, self._total - emitted)
+            idx = self._rng.integers(0, n, take)
+            yield DataSet(
+                self.ds.features[idx],
+                None if self.ds.labels is None else self.ds.labels[idx])
+            emitted += take
+
+    def batch_size(self) -> int:
+        return self._bs
+
+
+def as_iterator(data) -> DataSetIterator:
+    """Normalize DataSet / list / iterator inputs to a DataSetIterator."""
+    if isinstance(data, DataSetIterator):
+        return data
+    if isinstance(data, DataSet):
+        return ListDataSetIterator([data])
+    if isinstance(data, (list, tuple)):
+        return ListDataSetIterator(list(data))
+    raise TypeError(f"Cannot iterate {type(data)} as DataSets")

@@ -1,0 +1,185 @@
+"""MNIST dataset fetcher/iterator (reference
+datasets/fetchers/MnistDataFetcher.java + datasets/mnist/ IDX binary readers +
+iterator/impl/MnistDataSetIterator.java; SURVEY.md §2.3).
+
+The reference downloads the IDX files; this environment has no egress, so:
+1. if the IDX files exist locally (``MNIST_DIR`` env var, ``~/.mnist`` or
+   ``./data/mnist``), they are parsed with the same binary format logic;
+2. otherwise a deterministic synthetic stand-in is generated (per-class glyph
+   prototypes + noise) with the same shapes/API so training pipelines and
+   tests behave identically.
+
+Features are [N, 28, 28, 1] float32 in [0,1] (NHWC — see input_type.py layout
+note) or flat [N, 784]; labels one-hot [N, 10].
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..ops.dataset import DataSet
+from .iterators import DataSetIterator
+
+NUM_EXAMPLES_TRAIN = 60000
+NUM_EXAMPLES_TEST = 10000
+
+
+def _find_mnist_dir() -> Optional[Path]:
+    candidates = []
+    if os.environ.get("MNIST_DIR"):
+        candidates.append(Path(os.environ["MNIST_DIR"]))
+    candidates += [Path.home() / ".mnist", Path("data/mnist")]
+    for c in candidates:
+        if (c / "train-images-idx3-ubyte").exists() or \
+                (c / "train-images-idx3-ubyte.gz").exists():
+            return c
+    return None
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    """IDX format reader (reference datasets/mnist/MnistImageFile.java)."""
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _load_real(train: bool) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    d = _find_mnist_dir()
+    if d is None:
+        return None
+    prefix = "train" if train else "t10k"
+    imgs = labels = None
+    for suffix in ("", ".gz"):
+        ipath = d / f"{prefix}-images-idx3-ubyte{suffix}"
+        lpath = d / f"{prefix}-labels-idx1-ubyte{suffix}"
+        if ipath.exists() and lpath.exists():
+            imgs = _read_idx(ipath)
+            labels = _read_idx(lpath)
+            break
+    if imgs is None:
+        return None
+    return imgs.astype(np.float32) / 255.0, labels.astype(np.int64)
+
+
+_GLYPH_CACHE = {}
+
+
+def _synthetic(n: int, train: bool, seed: int = 123) -> \
+        Tuple[np.ndarray, np.ndarray]:
+    """Deterministic MNIST stand-in: 10 fixed glyph prototypes + noise."""
+    key = seed
+    if key not in _GLYPH_CACHE:
+        g = np.random.default_rng(seed)
+        protos = np.zeros((10, 28, 28), np.float32)
+        for c in range(10):
+            # blobby class-specific strokes: a few random thick line segments
+            canvas = np.zeros((28, 28), np.float32)
+            cg = np.random.default_rng(seed * 100 + c)
+            for _ in range(4):
+                x0, y0 = cg.integers(4, 24, 2)
+                dx, dy = cg.integers(-3, 4, 2)
+                for t in range(10):
+                    x = int(np.clip(x0 + t * dx / 3, 0, 27))
+                    y = int(np.clip(y0 + t * dy / 3, 0, 27))
+                    canvas[max(0, x - 1):x + 2, max(0, y - 1):y + 2] = 1.0
+            protos[c] = canvas
+        _GLYPH_CACHE[key] = protos
+    protos = _GLYPH_CACHE[key]
+    rng = np.random.default_rng(seed + (0 if train else 1))
+    labels = rng.integers(0, 10, n)
+    imgs = protos[labels] * rng.uniform(0.7, 1.0, (n, 1, 1)).astype(np.float32)
+    imgs = np.clip(imgs + rng.normal(0, 0.15, (n, 28, 28)), 0, 1)
+    return imgs.astype(np.float32), labels
+
+
+class MnistDataSetIterator(DataSetIterator):
+    """reference MnistDataSetIterator(batch, train[, shuffle, seed])."""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 train: bool = True, shuffle: bool = True, seed: int = 6,
+                 flatten: bool = False):
+        self._bs = int(batch_size)
+        self.train = train
+        self.flatten = flatten
+        real = _load_real(train)
+        self.is_synthetic = real is None
+        if real is not None:
+            imgs, labels = real
+        else:
+            n = num_examples or (NUM_EXAMPLES_TRAIN if train
+                                 else NUM_EXAMPLES_TEST)
+            n = min(n, 10000)  # synthetic sets stay small
+            imgs, labels = _synthetic(n, train)
+        if num_examples:
+            imgs, labels = imgs[:num_examples], labels[:num_examples]
+        self._images = imgs
+        self._labels = labels
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        n = len(self._images)
+        order = self._rng.permutation(n) if self._shuffle else np.arange(n)
+        for i in range(0, n - n % self._bs or n, self._bs):
+            idx = order[i:i + self._bs]
+            feats = self._images[idx]
+            feats = feats.reshape(len(idx), -1) if self.flatten \
+                else feats[..., None]
+            labels = np.eye(10, dtype=np.float32)[self._labels[idx]]
+            yield DataSet(feats, labels)
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def total_examples(self) -> int:
+        return len(self._images)
+
+
+class IrisDataSetIterator(DataSetIterator):
+    """reference IrisDataSetIterator. Without the CSV on disk (zero egress),
+    generates the classic 3-cluster structure from published per-class
+    feature means/stds, deterministic by seed."""
+
+    _MEANS = np.array([[5.01, 3.42, 1.46, 0.24],
+                       [5.94, 2.77, 4.26, 1.33],
+                       [6.59, 2.97, 5.55, 2.03]], np.float32)
+    _STDS = np.array([[0.35, 0.38, 0.17, 0.11],
+                      [0.52, 0.31, 0.47, 0.20],
+                      [0.64, 0.32, 0.55, 0.27]], np.float32)
+
+    def __init__(self, batch_size: int = 150, num_examples: int = 150,
+                 seed: int = 42):
+        rng = np.random.default_rng(seed)
+        per = max(1, num_examples // 3)
+        feats, labels = [], []
+        for c in range(3):
+            feats.append(rng.normal(self._MEANS[c], self._STDS[c],
+                                    (per, 4)).astype(np.float32))
+            labels.append(np.full(per, c))
+        self.features = np.concatenate(feats)
+        self.labels = np.concatenate(labels)
+        order = rng.permutation(len(self.features))
+        self.features, self.labels = self.features[order], self.labels[order]
+        self._bs = int(batch_size)
+
+    def __iter__(self):
+        for i in range(0, len(self.features), self._bs):
+            f = self.features[i:i + self._bs]
+            l = np.eye(3, dtype=np.float32)[self.labels[i:i + self._bs]]
+            yield DataSet(f, l)
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def total_examples(self) -> int:
+        return len(self.features)

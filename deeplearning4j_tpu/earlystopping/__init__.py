@@ -1,0 +1,25 @@
+"""Early stopping (reference earlystopping/: EarlyStoppingConfiguration,
+terminations (7), savers, score calculators, BaseEarlyStoppingTrainer;
+SURVEY.md §2.1)."""
+
+from .core import (EarlyStoppingConfiguration, EarlyStoppingResult,
+                   EarlyStoppingTrainer, DataSetLossCalculator,
+                   MaxEpochsTerminationCondition,
+                   ScoreImprovementEpochTerminationCondition,
+                   BestScoreEpochTerminationCondition,
+                   MaxTimeIterationTerminationCondition,
+                   MaxScoreIterationTerminationCondition,
+                   InvalidScoreIterationTerminationCondition,
+                   InMemoryModelSaver, LocalFileModelSaver)
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult",
+    "EarlyStoppingTrainer", "DataSetLossCalculator",
+    "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+    "InMemoryModelSaver", "LocalFileModelSaver",
+]

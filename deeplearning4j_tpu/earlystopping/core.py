@@ -1,0 +1,229 @@
+"""Early stopping engine (reference earlystopping/EarlyStoppingConfiguration
+.java, trainer/BaseEarlyStoppingTrainer.java, termination/ (7 conditions),
+saver/, scorecalc/DataSetLossCalculator; SURVEY.md §2.1): fit-with-eval loop
+that tracks the best model by held-out score, stops on epoch/iteration
+termination conditions, and saves best/latest checkpoints."""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import math
+import time
+from pathlib import Path
+from typing import List, Optional
+
+
+class DataSetLossCalculator:
+    """Held-out loss score calculator (reference scorecalc/DataSetLossCalculator)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        total, count = 0.0, 0
+        for ds in self.iterator:
+            total += net.score(ds) * ds.num_examples()
+            count += ds.num_examples()
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        return total / max(count, 1) if self.average else total
+
+
+# --- termination conditions ---------------------------------------------------
+
+class MaxEpochsTerminationCondition:
+    def __init__(self, max_epochs: int):
+        self.max_epochs = int(max_epochs)
+
+    def terminate(self, epoch: int, score: float, best_score: float) -> bool:
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition:
+    """Stop after ``patience`` epochs without improvement."""
+
+    def __init__(self, patience: int, min_improvement: float = 0.0):
+        self.patience = int(patience)
+        self.min_improvement = float(min_improvement)
+        self._best = math.inf
+        self._since = 0
+
+    def terminate(self, epoch: int, score: float, best_score: float) -> bool:
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._since = 0
+            return False
+        self._since += 1
+        return self._since > self.patience
+
+
+class BestScoreEpochTerminationCondition:
+    """Stop once the score is at/below a target (reference BestScoreEpoch...)."""
+
+    def __init__(self, target: float):
+        self.target = float(target)
+
+    def terminate(self, epoch: int, score: float, best_score: float) -> bool:
+        return score <= self.target
+
+
+class MaxTimeIterationTerminationCondition:
+    def __init__(self, max_seconds: float):
+        self.max_seconds = float(max_seconds)
+        self._start = None
+
+    def start(self):
+        self._start = time.monotonic()
+
+    def terminate(self, iteration: int, score: float) -> bool:
+        if self._start is None:
+            self.start()
+        return time.monotonic() - self._start > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition:
+    """Bail out if score explodes above a bound."""
+
+    def __init__(self, max_score: float):
+        self.max_score = float(max_score)
+
+    def terminate(self, iteration: int, score: float) -> bool:
+        return score > self.max_score
+
+
+class InvalidScoreIterationTerminationCondition:
+    """NaN/Inf bailout (reference InvalidScoreIterationTerminationCondition —
+    the reference's only NaN resilience primitive, SURVEY.md §5.3)."""
+
+    def terminate(self, iteration: int, score: float) -> bool:
+        return math.isnan(score) or math.isinf(score)
+
+
+# --- model savers -------------------------------------------------------------
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best_model(self, net, score: float):
+        self.best = net.clone()
+
+    def save_latest_model(self, net, score: float):
+        self.latest = net.clone()
+
+    def get_best_model(self):
+        return self.best
+
+    def get_latest_model(self):
+        return self.latest
+
+
+class LocalFileModelSaver:
+    """Save to <dir>/bestModel.zip / latestModel.zip (reference LocalFileModelSaver)."""
+
+    def __init__(self, directory):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def save_best_model(self, net, score: float):
+        from ..utils.serializer import ModelSerializer
+        ModelSerializer.write_model(net, self.dir / "bestModel.zip")
+
+    def save_latest_model(self, net, score: float):
+        from ..utils.serializer import ModelSerializer
+        ModelSerializer.write_model(net, self.dir / "latestModel.zip")
+
+    def get_best_model(self):
+        from ..utils.serializer import ModelSerializer
+        return ModelSerializer.restore_multi_layer_network(
+            self.dir / "bestModel.zip")
+
+    def get_latest_model(self):
+        from ..utils.serializer import ModelSerializer
+        return ModelSerializer.restore_multi_layer_network(
+            self.dir / "latestModel.zip")
+
+
+@dataclasses.dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: DataSetLossCalculator = None
+    model_saver: object = dataclasses.field(default_factory=InMemoryModelSaver)
+    epoch_terminations: List = dataclasses.field(default_factory=list)
+    iteration_terminations: List = dataclasses.field(default_factory=list)
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+
+@dataclasses.dataclass
+class EarlyStoppingResult:
+    termination_reason: str
+    termination_details: str
+    score_vs_epoch: dict
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: object
+
+
+class EarlyStoppingTrainer:
+    """Drive fit + periodic held-out scoring (reference
+    trainer/BaseEarlyStoppingTrainer.java)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_data):
+        self.config = config
+        self.net = net
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        best_score, best_epoch = math.inf, -1
+        scores = {}
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        while True:
+            stop_iter = False
+            from ..datasets.iterators import as_iterator
+            for ds in as_iterator(self.train_data):
+                if self.net.conf.backprop_type == "truncated_bptt" and \
+                        ds.features.ndim == 3:
+                    self.net._fit_tbptt(ds)
+                else:
+                    self.net._fit_batch(ds)
+                for cond in cfg.iteration_terminations:
+                    if cond.terminate(self.net.iteration, self.net.score_value):
+                        reason = "IterationTermination"
+                        details = type(cond).__name__
+                        stop_iter = True
+                        break
+                if stop_iter:
+                    break
+            if stop_iter:
+                break
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.net) \
+                    if cfg.score_calculator else self.net.score_value
+                scores[epoch] = score
+                if score < best_score:
+                    best_score, best_epoch = score, epoch
+                    cfg.model_saver.save_best_model(self.net, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.net, score)
+                terminated = False
+                for cond in cfg.epoch_terminations:
+                    if cond.terminate(epoch, score, best_score):
+                        reason = "EpochTermination"
+                        details = type(cond).__name__
+                        terminated = True
+                        break
+                if terminated:
+                    break
+            epoch += 1
+        best = cfg.model_saver.get_best_model() or self.net
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            score_vs_epoch=scores, best_model_epoch=best_epoch,
+            best_model_score=best_score, total_epochs=epoch + 1,
+            best_model=best)

@@ -1,0 +1,119 @@
+"""Classification evaluation (reference eval/Evaluation.java, 1,110 LoC):
+confusion matrix, accuracy, per-class + aggregate precision/recall/F1,
+top-N accuracy, text report. Mask-aware for time-series output
+(per-timestep classification with labels_mask)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None, top_n: int = 1):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.top_n = top_n
+        self.confusion: Optional[np.ndarray] = None
+        self.top_n_correct = 0
+        self.total = 0
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = np.zeros((self.num_classes, self.num_classes),
+                                      np.int64)
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None):
+        """labels/predictions: [N, C] one-hot/probabilities, or [N, T, C]
+        (flattened with optional [N, T] mask)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            n, t, c = labels.shape
+            labels = labels.reshape(n * t, c)
+            predictions = predictions.reshape(n * t, c)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(n * t) > 0
+                labels = labels[keep]
+                predictions = predictions[keep]
+        self._ensure(labels.shape[-1])
+        actual = np.argmax(labels, axis=-1)
+        pred = np.argmax(predictions, axis=-1)
+        np.add.at(self.confusion, (actual, pred), 1)
+        self.total += len(actual)
+        if self.top_n > 1:
+            topk = np.argsort(-predictions, axis=-1)[:, :self.top_n]
+            self.top_n_correct += int(np.sum(topk == actual[:, None]))
+        else:
+            self.top_n_correct += int(np.sum(actual == pred))
+
+    # --- metrics (Evaluation.java accuracy/precision/recall/f1) ---
+    def accuracy(self) -> float:
+        if self.total == 0:
+            return 0.0
+        return float(np.trace(self.confusion)) / self.total
+
+    def top_n_accuracy(self) -> float:
+        return self.top_n_correct / self.total if self.total else 0.0
+
+    def true_positives(self, c: int) -> int:
+        return int(self.confusion[c, c])
+
+    def false_positives(self, c: int) -> int:
+        return int(np.sum(self.confusion[:, c]) - self.confusion[c, c])
+
+    def false_negatives(self, c: int) -> int:
+        return int(np.sum(self.confusion[c, :]) - self.confusion[c, c])
+
+    def precision(self, c: Optional[int] = None) -> float:
+        if c is not None:
+            tp, fp = self.true_positives(c), self.false_positives(c)
+            return tp / (tp + fp) if tp + fp else 0.0
+        vals = [self.precision(i) for i in range(self.num_classes)
+                if np.sum(self.confusion[:, i]) + np.sum(self.confusion[i, :]) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, c: Optional[int] = None) -> float:
+        if c is not None:
+            tp, fn = self.true_positives(c), self.false_negatives(c)
+            return tp / (tp + fn) if tp + fn else 0.0
+        vals = [self.recall(i) for i in range(self.num_classes)
+                if np.sum(self.confusion[i, :]) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, c: Optional[int] = None) -> float:
+        p, r = self.precision(c), self.recall(c)
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    def stats(self) -> str:
+        lines = ["==================== Evaluation ===================="]
+        names = self.label_names or [str(i) for i in
+                                     range(self.num_classes or 0)]
+        lines.append(f" Examples:  {self.total}")
+        lines.append(f" Accuracy:  {self.accuracy():.4f}")
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n}:    {self.top_n_accuracy():.4f}")
+        lines.append(f" Precision: {self.precision():.4f}")
+        lines.append(f" Recall:    {self.recall():.4f}")
+        lines.append(f" F1 Score:  {self.f1():.4f}")
+        lines.append(" Confusion matrix (rows=actual, cols=predicted):")
+        if self.confusion is not None:
+            header = "        " + " ".join(f"{n[:6]:>6}" for n in names)
+            lines.append(header)
+            for i, row in enumerate(self.confusion):
+                lines.append(f" {names[i][:6]:>6} " +
+                             " ".join(f"{v:>6}" for v in row))
+        return "\n".join(lines)
+
+    def merge(self, other: "Evaluation"):
+        if other.confusion is None:
+            return self
+        self._ensure(other.num_classes)
+        self.confusion += other.confusion
+        self.total += other.total
+        self.top_n_correct += other.top_n_correct
+        return self

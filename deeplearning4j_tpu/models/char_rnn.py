@@ -1,0 +1,92 @@
+"""GravesLSTM character RNN — BASELINE.md config #2 (the reference ecosystem's
+GravesLSTMCharModellingExample: 2×LSTM + RnnOutput, TBPTT). Exercises the LSTM
+acceleration seam (helpers registry kind="lstm")."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..datasets.iterators import DataSetIterator
+from ..nn.conf.config import NeuralNetConfiguration, MultiLayerConfiguration
+from ..nn.conf.input_type import InputType
+from ..nn.conf.layers import GravesLSTM, RnnOutputLayer
+from ..ops.dataset import DataSet
+
+
+def char_rnn_conf(vocab_size: int, hidden: int = 200, layers: int = 2,
+                  learning_rate: float = 0.1, tbptt_length: int = 50,
+                  seed: int = 12345) -> MultiLayerConfiguration:
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed)
+         .learning_rate(learning_rate)
+         .updater("rmsprop").rms_decay(0.95)
+         .weight_init("xavier")
+         .regularization(True).l2(0.001)
+         .list())
+    for _ in range(layers):
+        b.layer(GravesLSTM(n_out=hidden, activation="tanh"))
+    b.layer(RnnOutputLayer(n_out=vocab_size, loss="mcxent",
+                           activation="softmax"))
+    return (b.backprop_type("truncated_bptt")
+            .tbptt_fwd_length(tbptt_length).tbptt_back_length(tbptt_length)
+            .set_input_type(InputType.recurrent(vocab_size))
+            .build())
+
+
+class CharacterIterator(DataSetIterator):
+    """One-hot char sequences from raw text (the example's CharacterIterator)."""
+
+    def __init__(self, text: str, seq_length: int = 50, batch_size: int = 32,
+                 seed: int = 0):
+        self.chars = sorted(set(text))
+        self.char_to_idx = {c: i for i, c in enumerate(self.chars)}
+        self.encoded = np.array([self.char_to_idx[c] for c in text], np.int32)
+        self.seq_length = int(seq_length)
+        self._bs = int(batch_size)
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.chars)
+
+    def __iter__(self):
+        n_seqs = (len(self.encoded) - 1) // self.seq_length
+        starts = np.arange(n_seqs) * self.seq_length
+        self._rng.shuffle(starts)
+        v = self.vocab_size
+        eye = np.eye(v, dtype=np.float32)
+        for i in range(0, n_seqs - n_seqs % self._bs or n_seqs, self._bs):
+            batch_starts = starts[i:i + self._bs]
+            if len(batch_starts) == 0:
+                return
+            feats = np.stack([eye[self.encoded[s:s + self.seq_length]]
+                              for s in batch_starts])
+            labels = np.stack([eye[self.encoded[s + 1:s + 1 + self.seq_length]]
+                               for s in batch_starts])
+            yield DataSet(feats, labels)
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def sample(self, net, seed_char: str, length: int = 100,
+               temperature: float = 1.0, rng_seed: int = 0) -> str:
+        """Greedy/temperature sampling via rnnTimeStep stateful inference."""
+        rng = np.random.default_rng(rng_seed)
+        net.rnn_clear_previous_state()
+        v = self.vocab_size
+        idx = self.char_to_idx[seed_char]
+        out_chars = [seed_char]
+        for _ in range(length):
+            x = np.zeros((1, v), np.float32)
+            x[0, idx] = 1.0
+            probs = net.rnn_time_step(x)[0]
+            probs = np.asarray(probs, np.float64)
+            if temperature != 1.0:
+                logp = np.log(np.maximum(probs, 1e-12)) / temperature
+                probs = np.exp(logp - logp.max())
+            probs = probs / probs.sum()
+            idx = int(rng.choice(v, p=probs))
+            out_chars.append(self.chars[idx])
+        return "".join(out_chars)

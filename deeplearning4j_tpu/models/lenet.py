@@ -1,0 +1,35 @@
+"""LeNet MNIST — BASELINE.md config #1 (the reference ecosystem's canonical
+dl4j-examples LeNet MultiLayerNetwork)."""
+
+from __future__ import annotations
+
+from ..nn.conf.config import NeuralNetConfiguration, MultiLayerConfiguration
+from ..nn.conf.input_type import InputType
+from ..nn.conf.layers import (ConvolutionLayer, SubsamplingLayer, DenseLayer,
+                              OutputLayer)
+
+
+def lenet_conf(num_classes: int = 10, learning_rate: float = 0.01,
+               updater: str = "nesterovs", seed: int = 123,
+               channels: int = 1, height: int = 28,
+               width: int = 28) -> MultiLayerConfiguration:
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .learning_rate(learning_rate)
+            .updater(updater).momentum(0.9)
+            .weight_init("xavier")
+            .regularization(True).l2(5e-4)
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=[5, 5],
+                                    stride=[1, 1], activation="identity"))
+            .layer(SubsamplingLayer(kernel_size=[2, 2], stride=[2, 2],
+                                    pooling_type="max"))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=[5, 5],
+                                    stride=[1, 1], activation="identity"))
+            .layer(SubsamplingLayer(kernel_size=[2, 2], stride=[2, 2],
+                                    pooling_type="max"))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=num_classes, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.convolutional(height, width, channels))
+            .build())

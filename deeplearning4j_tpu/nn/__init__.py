@@ -1,0 +1,10 @@
+"""Network core (reference deeplearning4j-nn; SURVEY.md §2.1)."""
+
+from .conf import (InputType, NeuralNetConfiguration, MultiLayerConfiguration,
+                   layers)
+from .multilayer import MultiLayerNetwork
+from .helpers import register_helper, get_helper, disable_helper, enable_helper
+
+__all__ = ["InputType", "NeuralNetConfiguration", "MultiLayerConfiguration",
+           "layers", "MultiLayerNetwork", "register_helper", "get_helper",
+           "disable_helper", "enable_helper"]

@@ -1,0 +1,22 @@
+"""Configuration system (reference nn/conf/*; SURVEY.md §2.1)."""
+
+from .input_type import InputType
+from .config import (NeuralNetConfiguration, ListBuilder,
+                     MultiLayerConfiguration, GLOBAL_DEFAULTS)
+from .preprocessors import (InputPreProcessor, CnnToFeedForwardPreProcessor,
+                            FeedForwardToCnnPreProcessor,
+                            FeedForwardToRnnPreProcessor,
+                            RnnToFeedForwardPreProcessor,
+                            CnnToRnnPreProcessor, RnnToCnnPreProcessor,
+                            auto_preprocessor)
+from .serde import register_config, to_jsonable, from_jsonable
+from . import layers
+
+__all__ = [
+    "InputType", "NeuralNetConfiguration", "ListBuilder",
+    "MultiLayerConfiguration", "GLOBAL_DEFAULTS", "InputPreProcessor",
+    "CnnToFeedForwardPreProcessor", "FeedForwardToCnnPreProcessor",
+    "FeedForwardToRnnPreProcessor", "RnnToFeedForwardPreProcessor",
+    "CnnToRnnPreProcessor", "RnnToCnnPreProcessor", "auto_preprocessor",
+    "register_config", "to_jsonable", "from_jsonable", "layers",
+]

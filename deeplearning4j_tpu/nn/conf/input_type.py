@@ -14,7 +14,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+from .serde import register_config
 
+
+@register_config
 @dataclasses.dataclass(frozen=True)
 class InputType:
     kind: str                      # "ff" | "rnn" | "cnn" | "cnnflat"

@@ -1,0 +1,22 @@
+"""Layer configuration zoo (reference nn/conf/layers/*; SURVEY.md §2.1)."""
+
+from .base import LayerConf, FeedForwardLayerConf, BaseRecurrentLayerConf
+from .feedforward import (DenseLayer, OutputLayer, RnnOutputLayer, LossLayer,
+                          ActivationLayer, DropoutLayer, EmbeddingLayer,
+                          AutoEncoder, RBM, CenterLossOutputLayer)
+from .convolution import (ConvolutionLayer, Convolution1DLayer,
+                          SubsamplingLayer, Subsampling1DLayer,
+                          BatchNormalization, LocalResponseNormalization,
+                          ZeroPaddingLayer, GlobalPoolingLayer)
+from .recurrent import GravesLSTM, LSTM, GravesBidirectionalLSTM
+from .variational import VariationalAutoencoder
+
+__all__ = [
+    "LayerConf", "FeedForwardLayerConf", "BaseRecurrentLayerConf",
+    "DenseLayer", "OutputLayer", "RnnOutputLayer", "LossLayer",
+    "ActivationLayer", "DropoutLayer", "EmbeddingLayer", "AutoEncoder", "RBM",
+    "CenterLossOutputLayer", "ConvolutionLayer", "Convolution1DLayer",
+    "SubsamplingLayer", "Subsampling1DLayer", "BatchNormalization",
+    "LocalResponseNormalization", "ZeroPaddingLayer", "GlobalPoolingLayer",
+    "GravesLSTM", "LSTM", "GravesBidirectionalLSTM", "VariationalAutoencoder",
+]

@@ -1,0 +1,143 @@
+"""Layer configuration base classes.
+
+The reference splits declarative configs (nn/conf/layers/*) from imperative
+impls (nn/layers/*); here each layer is one dataclass carrying hyperparameters
+(the JSON-serialized surface, cascaded from the global builder exactly like
+NeuralNetConfiguration.Builder does — reference
+nn/conf/NeuralNetConfiguration.java:495-529) plus pure functions:
+
+    set_n_in(input_type)                      nIn inference (InputTypeUtil)
+    get_output_type(input_type) -> InputType  shape inference
+    init_params(key, dtype) -> params dict    ParamInitializer parity
+    init_state() -> state dict                (BN running stats, ...)
+    forward(params, state, x, train, rng, mask) -> (y, new_state)
+
+Backprop is autodiff over ``forward`` — replacing the reference's hand-written
+``backpropGradient`` — with finite-difference gradient checks as the oracle
+(reference gradientcheck/GradientCheckUtil.java pattern, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.activations import get_activation
+from ....ops.weight_init import init_weights
+from ..input_type import InputType
+
+
+@dataclasses.dataclass
+class LayerConf:
+    """Common per-layer hyperparameters. ``None`` means "inherit from the
+    global NeuralNetConfiguration builder" (the cascade in build())."""
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[dict] = None
+    bias_init: Optional[float] = None
+    learning_rate: Optional[float] = None
+    bias_learning_rate: Optional[float] = None
+    updater: Optional[str] = None
+    momentum: Optional[float] = None
+    rho: Optional[float] = None
+    rms_decay: Optional[float] = None
+    adam_mean_decay: Optional[float] = None
+    adam_var_decay: Optional[float] = None
+    epsilon: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    drop_out: Optional[float] = None          # retention probability, DL4J-style
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    # --- shape plumbing ---
+    def input_kind(self) -> str:
+        return "ff"
+
+    def set_n_in(self, it: InputType) -> None:
+        pass
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return it
+
+    # --- params/state ---
+    def init_params(self, key: jax.Array, dtype=jnp.float32) -> Dict:
+        return {}
+
+    def init_state(self) -> Dict:
+        return {}
+
+    def regularizable(self):
+        """Param names the l1/l2 penalty applies to (weights, not biases —
+        matching the reference's default W-only regularization)."""
+        return ("W", "R")
+
+    def reg_penalty(self, params: Dict) -> jnp.ndarray:
+        pen = jnp.asarray(0.0, jnp.float32)
+        l1 = self.l1 or 0.0
+        l2 = self.l2 or 0.0
+        if (l1 == 0.0 and l2 == 0.0) or not params:
+            return pen
+        for name in self.regularizable():
+            if name in params:
+                w = params[name]
+                if l1:
+                    pen = pen + l1 * jnp.sum(jnp.abs(w))
+                if l2:
+                    pen = pen + 0.5 * l2 * jnp.sum(w * w)
+        return pen
+
+    # --- compute ---
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    def activation_fn(self):
+        return get_activation(self.activation or "identity")
+
+    def maybe_dropout(self, x, *, train: bool, rng):
+        """Input dropout (reference util/Dropout.java applied to layer input;
+        drop_out is the retention probability, inverted-dropout scaling)."""
+        p = self.drop_out
+        if not train or p is None or p >= 1.0 or p <= 0.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return jnp.where(keep, x / p, jnp.zeros_like(x))
+
+    # convenience for initializers
+    def _winit(self, key, shape, fan_in, fan_out, dtype):
+        return init_weights(key, shape, fan_in, fan_out,
+                            self.weight_init or "xavier", self.dist, dtype)
+
+    def _binit(self, shape, dtype):
+        return jnp.full(shape, self.bias_init or 0.0, dtype)
+
+
+@dataclasses.dataclass
+class FeedForwardLayerConf(LayerConf):
+    """Layers with a dense [nIn → nOut] core (reference FeedForwardLayer)."""
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_n_in(self, it: InputType) -> None:
+        if not self.n_in:
+            self.n_in = it.flat_size()
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+
+@dataclasses.dataclass
+class BaseRecurrentLayerConf(FeedForwardLayerConf):
+    def input_kind(self) -> str:
+        return "rnn"
+
+    def set_n_in(self, it: InputType) -> None:
+        if not self.n_in:
+            self.n_in = it.size
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timesteps)

@@ -1,0 +1,390 @@
+"""Convolutional layer family: Convolution(1D/2D), Subsampling(1D/2D),
+BatchNormalization, LocalResponseNormalization, ZeroPadding, GlobalPooling
+(reference nn/conf/layers/* + nn/layers/{convolution,normalization,pooling}/;
+SURVEY.md §2.1).
+
+TPU-first: convs lower to ``lax.conv_general_dilated`` in NHWC/HWIO — no
+im2col+gemm staging as in the reference (ConvolutionLayer.java:172-197); XLA
+tiles the conv straight onto the MXU. Pooling is ``lax.reduce_window``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..input_type import InputType
+from ..serde import register_config
+from .base import LayerConf, FeedForwardLayerConf
+from ...helpers import get_helper
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+def _conv_out(size: int, k: int, s: int, p: int, mode: str) -> int:
+    if mode == "same":
+        return -(-size // s)
+    return (size + 2 * p - k) // s + 1
+
+
+@register_config
+@dataclasses.dataclass
+class ConvolutionLayer(FeedForwardLayerConf):
+    """2-D convolution (reference ConvolutionLayer). n_in = input channels,
+    n_out = output channels; kernel [kh, kw, inC, outC] (HWIO)."""
+    kernel_size: List[int] = dataclasses.field(default_factory=lambda: [3, 3])
+    stride: List[int] = dataclasses.field(default_factory=lambda: [1, 1])
+    padding: List[int] = dataclasses.field(default_factory=lambda: [0, 0])
+    dilation: List[int] = dataclasses.field(default_factory=lambda: [1, 1])
+    convolution_mode: str = "truncate"     # strict | truncate | same
+    has_bias: bool = True
+
+    def input_kind(self) -> str:
+        return "cnn"
+
+    def set_n_in(self, it: InputType) -> None:
+        if not self.n_in:
+            self.n_in = it.channels
+
+    def get_output_type(self, it: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        mode = self.convolution_mode.lower()
+        return InputType.convolutional(
+            _conv_out(it.height, kh, sh, ph, mode),
+            _conv_out(it.width, kw, sw, pw, mode),
+            self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        kh, kw = _pair(self.kernel_size)
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        kweights, _ = jax.random.split(key)
+        p = {"W": self._winit(kweights, (kh, kw, self.n_in, self.n_out),
+                              fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,), dtype)
+        return p
+
+    def _padding_spec(self):
+        if self.convolution_mode.lower() == "same":
+            return "SAME"
+        ph, pw = _pair(self.padding)
+        return [(ph, ph), (pw, pw)]
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        helper = get_helper("conv2d")
+        if helper is not None:
+            pre = helper(self, params, x)
+        else:
+            pre = lax.conv_general_dilated(
+                x, params["W"],
+                window_strides=_pair(self.stride),
+                padding=self._padding_spec(),
+                rhs_dilation=_pair(self.dilation),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if self.has_bias:
+                pre = pre + params["b"]
+        return self.activation_fn()(pre), state
+
+
+@register_config
+@dataclasses.dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-D convolution over [N, T, C] (reference Convolution1DLayer)."""
+
+    def input_kind(self) -> str:
+        return "rnn"
+
+    def set_n_in(self, it: InputType) -> None:
+        if not self.n_in:
+            self.n_in = it.size
+
+    def get_output_type(self, it: InputType) -> InputType:
+        k = _pair(self.kernel_size)[0]
+        s = _pair(self.stride)[0]
+        p = _pair(self.padding)[0]
+        t = it.timesteps
+        t_out = None if t is None else _conv_out(t, k, s, p,
+                                                 self.convolution_mode.lower())
+        return InputType.recurrent(self.n_out, t_out)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        k = _pair(self.kernel_size)[0]
+        fan_in = self.n_in * k
+        fan_out = self.n_out * k
+        kweights, _ = jax.random.split(key)
+        p = {"W": self._winit(kweights, (k, self.n_in, self.n_out),
+                              fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._binit((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        if self.convolution_mode.lower() == "same":
+            pad = "SAME"
+        else:
+            p = _pair(self.padding)[0]
+            pad = [(p, p)]
+        pre = lax.conv_general_dilated(
+            x, params["W"], window_strides=(_pair(self.stride)[0],),
+            padding=pad, rhs_dilation=(_pair(self.dilation)[0],),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.has_bias:
+            pre = pre + params["b"]
+        return self.activation_fn()(pre), state
+
+
+@register_config
+@dataclasses.dataclass
+class SubsamplingLayer(LayerConf):
+    """Max/avg/p-norm pooling (reference SubsamplingLayer)."""
+    kernel_size: List[int] = dataclasses.field(default_factory=lambda: [2, 2])
+    stride: List[int] = dataclasses.field(default_factory=lambda: [2, 2])
+    padding: List[int] = dataclasses.field(default_factory=lambda: [0, 0])
+    pooling_type: str = "max"              # max | avg | pnorm | sum
+    pnorm: int = 2
+    convolution_mode: str = "truncate"
+
+    def input_kind(self) -> str:
+        return "cnn"
+
+    def get_output_type(self, it: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        mode = self.convolution_mode.lower()
+        return InputType.convolutional(
+            _conv_out(it.height, kh, sh, ph, mode),
+            _conv_out(it.width, kw, sw, pw, mode),
+            it.channels)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        if self.convolution_mode.lower() == "same":
+            pad = "SAME"
+        else:
+            ph, pw = _pair(self.padding)
+            pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        ptype = self.pooling_type.lower()
+        if ptype == "max":
+            init = -jnp.inf
+            out = lax.reduce_window(x, init, lax.max, window, strides, pad)
+        elif ptype in ("avg", "sum"):
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            if ptype == "avg":
+                out = out / (kh * kw)
+        elif ptype == "pnorm":
+            p = float(self.pnorm)
+            out = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window,
+                                    strides, pad) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type}")
+        return out, state
+
+
+@register_config
+@dataclasses.dataclass
+class Subsampling1DLayer(SubsamplingLayer):
+    """1-D pooling over [N, T, C] (reference Subsampling1DLayer)."""
+
+    def input_kind(self) -> str:
+        return "rnn"
+
+    def get_output_type(self, it: InputType) -> InputType:
+        k = _pair(self.kernel_size)[0]
+        s = _pair(self.stride)[0]
+        p = _pair(self.padding)[0]
+        t = it.timesteps
+        t_out = None if t is None else _conv_out(t, k, s, p,
+                                                 self.convolution_mode.lower())
+        return InputType.recurrent(it.size, t_out)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        k = _pair(self.kernel_size)[0]
+        s = _pair(self.stride)[0]
+        if self.convolution_mode.lower() == "same":
+            pad = "SAME"
+        else:
+            p = _pair(self.padding)[0]
+            pad = ((0, 0), (p, p), (0, 0))
+        window = (1, k, 1)
+        strides = (1, s, 1)
+        ptype = self.pooling_type.lower()
+        if ptype == "max":
+            out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        else:
+            out = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            if ptype == "avg":
+                out = out / k
+        return out, state
+
+
+@register_config
+@dataclasses.dataclass
+class BatchNormalization(LayerConf):
+    """Batch normalization (reference nn/layers/normalization/
+    BatchNormalization.java): per-feature (FF) or per-channel (CNN NHWC)
+    standardize + learned gamma/beta; running stats carried in layer state —
+    the functional replacement for the reference's mutable running mean/var."""
+    n_out: int = 0                    # feature/channel count (inferred)
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+    lock_gamma_beta: bool = False
+
+    def input_kind(self) -> str:
+        return "any"
+
+    def set_n_in(self, it: InputType) -> None:
+        if not self.n_out:
+            self.n_out = it.channels if it.kind == "cnn" else it.flat_size()
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return it
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((self.n_out,), self.gamma, dtype),
+                "beta": jnp.full((self.n_out,), self.beta, dtype)}
+
+    def init_state(self) -> Dict:
+        return {"mean": jnp.zeros((self.n_out,), jnp.float32),
+                "var": jnp.ones((self.n_out,), jnp.float32)}
+
+    def regularizable(self):
+        return ()
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))          # all but channel/feature
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = self.decay
+            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
+                         "var": d * state["var"] + (1 - d) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        if not self.lock_gamma_beta and params:
+            xhat = xhat * params["gamma"] + params["beta"]
+        else:
+            xhat = xhat * self.gamma + self.beta
+        return self.activation_fn()(xhat), new_state
+
+
+@register_config
+@dataclasses.dataclass
+class LocalResponseNormalization(LayerConf):
+    """Across-channel LRN (reference LocalResponseNormalization):
+    y = x / (k + alpha·sum_{nearby channels} x²)^beta."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def input_kind(self) -> str:
+        return "cnn"
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        half = int(self.n) // 2
+        sq = x * x
+        # windowed sum over the channel (last) axis
+        summed = lax.reduce_window(sq, 0.0, lax.add,
+                                   (1, 1, 1, int(self.n)), (1, 1, 1, 1),
+                                   ((0, 0), (0, 0), (0, 0), (half, half)))
+        denom = jnp.power(self.k + self.alpha * summed, self.beta)
+        return x / denom, state
+
+
+@register_config
+@dataclasses.dataclass
+class ZeroPaddingLayer(LayerConf):
+    """Spatial zero padding [top, bottom, left, right] (reference
+    ZeroPaddingLayer)."""
+    pad: List[int] = dataclasses.field(default_factory=lambda: [0, 0, 0, 0])
+
+    def input_kind(self) -> str:
+        return "cnn"
+
+    def _p4(self):
+        p = self.pad
+        if len(p) == 1:
+            return [p[0]] * 4
+        if len(p) == 2:
+            return [p[0], p[0], p[1], p[1]]
+        return list(p)
+
+    def get_output_type(self, it: InputType) -> InputType:
+        t, b, l, r = self._p4()
+        return InputType.convolutional(it.height + t + b, it.width + l + r,
+                                       it.channels)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self._p4()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_config
+@dataclasses.dataclass
+class GlobalPoolingLayer(LayerConf):
+    """Global pooling over time ([N,T,F]→[N,F]) or space ([N,H,W,C]→[N,C]),
+    mask-aware for variable-length sequences (reference GlobalPoolingLayer +
+    MaskedReductionUtil)."""
+    pooling_type: str = "max"        # max | avg | sum | pnorm
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def input_kind(self) -> str:
+        return "any"
+
+    def get_output_type(self, it: InputType) -> InputType:
+        if it.kind == "rnn":
+            return InputType.feed_forward(it.size)
+        if it.kind == "cnn":
+            return InputType.feed_forward(it.channels)
+        return it
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = (1,) if x.ndim == 3 else tuple(range(1, x.ndim - 1))
+        ptype = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[..., None]           # [N, T, 1]
+            if ptype == "max":
+                neg = jnp.where(m > 0, x, jnp.full_like(x, -jnp.inf))
+                return jnp.max(neg, axis=1), state
+            if ptype in ("avg", "sum"):
+                s = jnp.sum(x * m, axis=1)
+                if ptype == "avg":
+                    s = s / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+                return s, state
+            if ptype == "pnorm":
+                p = float(self.pnorm)
+                return jnp.sum((jnp.abs(x) * m) ** p, axis=1) ** (1 / p), state
+        if ptype == "max":
+            return jnp.max(x, axis=axes), state
+        if ptype == "sum":
+            return jnp.sum(x, axis=axes), state
+        if ptype == "avg":
+            return jnp.mean(x, axis=axes), state
+        if ptype == "pnorm":
+            p = float(self.pnorm)
+            return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1 / p), state
+        raise ValueError(f"Unknown pooling type {self.pooling_type}")

@@ -1,0 +1,186 @@
+"""Recurrent layer family: GravesLSTM (peephole), LSTM, GravesBidirectionalLSTM
+(reference nn/layers/recurrent/GravesLSTM.java + LSTMHelpers.java:57/:271 —
+the 520-LoC shared fwd/bwd LSTM math; SURVEY.md §2.1).
+
+TPU-first: the per-timestep Java loop becomes ``lax.scan``; the input
+projection x·W for ALL timesteps is hoisted out of the scan into one large
+[N·T, nIn]×[nIn, 4H] matmul (MXU-friendly), leaving only the [N,H]×[H,4H]
+recurrent matmul inside the scan. Backprop through time is autodiff through
+the scan — no hand-written backpropGradientHelper. Masking keeps h/c frozen
+on padded steps; layer state carries (h, c) for rnnTimeStep and TBPTT
+(SURVEY.md §5.7).
+
+Gate block order in the 4H axis: [input, forget, cell(g), output] — chosen to
+match Keras' kernel layout so the HDF5 importer maps weights without
+reshuffling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..input_type import InputType
+from ..serde import register_config
+from .base import BaseRecurrentLayerConf
+from ...helpers import get_helper
+
+
+def _lstm_scan(conf, W, R, b, peepholes, x, h0, c0, mask, gate_act, cell_act):
+    """Shared scan core. x: [N,T,nIn] → y: [N,T,H], final (h, c)."""
+    n, t, _ = x.shape
+    hsize = R.shape[0]
+    xw = (x.reshape(n * t, -1) @ W).reshape(n, t, 4 * hsize) + b
+    xw_t = jnp.transpose(xw, (1, 0, 2))          # [T, N, 4H] scan order
+    mask_t = None
+    if mask is not None:
+        mask_t = jnp.transpose(mask.astype(x.dtype), (1, 0))[..., None]  # [T,N,1]
+
+    pi, pf, po = peepholes if peepholes is not None else (None, None, None)
+
+    def step(carry, inputs):
+        h_prev, c_prev = carry
+        if mask_t is None:
+            xw_step = inputs
+            m = None
+        else:
+            xw_step, m = inputs
+        pre = xw_step + h_prev @ R
+        pre_i, pre_f, pre_g, pre_o = jnp.split(pre, 4, axis=-1)
+        if pi is not None:
+            pre_i = pre_i + c_prev * pi
+            pre_f = pre_f + c_prev * pf
+        i = gate_act(pre_i)
+        f = gate_act(pre_f)
+        g = cell_act(pre_g)
+        c = f * c_prev + i * g
+        if po is not None:
+            pre_o = pre_o + c * po
+        o = gate_act(pre_o)
+        h = o * cell_act(c)
+        if m is not None:
+            h = m * h + (1 - m) * h_prev
+            c = m * c + (1 - m) * c_prev
+        return (h, c), h
+
+    xs = xw_t if mask_t is None else (xw_t, mask_t)
+    (hT, cT), ys = lax.scan(step, (h0, c0), xs)
+    return jnp.transpose(ys, (1, 0, 2)), hT, cT
+
+
+@register_config
+@dataclasses.dataclass
+class GravesLSTM(BaseRecurrentLayerConf):
+    """LSTM with peephole connections, per Graves (2013) — the reference's
+    GravesLSTM. ``activation`` is the cell/output activation (default tanh);
+    ``gate_activation`` the gate squashing (sigmoid)."""
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+    peephole: bool = True
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        h = self.n_out
+        kw, kr, kp = jax.random.split(key, 3)
+        params = {
+            "W": self._winit(kw, (self.n_in, 4 * h), self.n_in, h, dtype),
+            "R": self._winit(kr, (h, 4 * h), h, h, dtype),
+            "b": jnp.concatenate([
+                jnp.zeros((h,), dtype),
+                jnp.full((h,), self.forget_gate_bias_init, dtype),
+                jnp.zeros((2 * h,), dtype)]),
+        }
+        if self.peephole:
+            k1, k2, k3 = jax.random.split(kp, 3)
+            params["pi"] = jnp.zeros((h,), dtype)
+            params["pf"] = jnp.zeros((h,), dtype)
+            params["po"] = jnp.zeros((h,), dtype)
+        return params
+
+    def _acts(self):
+        from ....ops.activations import get_activation
+        return (get_activation(self.gate_activation),
+                get_activation(self.activation or "tanh"))
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        n = x.shape[0]
+        h = self.n_out
+        h0 = state.get("h", jnp.zeros((n, h), x.dtype)) if state else \
+            jnp.zeros((n, h), x.dtype)
+        c0 = state.get("c", jnp.zeros((n, h), x.dtype)) if state else \
+            jnp.zeros((n, h), x.dtype)
+        gate_act, cell_act = self._acts()
+        peep = (params["pi"], params["pf"], params["po"]) \
+            if self.peephole and "pi" in params else None
+        helper = get_helper("lstm")
+        if helper is not None:
+            y, hT, cT = helper(self, params, x, h0, c0, mask)
+        else:
+            y, hT, cT = _lstm_scan(self, params["W"], params["R"], params["b"],
+                                   peep, x, h0, c0, mask, gate_act, cell_act)
+        return y, {"h": hT, "c": cT}
+
+    def step(self, params, state, x_t):
+        """Single inference step (rnnTimeStep analog): x_t [N, nIn] → y [N, H]."""
+        y, new_state = self.forward(params, state, x_t[:, None, :], train=False)
+        return y[:, 0, :], new_state
+
+
+@register_config
+@dataclasses.dataclass
+class LSTM(GravesLSTM):
+    """Standard LSTM without peepholes."""
+    peephole: bool = False
+
+
+@register_config
+@dataclasses.dataclass
+class GravesBidirectionalLSTM(BaseRecurrentLayerConf):
+    """Bidirectional peephole LSTM (reference GravesBidirectionalLSTM):
+    independent forward/backward passes combined by ``mode`` (the reference
+    adds them; concat also supported)."""
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+    peephole: bool = True
+    mode: str = "add"            # add | concat
+
+    def get_output_type(self, it: InputType) -> InputType:
+        out = self.n_out * (2 if self.mode == "concat" else 1)
+        return InputType.recurrent(out, it.timesteps)
+
+    def _dir_conf(self) -> GravesLSTM:
+        return GravesLSTM(n_in=self.n_in, n_out=self.n_out,
+                          activation=self.activation,
+                          gate_activation=self.gate_activation,
+                          weight_init=self.weight_init, dist=self.dist,
+                          forget_gate_bias_init=self.forget_gate_bias_init,
+                          peephole=self.peephole)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        kf, kb = jax.random.split(key)
+        sub = self._dir_conf()
+        fwd = sub.init_params(kf, dtype)
+        bwd = sub.init_params(kb, dtype)
+        params = {f"{k}_f": v for k, v in fwd.items()}
+        params.update({f"{k}_b": v for k, v in bwd.items()})
+        return params
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        sub = self._dir_conf()
+        fwd_p = {k[:-2]: v for k, v in params.items() if k.endswith("_f")}
+        bwd_p = {k[:-2]: v for k, v in params.items() if k.endswith("_b")}
+        y_f, st_f = sub.forward(fwd_p, {}, x, train=False, mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        mask_rev = None if mask is None else jnp.flip(mask, axis=1)
+        y_b, _ = sub.forward(bwd_p, {}, x_rev, train=False, mask=mask_rev)
+        y_b = jnp.flip(y_b, axis=1)
+        if self.mode == "concat":
+            y = jnp.concatenate([y_f, y_b], axis=-1)
+        else:
+            y = y_f + y_b
+        return y, st_f

@@ -1,0 +1,149 @@
+"""InputPreProcessors: shape adapters between layer families (reference
+nn/conf/preprocessor/ — CnnToFeedForward, FeedForwardToCnn, FeedForwardToRnn,
+RnnToFeedForward, CnnToRnn, RnnToCnn; SURVEY.md §2.1).
+
+Pure reshape/transpose functions; backprop comes from autodiff, so the
+reference's explicit ``backprop`` methods are unnecessary. Layouts are the
+TPU-native ones declared in input_type.py (NHWC, [N, T, C]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .input_type import InputType
+from .serde import register_config
+
+
+class InputPreProcessor:
+    def pre_process(self, x, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    # mask pass-through; time-structure-changing preprocessors override
+    def feed_forward_mask(self, mask):
+        return mask
+
+
+@register_config
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[N,H,W,C] → [N, H*W*C] (reference CnnToFeedForwardPreProcessor)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.height * it.width * it.channels)
+
+
+@register_config
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    """[N, H*W*C] → [N,H,W,C]."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_config
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[N*T, F] → [N, T, F]. Used when dense layers feed an RNN."""
+    timesteps: int = dataclasses.field(default=0)
+
+    def pre_process(self, x, mask=None):
+        t = self.timesteps
+        return x.reshape(-1, t, x.shape[-1])
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.size, self.timesteps or None)
+
+
+@register_config
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[N, T, F] → [N*T, F] (dense applied per timestep)."""
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.feed_forward(it.size)
+
+
+@register_config
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[N,H,W,C] → [N, 1, H*W*C] — cnn activations as a length-1 sequence,
+    or [N*T,H,W,C] → [N,T,H*W*C] when timesteps known."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timesteps: int = 1
+
+    def pre_process(self, x, mask=None):
+        flat = x.reshape(x.shape[0], -1)
+        return flat.reshape(-1, self.timesteps, flat.shape[-1])
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(it.height * it.width * it.channels,
+                                   self.timesteps)
+
+
+@register_config
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """[N, T, H*W*C] → [N*T, H, W, C]."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        n, t, _ = x.shape
+        return x.reshape(n * t, self.height, self.width, self.channels)
+
+    def output_type(self, it: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+def auto_preprocessor(prev: InputType, needed_kind: str, **kw):
+    """Pick the preprocessor bridging ``prev`` to a layer expecting
+    ``needed_kind`` — the InputTypeUtil auto-insertion logic."""
+    if prev.kind == needed_kind:
+        return None
+    if prev.kind == "cnnflat" and needed_kind == "cnn":
+        return FeedForwardToCnnPreProcessor(prev.height, prev.width, prev.channels)
+    if prev.kind == "cnnflat" and needed_kind == "ff":
+        return None  # already flat
+    if prev.kind == "cnn" and needed_kind == "ff":
+        return CnnToFeedForwardPreProcessor(prev.height, prev.width, prev.channels)
+    if prev.kind == "ff" and needed_kind == "cnn":
+        h, w, c = kw.get("height"), kw.get("width"), kw.get("channels")
+        return FeedForwardToCnnPreProcessor(h, w, c)
+    if prev.kind == "rnn" and needed_kind == "ff":
+        return RnnToFeedForwardPreProcessor()
+    if prev.kind == "ff" and needed_kind == "rnn":
+        return FeedForwardToRnnPreProcessor(kw.get("timesteps", 0))
+    if prev.kind == "cnn" and needed_kind == "rnn":
+        return CnnToRnnPreProcessor(prev.height, prev.width, prev.channels,
+                                    kw.get("timesteps", 1))
+    if prev.kind == "rnn" and needed_kind == "cnn":
+        return RnnToCnnPreProcessor(kw.get("height"), kw.get("width"),
+                                    kw.get("channels"))
+    return None

@@ -1,0 +1,65 @@
+"""Finite-difference gradient checking (reference
+gradientcheck/GradientCheckUtil.java, 515 LoC — the correctness oracle the
+reference's whole test suite drives; SURVEY.md §4).
+
+Autodiff replaces the reference's hand-written backprop, but the oracle stays:
+central-difference numeric gradients vs the analytic (autodiff) gradients,
+per parameter element, with a max-relative-error threshold. Run in float64
+(tests enable x64) exactly as the reference runs its checks in double.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def check_gradients(net, ds, epsilon: float = 1e-6,
+                    max_rel_error: float = 1e-3,
+                    min_abs_error: float = 1e-8,
+                    subsample: Optional[int] = None,
+                    seed: int = 0, print_failures: bool = True) -> bool:
+    """Central-difference check on a MultiLayerNetwork (or any model exposing
+    compute_gradient_and_score / params_flat / set_params_flat / score).
+
+    ``subsample``: check only N randomly chosen parameter elements (the
+    reference checks all; subsampling keeps CI fast for big nets).
+    """
+    grads, _ = net.compute_gradient_and_score(ds)
+    # flatten analytic grads in the same deterministic order as params_flat
+    parts = []
+    for i, g in enumerate(grads):
+        for k in sorted(g.keys()):
+            parts.append(np.asarray(g[k], np.float64).reshape(-1))
+    analytic = np.concatenate(parts) if parts else np.zeros(0)
+
+    flat0 = net.params_flat().astype(np.float64)
+    n = flat0.size
+    idxs = np.arange(n)
+    if subsample is not None and subsample < n:
+        idxs = np.random.default_rng(seed).choice(n, subsample, replace=False)
+
+    failures = 0
+    for j in idxs:
+        pert = flat0.copy()
+        pert[j] += epsilon
+        net.set_params_flat(pert)
+        s_plus = net.score(ds)
+        pert[j] -= 2 * epsilon
+        net.set_params_flat(pert)
+        s_minus = net.score(ds)
+        numeric = (s_plus - s_minus) / (2 * epsilon)
+        a = analytic[j]
+        abs_err = abs(a - numeric)
+        denom = max(abs(a), abs(numeric))
+        rel_err = abs_err / denom if denom > 0 else 0.0
+        if rel_err > max_rel_error and abs_err > min_abs_error:
+            failures += 1
+            if print_failures:
+                print(f"  param[{j}]: analytic={a:.8g} numeric={numeric:.8g} "
+                      f"rel_err={rel_err:.3g}")
+    net.set_params_flat(flat0)
+    if failures and print_failures:
+        print(f"Gradient check FAILED for {failures}/{len(idxs)} params")
+    return failures == 0

@@ -1,0 +1,18 @@
+"""ComputationGraph: DAG networks (reference nn/graph/; SURVEY.md §2.1)."""
+
+from .graph_config import (ComputationGraphConfiguration, GraphBuilder,
+                           topological_sort)
+from .computation_graph import ComputationGraph
+from .vertices import (GraphVertexConf, LayerVertex, MergeVertex,
+                       ElementWiseVertex, SubsetVertex, StackVertex,
+                       UnstackVertex, ScaleVertex, ShiftVertex, L2Vertex,
+                       L2NormalizeVertex, PreprocessorVertex,
+                       LastTimeStepVertex, DuplicateToTimeSeriesVertex)
+
+__all__ = [
+    "ComputationGraphConfiguration", "GraphBuilder", "topological_sort",
+    "ComputationGraph", "GraphVertexConf", "LayerVertex", "MergeVertex",
+    "ElementWiseVertex", "SubsetVertex", "StackVertex", "UnstackVertex",
+    "ScaleVertex", "ShiftVertex", "L2Vertex", "L2NormalizeVertex",
+    "PreprocessorVertex", "LastTimeStepVertex", "DuplicateToTimeSeriesVertex",
+]

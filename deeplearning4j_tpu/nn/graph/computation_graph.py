@@ -1,0 +1,352 @@
+"""ComputationGraph: the DAG model (reference nn/graph/ComputationGraph.java,
+2,782 LoC — feedForward in topo order :1147, calcBackpropGradients reverse
+topo :1062, multi-input/multi-output, rnn state; SURVEY.md §2.1, §3.2).
+
+Functional executor: the stored topological order is walked inside one jitted
+train step; autodiff differentiates through the whole DAG, so there is no
+reverse-topo pass to write. Multi-output losses sum over all output layer
+vertices (reference behaviour)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import rng as rngmod
+from ...ops.dataset import DataSet, MultiDataSet
+from ...ops.updaters import make_updater, normalize_gradient, schedule_lr
+from .graph_config import ComputationGraphConfiguration
+from .vertices import LayerVertex
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration,
+                 compute_dtype=None):
+        self.conf = conf
+        self.compute_dtype = compute_dtype or jnp.float32
+        self.params: Dict[str, Dict] = {}
+        self.state: Dict[str, Dict] = {}
+        self.updaters: Dict[str, object] = {}
+        self.updater_state: Dict[str, Dict] = {}
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List = []
+        self.score_value = float("nan")
+        self._jit_cache: Dict = {}
+        self._initialized = False
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "ComputationGraph":
+        key = rngmod.root_key(self.conf.seed)
+        self.params, self.state = {}, {}
+        self.updaters, self.updater_state = {}, {}
+        for idx, name in enumerate(self.conf.topological_order):
+            v = self.conf.vertices[name]
+            vkey = rngmod.for_layer(rngmod.for_purpose(key, "init"), idx)
+            p = v.init_params(vkey, self.compute_dtype)
+            self.params[name] = p
+            self.state[name] = v.init_state()
+            layer = v.layer if isinstance(v, LayerVertex) else None
+            upd = make_updater(
+                (layer.updater if layer else None) or "sgd",
+                momentum=(layer.momentum if layer else None) or 0.9,
+                adam_mean_decay=(layer.adam_mean_decay if layer else None) or 0.9,
+                adam_var_decay=(layer.adam_var_decay if layer else None) or 0.999,
+                rho=(layer.rho if layer else None) or 0.95,
+                rms_decay=(layer.rms_decay if layer else None) or 0.95,
+                epsilon=(layer.epsilon if layer else None) or 1e-8)
+            self.updaters[name] = upd
+            self.updater_state[name] = {k: upd.init(val)
+                                        for k, val in p.items()}
+        self._initialized = True
+        return self
+
+    def _ensure_init(self):
+        if not self._initialized:
+            self.init()
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, state, inputs: Dict[str, jnp.ndarray], *,
+                 train, rng, input_masks: Optional[Dict] = None,
+                 output_preout: bool = False):
+        """Walk topo order. Returns (activations dict, new_state dict, reg).
+        With ``output_preout``, output layer vertices contribute their
+        PRE-activation (for fused losses) in a separate dict."""
+        acts: Dict[str, jnp.ndarray] = dict(inputs)
+        masks: Dict[str, Optional[jnp.ndarray]] = dict(input_masks or {})
+        new_state: Dict[str, Dict] = {}
+        preouts: Dict[str, jnp.ndarray] = {}
+        last_inputs: Dict[str, jnp.ndarray] = {}
+        reg = jnp.asarray(0.0, jnp.float32)
+        out_set = set(self.conf.network_outputs) if output_preout else set()
+        for idx, name in enumerate(self.conf.topological_order):
+            v = self.conf.vertices[name]
+            in_names = self.conf.vertex_inputs[name]
+            xs = [acts[i] for i in in_names]
+            ms = [masks.get(i) for i in in_names]
+            vrng = rngmod.for_layer(rng, idx) if rng is not None else None
+            if isinstance(v, LayerVertex):
+                reg = reg + v.layer.reg_penalty(params[name])
+            if name in out_set and isinstance(v, LayerVertex) and \
+                    hasattr(v.layer, "preoutput"):
+                x = xs[0]
+                m = ms[0]
+                if v.preprocessor is not None:
+                    x = v.preprocessor.pre_process(x, m)
+                    m = v.preprocessor.feed_forward_mask(m)
+                if v.layer.drop_out and train:
+                    x = v.layer.maybe_dropout(x, train=train, rng=vrng)
+                pre = v.layer.preoutput(params[name], x)
+                preouts[name] = pre
+                last_inputs[name] = x
+                masks[name] = m
+                acts[name] = v.layer.activation_fn()(pre)
+                new_state[name] = state[name]
+            else:
+                y, nstate = v.forward(params[name], state[name], xs,
+                                      train=train, rng=vrng, masks=ms)
+                acts[name] = y
+                new_state[name] = nstate
+                masks[name] = ms[0] if ms else None
+        return acts, new_state, reg, preouts, masks, last_inputs
+
+    def _inputs_dict(self, features) -> Dict[str, jnp.ndarray]:
+        names = self.conf.network_inputs
+        if isinstance(features, dict):
+            return {k: jnp.asarray(v, self.compute_dtype)
+                    for k, v in features.items()}
+        if isinstance(features, (list, tuple)):
+            return {n: jnp.asarray(f, self.compute_dtype)
+                    for n, f in zip(names, features)}
+        return {names[0]: jnp.asarray(features, self.compute_dtype)}
+
+    def output(self, *features, train: bool = False):
+        """Forward pass → list of output activations (reference
+        ComputationGraph.output)."""
+        self._ensure_init()
+        if len(features) == 1:
+            inputs = self._inputs_dict(features[0])
+        else:
+            inputs = self._inputs_dict(list(features))
+        fn = self._jit_cache.get("output")
+        if fn is None:
+            def _out(params, state, inputs):
+                acts, *_ = self._forward(params, state, inputs, train=False,
+                                         rng=None)
+                return [acts[o] for o in self.conf.network_outputs]
+            fn = jax.jit(_out)
+            self._jit_cache["output"] = fn
+        outs = fn(self.params, self.state, inputs)
+        return [np.asarray(o) for o in outs]
+
+    # -------------------------------------------------------------- training
+    def _loss(self, params, state, inputs, labels: Dict, rng,
+              label_masks: Optional[Dict] = None, input_masks=None):
+        acts, new_state, reg, preouts, masks, last_in = self._forward(
+            params, state, inputs, train=True, rng=rng,
+            input_masks=input_masks, output_preout=True)
+        score = reg
+        for out_name in self.conf.network_outputs:
+            v = self.conf.vertices[out_name]
+            if not isinstance(v, LayerVertex) or \
+                    not hasattr(v.layer, "compute_score"):
+                continue
+            y = labels[out_name]
+            pre = preouts[out_name]
+            lmask = (label_masks or {}).get(out_name)
+            if lmask is None and pre.ndim == 3:
+                lmask = masks.get(out_name)
+            score = score + v.layer.compute_score(params[out_name], y, pre,
+                                                  lmask)
+        return score, new_state
+
+    def _make_train_step(self):
+        conf = self.conf
+
+        def train_step(params, upd_state, state, inputs, labels, input_masks,
+                       label_masks, iteration):
+            rng = rngmod.for_iteration(
+                rngmod.for_purpose(rngmod.root_key(conf.seed), "dropout"),
+                iteration)
+
+            def lf(p):
+                return self._loss(p, state, inputs, labels, rng, label_masks,
+                                  input_masks)
+
+            (score, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            it_f = jnp.asarray(iteration, jnp.float32)
+            new_params, new_upd = {}, {}
+            for name in conf.topological_order:
+                g = grads.get(name, {})
+                if not g:
+                    new_params[name] = params[name]
+                    new_upd[name] = upd_state[name]
+                    continue
+                v = conf.vertices[name]
+                layer = v.layer if isinstance(v, LayerVertex) else None
+                if layer is not None:
+                    g = normalize_gradient(
+                        g, layer.gradient_normalization,
+                        layer.gradient_normalization_threshold or 1.0)
+                lr = schedule_lr(
+                    (layer.learning_rate if layer else None) or 0.1,
+                    conf.lr_policy, it_f,
+                    decay_rate=conf.lr_policy_decay_rate,
+                    steps=conf.lr_policy_steps, power=conf.lr_policy_power,
+                    max_iterations=float(conf.max_iterations or 1),
+                    schedule=conf.learning_rate_schedule)
+                upd = self.updaters[name]
+                np_, nu = {}, {}
+                for pname, grad in g.items():
+                    step, nstate = upd.update(grad, upd_state[name][pname],
+                                              lr, it_f)
+                    np_[pname] = params[name][pname] - step
+                    nu[pname] = nstate
+                new_params[name] = np_
+                new_upd[name] = nu
+            return new_params, new_upd, new_state, score
+
+        return train_step
+
+    def _labels_dict(self, labels) -> Dict:
+        names = self.conf.network_outputs
+        if isinstance(labels, dict):
+            return {k: jnp.asarray(v, self.compute_dtype)
+                    for k, v in labels.items()}
+        if isinstance(labels, (list, tuple)):
+            return {n: jnp.asarray(l, self.compute_dtype)
+                    for n, l in zip(names, labels)}
+        return {names[0]: jnp.asarray(labels, self.compute_dtype)}
+
+    def fit(self, data, num_epochs: int = 1):
+        """Train on DataSet / MultiDataSet / iterator thereof (reference
+        ComputationGraph.fit)."""
+        self._ensure_init()
+        from ...datasets.iterators import as_iterator
+        for _ in range(num_epochs):
+            if isinstance(data, (DataSet, MultiDataSet)):
+                batches = [data]
+            elif isinstance(data, (list, tuple)):
+                batches = data
+            else:
+                batches = data
+            for ds in batches:
+                self.fit_batch(ds)
+            if hasattr(data, "reset"):
+                data.reset()
+            self.epoch += 1
+        return self
+
+    def fit_batch(self, ds):
+        self._ensure_init()
+        if isinstance(ds, MultiDataSet):
+            inputs = self._inputs_dict(ds.features)
+            labels = self._labels_dict(ds.labels)
+            imasks = None
+            if ds.features_masks:
+                imasks = {n: None if m is None else
+                          jnp.asarray(m, self.compute_dtype)
+                          for n, m in zip(self.conf.network_inputs,
+                                          ds.features_masks)}
+            lmasks = None
+            if ds.labels_masks:
+                lmasks = {n: None if m is None else
+                          jnp.asarray(m, self.compute_dtype)
+                          for n, m in zip(self.conf.network_outputs,
+                                          ds.labels_masks)}
+        else:
+            inputs = self._inputs_dict(ds.features)
+            labels = self._labels_dict(ds.labels)
+            imasks = None if ds.features_mask is None else \
+                {self.conf.network_inputs[0]:
+                 jnp.asarray(ds.features_mask, self.compute_dtype)}
+            lmasks = None if ds.labels_mask is None else \
+                {self.conf.network_outputs[0]:
+                 jnp.asarray(ds.labels_mask, self.compute_dtype)}
+        step = self._jit_cache.get("train")
+        if step is None:
+            step = jax.jit(self._make_train_step(), donate_argnums=(0, 1, 2))
+            self._jit_cache["train"] = step
+        self.params, self.updater_state, self.state, score = step(
+            self.params, self.updater_state, self.state, inputs, labels,
+            imasks, lmasks, self.iteration)
+        self.score_value = float(score)
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration)
+
+    # --------------------------------------------------------------- scoring
+    def score(self, ds) -> float:
+        self._ensure_init()
+        if isinstance(ds, MultiDataSet):
+            inputs = self._inputs_dict(ds.features)
+            labels = self._labels_dict(ds.labels)
+        else:
+            inputs = self._inputs_dict(ds.features)
+            labels = self._labels_dict(ds.labels)
+        loss, _ = self._loss(self.params, self.state, inputs, labels, None)
+        return float(loss)
+
+    def compute_gradient_and_score(self, ds):
+        self._ensure_init()
+        inputs = self._inputs_dict(ds.features)
+        labels = self._labels_dict(ds.labels)
+
+        def lf(p):
+            return self._loss(p, self.state, inputs, labels, None)
+        (score, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
+        return grads, float(score)
+
+    def evaluate(self, data):
+        from ...eval.evaluation import Evaluation
+        from ...datasets.iterators import as_iterator
+        ev = Evaluation()
+        for ds in as_iterator(data):
+            out = self.output(ds.features)[0]
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
+    # ----------------------------------------------------------- param utils
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def num_params(self) -> int:
+        self._ensure_init()
+        return sum(int(np.prod(v.shape)) for p in self.params.values()
+                   for v in p.values())
+
+    def params_flat(self) -> np.ndarray:
+        self._ensure_init()
+        parts = []
+        for name in self.conf.topological_order:
+            p = self.params[name]
+            for k in sorted(p.keys()):
+                parts.append(np.asarray(p[k]).reshape(-1))
+        return np.concatenate(parts) if parts else np.zeros(0, np.float32)
+
+    def set_params_flat(self, flat: np.ndarray):
+        self._ensure_init()
+        offset = 0
+        for name in self.conf.topological_order:
+            p = self.params[name]
+            for k in sorted(p.keys()):
+                size = int(np.prod(p[k].shape))
+                self.params[name][k] = jnp.asarray(
+                    flat[offset:offset + size].reshape(p[k].shape), p[k].dtype)
+                offset += size
+
+    def clone(self) -> "ComputationGraph":
+        import copy as _copy
+        net = ComputationGraph(_copy.deepcopy(self.conf), self.compute_dtype)
+        net.init()
+        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a,
+                                                   self.updater_state)
+        net.iteration = self.iteration
+        return net

@@ -1,0 +1,49 @@
+"""Accelerated-implementation registry — the TPU analog of the reference's
+Helper SPI (ConvolutionHelper/SubsamplingHelper/BatchNormalizationHelper/
+LocalResponseNormalizationHelper + the LSTMHelpers seam; reference
+nn/layers/convolution/ConvolutionLayer.java:69-76 reflective cuDNN loading,
+SURVEY.md §2.2).
+
+Instead of reflective class loading, layers consult this registry by op kind;
+a registered override (typically a Pallas kernel or custom lowering) is used
+when its platform matches, with the pure-jnp implementation as the
+always-available reference path — which is exactly what the reference's
+"silent fallback to built-in" does, and what its CuDNN-vs-builtin equivalence
+tests rely on (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+
+_HELPERS: Dict[str, Tuple[Callable, Tuple[str, ...]]] = {}
+_DISABLED: set = set()
+
+
+def register_helper(kind: str, fn: Callable,
+                    platforms: Tuple[str, ...] = ("tpu",)) -> None:
+    _HELPERS[kind] = (fn, tuple(p.lower() for p in platforms))
+
+
+def get_helper(kind: str) -> Optional[Callable]:
+    """Return the accelerated impl for ``kind`` if one is registered for the
+    default backend platform, else None (caller falls back to pure jnp)."""
+    if kind in _DISABLED or kind not in _HELPERS:
+        return None
+    fn, platforms = _HELPERS[kind]
+    try:
+        platform = jax.default_backend().lower()
+    except Exception:
+        return None
+    return fn if platform in platforms else None
+
+
+def disable_helper(kind: str) -> None:
+    """Force the built-in path (used by helper-vs-builtin equivalence tests)."""
+    _DISABLED.add(kind)
+
+
+def enable_helper(kind: str) -> None:
+    _DISABLED.discard(kind)

@@ -1,0 +1,159 @@
+"""Transfer learning: clone + surgery on a trained network (reference
+nn/transferlearning/TransferLearning.java (777 LoC), FineTuneConfiguration,
+TransferLearningHelper; SURVEY.md §2.1): freeze layers below a boundary,
+replace/append output layers, override hyperparameters on the rest, and
+featurize through the frozen sub-stack."""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .conf.config import MultiLayerConfiguration
+from .conf.input_type import InputType
+from .multilayer import MultiLayerNetwork
+from ..ops.dataset import DataSet
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Hyperparameter overrides applied to every non-frozen layer
+    (reference FineTuneConfiguration)."""
+    learning_rate: Optional[float] = None
+    updater: Optional[str] = None
+    momentum: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    drop_out: Optional[float] = None
+    activation: Optional[str] = None
+    seed: Optional[int] = None
+
+    def apply(self, layer):
+        for f in ("learning_rate", "updater", "momentum", "l1", "l2",
+                  "drop_out", "activation"):
+            v = getattr(self, f)
+            if v is not None and hasattr(layer, f):
+                setattr(layer, f, v)
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._removed_from: Optional[int] = None
+            self._added: List = []
+            self._n_out_overrides: Dict[int, int] = {}
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0..layer_index] (reference setFeatureExtractor)."""
+            self._freeze_until = int(layer_index)
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            count = len(self._net.layers)
+            self._removed_from = count - int(n)
+            return self
+
+        def add_layer(self, conf):
+            self._added.append(conf)
+            return self
+
+        def n_out_replace(self, layer_index: int, n_out: int):
+            """Change a layer's nOut, re-initializing it and the next layer's
+            nIn (reference nOutReplace)."""
+            self._n_out_overrides[int(layer_index)] = int(n_out)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._net
+            conf = copy.deepcopy(src.conf)
+            keep = self._removed_from if self._removed_from is not None \
+                else len(conf.layers)
+            layers = conf.layers[:keep]
+            reinit = set()
+
+            for idx, n_out in self._n_out_overrides.items():
+                layers[idx].n_out = n_out
+                reinit.add(idx)
+                if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
+                    layers[idx + 1].n_in = n_out
+                    reinit.add(idx + 1)
+
+            # infer shapes for appended layers from the running output type
+            current = conf.input_type
+            if current is not None:
+                for i, l in enumerate(layers):
+                    pp = conf.preprocessor_for(i)
+                    if pp is not None:
+                        current = pp.output_type(current)
+                    current = l.get_output_type(current)
+            for l in self._added:
+                l = copy.deepcopy(l)
+                if self._fine_tune:
+                    self._fine_tune.apply(l)
+                if current is not None:
+                    l.set_n_in(current)
+                    current = l.get_output_type(current)
+                layers.append(l)
+                reinit.add(len(layers) - 1)
+
+            frozen_upto = self._freeze_until if self._freeze_until is not None \
+                else -1
+            for i, l in enumerate(layers):
+                if i <= frozen_upto:
+                    l.learning_rate = 0.0     # frozen == zero-lr (+ exact copy)
+                elif self._fine_tune and i not in reinit:
+                    self._fine_tune.apply(l)
+            conf.layers = layers
+            conf.input_preprocessors = {
+                k: v for k, v in conf.input_preprocessors.items()
+                if int(k) < len(layers)}
+            if self._fine_tune and self._fine_tune.seed is not None:
+                conf.seed = self._fine_tune.seed
+
+            new_net = MultiLayerNetwork(conf, src.compute_dtype).init()
+            for i in range(len(layers)):
+                if i not in reinit and i < len(src.params):
+                    new_net.params[i] = jax.tree_util.tree_map(
+                        lambda a: a, src.params[i])
+                    if i < len(src.state):
+                        new_net.state[i] = jax.tree_util.tree_map(
+                            lambda a: a, src.state[i])
+            new_net.frozen_until = frozen_upto
+            return new_net
+
+
+class TransferLearningHelper:
+    """Featurize through the frozen sub-stack once, then train only the
+    unfrozen head (reference TransferLearningHelper)."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: int):
+        self.net = net
+        self.frozen_until = int(frozen_until)
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        import jax.numpy as jnp
+        act = jnp.asarray(ds.features, self.net.compute_dtype)
+        mask = None
+        for i in range(self.frozen_until + 1):
+            layer = self.net.layers[i]
+            pp = self.net.conf.preprocessor_for(i)
+            if pp is not None:
+                act = pp.pre_process(act, mask)
+            act, _ = layer.forward(self.net.params[i], self.net.state[i], act,
+                                   train=False, rng=None, mask=mask)
+        return DataSet(np.asarray(act), ds.labels, ds.features_mask,
+                       ds.labels_mask)

@@ -1,0 +1,38 @@
+"""Device-mesh helpers: the TPU topology surface that replaces the
+reference's AffinityManager device enumeration (SURVEY.md §2.9) and carries
+the sharding layout for data/model parallelism over ICI/DCN."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("data",),
+              shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Build a Mesh over the first n_devices (default: all). For multi-axis
+    meshes pass shape, e.g. shape=(4, 2), axis_names=("data", "model")."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if shape is None:
+        shape = (len(devs),)
+    arr = np.array(devs[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch_spec(ndim: int, axis: str = "data") -> P:
+    """PartitionSpec sharding dim 0 (batch) over ``axis``."""
+    return P(axis, *([None] * (ndim - 1)))

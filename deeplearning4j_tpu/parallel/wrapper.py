@@ -1,0 +1,250 @@
+"""ParallelWrapper: data-parallel training over a device mesh (reference
+parallelism/ParallelWrapper.java, 662 LoC; SURVEY.md §2.4, §3.3).
+
+The reference spawns one trainer thread + model replica per device,
+round-robins DataSets into per-worker queues, and every
+``averaging_frequency`` iterations averages parameters across replicas with
+``Nd4j.averageAndPropagate`` (and optionally updater state, ``averageUpdaters``).
+
+TPU-first redesign (SURVEY.md §7): one SPMD program instead of threads.
+
+- ``averaging_frequency == 1`` (synchronous DP): the global batch is sharded
+  over the mesh's ``data`` axis and params are replicated; XLA/GSPMD inserts
+  the gradient all-reduce over ICI — the collective the reference stages
+  through host memory.
+- ``averaging_frequency == k > 1`` (the reference's actual semantics): each
+  device keeps its OWN diverged replica (params stacked on a leading device
+  axis) and runs k local steps via ``lax.scan``; then params (+ updater state,
+  matching ``averageUpdaters(true)``) are ``pmean``-ed across the mesh inside
+  ``shard_map`` — local-steps/periodic-averaging DP, one compiled program per
+  round, no host round-trips.
+
+Multi-host: the same program runs under ``jax.distributed`` initialization
+(see multihost.py); the mesh then spans hosts and XLA routes the same
+collectives over ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.dataset import DataSet
+from .mesh import make_mesh
+
+
+class ParallelWrapper:
+    """Builder-style API mirroring the reference:
+
+        ParallelWrapper.Builder(net).workers(8).averaging_frequency(5)
+            .average_updaters(True).build().fit(iterator)
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 averaging_frequency: int = 1, average_updaters: bool = True,
+                 prefetch_buffer: int = 2, report_score: bool = True):
+        self.net = net
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.averaging_frequency = max(1, int(averaging_frequency))
+        self.average_updaters = average_updaters
+        self.prefetch_buffer = prefetch_buffer
+        self.report_score = report_score
+        self._jit_sync = None
+        self._jit_round = None
+        self.listeners: List = []
+
+    class Builder:
+        def __init__(self, net):
+            self._net = net
+            self._mesh = None
+            self._freq = 1
+            self._avg_upd = True
+            self._prefetch = 2
+
+        def workers(self, n: int):
+            self._mesh = make_mesh(n)
+            return self
+
+        def mesh(self, mesh: Mesh):
+            self._mesh = mesh
+            return self
+
+        def averaging_frequency(self, k: int):
+            self._freq = int(k)
+            return self
+
+        def average_updaters(self, flag: bool):
+            self._avg_upd = bool(flag)
+            return self
+
+        def prefetch_buffer(self, n: int):
+            self._prefetch = int(n)
+            return self
+
+        def build(self) -> "ParallelWrapper":
+            return ParallelWrapper(self._net, self._mesh, self._freq,
+                                   self._avg_upd, self._prefetch)
+
+    # ------------------------------------------------------------------ fit
+    @property
+    def num_workers(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def fit(self, data, num_epochs: int = 1):
+        net = self.net
+        net._ensure_init()
+        from ..datasets.iterators import as_iterator, AsyncDataSetIterator
+        for _ in range(num_epochs):
+            it = as_iterator(data)
+            if getattr(it, "async_supported", True):
+                it = AsyncDataSetIterator(it, self.prefetch_buffer)
+            if self.averaging_frequency == 1:
+                self._fit_sync(it)
+            else:
+                self._fit_local_steps(it)
+            net.epoch += 1
+        return self
+
+    # --- mode 1: synchronous DP, grads all-reduced by GSPMD ---
+    def _fit_sync(self, iterator):
+        net = self.net
+        mesh = self.mesh
+        if self._jit_sync is None:
+            step = net._make_train_step(False)
+            rep = NamedSharding(mesh, P())
+
+            def sharded_step(params, upd, state, feats, labels, iteration,
+                             empty_rnn):
+                return step(params, upd, state, feats, labels, None, None,
+                            iteration, empty_rnn)
+
+            self._jit_sync = jax.jit(
+                sharded_step,
+                in_shardings=(rep, rep, rep,
+                              NamedSharding(mesh, P("data")),
+                              NamedSharding(mesh, P("data")), None, rep),
+                out_shardings=(rep, rep, rep, rep),
+                donate_argnums=(0, 1, 2))
+        empty_rnn = [{} for _ in getattr(net, "layers", [])]
+        for ds in iterator:
+            feats, labels = self._pad_to_devices(ds)
+            net.params, net.updater_state, net.state, score = self._jit_sync(
+                net.params, net.updater_state, net.state,
+                jnp.asarray(feats, net.compute_dtype),
+                jnp.asarray(labels, net.compute_dtype),
+                net.iteration, empty_rnn)
+            net.score_value = float(score)
+            net.iteration += 1
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration)
+
+    # --- mode k: local steps + periodic parameter averaging ---
+    def _fit_local_steps(self, iterator):
+        net = self.net
+        mesh = self.mesh
+        n_dev = self.num_workers
+        k = self.averaging_frequency
+        if self._jit_round is None:
+            step = net._make_train_step(False)
+            avg_upd = self.average_updaters
+
+            def round_fn(stacked_params, stacked_upd, stacked_state,
+                         feats, labels, iteration):
+                # per-device view: strip the leading device axis
+                params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+                upd = jax.tree_util.tree_map(lambda a: a[0], stacked_upd)
+                state = jax.tree_util.tree_map(lambda a: a[0], stacked_state)
+                feats = feats[:, 0]       # [k, 1, b, ...] -> [k, b, ...]
+                labels = labels[:, 0]
+                empty_rnn = [{} for _ in getattr(net, "layers", [])]
+
+                def body(carry, batch):
+                    p, u, s, it = carry
+                    f, l = batch
+                    p, u, s, score = step(p, u, s, f, l, None, None, it,
+                                          empty_rnn)
+                    return (p, u, s, it + 1.0), score
+
+                (params, upd, state, _), scores = lax.scan(
+                    body, (params, upd, state,
+                           jnp.asarray(iteration, jnp.float32)),
+                    (feats, labels))
+                # Nd4j.averageAndPropagate analog over ICI:
+                params = lax.pmean(params, "data")
+                if avg_upd:
+                    upd = lax.pmean(upd, "data")
+                state = lax.pmean(state, "data")
+                score = lax.pmean(jnp.mean(scores), "data")
+                restack = lambda t: jax.tree_util.tree_map(
+                    lambda a: a[None], t)
+                return (restack(params), restack(upd), restack(state), score)
+
+            self._jit_round = jax.jit(shard_map(
+                round_fn, mesh=mesh,
+                in_specs=(P("data"), P("data"), P("data"),
+                          P(None, "data"), P(None, "data"), P()),
+                out_specs=(P("data"), P("data"), P("data"), P()),
+                check_vma=False))
+            # stack replicas once: [n_dev, ...] per leaf
+            self._stacked = (
+                jax.tree_util.tree_map(
+                    lambda a: jnp.broadcast_to(a, (n_dev,) + a.shape),
+                    net.params),
+                jax.tree_util.tree_map(
+                    lambda a: jnp.broadcast_to(a, (n_dev,) + a.shape),
+                    net.updater_state),
+                jax.tree_util.tree_map(
+                    lambda a: jnp.broadcast_to(a, (n_dev,) + a.shape),
+                    net.state))
+
+        buf = []
+        for ds in iterator:
+            buf.append(ds)
+            if len(buf) == k:
+                self._run_round(buf)
+                buf = []
+        if buf:
+            self._run_round(buf)
+        # unstack back into the wrapped net
+        sp, su, ss = self._stacked
+        net.params = jax.tree_util.tree_map(lambda a: a[0], sp)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a[0], su)
+        net.state = jax.tree_util.tree_map(lambda a: a[0], ss)
+
+    def _run_round(self, batches: List[DataSet]):
+        net = self.net
+        k = len(batches)
+        n_dev = self.num_workers
+        feats = np.stack([self._pad_to_devices(b)[0] for b in batches])
+        labels = np.stack([self._pad_to_devices(b)[1] for b in batches])
+        # [k, global_b, ...] -> [k, n_dev, b, ...]
+        feats = feats.reshape((k, n_dev, -1) + feats.shape[2:])
+        labels = labels.reshape((k, n_dev, -1) + labels.shape[2:])
+        sp, su, ss = self._stacked
+        sp, su, ss, score = self._jit_round(
+            sp, su, ss, jnp.asarray(feats, net.compute_dtype),
+            jnp.asarray(labels, net.compute_dtype), net.iteration)
+        self._stacked = (sp, su, ss)
+        net.score_value = float(score)
+        net.iteration += k
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration)
+
+    def _pad_to_devices(self, ds: DataSet):
+        """Pad the batch so it divides evenly across devices (the reference
+        round-robins leftovers; padding with repeated rows keeps SPMD shapes
+        static)."""
+        n = ds.num_examples()
+        n_dev = self.num_workers
+        rem = n % n_dev
+        if rem == 0:
+            return ds.features, ds.labels
+        pad = n_dev - rem
+        idx = np.concatenate([np.arange(n), np.arange(pad) % n])
+        return ds.features[idx], ds.labels[idx]

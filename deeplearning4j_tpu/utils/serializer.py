@@ -1,0 +1,157 @@
+"""Model checkpoint serialization (reference util/ModelSerializer.java:39-41,
+:79-118, :136): a zip holding the full config JSON, the parameters, the
+updater (optimizer) state so training resumes exactly, layer state (BN running
+stats / RNN carries), and optionally the data normalizer — the same four-slot
+layout as the reference (`configuration.json`, `coefficients.bin`,
+`updaterState.bin`, `normalizer.bin`), with npz payloads instead of ND4J
+binary. Resume == restore + keep fitting (SURVEY.md §5.4)."""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+CONFIG_ENTRY = "configuration.json"
+COEFF_ENTRY = "coefficients.npz"
+UPDATER_ENTRY = "updaterState.npz"
+STATE_ENTRY = "layerState.npz"
+NORMALIZER_ENTRY = "normalizer.bin"
+META_ENTRY = "meta.json"
+
+
+def _tree_to_npz_bytes(tree) -> bytes:
+    """Flatten a nested list/dict pytree of arrays into npz with path keys."""
+    flat = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k in sorted(node.keys()):
+                walk(node[k], f"{prefix}/{k}")
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{prefix}/{i}")
+        elif node is None or (isinstance(node, tuple) and not node):
+            pass
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk(tree, "r")
+    buf = io.BytesIO()
+    np.savez(buf, **flat) if flat else np.savez(buf, __empty__=np.zeros(1))
+    return buf.getvalue()
+
+
+def _npz_bytes_to_flat(data: bytes) -> dict:
+    with np.load(io.BytesIO(data)) as z:
+        return {k: z[k] for k in z.files if k != "__empty__"}
+
+
+def _restore_tree(template, flat: dict):
+    """Fill a template pytree (from a freshly init'd net) with npz values."""
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            return {k: walk(v, f"{prefix}/{k}") for k, v in node.items()}
+        if isinstance(node, (list, tuple)) and not (
+                isinstance(node, tuple) and len(node) == 0):
+            vals = [walk(v, f"{prefix}/{i}") for i, v in enumerate(node)]
+            return type(node)(vals) if isinstance(node, tuple) else vals
+        if prefix in flat:
+            return jnp.asarray(flat[prefix])
+        return node
+
+    return walk(template, "r")
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(net, path, save_updater: bool = True,
+                    normalizer=None) -> None:
+        """Save MultiLayerNetwork or ComputationGraph (reference writeModel)."""
+        path = Path(path)
+        model_type = type(net).__name__
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr(CONFIG_ENTRY, net.conf.to_json())
+            z.writestr(COEFF_ENTRY, _tree_to_npz_bytes(net.params))
+            z.writestr(STATE_ENTRY, _tree_to_npz_bytes(net.state))
+            if save_updater:
+                z.writestr(UPDATER_ENTRY,
+                           _tree_to_npz_bytes(net.updater_state))
+            if normalizer is not None:
+                z.writestr(NORMALIZER_ENTRY, normalizer.to_bytes())
+            z.writestr(META_ENTRY, json.dumps({
+                "model_type": model_type,
+                "iteration": net.iteration,
+                "epoch": getattr(net, "epoch", 0),
+                "format_version": 1,
+            }))
+
+    @staticmethod
+    def _read(path):
+        path = Path(path)
+        with zipfile.ZipFile(path, "r") as z:
+            names = set(z.namelist())
+            meta = json.loads(z.read(META_ENTRY)) if META_ENTRY in names \
+                else {"model_type": "MultiLayerNetwork"}
+            conf_json = z.read(CONFIG_ENTRY).decode()
+            coeffs = _npz_bytes_to_flat(z.read(COEFF_ENTRY))
+            state = _npz_bytes_to_flat(z.read(STATE_ENTRY)) \
+                if STATE_ENTRY in names else {}
+            upd = _npz_bytes_to_flat(z.read(UPDATER_ENTRY)) \
+                if UPDATER_ENTRY in names else None
+            norm = z.read(NORMALIZER_ENTRY) if NORMALIZER_ENTRY in names \
+                else None
+        return meta, conf_json, coeffs, state, upd, norm
+
+    @staticmethod
+    def restore_multi_layer_network(path, load_updater: bool = True):
+        from ..nn.multilayer import MultiLayerNetwork
+        from ..nn.conf.config import MultiLayerConfiguration
+        meta, conf_json, coeffs, state, upd, _ = ModelSerializer._read(path)
+        conf = MultiLayerConfiguration.from_json(conf_json)
+        net = MultiLayerNetwork(conf).init()
+        net.params = _restore_tree(net.params, coeffs)
+        if state:
+            net.state = _restore_tree(net.state, state)
+        if load_updater and upd is not None:
+            net.updater_state = _restore_tree(net.updater_state, upd)
+        net.iteration = int(meta.get("iteration", 0))
+        net.epoch = int(meta.get("epoch", 0))
+        return net
+
+    @staticmethod
+    def restore_computation_graph(path, load_updater: bool = True):
+        from ..nn.graph.computation_graph import ComputationGraph
+        from ..nn.graph.graph_config import ComputationGraphConfiguration
+        meta, conf_json, coeffs, state, upd, _ = ModelSerializer._read(path)
+        conf = ComputationGraphConfiguration.from_json(conf_json)
+        net = ComputationGraph(conf).init()
+        net.params = _restore_tree(net.params, coeffs)
+        if state:
+            net.state = _restore_tree(net.state, state)
+        if load_updater and upd is not None:
+            net.updater_state = _restore_tree(net.updater_state, upd)
+        net.iteration = int(meta.get("iteration", 0))
+        return net
+
+    @staticmethod
+    def restore_normalizer(path):
+        from ..ops.dataset import DataNormalizer
+        *_, norm = ModelSerializer._read(path)
+        return None if norm is None else DataNormalizer.from_bytes(norm)
+
+
+class ModelGuesser:
+    """Load any saved model guessing its type (reference util/ModelGuesser.java)."""
+
+    @staticmethod
+    def load_model_guess_type(path):
+        meta, *_ = ModelSerializer._read(path)
+        if meta.get("model_type") == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(path)
+        return ModelSerializer.restore_multi_layer_network(path)

@@ -4,12 +4,18 @@ hardware (the pattern SURVEY.md §4 prescribes: local[n]-Spark analog)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"   # force-set: axon presets this var
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ.setdefault("JAX_ENABLE_X64", "1")  # float64 for gradient checks
+
+import jax
+
+# Robust even if a pytest plugin imported jax before this conftest ran:
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 import pytest

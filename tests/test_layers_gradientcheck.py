@@ -1,0 +1,214 @@
+"""Gradient checks — the correctness oracle for every layer type, mirroring
+the reference's gradientcheck suite (CNNGradientCheckTest, BNGradientCheckTest,
+GradientCheckTests...; SURVEY.md §4). Tiny nets, float64, central differences."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, OutputLayer, RnnOutputLayer, ConvolutionLayer,
+    SubsamplingLayer, BatchNormalization, GravesLSTM, LSTM, EmbeddingLayer,
+    GlobalPoolingLayer, ActivationLayer, ZeroPaddingLayer,
+    LocalResponseNormalization, GravesBidirectionalLSTM, AutoEncoder,
+    Convolution1DLayer)
+from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+from deeplearning4j_tpu.ops.dataset import DataSet
+
+
+def _net(layer_list, input_type, seed=42):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).learning_rate(0.1).updater("sgd")
+         .weight_init("xavier").activation("tanh").list())
+    for l in layer_list:
+        b.layer(l)
+    conf = b.set_input_type(input_type).build()
+    return MultiLayerNetwork(conf, compute_dtype=jnp.float64).init()
+
+
+def _onehot(rng, n, c):
+    return np.eye(c)[rng.integers(0, c, n)].astype(np.float64)
+
+
+class TestGradientChecks:
+    def test_dense_mlp(self, rng_np):
+        net = _net([DenseLayer(n_out=5),
+                    OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                   InputType.feed_forward(4))
+        ds = DataSet(rng_np.normal(size=(6, 4)), _onehot(rng_np, 6, 3))
+        assert check_gradients(net, ds)
+
+    def test_dense_mse_sigmoid(self, rng_np):
+        net = _net([DenseLayer(n_out=4, activation="sigmoid"),
+                    OutputLayer(n_out=2, loss="mse", activation="identity")],
+                   InputType.feed_forward(3))
+        ds = DataSet(rng_np.normal(size=(5, 3)),
+                     rng_np.normal(size=(5, 2)))
+        assert check_gradients(net, ds)
+
+    def test_l1_l2_regularization(self, rng_np):
+        b = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.1)
+             .regularization(True).l1(0.01).l2(0.02)
+             .weight_init("xavier").activation("tanh").list())
+        b.layer(DenseLayer(n_out=4))
+        b.layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        conf = b.set_input_type(InputType.feed_forward(3)).build()
+        net = MultiLayerNetwork(conf, compute_dtype=jnp.float64).init()
+        ds = DataSet(rng_np.normal(size=(4, 3)), _onehot(rng_np, 4, 2))
+        assert check_gradients(net, ds)
+
+    def test_cnn(self, rng_np):
+        net = _net([ConvolutionLayer(n_out=3, kernel_size=[3, 3],
+                                     stride=[1, 1], activation="tanh"),
+                    SubsamplingLayer(kernel_size=[2, 2], stride=[2, 2],
+                                     pooling_type="max"),
+                    OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                   InputType.convolutional(8, 8, 1))
+        ds = DataSet(rng_np.normal(size=(3, 8, 8, 1)), _onehot(rng_np, 3, 2))
+        assert check_gradients(net, ds, subsample=80)
+
+    def test_cnn_avg_pool_same_mode(self, rng_np):
+        net = _net([ConvolutionLayer(n_out=2, kernel_size=[3, 3],
+                                     convolution_mode="same"),
+                    SubsamplingLayer(kernel_size=[2, 2], stride=[2, 2],
+                                     pooling_type="avg"),
+                    OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                   InputType.convolutional(6, 6, 2))
+        ds = DataSet(rng_np.normal(size=(3, 6, 6, 2)), _onehot(rng_np, 3, 2))
+        assert check_gradients(net, ds, subsample=80)
+
+    def test_batchnorm_dense(self, rng_np):
+        net = _net([DenseLayer(n_out=5),
+                    BatchNormalization(),
+                    OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                   InputType.feed_forward(4))
+        ds = DataSet(rng_np.normal(size=(8, 4)), _onehot(rng_np, 8, 3))
+        assert check_gradients(net, ds)
+
+    def test_graves_lstm(self, rng_np):
+        net = _net([GravesLSTM(n_out=4),
+                    RnnOutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax")],
+                   InputType.recurrent(2, 5))
+        labels = np.stack([_onehot(rng_np, 5, 3) for _ in range(3)])
+        ds = DataSet(rng_np.normal(size=(3, 5, 2)), labels)
+        assert check_gradients(net, ds, subsample=80)
+
+    def test_lstm_no_peephole(self, rng_np):
+        net = _net([LSTM(n_out=3),
+                    RnnOutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax")],
+                   InputType.recurrent(2, 4))
+        labels = np.stack([_onehot(rng_np, 4, 2) for _ in range(2)])
+        ds = DataSet(rng_np.normal(size=(2, 4, 2)), labels)
+        assert check_gradients(net, ds)
+
+    def test_bidirectional_lstm(self, rng_np):
+        net = _net([GravesBidirectionalLSTM(n_out=3),
+                    RnnOutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax")],
+                   InputType.recurrent(2, 4))
+        labels = np.stack([_onehot(rng_np, 4, 2) for _ in range(2)])
+        ds = DataSet(rng_np.normal(size=(2, 4, 2)), labels)
+        assert check_gradients(net, ds, subsample=80)
+
+    def test_lstm_masked(self, rng_np):
+        net = _net([GravesLSTM(n_out=3),
+                    RnnOutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax")],
+                   InputType.recurrent(2, 5))
+        labels = np.stack([_onehot(rng_np, 5, 2) for _ in range(3)])
+        fmask = np.ones((3, 5))
+        fmask[0, 3:] = 0
+        fmask[2, 2:] = 0
+        ds = DataSet(rng_np.normal(size=(3, 5, 2)), labels,
+                     features_mask=fmask, labels_mask=fmask.copy())
+        assert check_gradients(net, ds, subsample=80)
+
+    def test_global_pooling_rnn(self, rng_np):
+        net = _net([GravesLSTM(n_out=3),
+                    GlobalPoolingLayer(pooling_type="avg"),
+                    OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                   InputType.recurrent(2, 4))
+        ds = DataSet(rng_np.normal(size=(3, 4, 2)), _onehot(rng_np, 3, 2))
+        assert check_gradients(net, ds, subsample=80)
+
+    def test_embedding(self, rng_np):
+        net = _net([EmbeddingLayer(n_in=10, n_out=4, activation="identity"),
+                    OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+                   InputType.feed_forward(10))
+        ids = rng_np.integers(0, 10, (6, 1)).astype(np.float64)
+        ds = DataSet(ids, _onehot(rng_np, 6, 3))
+        assert check_gradients(net, ds, subsample=60)
+
+    def test_conv1d_zeropad_lrn(self, rng_np):
+        net = _net([Convolution1DLayer(n_out=3, kernel_size=[3],
+                                       convolution_mode="same"),
+                    GlobalPoolingLayer(pooling_type="max"),
+                    OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                   InputType.recurrent(2, 6))
+        ds = DataSet(rng_np.normal(size=(3, 6, 2)), _onehot(rng_np, 3, 2))
+        assert check_gradients(net, ds, subsample=60)
+
+    def test_autoencoder_supervised(self, rng_np):
+        net = _net([AutoEncoder(n_out=4, activation="sigmoid"),
+                    OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+                   InputType.feed_forward(5))
+        ds = DataSet(rng_np.normal(size=(4, 5)), _onehot(rng_np, 4, 2))
+        assert check_gradients(net, ds)
+
+
+class TestLayerBehaviors:
+    def test_zeropad_shapes(self, rng_np):
+        layer = ZeroPaddingLayer(pad=[1, 2, 3, 4])
+        x = jnp.asarray(rng_np.normal(size=(2, 5, 6, 3)))
+        y, _ = layer.forward({}, {}, x)
+        assert y.shape == (2, 8, 13, 3)
+        it = layer.get_output_type(InputType.convolutional(5, 6, 3))
+        assert (it.height, it.width) == (8, 13)
+
+    def test_lrn_normalizes(self, rng_np):
+        layer = LocalResponseNormalization()
+        x = jnp.asarray(rng_np.normal(size=(2, 4, 4, 8)))
+        y, _ = layer.forward({}, {}, x)
+        assert y.shape == x.shape
+        assert float(jnp.max(jnp.abs(y))) <= float(jnp.max(jnp.abs(x)))
+
+    def test_batchnorm_running_stats(self, rng_np):
+        layer = BatchNormalization(n_out=4)
+        params = layer.init_params(__import__("jax").random.PRNGKey(0))
+        state = layer.init_state()
+        x = jnp.asarray(rng_np.normal(5.0, 2.0, size=(32, 4)))
+        for _ in range(50):
+            y, state = layer.forward(params, state, x, train=True)
+        # train-mode output is standardized
+        assert abs(float(jnp.mean(y))) < 0.1
+        # running stats converge toward batch stats
+        np.testing.assert_allclose(np.asarray(state["mean"]),
+                                   np.asarray(jnp.mean(x, axis=0)), atol=0.5)
+        y_test, _ = layer.forward(params, state, x, train=False)
+        assert abs(float(jnp.mean(y_test))) < 0.5
+
+    def test_dropout_train_vs_test(self, rng_np):
+        import jax
+        layer = DropoutLayer = None
+        from deeplearning4j_tpu.nn.conf.layers import DropoutLayer
+        d = DropoutLayer(drop_out=0.5)
+        x = jnp.ones((10, 20))
+        y_test, _ = d.forward({}, {}, x, train=False, rng=None)
+        np.testing.assert_allclose(y_test, x)
+        y_train, _ = d.forward({}, {}, x, train=True,
+                               rng=jax.random.PRNGKey(0))
+        kept = np.asarray(y_train) > 0
+        assert 0.2 < kept.mean() < 0.8
+        np.testing.assert_allclose(np.asarray(y_train)[kept], 2.0)
+
+    def test_subsampling_pnorm(self, rng_np):
+        layer = SubsamplingLayer(kernel_size=[2, 2], stride=[2, 2],
+                                 pooling_type="pnorm", pnorm=2)
+        x = jnp.asarray(np.abs(rng_np.normal(size=(1, 4, 4, 1))))
+        y, _ = layer.forward({}, {}, x)
+        manual = np.sqrt(np.sum(np.asarray(x)[0, :2, :2, 0] ** 2))
+        np.testing.assert_allclose(float(y[0, 0, 0, 0]), manual, rtol=1e-5)
