@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip
+(BASELINE.md north-star metric). Runs the full fit() train step — forward,
+backward, updater — as one jitted XLA program on the default backend (the
+real TPU chip under the driver), bf16 compute with f32 params.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` compares against the recorded number in BASELINE.md
+(self-generated: the reference publishes no numbers — SURVEY.md §6). First
+recording ⇒ 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Recorded baseline (images/sec/chip) from the first benched round (r1,
+# 2026-07-29, v5e single chip, bf16, batch 64); update BASELINE.md alongside
+# any change.
+RECORDED_BASELINE = float(os.environ.get("BENCH_BASELINE", "") or 1987.39)
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+IMG = int(os.environ.get("BENCH_IMG", "224"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
+STEPS = int(os.environ.get("BENCH_STEPS", "30"))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import resnet50_conf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.ops.dataset import DataSet
+
+    conf = resnet50_conf(num_classes=1000, height=IMG, width=IMG, channels=3,
+                         updater="nesterovs", learning_rate=0.1)
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
+    # params in f32 for stable updates; activations/backprop run bf16 on MXU
+    net.params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32), net.params)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(BATCH, IMG, IMG, 3)).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, BATCH)]
+    # transfer once; the fit loop then reuses device buffers (the real input
+    # pipeline overlaps transfer via AsyncDataSetIterator)
+    ds = DataSet(jax.device_put(jnp.asarray(X, jnp.bfloat16)),
+                 jax.device_put(jnp.asarray(y, jnp.bfloat16)))
+
+    for _ in range(WARMUP):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    float(net.score_value)               # hard sync of the dispatch chain
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        net.fit_batch(ds)
+    jax.block_until_ready(net.params)
+    float(net.score_value)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * STEPS / dt
+    vs = imgs_per_sec / RECORDED_BASELINE if RECORDED_BASELINE > 0 else 1.0
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""Clustering + space-partitioning trees (reference deeplearning4j-core
+clustering/, 33 files: kmeans, kdtree, vptree, quadtree/sptree for t-SNE;
+SURVEY.md §2.3)."""
+
+from .kmeans import KMeansClustering
+from .trees import KDTree, VPTree
+from .tsne import Tsne
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree", "Tsne"]

@@ -1,0 +1,75 @@
+"""K-means clustering (reference clustering/kmeans/KMeansClustering.java):
+Lloyd's algorithm with k-means++ seeding; the assignment/update iteration is
+one jitted XLA program (distance matrix on the MXU)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(points, centers, k: int):
+    d2 = jnp.sum(points ** 2, 1, keepdims=True) - \
+        2 * points @ centers.T + jnp.sum(centers ** 2, 1)
+    assign = jnp.argmin(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)     # [N, k]
+    counts = jnp.sum(onehot, axis=0)
+    sums = onehot.T @ points
+    new_centers = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts[:, None], 1.0),
+                            centers)
+    cost = jnp.sum(jnp.min(d2, axis=1))
+    return new_centers, assign, cost
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-6,
+                 seed: int = 0):
+        self.k = int(k)
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.centers: Optional[np.ndarray] = None
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100, distance: str = "euclidean",
+              seed: int = 0) -> "KMeansClustering":
+        return KMeansClustering(k, max_iterations, seed=seed)
+
+    def _init_pp(self, points: np.ndarray, rng) -> np.ndarray:
+        """k-means++ seeding."""
+        n = len(points)
+        centers = [points[rng.integers(0, n)]]
+        for _ in range(1, self.k):
+            d2 = np.min([np.sum((points - c) ** 2, axis=1)
+                         for c in centers], axis=0)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(points[rng.choice(n, p=probs)])
+        return np.stack(centers)
+
+    def apply_to(self, points: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Fit; returns (assignments [N], centers [k, D])."""
+        points = np.asarray(points, np.float32)
+        rng = np.random.default_rng(self.seed)
+        centers = jnp.asarray(self._init_pp(points, rng))
+        pts = jnp.asarray(points)
+        last_cost = np.inf
+        assign = None
+        for _ in range(self.max_iterations):
+            centers, assign, cost = _lloyd_step(pts, centers, self.k)
+            cost = float(cost)
+            if abs(last_cost - cost) < self.tol * max(abs(last_cost), 1.0):
+                break
+            last_cost = cost
+        self.centers = np.asarray(centers)
+        return np.asarray(assign), self.centers
+
+    def predict(self, points: np.ndarray) -> np.ndarray:
+        d2 = np.sum((np.asarray(points)[:, None, :] -
+                     self.centers[None]) ** 2, axis=2)
+        return np.argmin(d2, axis=1)

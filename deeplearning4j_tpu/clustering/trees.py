@@ -1,0 +1,141 @@
+"""Space-partitioning trees for nearest-neighbor queries (reference
+clustering/kdtree/KDTree.java and clustering/vptree/VPTree.java — used by
+t-SNE and the nearest-neighbors UI; SURVEY.md §2.3). Host-side structures
+(queries are pointer-chasing, not MXU work)."""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class KDTree:
+    """k-d tree over rows of a point matrix."""
+
+    class _Node:
+        __slots__ = ("idx", "dim", "left", "right")
+
+        def __init__(self, idx, dim):
+            self.idx = idx
+            self.dim = dim
+            self.left = None
+            self.right = None
+
+    def __init__(self, points: np.ndarray):
+        self.points = np.asarray(points, np.float64)
+        idxs = list(range(len(self.points)))
+        self.root = self._build(idxs, 0)
+
+    def _build(self, idxs: List[int], depth: int):
+        if not idxs:
+            return None
+        dim = depth % self.points.shape[1]
+        idxs.sort(key=lambda i: self.points[i, dim])
+        mid = len(idxs) // 2
+        node = KDTree._Node(idxs[mid], dim)
+        node.left = self._build(idxs[:mid], depth + 1)
+        node.right = self._build(idxs[mid + 1:], depth + 1)
+        return node
+
+    def nn(self, query: np.ndarray) -> Tuple[int, float]:
+        best = [(-1, np.inf)]
+
+        def visit(node):
+            if node is None:
+                return
+            p = self.points[node.idx]
+            d = float(np.sum((p - query) ** 2))
+            if d < best[0][1]:
+                best[0] = (node.idx, d)
+            diff = query[node.dim] - p[node.dim]
+            near, far = (node.left, node.right) if diff < 0 else \
+                (node.right, node.left)
+            visit(near)
+            if diff * diff < best[0][1]:
+                visit(far)
+
+        visit(self.root)
+        return best[0][0], float(np.sqrt(best[0][1]))
+
+    def knn(self, query: np.ndarray, k: int) -> List[Tuple[int, float]]:
+        heap: List[Tuple[float, int]] = []   # max-heap by -dist
+
+        def visit(node):
+            if node is None:
+                return
+            p = self.points[node.idx]
+            d = float(np.sum((p - query) ** 2))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.idx))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.idx))
+            diff = query[node.dim] - p[node.dim]
+            near, far = (node.left, node.right) if diff < 0 else \
+                (node.right, node.left)
+            visit(near)
+            if len(heap) < k or diff * diff < -heap[0][0]:
+                visit(far)
+
+        visit(self.root)
+        out = [(i, float(np.sqrt(-d))) for d, i in heap]
+        return sorted(out, key=lambda t: t[1])
+
+
+class VPTree:
+    """Vantage-point tree (metric tree; reference VPTree used by
+    words-nearest queries)."""
+
+    class _Node:
+        __slots__ = ("idx", "radius", "inside", "outside")
+
+        def __init__(self, idx):
+            self.idx = idx
+            self.radius = 0.0
+            self.inside = None
+            self.outside = None
+
+    def __init__(self, points: np.ndarray, seed: int = 0):
+        self.points = np.asarray(points, np.float64)
+        rng = np.random.default_rng(seed)
+        self.root = self._build(list(range(len(self.points))), rng)
+
+    def _dist(self, a: int, q) -> float:
+        return float(np.linalg.norm(self.points[a] - q))
+
+    def _build(self, idxs: List[int], rng):
+        if not idxs:
+            return None
+        vp = idxs[rng.integers(0, len(idxs))] if len(idxs) > 1 else idxs[0]
+        rest = [i for i in idxs if i != vp]
+        node = VPTree._Node(vp)
+        if not rest:
+            return node
+        dists = [self._dist(i, self.points[vp]) for i in rest]
+        node.radius = float(np.median(dists))
+        inside = [i for i, d in zip(rest, dists) if d <= node.radius]
+        outside = [i for i, d in zip(rest, dists) if d > node.radius]
+        node.inside = self._build(inside, rng)
+        node.outside = self._build(outside, rng)
+        return node
+
+    def knn(self, query: np.ndarray, k: int) -> List[Tuple[int, float]]:
+        heap: List[Tuple[float, int]] = []
+
+        def visit(node):
+            if node is None:
+                return
+            d = self._dist(node.idx, query)
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.idx))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.idx))
+            tau = -heap[0][0] if len(heap) == k else np.inf
+            if node.inside is not None and d - tau <= node.radius:
+                visit(node.inside)
+            if node.outside is not None and d + tau > node.radius:
+                visit(node.outside)
+
+        visit(self.root)
+        return sorted([(i, -d) for d, i in heap], key=lambda t: t[1])

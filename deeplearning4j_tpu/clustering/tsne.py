@@ -1,0 +1,140 @@
+"""t-SNE embedding (reference plot/Tsne.java + plot/BarnesHutTsne.java (853
+LoC) — used for UI word-vector visualization; SURVEY.md §2.3).
+
+TPU-first: instead of the Barnes-Hut quadtree approximation (a pointer-chasing
+CPU structure), the exact O(N²) gradient runs as one jitted XLA program —
+dense [N, N] affinity algebra on the MXU, which for the N ≤ ~20k points a
+visualization uses is faster on accelerator than BH on host. Perplexity
+calibration by binary search, early exaggeration, momentum + gain adaptation
+per the original implementation."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _cond_probs(D2_row, beta):
+    p = jnp.exp(-D2_row * beta)
+    return p
+
+
+def _perplexity_search(D2: np.ndarray, perplexity: float,
+                       tol: float = 1e-5, max_tries: int = 50) -> np.ndarray:
+    """Per-point binary search for beta = 1/(2σ²) hitting the target
+    perplexity (reference Tsne d2p / computeGaussianPerplexity)."""
+    n = D2.shape[0]
+    P = np.zeros((n, n))
+    log_u = np.log(perplexity)
+    for i in range(n):
+        beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+        row = D2[i].copy()
+        row[i] = np.inf
+        for _ in range(max_tries):
+            p = np.exp(-row * beta)
+            sum_p = max(p.sum(), 1e-12)
+            h = np.log(sum_p) + beta * np.sum(row[np.isfinite(row)] *
+                                              p[np.isfinite(row)]) / sum_p
+            diff = h - log_u
+            if abs(diff) < tol:
+                break
+            if diff > 0:
+                beta_min = beta
+                beta = beta * 2 if beta_max == np.inf else \
+                    (beta + beta_max) / 2
+            else:
+                beta_max = beta
+                beta = beta / 2 if beta_min == -np.inf else \
+                    (beta + beta_min) / 2
+        P[i] = np.exp(-row * beta)
+        P[i, i] = 0
+        P[i] /= max(P[i].sum(), 1e-12)
+    return P
+
+
+@jax.jit
+def _tsne_grad(Y, P):
+    D2 = jnp.sum(Y ** 2, 1, keepdims=True) - 2 * Y @ Y.T + \
+        jnp.sum(Y ** 2, 1)
+    num = 1.0 / (1.0 + D2)
+    num = num * (1 - jnp.eye(Y.shape[0]))
+    Q = num / jnp.maximum(jnp.sum(num), 1e-12)
+    PQ = (P - jnp.maximum(Q, 1e-12)) * num
+    grad = 4.0 * (jnp.diag(jnp.sum(PQ, axis=1)) - PQ) @ Y
+    kl = jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12) /
+                             jnp.maximum(Q, 1e-12)))
+    return grad, kl
+
+
+class Tsne:
+    """Builder-compatible t-SNE (reference BarnesHutTsne.Builder surface)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, n_iter: int = 500,
+                 early_exaggeration: float = 12.0, momentum: float = 0.8,
+                 seed: int = 42):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.early_exaggeration = early_exaggeration
+        self.momentum = momentum
+        self.seed = seed
+        self.kl_divergence_: Optional[float] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def perplexity(self, p):
+            self._kw["perplexity"] = float(p)
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = float(lr)
+            return self
+
+        def set_max_iter(self, n):
+            self._kw["n_iter"] = int(n)
+            return self
+
+        def theta(self, t):
+            return self   # BH approximation knob: exact impl ignores
+
+        def build(self) -> "Tsne":
+            return Tsne(**self._kw)
+
+    def calculate(self, X: np.ndarray) -> np.ndarray:
+        """Embed rows of X → [N, n_components] (reference BarnesHutTsne.fit)."""
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        D2 = np.sum(X ** 2, 1, keepdims=True) - 2 * X @ X.T + np.sum(X ** 2, 1)
+        P = _perplexity_search(D2, min(self.perplexity, (n - 1) / 3.0))
+        P = (P + P.T) / (2.0 * n)
+        P = np.maximum(P, 1e-12)
+
+        rng = np.random.default_rng(self.seed)
+        Y = jnp.asarray(rng.normal(0, 1e-4, (n, self.n_components)))
+        Pj = jnp.asarray(P)
+        vel = jnp.zeros_like(Y)
+        gains = jnp.ones_like(Y)
+        exag_until = min(100, self.n_iter // 4)
+        kl = None
+        for it in range(self.n_iter):
+            Puse = Pj * self.early_exaggeration if it < exag_until else Pj
+            grad, kl = _tsne_grad(Y, Puse)
+            gains = jnp.where(jnp.sign(grad) != jnp.sign(vel),
+                              gains + 0.2, gains * 0.8)
+            gains = jnp.maximum(gains, 0.01)
+            mom = 0.5 if it < 20 else self.momentum
+            vel = mom * vel - self.learning_rate * gains * grad
+            Y = Y + vel
+            Y = Y - jnp.mean(Y, axis=0)
+        self.kl_divergence_ = float(kl)
+        return np.asarray(Y)
+
+    fit_transform = calculate

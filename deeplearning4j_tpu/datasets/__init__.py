@@ -6,8 +6,17 @@ from .iterators import (DataSetIterator, ListDataSetIterator,
                         MultipleEpochsIterator, SamplingDataSetIterator,
                         as_iterator)
 from .mnist import MnistDataSetIterator, IrisDataSetIterator
+from .datavec import (RecordReader, CSVRecordReader, CollectionRecordReader,
+                      CollectionSequenceRecordReader,
+                      RecordReaderDataSetIterator,
+                      SequenceRecordReaderDataSetIterator,
+                      RecordReaderMultiDataSetIterator)
 
 __all__ = ["DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
            "AsyncDataSetIterator", "MultipleEpochsIterator",
            "SamplingDataSetIterator", "as_iterator", "MnistDataSetIterator",
-           "IrisDataSetIterator"]
+           "IrisDataSetIterator", "RecordReader", "CSVRecordReader",
+           "CollectionRecordReader", "CollectionSequenceRecordReader",
+           "RecordReaderDataSetIterator",
+           "SequenceRecordReaderDataSetIterator",
+           "RecordReaderMultiDataSetIterator"]

@@ -90,12 +90,27 @@ class ArrayDataSetIterator(DataSetIterator):
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch with a bounded queue (reference
-    AsyncDataSetIterator; queue depth = ``prefetch``)."""
+    AsyncDataSetIterator; queue depth = ``prefetch``). With
+    ``device_put=True`` (default) the producer thread also starts the
+    host→device transfer, so the next batch's DMA overlaps the current
+    train step."""
     async_supported = False  # don't double-wrap
 
-    def __init__(self, source: DataSetIterator, prefetch: int = 2):
+    def __init__(self, source: DataSetIterator, prefetch: int = 2,
+                 device_put: bool = True):
         self.source = source
         self.prefetch = max(1, int(prefetch))
+        self.device_put = device_put
+
+    @staticmethod
+    def _to_device(ds: DataSet) -> DataSet:
+        try:
+            import jax
+            put = lambda a: None if a is None else jax.device_put(a)
+            return DataSet(put(ds.features), put(ds.labels),
+                           put(ds.features_mask), put(ds.labels_mask))
+        except Exception:
+            return ds   # multi-device/odd-backend cases: defer to the step
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -105,7 +120,7 @@ class AsyncDataSetIterator(DataSetIterator):
         def producer():
             try:
                 for ds in self.source:
-                    q.put(ds)
+                    q.put(self._to_device(ds) if self.device_put else ds)
             except BaseException as e:  # surfaced on the consumer side
                 err.append(e)
             finally:

@@ -137,13 +137,12 @@ class LocalFileModelSaver:
         ModelSerializer.write_model(net, self.dir / "latestModel.zip")
 
     def get_best_model(self):
-        from ..utils.serializer import ModelSerializer
-        return ModelSerializer.restore_multi_layer_network(
-            self.dir / "bestModel.zip")
+        from ..utils.serializer import ModelGuesser
+        return ModelGuesser.load_model_guess_type(self.dir / "bestModel.zip")
 
     def get_latest_model(self):
-        from ..utils.serializer import ModelSerializer
-        return ModelSerializer.restore_multi_layer_network(
+        from ..utils.serializer import ModelGuesser
+        return ModelGuesser.load_model_guess_type(
             self.dir / "latestModel.zip")
 
 
@@ -179,10 +178,15 @@ class EarlyStoppingTrainer:
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        if not cfg.epoch_terminations and not cfg.iteration_terminations:
+            raise ValueError("EarlyStoppingConfiguration needs at least one "
+                             "termination condition (the loop would never "
+                             "exit)")
         best_score, best_epoch = math.inf, -1
         scores = {}
         epoch = 0
         reason, details = "MaxEpochs", ""
+        score = math.inf
         while True:
             stop_iter = False
             from ..datasets.iterators import as_iterator
@@ -204,22 +208,24 @@ class EarlyStoppingTrainer:
                 break
             if epoch % cfg.evaluate_every_n_epochs == 0:
                 score = cfg.score_calculator.calculate_score(self.net) \
-                    if cfg.score_calculator else self.net.score_value
+                    if cfg.score_calculator else float(self.net.score_value)
                 scores[epoch] = score
                 if score < best_score:
                     best_score, best_epoch = score, epoch
                     cfg.model_saver.save_best_model(self.net, score)
                 if cfg.save_last_model:
                     cfg.model_saver.save_latest_model(self.net, score)
-                terminated = False
-                for cond in cfg.epoch_terminations:
-                    if cond.terminate(epoch, score, best_score):
-                        reason = "EpochTermination"
-                        details = type(cond).__name__
-                        terminated = True
-                        break
-                if terminated:
+            # epoch terminations run EVERY epoch (with the latest known
+            # score), matching the reference — not only on eval epochs
+            terminated = False
+            for cond in cfg.epoch_terminations:
+                if cond.terminate(epoch, score, best_score):
+                    reason = "EpochTermination"
+                    details = type(cond).__name__
+                    terminated = True
                     break
+            if terminated:
+                break
             epoch += 1
         best = cfg.model_saver.get_best_model() or self.net
         return EarlyStoppingResult(

@@ -1,0 +1,8 @@
+"""Evaluation suite (reference eval/, 11 classes; SURVEY.md §2.1)."""
+
+from .evaluation import Evaluation
+from .regression import RegressionEvaluation
+from .roc import ROC, ROCBinary, ROCMultiClass, EvaluationBinary
+
+__all__ = ["Evaluation", "RegressionEvaluation", "ROC", "ROCBinary",
+           "ROCMultiClass", "EvaluationBinary"]

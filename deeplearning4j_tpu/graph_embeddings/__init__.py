@@ -1,0 +1,9 @@
+"""Graph embeddings (reference deeplearning4j-graph; SURVEY.md §2.6):
+IGraph API, random walks, DeepWalk trainer, GraphVectors serialization."""
+
+from .graph import Graph, Vertex, Edge
+from .walks import RandomWalkIterator, WeightedWalkIterator
+from .deepwalk import DeepWalk, GraphVectorSerializer
+
+__all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
+           "WeightedWalkIterator", "DeepWalk", "GraphVectorSerializer"]

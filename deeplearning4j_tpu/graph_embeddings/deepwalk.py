@@ -1,0 +1,162 @@
+"""DeepWalk graph embeddings (reference graph/models/deepwalk/DeepWalk.java
+(254 LoC) — skip-gram with hierarchical softmax over random walks, with the
+Huffman coding built from VERTEX DEGREES (GraphHuffman.java:36-39);
+SURVEY.md §2.6).
+
+Reuses the batched jitted skip-gram HS step from nlp/skipgram.py — same
+aggregate op, different corpus."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nlp.huffman import build_huffman
+from ..nlp.skipgram import skipgram_hs_step, generate_skipgram_pairs
+from .graph import Graph
+from .walks import RandomWalkIterator
+
+
+class DeepWalk:
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def vector_size(self, n):
+            self._kw["vector_size"] = int(n)
+            return self
+
+        def window_size(self, n):
+            self._kw["window"] = int(n)
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = float(lr)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(**self._kw)
+
+    def __init__(self, vector_size: int = 100, window: int = 5,
+                 learning_rate: float = 0.025, batch_size: int = 2048,
+                 seed: int = 42):
+        self.vector_size = vector_size
+        self.window = window
+        self.learning_rate = learning_rate
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vertex_vectors = None
+        self._syn1 = None
+        self._codes = self._points = self._lengths = None
+
+    def initialize(self, graph: Graph):
+        """Build degree-based Huffman coding (GraphHuffman parity) + tables."""
+        degrees = [max(graph.degree(i), 1)
+                   for i in range(graph.num_vertices())]
+        codes, points = build_huffman(degrees)
+        L = max(len(c) for c in codes)
+        V = graph.num_vertices()
+        carr = np.zeros((V, L), np.float32)
+        parr = np.zeros((V, L), np.int32)
+        larr = np.zeros(V, np.int32)
+        for i in range(V):
+            l = len(codes[i])
+            carr[i, :l] = codes[i]
+            parr[i, :l] = points[i]
+            larr[i] = l
+        self._codes = jnp.asarray(carr)
+        self._points = jnp.asarray(parr)
+        self._lengths = jnp.asarray(larr)
+        rng = np.random.default_rng(self.seed)
+        self.vertex_vectors = jnp.asarray(
+            (rng.random((V, self.vector_size)) - 0.5) / self.vector_size,
+            jnp.float32)
+        self._syn1 = jnp.zeros((max(V - 1, 1), self.vector_size), jnp.float32)
+        return self
+
+    def fit(self, graph: Graph, walk_length: int = 40, walks_per_vertex: int = 1):
+        if self.vertex_vectors is None:
+            self.initialize(graph)
+        for rep in range(walks_per_vertex):
+            it = RandomWalkIterator(graph, walk_length,
+                                    seed=self.seed + rep)
+            self.fit_walks(it)
+        return self
+
+    def fit_walks(self, walks: Iterable[List[int]]):
+        rng = np.random.default_rng(self.seed)
+        buf_c, buf_t = [], []
+        for walk in walks:
+            c, t = generate_skipgram_pairs(np.asarray(walk, np.int32),
+                                           self.window, rng)
+            if len(c):
+                buf_c.append(c)
+                buf_t.append(t)
+            if sum(len(x) for x in buf_c) >= self.batch_size:
+                self._flush(np.concatenate(buf_c), np.concatenate(buf_t))
+                buf_c, buf_t = [], []
+        if buf_c:
+            self._flush(np.concatenate(buf_c), np.concatenate(buf_t))
+        return self
+
+    def _flush(self, centers, targets):
+        B = self.batch_size
+        for i in range(0, len(centers), B):
+            c, t = centers[i:i + B], targets[i:i + B]
+            if len(c) < B:
+                pad = B - len(c)
+                c = np.concatenate([c, np.zeros(pad, np.int32)])
+                t = np.concatenate([t, np.zeros(pad, np.int32)])
+            cj, tj = jnp.asarray(c), jnp.asarray(t)
+            self.vertex_vectors, self._syn1, self._loss = skipgram_hs_step(
+                self.vertex_vectors, self._syn1, cj, tj, self._codes[tj],
+                self._points[tj], self._lengths[tj],
+                jnp.float32(self.learning_rate))
+
+    # --- GraphVectors query surface (reference models/embeddings) ---
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        return np.asarray(self.vertex_vectors[idx])
+
+    def similarity(self, a: int, b: int) -> float:
+        va, vb = self.get_vertex_vector(a), self.get_vertex_vector(b)
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0
+
+    def verticies_nearest(self, idx: int, n: int = 10) -> List[int]:
+        v = self.get_vertex_vector(idx)
+        all_v = np.asarray(self.vertex_vectors)
+        sims = all_v @ v / np.maximum(
+            np.linalg.norm(all_v, axis=1) * np.linalg.norm(v), 1e-12)
+        sims[idx] = -np.inf
+        return [int(i) for i in np.argsort(-sims)[:n]]
+
+
+class GraphVectorSerializer:
+    """reference models/loader/GraphVectorSerializer: vertex-id + vector rows."""
+
+    @staticmethod
+    def write_graph_vectors(model: DeepWalk, path):
+        with open(path, "w", encoding="utf-8") as f:
+            all_v = np.asarray(model.vertex_vectors)
+            for i in range(all_v.shape[0]):
+                f.write(f"{i} " + " ".join(f"{x:.6f}" for x in all_v[i])
+                        + "\n")
+
+    @staticmethod
+    def load_graph_vectors(path) -> np.ndarray:
+        rows = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                rows.append((int(parts[0]),
+                             np.array([float(x) for x in parts[1:]],
+                                      np.float32)))
+        rows.sort(key=lambda r: r[0])
+        return np.stack([v for _, v in rows])

@@ -1,0 +1,7 @@
+"""Keras HDF5 model import (reference deeplearning4j-modelimport; SURVEY.md §2.7)."""
+
+from .importer import KerasModelImport
+from .layers import KerasLayerError, convert_layer, convert_vertex
+
+__all__ = ["KerasModelImport", "KerasLayerError", "convert_layer",
+           "convert_vertex"]

@@ -1,0 +1,256 @@
+"""Keras HDF5 model import (reference deeplearning4j-modelimport:
+KerasModelImport.java:48-172 entry points, KerasModel.java config parsing,
+KerasLayer.java:47-69 string-keyed layer registry, Hdf5Archive.java traversal;
+SURVEY.md §2.7, §3.6).
+
+h5py replaces the JavaCPP hdf5 preset. Supports Keras 1.x (param_0.. layout,
+th/tf dim ordering) and 2.x (model_weights/<layer>/<weight_names>):
+
+- Sequential config  → MultiLayerConfiguration → MultiLayerNetwork
+- functional Model   → ComputationGraphConfiguration → ComputationGraph
+
+Layout note: this framework is natively NHWC (the Keras/TF convention), so
+conv kernels (HWIO) and dense weights map with NO transposition — unlike the
+reference, which must permute into NCHW. Theano-ordered (th) kernels are
+flipped/transposed to HWIO on load, the analog of the reference's
+dim-ordering preprocessors."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.conf.config import (NeuralNetConfiguration,
+                              MultiLayerConfiguration)
+from ..nn.conf.input_type import InputType
+from ..nn.multilayer import MultiLayerNetwork
+from ..nn.graph.computation_graph import ComputationGraph
+from .layers import (KERAS_LAYER_CONVERTERS, convert_layer, KerasLayerError,
+                     map_weights)
+
+
+def _read_json_attr(obj, name: str):
+    if name not in obj.attrs:
+        return None
+    raw = obj.attrs[name]
+    if isinstance(raw, bytes):
+        raw = raw.decode("utf-8")
+    return json.loads(raw)
+
+
+class KerasModelImport:
+    """Static entry points (reference KerasModelImport.java)."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path,
+                                                  enforce_training_config:
+                                                  bool = False):
+        return _import(path, expect="Sequential")
+
+    @staticmethod
+    def import_keras_model_and_weights(path,
+                                       enforce_training_config: bool = False):
+        return _import(path, expect=None)
+
+    @staticmethod
+    def import_keras_model_configuration(path):
+        net = _import(path, expect=None, load_weights=False)
+        return net.conf
+
+
+def _import(path, expect: Optional[str], load_weights: bool = True):
+    import h5py
+    with h5py.File(path, "r") as f:
+        model_config = _read_json_attr(f, "model_config")
+        if model_config is None:
+            raise KerasLayerError(f"No model_config attribute in {path}")
+        cls = model_config.get("class_name")
+        if expect and cls != expect:
+            raise KerasLayerError(f"Expected {expect} model, got {cls}")
+        if cls == "Sequential":
+            net = _build_sequential(model_config)
+        elif cls in ("Model", "Functional"):
+            net = _build_functional(model_config)
+        else:
+            raise KerasLayerError(f"Unsupported Keras model class {cls}")
+        if load_weights:
+            _load_weights(f, net)
+    return net
+
+
+def _layer_list(model_config) -> List[dict]:
+    cfg = model_config["config"]
+    return cfg["layers"] if isinstance(cfg, dict) else cfg
+
+
+def _input_type_from_shape(shape) -> InputType:
+    """batch_input_shape (without batch dim) → InputType."""
+    dims = [d for d in shape if d is not None]
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    return InputType.feed_forward(dims[0] if dims else 0)
+
+
+def _build_sequential(model_config) -> MultiLayerNetwork:
+    layers_cfg = _layer_list(model_config)
+    builder = (NeuralNetConfiguration.Builder().activation("identity")
+               .weight_init("xavier").list())
+    input_type = None
+    keras_names: List[Tuple[str, str, int]] = []   # (keras name, class, our idx)
+    idx = 0
+    for lc in layers_cfg:
+        cls = lc["class_name"]
+        conf = lc["config"]
+        if input_type is None:
+            shape = conf.get("batch_input_shape") or \
+                conf.get("batch_shape")
+            if shape is not None:
+                input_type = _input_type_from_shape(shape[1:])
+        if cls == "InputLayer":
+            continue
+        converted = convert_layer(cls, conf)
+        if converted is None:
+            continue        # shape-only layers (Flatten/Reshape) handled by
+            # the auto-preprocessor system
+        builder.layer(converted)
+        keras_names.append((conf.get("name", cls), cls, idx))
+        idx += 1
+    if input_type is not None:
+        builder.set_input_type(input_type)
+    conf = builder.build()
+    net = MultiLayerNetwork(conf).init()
+    net._keras_layer_map = keras_names
+    return net
+
+
+def _build_functional(model_config) -> ComputationGraph:
+    cfg = model_config["config"]
+    layers_cfg = cfg["layers"]
+    g = (NeuralNetConfiguration.Builder().activation("identity")
+         .weight_init("xavier").graph_builder())
+    input_names = []
+    input_types = []
+    keras_names = []
+    for lc in layers_cfg:
+        cls = lc["class_name"]
+        conf = lc["config"]
+        name = conf.get("name") or lc.get("name")
+        inbound = lc.get("inbound_nodes") or []
+        in_names = []
+        if inbound:
+            node = inbound[0]
+            if isinstance(node, dict):      # keras 3 style
+                args = node.get("args", [])
+                def walk(a):
+                    if isinstance(a, dict) and "config" in a and \
+                            "keras_history" in a.get("config", {}):
+                        in_names.append(a["config"]["keras_history"][0])
+                    elif isinstance(a, (list, tuple)):
+                        for x in a:
+                            walk(x)
+                walk(args)
+            else:
+                for entry in node:
+                    in_names.append(entry[0])
+        if cls == "InputLayer":
+            input_names.append(name)
+            shape = conf.get("batch_input_shape") or conf.get("batch_shape")
+            input_types.append(_input_type_from_shape(shape[1:]))
+            continue
+        from .layers import convert_vertex
+        vertex = convert_vertex(cls, conf)
+        if vertex is not None:
+            g.add_vertex(name, vertex, *in_names)
+            continue
+        converted = convert_layer(cls, conf)
+        if converted is None:
+            # shape-only: represent as identity preprocessor vertex
+            from ..nn.graph.vertices import PreprocessorVertex
+            from ..nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+            if cls in ("Flatten", "Reshape", "GlobalAveragePooling2D"):
+                g.add_vertex(name,
+                             PreprocessorVertex(
+                                 preprocessor=CnnToFeedForwardPreProcessor()),
+                             *in_names)
+            continue
+        g.add_layer(name, converted, *in_names)
+        keras_names.append((name, cls, name))
+    g.add_inputs(*input_names)
+    outs = []
+    out_cfg = cfg.get("output_layers", [])
+    for o in out_cfg:
+        outs.append(o[0] if isinstance(o, (list, tuple)) else o)
+    g.set_outputs(*outs)
+    g.set_input_types(*input_types)
+    net = ComputationGraph(g.build()).init()
+    net._keras_layer_map = keras_names
+    return net
+
+
+def _weight_group(f):
+    import h5py
+    if "model_weights" in f:
+        return f["model_weights"]
+    return f
+
+
+def _layer_weights(group, keras_name: str) -> List[np.ndarray]:
+    """Weight arrays for one Keras layer, in stored order (2.x weight_names
+    attr, or 1.x param_N order)."""
+    if keras_name not in group:
+        return []
+    lg = group[keras_name]
+    if "weight_names" in lg.attrs:
+        names = [n.decode() if isinstance(n, bytes) else n
+                 for n in lg.attrs["weight_names"]]
+        out = []
+        for n in names:
+            node = lg
+            for part in n.split("/"):
+                if part in node:
+                    node = node[part]
+            out.append(np.asarray(node))
+        return out
+    keys = sorted(lg.keys(),
+                  key=lambda k: int(k.split("_")[-1]) if "_" in k and
+                  k.split("_")[-1].isdigit() else 0)
+    out = []
+    for k in keys:
+        node = lg[k]
+        if hasattr(node, "keys"):
+            for kk in node.keys():
+                out.append(np.asarray(node[kk]))
+        else:
+            out.append(np.asarray(node))
+    return out
+
+
+def _load_weights(f, net):
+    group = _weight_group(f)
+    if isinstance(net, MultiLayerNetwork):
+        for keras_name, cls, idx in net._keras_layer_map:
+            arrays = _layer_weights(group, keras_name)
+            if not arrays:
+                continue
+            params = map_weights(cls, net.layers[idx], arrays)
+            if params:
+                p, state_update = params
+                net.params[idx].update(p)
+                if state_update:
+                    net.state[idx].update(state_update)
+    else:
+        for keras_name, cls, vname in net._keras_layer_map:
+            arrays = _layer_weights(group, keras_name)
+            if not arrays:
+                continue
+            v = net.conf.vertices[vname]
+            params = map_weights(cls, v.layer, arrays)
+            if params:
+                p, state_update = params
+                net.params[vname].update(p)
+                if state_update:
+                    net.state[vname].update(state_update)

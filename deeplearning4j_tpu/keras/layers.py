@@ -1,0 +1,221 @@
+"""Keras layer → framework layer conversion + weight mapping (reference
+KerasLayer.java:47-69 registry and the per-layer subclasses in
+modelimport/keras/layers/ (14 classes); SURVEY.md §2.7).
+
+Supported set mirrors the reference: Dense, Conv1D/2D, MaxPooling/
+AveragePooling1D/2D, GlobalMax/AveragePooling1D/2D, BatchNormalization,
+Embedding, LSTM, Dropout, Activation, Flatten (via preprocessor inference),
+ZeroPadding2D, Merge/Add/Concatenate (graph), TimeDistributed(Dense).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.conf.layers import (DenseLayer, OutputLayer, ConvolutionLayer,
+                              Convolution1DLayer, SubsamplingLayer,
+                              Subsampling1DLayer, BatchNormalization,
+                              ActivationLayer, DropoutLayer, EmbeddingLayer,
+                              GlobalPoolingLayer, ZeroPaddingLayer, LSTM,
+                              GravesLSTM)
+from ..nn.graph.vertices import MergeVertex, ElementWiseVertex
+
+
+class KerasLayerError(ValueError):
+    pass
+
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid",
+    "tanh": "tanh", "softmax": "softmax", "softplus": "softplus",
+    "softsign": "softsign", "elu": "elu", "selu": "selu",
+    "hard_sigmoid": "hardsigmoid", "swish": "swish", "gelu": "gelu",
+    "leaky_relu": "leakyrelu", "exponential": "identity",
+}
+
+
+def _act(conf, default="identity") -> str:
+    a = conf.get("activation", default)
+    if isinstance(a, dict):
+        a = a.get("config", {}).get("activation", default) \
+            if "config" in a else default
+    return _ACTIVATIONS.get(a, a or default)
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+def _padding_mode(conf) -> str:
+    return "same" if conf.get("padding", conf.get("border_mode",
+                                                  "valid")) == "same" \
+        else "truncate"
+
+
+def convert_layer(cls: str, conf: dict):
+    """Keras layer config → framework layer conf, or None for shape-only
+    layers the preprocessor system absorbs. Raises on unsupported types."""
+    units = conf.get("units", conf.get("output_dim", 0))
+    if cls in ("Dense", "TimeDistributed"):
+        if cls == "TimeDistributed":
+            inner = conf.get("layer", {})
+            if inner.get("class_name") != "Dense":
+                raise KerasLayerError("TimeDistributed supports Dense only")
+            conf = inner["config"]
+            units = conf.get("units", conf.get("output_dim", 0))
+        return DenseLayer(n_out=int(units), activation=_act(conf))
+    if cls in ("Conv2D", "Convolution2D"):
+        ks = _pair(conf.get("kernel_size") or
+                   [conf.get("nb_row", 3), conf.get("nb_col", 3)])
+        return ConvolutionLayer(
+            n_out=int(conf.get("filters", conf.get("nb_filter", 0))),
+            kernel_size=ks, stride=_pair(conf.get("strides", [1, 1])),
+            convolution_mode=_padding_mode(conf),
+            has_bias=bool(conf.get("use_bias", True)),
+            activation=_act(conf))
+    if cls in ("Conv1D", "Convolution1D"):
+        k = conf.get("kernel_size", conf.get("filter_length", 3))
+        k = k[0] if isinstance(k, (list, tuple)) else k
+        s = conf.get("strides", conf.get("subsample_length", 1))
+        s = s[0] if isinstance(s, (list, tuple)) else s
+        return Convolution1DLayer(
+            n_out=int(conf.get("filters", conf.get("nb_filter", 0))),
+            kernel_size=[int(k)], stride=[int(s)],
+            convolution_mode=_padding_mode(conf),
+            has_bias=bool(conf.get("use_bias", True)),
+            activation=_act(conf))
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        return SubsamplingLayer(
+            kernel_size=_pair(conf.get("pool_size", [2, 2])),
+            stride=_pair(conf.get("strides") or conf.get("pool_size", [2, 2])),
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            convolution_mode=_padding_mode(conf))
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        p = conf.get("pool_size", conf.get("pool_length", 2))
+        p = p[0] if isinstance(p, (list, tuple)) else p
+        s = conf.get("strides") or p
+        s = s[0] if isinstance(s, (list, tuple)) else s
+        return Subsampling1DLayer(
+            kernel_size=[int(p)], stride=[int(s)],
+            pooling_type="max" if cls.startswith("Max") else "avg",
+            convolution_mode=_padding_mode(conf))
+    if cls in ("GlobalMaxPooling1D", "GlobalMaxPooling2D"):
+        return GlobalPoolingLayer(pooling_type="max")
+    if cls in ("GlobalAveragePooling1D", "GlobalAveragePooling2D"):
+        return GlobalPoolingLayer(pooling_type="avg")
+    if cls == "BatchNormalization":
+        return BatchNormalization(
+            eps=float(conf.get("epsilon", 1e-3)),
+            decay=float(conf.get("momentum", 0.99)))
+    if cls == "Activation":
+        return ActivationLayer(activation=_act(conf))
+    if cls == "LeakyReLU":
+        return ActivationLayer(activation="leakyrelu")
+    if cls == "Dropout":
+        # Keras rate = drop probability; ours = retention probability
+        return DropoutLayer(drop_out=1.0 - float(conf.get("rate",
+                                                          conf.get("p", 0.5))))
+    if cls in ("SpatialDropout1D", "SpatialDropout2D"):
+        return DropoutLayer(drop_out=1.0 - float(conf.get("rate", 0.5)))
+    if cls == "Embedding":
+        return EmbeddingLayer(
+            n_in=int(conf.get("input_dim", 0)),
+            n_out=int(conf.get("output_dim", 0)),
+            activation="identity")
+    if cls == "LSTM":
+        inner = _ACTIVATIONS.get(conf.get("inner_activation",
+                                          conf.get("recurrent_activation",
+                                                   "sigmoid")), "sigmoid")
+        return LSTM(n_out=int(units), activation=_act(conf, "tanh"),
+                    gate_activation=inner)
+    if cls == "ZeroPadding2D":
+        pad = conf.get("padding", [[0, 0], [0, 0]])
+        if isinstance(pad, int):
+            p4 = [pad] * 4
+        elif isinstance(pad[0], (list, tuple)):
+            p4 = [pad[0][0], pad[0][1], pad[1][0], pad[1][1]]
+        else:
+            p4 = [pad[0], pad[0], pad[1], pad[1]]
+        return ZeroPaddingLayer(pad=[int(p) for p in p4])
+    if cls in ("Flatten", "Reshape", "InputLayer", "Permute",
+               "RepeatVector", "Masking"):
+        return None     # shape plumbing — preprocessors handle it
+    raise KerasLayerError(f"Unsupported Keras layer type: {cls}")
+
+
+def convert_vertex(cls: str, conf: dict):
+    """Graph-only Keras layers → vertices."""
+    if cls in ("Add", "add"):
+        return ElementWiseVertex(op="add")
+    if cls in ("Subtract",):
+        return ElementWiseVertex(op="subtract")
+    if cls in ("Multiply",):
+        return ElementWiseVertex(op="product")
+    if cls in ("Average",):
+        return ElementWiseVertex(op="average")
+    if cls in ("Maximum",):
+        return ElementWiseVertex(op="max")
+    if cls in ("Concatenate", "Merge"):
+        mode = conf.get("mode", "concat")
+        if cls == "Merge" and mode in ("sum", "ave", "mul", "max"):
+            return ElementWiseVertex(op={"sum": "add", "ave": "average",
+                                         "mul": "product",
+                                         "max": "max"}[mode])
+        return MergeVertex()
+    return None
+
+
+def _to_jnp(a):
+    import jax.numpy as jnp
+    return jnp.asarray(np.asarray(a, np.float32))
+
+
+def map_weights(cls: str, layer, arrays: List[np.ndarray]
+                ) -> Optional[Tuple[Dict, Dict]]:
+    """Stored Keras weight arrays → (params update, state update)."""
+    if not arrays:
+        return None
+    if cls in ("Dense", "TimeDistributed"):
+        p = {"W": _to_jnp(arrays[0])}
+        if len(arrays) > 1:
+            p["b"] = _to_jnp(arrays[1])
+        return p, {}
+    if cls in ("Conv2D", "Convolution2D", "Conv1D", "Convolution1D"):
+        k = np.asarray(arrays[0])
+        if cls in ("Conv2D", "Convolution2D") and k.ndim == 4 and \
+                k.shape[0] == layer.n_out and k.shape[0] not in k.shape[2:]:
+            # theano OIHW → HWIO
+            k = np.transpose(k, (2, 3, 1, 0))[::-1, ::-1]
+        p = {"W": _to_jnp(k)}
+        if len(arrays) > 1:
+            p["b"] = _to_jnp(arrays[1])
+        return p, {}
+    if cls == "BatchNormalization":
+        p, s = {}, {}
+        if len(arrays) == 4:
+            p["gamma"] = _to_jnp(arrays[0])
+            p["beta"] = _to_jnp(arrays[1])
+            s["mean"] = _to_jnp(arrays[2])
+            s["var"] = _to_jnp(arrays[3])
+        return p, s
+    if cls == "Embedding":
+        return {"W": _to_jnp(arrays[0])}, {}
+    if cls == "LSTM":
+        if len(arrays) == 3:      # keras 2: kernel, recurrent, bias (i,f,c,o)
+            return {"W": _to_jnp(arrays[0]), "R": _to_jnp(arrays[1]),
+                    "b": _to_jnp(arrays[2])}, {}
+        if len(arrays) == 12:     # keras 1: W/U/b per gate i,c,f,o
+            Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo = \
+                [np.asarray(a) for a in arrays]
+            W = np.concatenate([Wi, Wf, Wc, Wo], axis=1)
+            R = np.concatenate([Ui, Uf, Uc, Uo], axis=1)
+            b = np.concatenate([bi, bf, bc, bo])
+            return {"W": _to_jnp(W), "R": _to_jnp(R), "b": _to_jnp(b)}, {}
+    return None
+
+
+KERAS_LAYER_CONVERTERS = convert_layer  # registry alias (reference naming)

@@ -1,0 +1,8 @@
+"""Model zoo: the BASELINE.md benchmark configs built on the framework."""
+
+from .lenet import lenet_conf
+from .char_rnn import char_rnn_conf, CharacterIterator
+from .resnet import resnet_conf, resnet50_conf, resnet_tiny_conf
+
+__all__ = ["lenet_conf", "char_rnn_conf", "CharacterIterator",
+           "resnet_conf", "resnet50_conf", "resnet_tiny_conf"]

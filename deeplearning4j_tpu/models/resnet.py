@@ -1,0 +1,94 @@
+"""ResNet-50 as a ComputationGraph — BASELINE.md config #3/#5 (the reference
+imports ResNet-50 via Keras modelimport into a ComputationGraph; here the same
+graph is also constructible natively).
+
+TPU-first: NHWC + bf16-friendly (BN statistics in f32 via layer state), conv
+stem/blocks lower to MXU convs; the whole fwd+bwd train step jit-compiles to
+one XLA program. ``resnet_tiny_conf`` is the small variant used by the
+multi-chip dry-run and CI."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..nn.conf.config import NeuralNetConfiguration
+from ..nn.conf.input_type import InputType
+from ..nn.conf.layers import (ConvolutionLayer, SubsamplingLayer,
+                              BatchNormalization, ActivationLayer,
+                              GlobalPoolingLayer, OutputLayer)
+from ..nn.graph.graph_config import ComputationGraphConfiguration
+from ..nn.graph.vertices import ElementWiseVertex
+
+
+def _conv_bn(g, name: str, inp: str, n_out: int, kernel: int, stride: int,
+             relu: bool, mode: str = "same") -> str:
+    g.add_layer(f"{name}_conv",
+                ConvolutionLayer(n_out=n_out, kernel_size=[kernel, kernel],
+                                 stride=[stride, stride],
+                                 convolution_mode=mode, has_bias=False,
+                                 activation="identity"), inp)
+    g.add_layer(f"{name}_bn",
+                BatchNormalization(activation="relu" if relu else "identity"),
+                f"{name}_conv")
+    return f"{name}_bn"
+
+
+def _bottleneck(g, name: str, inp: str, mid: int, out: int, stride: int,
+                project: bool) -> str:
+    a = _conv_bn(g, f"{name}_a", inp, mid, 1, stride, relu=True)
+    b = _conv_bn(g, f"{name}_b", a, mid, 3, 1, relu=True)
+    c = _conv_bn(g, f"{name}_c", b, out, 1, 1, relu=False)
+    shortcut = inp
+    if project:
+        shortcut = _conv_bn(g, f"{name}_proj", inp, out, 1, stride, relu=False)
+    g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), c, shortcut)
+    g.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                f"{name}_add")
+    return f"{name}_relu"
+
+
+def resnet_conf(blocks: List[int], widths: List[Tuple[int, int]],
+                num_classes: int = 1000, height: int = 224, width: int = 224,
+                channels: int = 3, learning_rate: float = 0.1,
+                updater: str = "nesterovs",
+                seed: int = 123) -> ComputationGraphConfiguration:
+    g = (NeuralNetConfiguration.Builder()
+         .seed(seed).learning_rate(learning_rate)
+         .updater(updater).momentum(0.9)
+         .weight_init("relu")            # He init for the conv stacks
+         .regularization(True).l2(1e-4)
+         .graph_builder()
+         .add_inputs("input"))
+    stem = _conv_bn(g, "stem", "input", widths[0][0], 7, 2, relu=True)
+    g.add_layer("stem_pool",
+                SubsamplingLayer(kernel_size=[3, 3], stride=[2, 2],
+                                 pooling_type="max", convolution_mode="same"),
+                stem)
+    x = "stem_pool"
+    for stage, (n_blocks, (mid, out)) in enumerate(zip(blocks, widths)):
+        for blk in range(n_blocks):
+            stride = 2 if (blk == 0 and stage > 0) else 1
+            x = _bottleneck(g, f"s{stage}b{blk}", x, mid, out, stride,
+                            project=(blk == 0))
+    g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+    g.add_layer("fc", OutputLayer(n_out=num_classes, loss="mcxent",
+                                  activation="softmax", weight_init="xavier"),
+                "avgpool")
+    return (g.set_outputs("fc")
+            .set_input_types(InputType.convolutional(height, width, channels))
+            .build())
+
+
+def resnet50_conf(num_classes: int = 1000, height: int = 224,
+                  width: int = 224, channels: int = 3,
+                  **kw) -> ComputationGraphConfiguration:
+    return resnet_conf([3, 4, 6, 3],
+                       [(64, 256), (128, 512), (256, 1024), (512, 2048)],
+                       num_classes, height, width, channels, **kw)
+
+
+def resnet_tiny_conf(num_classes: int = 10, height: int = 32, width: int = 32,
+                     channels: int = 3, **kw) -> ComputationGraphConfiguration:
+    """2-stage, 1-block-each miniature for dry-runs and CI."""
+    return resnet_conf([1, 1], [(8, 16), (16, 32)], num_classes, height,
+                       width, channels, **kw)

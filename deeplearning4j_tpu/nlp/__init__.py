@@ -1,0 +1,24 @@
+"""NLP/embeddings (reference deeplearning4j-nlp-parent; SURVEY.md §2.5):
+SequenceVectors engine, Word2Vec/ParagraphVectors/GloVe, vocab + Huffman,
+tokenization pipeline, BoW/TF-IDF, word-vector serializers."""
+
+from .vocab import VocabCache, VocabConstructor, VocabWord
+from .huffman import build_huffman, apply_huffman, pad_codes
+from .sequence_vectors import SequenceVectors, InMemoryLookupTable
+from .word2vec import Word2Vec, ParagraphVectors
+from .glove import Glove
+from .tokenization import (DefaultTokenizerFactory, NGramTokenizerFactory,
+                           CommonPreprocessor, CollectionSentenceIterator,
+                           LineSentenceIterator, LabelAwareSentenceIterator,
+                           StopWords)
+from .vectorizers import (BagOfWordsVectorizer, TfidfVectorizer,
+                          WordVectorSerializer, StaticWord2Vec)
+
+__all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
+           "apply_huffman", "pad_codes", "SequenceVectors",
+           "InMemoryLookupTable", "Word2Vec", "ParagraphVectors", "Glove",
+           "DefaultTokenizerFactory", "NGramTokenizerFactory",
+           "CommonPreprocessor", "CollectionSentenceIterator",
+           "LineSentenceIterator", "LabelAwareSentenceIterator", "StopWords",
+           "BagOfWordsVectorizer", "TfidfVectorizer", "WordVectorSerializer",
+           "StaticWord2Vec"]

@@ -1,0 +1,122 @@
+"""GloVe embeddings (reference models/glove/: co-occurrence counting with
+ring buffers + AdaGrad weighted-least-squares fit; SURVEY.md §2.5).
+
+Host-side co-occurrence dict (the reference's count/ round-trip files),
+then one jitted AdaGrad step over batched (i, j, X_ij) triples — the TPU
+replacement for the reference's per-pair threaded updates."""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import VocabCache, VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _glove_step(w, wc, b, bc, hw, hb, rows, cols, xij, lr, x_max, alpha):
+    """AdaGrad GloVe step. w/wc [V,D] main+context vectors, b/bc [V] biases,
+    hw/hb AdaGrad accumulators (packed: hw [2,V,D], hb [2,V])."""
+    wi = w[rows]
+    wj = wc[cols]
+    weight = jnp.minimum((xij / x_max) ** alpha, 1.0)
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols] - jnp.log(xij)
+    loss = jnp.mean(weight * diff * diff)
+    g = weight * diff                                   # [B]
+    gwi = g[:, None] * wj
+    gwj = g[:, None] * wi
+    # AdaGrad
+    hw_i = hw[0].at[rows].add(gwi * gwi)
+    hw_j = hw[1].at[cols].add(gwj * gwj)
+    w = w.at[rows].add(-lr * gwi / jnp.sqrt(hw_i[rows] + 1e-8))
+    wc = wc.at[cols].add(-lr * gwj / jnp.sqrt(hw_j[cols] + 1e-8))
+    hb_i = hb[0].at[rows].add(g * g)
+    hb_j = hb[1].at[cols].add(g * g)
+    b = b.at[rows].add(-lr * g / jnp.sqrt(hb_i[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * g / jnp.sqrt(hb_j[cols] + 1e-8))
+    return w, wc, b, bc, jnp.stack([hw_i, hw_j]), jnp.stack([hb_i, hb_j]), \
+        loss
+
+
+class Glove:
+    def __init__(self, vector_length: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, learning_rate: float = 0.05,
+                 epochs: int = 5, x_max: float = 100.0, alpha: float = 0.75,
+                 batch_size: int = 4096, symmetric: bool = True,
+                 seed: int = 42):
+        self.vector_length = vector_length
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.seed = seed
+        self.vocab: VocabCache = None
+        self.w = None
+
+    def fit(self, sequences: Sequence[List[str]]):
+        self.vocab = VocabConstructor(self.min_word_frequency).build(sequences)
+        cooc: Dict[Tuple[int, int], float] = defaultdict(float)
+        for seq in sequences:
+            idxs = [self.vocab.index_of(t) for t in seq if t in self.vocab]
+            for i, wi in enumerate(idxs):
+                for off in range(1, self.window + 1):
+                    j = i + off
+                    if j >= len(idxs):
+                        break
+                    inc = 1.0 / off                  # distance weighting
+                    cooc[(wi, idxs[j])] += inc
+                    if self.symmetric:
+                        cooc[(idxs[j], wi)] += inc
+        if not cooc:
+            return self
+        rows = np.array([k[0] for k in cooc], np.int32)
+        cols = np.array([k[1] for k in cooc], np.int32)
+        xij = np.array(list(cooc.values()), np.float32)
+
+        V, D = len(self.vocab), self.vector_length
+        rng = np.random.default_rng(self.seed)
+        self.w = jnp.asarray((rng.random((V, D)) - 0.5) / D, jnp.float32)
+        self.wc = jnp.asarray((rng.random((V, D)) - 0.5) / D, jnp.float32)
+        self.b = jnp.zeros(V, jnp.float32)
+        self.bc = jnp.zeros(V, jnp.float32)
+        hw = jnp.zeros((2, V, D), jnp.float32)
+        hb = jnp.zeros((2, V), jnp.float32)
+
+        n = len(rows)
+        B = min(self.batch_size, n)
+        order = np.arange(n)
+        for epoch in range(self.epochs):
+            rng.shuffle(order)
+            for s in range(0, n - n % B or n, B):
+                sel = order[s:s + B]
+                if len(sel) < B:
+                    sel = np.concatenate([sel, order[:B - len(sel)]])
+                self.w, self.wc, self.b, self.bc, hw, hb, loss = _glove_step(
+                    self.w, self.wc, self.b, self.bc, hw, hb,
+                    jnp.asarray(rows[sel]), jnp.asarray(cols[sel]),
+                    jnp.asarray(xij[sel]), jnp.float32(self.learning_rate),
+                    self.x_max, self.alpha)
+            self._last_loss = float(loss)
+        return self
+
+    def get_word_vector(self, word: str):
+        idx = self.vocab.index_of(word)
+        if idx < 0:
+            return None
+        return np.asarray(self.w[idx] + self.wc[idx])   # GloVe sums both
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0

@@ -1,0 +1,137 @@
+"""Batched skip-gram / CBOW training steps (reference
+models/embeddings/learning/impl/elements/{SkipGram,CBOW}.java; the reference
+batches pairs into a native ``AggregateSkipGram`` op executed on the
+executioner (SkipGram.java:271-279, SURVEY.md §3.5) — here the batch is a
+fixed-shape device array and one jitted XLA step does the whole aggregate:
+gather → dot → sigmoid loss → scatter-add updates.
+
+Both hierarchical softmax (padded Huffman code rows) and negative sampling
+are implemented; updates use ``.at[].add`` scatters, which XLA lowers to
+efficient TPU scatter ops. Learning-rate is passed per step (the word2vec
+linear decay lives in the caller)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+
+def _scatter_mean_add(table, idx, updates, lr):
+    """Add lr * (per-row summed updates / sqrt(occurrence count)) — the
+    stable batched analog of word2vec's sequential per-pair updates. Plain
+    scatter-ADD amplifies hot rows (the Huffman root appears in every pair's
+    path) linearly in batch size and diverges; full mean-normalization
+    under-trains (one batch collapses to one step). sqrt scaling matches the
+    variance growth of accumulated same-direction noise and empirically
+    preserves word2vec convergence at standard learning rates across batch
+    sizes (see tests/test_nlp_graph.py topic-similarity oracle)."""
+    counts = jnp.zeros((table.shape[0],), table.dtype).at[idx].add(1.0)
+    sums = jnp.zeros_like(table).at[idx].add(updates)
+    return table + lr * sums / jnp.sqrt(jnp.maximum(counts, 1.0))[:, None]
+
+@functools.partial(jax.jit, static_argnames=("hs",), donate_argnums=(0, 1))
+def skipgram_hs_step(syn0, syn1, centers, targets, codes, points, lengths,
+                     lr, hs: bool = True):
+    """Hierarchical-softmax skip-gram batch.
+
+    syn0 [V, D] input vectors; syn1 [V-1, D] inner-node vectors;
+    centers [B] int32; targets [B] int32 (the word whose code we predict);
+    codes [B, L] float 0/1; points [B, L] int32; lengths [B] int32.
+    Returns (syn0, syn1, mean_loss).
+    """
+    h = syn0[centers]                              # [B, D]
+    pts = points                                   # [B, L]
+    v = syn1[pts]                                  # [B, L, D]
+    dots = jnp.einsum("bd,bld->bl", h, v)
+    mask = (jnp.arange(codes.shape[1])[None, :] <
+            lengths[:, None]).astype(syn0.dtype)   # [B, L]
+    # word2vec: label = 1 - code; grad_scale = (label - sigma(dot))
+    label = 1.0 - codes
+    sig = jax.nn.sigmoid(dots)
+    g = (label - sig) * mask                       # [B, L]
+    loss = -jnp.sum(mask * jnp.log(jnp.clip(
+        jnp.where(label > 0.5, sig, 1.0 - sig), 1e-10, 1.0))) / \
+        jnp.maximum(jnp.sum(mask), 1.0)
+    dh = jnp.einsum("bl,bld->bd", g, v)            # neu1e
+    dv = jnp.einsum("bl,bd->bld", g, h)
+    syn0 = _scatter_mean_add(syn0, centers, dh, lr)
+    syn1 = _scatter_mean_add(syn1, pts.reshape(-1),
+                             dv.reshape(-1, dv.shape[-1]), lr)
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_ns_step(syn0, syn1neg, centers, pos, negs, lr):
+    """Negative-sampling skip-gram batch.
+
+    centers [B], pos [B], negs [B, K] sampled negatives.
+    syn1neg [V, D] output vectors. Returns (syn0, syn1neg, mean_loss)."""
+    h = syn0[centers]                              # [B, D]
+    tgt = jnp.concatenate([pos[:, None], negs], axis=1)   # [B, 1+K]
+    label = jnp.concatenate(
+        [jnp.ones_like(pos[:, None], dtype=syn0.dtype),
+         jnp.zeros(negs.shape, syn0.dtype)], axis=1)
+    v = syn1neg[tgt]                               # [B, 1+K, D]
+    dots = jnp.einsum("bd,bkd->bk", h, v)
+    sig = jax.nn.sigmoid(dots)
+    g = label - sig
+    loss = -jnp.mean(jnp.log(jnp.clip(
+        jnp.where(label > 0.5, sig, 1.0 - sig), 1e-10, 1.0)))
+    dh = jnp.einsum("bk,bkd->bd", g, v)
+    dv = jnp.einsum("bk,bd->bkd", g, h)
+    syn0 = _scatter_mean_add(syn0, centers, dh, lr)
+    syn1neg = _scatter_mean_add(syn1neg, tgt.reshape(-1),
+                                dv.reshape(-1, dv.shape[-1]), lr)
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_hs_step(syn0, syn1, context, context_mask, target, codes, points,
+                 lengths, lr):
+    """CBOW with hierarchical softmax: context [B, C] int32 (padded),
+    context_mask [B, C], target [B]."""
+    cm = context_mask.astype(syn0.dtype)
+    vecs = syn0[context] * cm[..., None]           # [B, C, D]
+    denom = jnp.maximum(jnp.sum(cm, axis=1, keepdims=True), 1.0)
+    h = jnp.sum(vecs, axis=1) / denom              # [B, D]
+    v = syn1[points]
+    dots = jnp.einsum("bd,bld->bl", h, v)
+    lmask = (jnp.arange(codes.shape[1])[None, :] <
+             lengths[:, None]).astype(syn0.dtype)
+    label = 1.0 - codes
+    sig = jax.nn.sigmoid(dots)
+    g = (label - sig) * lmask
+    loss = -jnp.sum(lmask * jnp.log(jnp.clip(
+        jnp.where(label > 0.5, sig, 1.0 - sig), 1e-10, 1.0))) / \
+        jnp.maximum(jnp.sum(lmask), 1.0)
+    dh = jnp.einsum("bl,bld->bd", g, v)            # [B, D]
+    dv = jnp.einsum("bl,bd->bld", g, h)
+    syn1 = _scatter_mean_add(syn1, points.reshape(-1),
+                             dv.reshape(-1, dv.shape[-1]), lr)
+    dctx = (dh / denom)[:, None, :] * cm[..., None]     # distribute to context
+    syn0 = _scatter_mean_add(syn0, context.reshape(-1),
+                             dctx.reshape(-1, dctx.shape[-1]), lr)
+    return syn0, syn1, loss
+
+
+def generate_skipgram_pairs(indexed_seq: np.ndarray, window: int,
+                            rng: np.random.Generator,
+                            dynamic_window: bool = True
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side pair generation: (center, context) with word2vec's random
+    window shrink (reference SkipGram.learnSequence iteration order)."""
+    centers, contexts = [], []
+    n = len(indexed_seq)
+    for i in range(n):
+        b = rng.integers(1, window + 1) if dynamic_window else window
+        lo, hi = max(0, i - b), min(n, i + b + 1)
+        for j in range(lo, hi):
+            if j != i:
+                centers.append(indexed_seq[i])
+                contexts.append(indexed_seq[j])
+    return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))

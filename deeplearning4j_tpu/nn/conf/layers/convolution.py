@@ -274,11 +274,16 @@ class BatchNormalization(LayerConf):
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))          # all but channel/feature
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # statistics in f32 even under bf16 compute (running stats must
+            # not accumulate bf16 rounding)
+            xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             d = self.decay
             new_state = {"mean": d * state["mean"] + (1 - d) * mean,
                          "var": d * state["var"] + (1 - d) * var}
+            mean = mean.astype(x.dtype)
+            var = var.astype(x.dtype)
         else:
             mean, var = state["mean"], state["var"]
             new_state = state

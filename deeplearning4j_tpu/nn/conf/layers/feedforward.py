@@ -80,6 +80,9 @@ class LossLayer(LayerConf):
     (reference LossLayer)."""
     loss: str = "mse"
 
+    def input_kind(self) -> str:
+        return "any"
+
     def compute_score(self, params, labels, preoutput, mask=None,
                       average: bool = True):
         return compute_loss(self.loss, labels, preoutput,
@@ -97,6 +100,9 @@ class LossLayer(LayerConf):
 class ActivationLayer(LayerConf):
     """Parameterless activation (reference ActivationLayer)."""
 
+    def input_kind(self) -> str:
+        return "any"
+
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         return self.activation_fn()(x), state
 
@@ -106,6 +112,9 @@ class ActivationLayer(LayerConf):
 class DropoutLayer(LayerConf):
     """Explicit dropout layer (reference DropoutLayer); drop_out is the
     retention probability."""
+
+    def input_kind(self) -> str:
+        return "any"
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         return self.maybe_dropout(x, train=train, rng=rng), state

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops import rng as rngmod
+from ..multilayer import _nz
 from ...ops.dataset import DataSet, MultiDataSet
 from ...ops.updaters import make_updater, normalize_gradient, schedule_lr
 from .graph_config import ComputationGraphConfiguration
@@ -43,21 +44,25 @@ class ComputationGraph:
         key = rngmod.root_key(self.conf.seed)
         self.params, self.state = {}, {}
         self.updaters, self.updater_state = {}, {}
+        storage_dtype = jnp.float64 if self.compute_dtype == jnp.float64 \
+            else jnp.float32   # f32 masters; bf16 cast happens in-step
         for idx, name in enumerate(self.conf.topological_order):
             v = self.conf.vertices[name]
             vkey = rngmod.for_layer(rngmod.for_purpose(key, "init"), idx)
-            p = v.init_params(vkey, self.compute_dtype)
+            p = v.init_params(vkey, storage_dtype)
             self.params[name] = p
             self.state[name] = v.init_state()
             layer = v.layer if isinstance(v, LayerVertex) else None
             upd = make_updater(
                 (layer.updater if layer else None) or "sgd",
-                momentum=(layer.momentum if layer else None) or 0.9,
-                adam_mean_decay=(layer.adam_mean_decay if layer else None) or 0.9,
-                adam_var_decay=(layer.adam_var_decay if layer else None) or 0.999,
-                rho=(layer.rho if layer else None) or 0.95,
-                rms_decay=(layer.rms_decay if layer else None) or 0.95,
-                epsilon=(layer.epsilon if layer else None) or 1e-8)
+                momentum=_nz(layer.momentum if layer else None, 0.9),
+                adam_mean_decay=_nz(
+                    layer.adam_mean_decay if layer else None, 0.9),
+                adam_var_decay=_nz(
+                    layer.adam_var_decay if layer else None, 0.999),
+                rho=_nz(layer.rho if layer else None, 0.95),
+                rms_decay=_nz(layer.rms_decay if layer else None, 0.95),
+                epsilon=_nz(layer.epsilon if layer else None, 1e-8))
             self.updaters[name] = upd
             self.updater_state[name] = {k: upd.init(val)
                                         for k, val in p.items()}
@@ -143,8 +148,18 @@ class ComputationGraph:
         return [np.asarray(o) for o in outs]
 
     # -------------------------------------------------------------- training
+    def _cast_params(self, params):
+        """Mixed precision: bf16 compute against f32 master params (see
+        MultiLayerNetwork._cast_params)."""
+        cd = self.compute_dtype
+        if cd == jnp.float32 or cd == jnp.float64:
+            return params
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(cd) if a.dtype == jnp.float32 else a, params)
+
     def _loss(self, params, state, inputs, labels: Dict, rng,
               label_masks: Optional[Dict] = None, input_masks=None):
+        params = self._cast_params(params)
         acts, new_state, reg, preouts, masks, last_in = self._forward(
             params, state, inputs, train=True, rng=rng,
             input_masks=input_masks, output_preout=True)
@@ -191,9 +206,9 @@ class ComputationGraph:
                 if layer is not None:
                     g = normalize_gradient(
                         g, layer.gradient_normalization,
-                        layer.gradient_normalization_threshold or 1.0)
+                        _nz(layer.gradient_normalization_threshold, 1.0))
                 lr = schedule_lr(
-                    (layer.learning_rate if layer else None) or 0.1,
+                    _nz(layer.learning_rate if layer else None, 0.1),
                     conf.lr_policy, it_f,
                     decay_rate=conf.lr_policy_decay_rate,
                     steps=conf.lr_policy_steps, power=conf.lr_policy_power,
@@ -226,15 +241,15 @@ class ComputationGraph:
         """Train on DataSet / MultiDataSet / iterator thereof (reference
         ComputationGraph.fit)."""
         self._ensure_init()
-        from ...datasets.iterators import as_iterator
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        elif not isinstance(data, (list, tuple)) and \
+                not hasattr(data, "reset"):
+            # plain generator/iterator: materialize once so every epoch
+            # actually trains (an exhausted generator would silently no-op)
+            data = list(data)
         for _ in range(num_epochs):
-            if isinstance(data, (DataSet, MultiDataSet)):
-                batches = [data]
-            elif isinstance(data, (list, tuple)):
-                batches = data
-            else:
-                batches = data
-            for ds in batches:
+            for ds in data:
                 self.fit_batch(ds)
             if hasattr(data, "reset"):
                 data.reset()
@@ -274,7 +289,7 @@ class ComputationGraph:
         self.params, self.updater_state, self.state, score = step(
             self.params, self.updater_state, self.state, inputs, labels,
             imasks, lmasks, self.iteration)
-        self.score_value = float(score)
+        self.score_value = score  # device scalar; sync deferred to reader
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)

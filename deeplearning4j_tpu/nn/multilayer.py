@@ -39,6 +39,11 @@ from .conf.layers.feedforward import (OutputLayer, LossLayer,
 from .conf.layers.recurrent import BaseRecurrentLayerConf
 
 
+def _nz(value, default):
+    """None-aware default (0.0 is a real value — e.g. frozen-layer lr)."""
+    return default if value is None else value
+
+
 def _as_jnp_batch(ds: DataSet, dtype):
     feats = jnp.asarray(ds.features, dtype)
     labels = jnp.asarray(ds.labels, dtype) if ds.labels is not None else None
@@ -73,20 +78,25 @@ class MultiLayerNetwork:
         self.state = []
         self.updaters = []
         self.updater_state = []
+        # master params live in f32 (f64 only for gradient checks):
+        # under bf16 compute, _cast_params casts INSIDE the step and the
+        # update applies to the full-precision master copy
+        storage_dtype = jnp.float64 if self.compute_dtype == jnp.float64 \
+            else jnp.float32
         for i, layer in enumerate(self.layers):
             lkey = rngmod.for_layer(rngmod.for_purpose(key, "init"), i)
-            p = layer.init_params(lkey, self.compute_dtype) \
+            p = layer.init_params(lkey, storage_dtype) \
                 if params is None else params[i]
             self.params.append(p)
             self.state.append(layer.init_state())
             upd = make_updater(
                 layer.updater or "sgd",
-                momentum=layer.momentum or 0.9,
-                adam_mean_decay=layer.adam_mean_decay or 0.9,
-                adam_var_decay=layer.adam_var_decay or 0.999,
-                rho=layer.rho or 0.95,
-                rms_decay=layer.rms_decay or 0.95,
-                epsilon=layer.epsilon or 1e-8)
+                momentum=_nz(layer.momentum, 0.9),
+                adam_mean_decay=_nz(layer.adam_mean_decay, 0.9),
+                adam_var_decay=_nz(layer.adam_var_decay, 0.999),
+                rho=_nz(layer.rho, 0.95),
+                rms_decay=_nz(layer.rms_decay, 0.95),
+                epsilon=_nz(layer.epsilon, 1e-8))
             self.updaters.append(upd)
             self.updater_state.append({k: upd.init(v) for k, v in p.items()})
         self._initialized = True
@@ -172,8 +182,21 @@ class MultiLayerNetwork:
             raise ValueError("Last layer has no loss (need Output/Loss layer)")
         return last
 
+    def _cast_params(self, params):
+        """Mixed precision: when compute_dtype is low-precision (bf16), cast
+        f32 master params to it for the forward/backward; autodiff through the
+        cast delivers f32 gradients to the f32 master copy — the TPU-idiomatic
+        replacement for the reference's fp16 HalfIndexer path
+        (CudnnConvolutionHelper fp16, SURVEY.md §2.2)."""
+        cd = self.compute_dtype
+        if cd == jnp.float32 or cd == jnp.float64:
+            return params
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(cd) if a.dtype == jnp.float32 else a, params)
+
     def _loss_fn(self, params, state, feats, labels, fmask, lmask, rng,
                  initial_rnn=None):
+        params = self._cast_params(params)
         out_layer = self._output_layer()
         pre, new_states, reg, last_in, out_mask = self._forward(
             params, state, feats, train=True, rng=rng, fmask=fmask,
@@ -216,9 +239,9 @@ class MultiLayerNetwork:
                     continue
                 g = normalize_gradient(
                     g, layer.gradient_normalization,
-                    layer.gradient_normalization_threshold or 1.0)
+                    _nz(layer.gradient_normalization_threshold, 1.0))
                 lr = schedule_lr(
-                    layer.learning_rate or 0.1, conf.lr_policy, it_f,
+                    _nz(layer.learning_rate, 0.1), conf.lr_policy, it_f,
                     decay_rate=conf.lr_policy_decay_rate,
                     steps=conf.lr_policy_steps, power=conf.lr_policy_power,
                     max_iterations=float(conf.max_iterations or 1),
@@ -287,7 +310,7 @@ class MultiLayerNetwork:
         self.params, self.updater_state, self.state, score = step(
             self.params, self.updater_state, self.state, feats, labels,
             fmask, lmask, self.iteration, empty_rnn)
-        self.score_value = float(score)
+        self.score_value = score  # device scalar; sync deferred to reader
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
@@ -317,7 +340,7 @@ class MultiLayerNetwork:
                 if isinstance(self.layers[i], BaseRecurrentLayerConf) else {}
                 for i, st in enumerate(new_states)]
             self.state = new_states
-            self.score_value = float(score)
+            self.score_value = score  # device scalar; sync deferred to reader
             self.iteration += 1
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration)
@@ -331,7 +354,7 @@ class MultiLayerNetwork:
         for li, layer in enumerate(self.layers):
             if not hasattr(layer, "pretrain_loss"):
                 continue
-            lr = layer.learning_rate or 0.1
+            lr = _nz(layer.learning_rate, 0.1)
             upd = self.updaters[li]
 
             @jax.jit

@@ -1,0 +1,13 @@
+"""Solvers + listeners (reference optimize/; SURVEY.md §2.1)."""
+
+from .solvers import (Solver, LineGradientDescent, ConjugateGradient, LBFGS,
+                      backtrack_line_search)
+from .listeners import (IterationListener, TrainingListener,
+                        ScoreIterationListener, PerformanceListener,
+                        CollectScoresIterationListener,
+                        ParamAndGradientIterationListener)
+
+__all__ = ["Solver", "LineGradientDescent", "ConjugateGradient", "LBFGS",
+           "backtrack_line_search", "IterationListener", "TrainingListener", "ScoreIterationListener",
+           "PerformanceListener", "CollectScoresIterationListener",
+           "ParamAndGradientIterationListener"]

@@ -1,0 +1,185 @@
+"""Second-order/full-batch solvers (reference optimize/solvers/: Solver.Builder
+→ ConvexOptimizer; StochasticGradientDescent (the default, implemented as the
+networks' jitted train step), BackTrackLineSearch, ConjugateGradient, LBFGS,
+LineGradientDescent; SURVEY.md §2.1).
+
+These optimize the full-batch loss over the flattened parameter vector —
+matching the reference's usage (small models / fine-tuning), each inner
+evaluation a jitted loss/grad call."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+
+def _loss_grad_fn(net, ds):
+    """(loss(θ), grad(θ)) over the flattened parameter vector."""
+    def f(theta: np.ndarray) -> Tuple[float, np.ndarray]:
+        net.set_params_flat(theta)
+        grads, score = net.compute_gradient_and_score(ds)
+        parts = []
+        it = grads if isinstance(grads, list) else \
+            [grads[k] for k in net.conf.topological_order]
+        for g in it:
+            for k in sorted(g.keys()):
+                parts.append(np.asarray(g[k], np.float64).reshape(-1))
+        flat_g = np.concatenate(parts) if parts else np.zeros(0)
+        return float(score), flat_g
+    return f
+
+
+def backtrack_line_search(f, theta, direction, loss0, grad0,
+                          initial_step: float = 1.0, c1: float = 1e-4,
+                          rho: float = 0.5, max_iter: int = 20) -> float:
+    """Armijo backtracking (reference BackTrackLineSearch)."""
+    slope = float(grad0 @ direction)
+    step = initial_step
+    for _ in range(max_iter):
+        loss_new, _ = f(theta + step * direction)
+        if loss_new <= loss0 + c1 * step * slope:
+            return step
+        step *= rho
+    return step
+
+
+class LineGradientDescent:
+    """Steepest descent with line search (reference LineGradientDescent)."""
+
+    def __init__(self, max_iterations: int = 100, tol: float = 1e-8):
+        self.max_iterations = max_iterations
+        self.tol = tol
+
+    def optimize(self, net, ds) -> float:
+        f = _loss_grad_fn(net, ds)
+        theta = net.params_flat().astype(np.float64)
+        loss, grad = f(theta)
+        for _ in range(self.max_iterations):
+            direction = -grad
+            step = backtrack_line_search(f, theta, direction, loss, grad)
+            theta = theta + step * direction
+            new_loss, grad = f(theta)
+            if abs(loss - new_loss) < self.tol * max(abs(loss), 1.0):
+                loss = new_loss
+                break
+            loss = new_loss
+        net.set_params_flat(theta)
+        net.score_value = loss
+        return loss
+
+
+class ConjugateGradient:
+    """Nonlinear CG (Polak-Ribière) with restarts (reference ConjugateGradient)."""
+
+    def __init__(self, max_iterations: int = 100, tol: float = 1e-8):
+        self.max_iterations = max_iterations
+        self.tol = tol
+
+    def optimize(self, net, ds) -> float:
+        f = _loss_grad_fn(net, ds)
+        theta = net.params_flat().astype(np.float64)
+        loss, grad = f(theta)
+        direction = -grad
+        for it in range(self.max_iterations):
+            step = backtrack_line_search(f, theta, direction, loss, grad)
+            theta = theta + step * direction
+            new_loss, new_grad = f(theta)
+            beta = max(0.0, float(new_grad @ (new_grad - grad)) /
+                       max(float(grad @ grad), 1e-12))
+            direction = -new_grad + beta * direction
+            if float(new_grad @ direction) > 0:   # not a descent dir: restart
+                direction = -new_grad
+            if abs(loss - new_loss) < self.tol * max(abs(loss), 1.0):
+                loss = new_loss
+                break
+            loss, grad = new_loss, new_grad
+        net.set_params_flat(theta)
+        net.score_value = loss
+        return loss
+
+
+class LBFGS:
+    """Limited-memory BFGS (reference LBFGS; two-loop recursion, m vectors)."""
+
+    def __init__(self, max_iterations: int = 100, m: int = 10,
+                 tol: float = 1e-8):
+        self.max_iterations = max_iterations
+        self.m = m
+        self.tol = tol
+
+    def optimize(self, net, ds) -> float:
+        f = _loss_grad_fn(net, ds)
+        theta = net.params_flat().astype(np.float64)
+        loss, grad = f(theta)
+        s_list, y_list = [], []
+        for it in range(self.max_iterations):
+            q = grad.copy()
+            alphas = []
+            for s, y in reversed(list(zip(s_list, y_list))):
+                rho_i = 1.0 / max(float(y @ s), 1e-12)
+                a = rho_i * float(s @ q)
+                alphas.append((a, rho_i, s, y))
+                q -= a * y
+            if y_list:
+                gamma = float(s_list[-1] @ y_list[-1]) / \
+                    max(float(y_list[-1] @ y_list[-1]), 1e-12)
+                q *= gamma
+            for a, rho_i, s, y in reversed(alphas):
+                b = rho_i * float(y @ q)
+                q += (a - b) * s
+            direction = -q
+            if float(grad @ direction) > 0:
+                direction = -grad
+            step = backtrack_line_search(f, theta, direction, loss, grad)
+            theta_new = theta + step * direction
+            new_loss, new_grad = f(theta_new)
+            s_vec = theta_new - theta
+            y_vec = new_grad - grad
+            if float(s_vec @ y_vec) > 1e-10:
+                s_list.append(s_vec)
+                y_list.append(y_vec)
+                if len(s_list) > self.m:
+                    s_list.pop(0)
+                    y_list.pop(0)
+            converged = abs(loss - new_loss) < self.tol * max(abs(loss), 1.0)
+            theta, loss, grad = theta_new, new_loss, new_grad
+            if converged:
+                break
+        net.set_params_flat(theta)
+        net.score_value = loss
+        return loss
+
+
+class Solver:
+    """reference Solver.Builder: picks the optimizer from the net's
+    configured optimization_algo."""
+
+    class Builder:
+        def __init__(self):
+            self._net = None
+
+        def model(self, net):
+            self._net = net
+            return self
+
+        def build(self) -> "Solver":
+            return Solver(self._net)
+
+    def __init__(self, net):
+        self.net = net
+
+    def optimize(self, ds, max_iterations: Optional[int] = None) -> float:
+        algo = getattr(self.net.conf, "optimization_algo",
+                       "stochastic_gradient_descent")
+        kw = {} if max_iterations is None else \
+            {"max_iterations": max_iterations}
+        if algo == "conjugate_gradient":
+            return ConjugateGradient(**kw).optimize(self.net, ds)
+        if algo == "lbfgs":
+            return LBFGS(**kw).optimize(self.net, ds)
+        if algo == "line_gradient_descent":
+            return LineGradientDescent(**kw).optimize(self.net, ds)
+        # default: one SGD pass over the data
+        self.net.fit(ds)
+        return float(self.net.score_value)

@@ -1,0 +1,11 @@
+"""Parallel training/inference over device meshes (reference
+deeplearning4j-scaleout; SURVEY.md §2.4): data parallelism (sync sharded-batch
+and local-steps/parameter-averaging modes), ComputationGraph DP trainer,
+parallel inference, multi-host init, sequence parallelism."""
+
+from .mesh import make_mesh, replicated, batch_sharded
+from .wrapper import ParallelWrapper
+from .graph_wrapper import GraphDataParallelTrainer
+
+__all__ = ["make_mesh", "replicated", "batch_sharded", "ParallelWrapper",
+           "GraphDataParallelTrainer"]

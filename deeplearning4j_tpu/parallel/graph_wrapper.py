@@ -1,0 +1,78 @@
+"""Synchronous data-parallel trainer for ComputationGraph (the CG face of
+ParallelWrapper; reference ParallelWrapper accepts Model = MLN or CG).
+
+Batch sharded over the mesh ``data`` axis, params replicated; XLA/GSPMD
+inserts the gradient all-reduce over ICI."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.dataset import DataSet
+from .mesh import make_mesh
+
+
+class GraphDataParallelTrainer:
+    def __init__(self, net, mesh: Optional[Mesh] = None):
+        self.net = net
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._jit_step = None
+
+    @property
+    def num_workers(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def _build(self):
+        net = self.net
+        mesh = self.mesh
+        step = net._make_train_step()
+        rep = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("data"))
+
+        def wrapped(params, upd, state, inputs, labels, iteration):
+            return step(params, upd, state, inputs, labels, None, None,
+                        iteration)
+
+        self._jit_step = jax.jit(
+            wrapped,
+            in_shardings=(rep, rep, rep, data, data, None),
+            out_shardings=(rep, rep, rep, rep),
+            donate_argnums=(0, 1, 2))
+
+    def fit_batch(self, ds: DataSet):
+        net = self.net
+        net._ensure_init()
+        if self._jit_step is None:
+            self._build()
+        n = ds.num_examples()
+        n_dev = self.num_workers
+        feats, labels = ds.features, ds.labels
+        if n % n_dev:
+            pad = n_dev - n % n_dev
+            idx = np.concatenate([np.arange(n), np.arange(pad) % n])
+            feats, labels = feats[idx], labels[idx]
+        inputs = net._inputs_dict(feats)
+        label_d = net._labels_dict(labels)
+        net.params, net.updater_state, net.state, score = self._jit_step(
+            net.params, net.updater_state, net.state, inputs, label_d,
+            net.iteration)
+        net.score_value = float(score)
+        net.iteration += 1
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration)
+
+    def fit(self, data, num_epochs: int = 1):
+        from ..datasets.iterators import as_iterator, AsyncDataSetIterator
+        for _ in range(num_epochs):
+            it = as_iterator(data)
+            if getattr(it, "async_supported", True):
+                it = AsyncDataSetIterator(it)
+            for ds in it:
+                self.fit_batch(ds)
+            self.net.epoch += 1
+        return self

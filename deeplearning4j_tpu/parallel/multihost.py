@@ -1,0 +1,106 @@
+"""Multi-host training initialization + cluster driver (the TrainingMaster
+analog; reference spark/api/TrainingMaster.java:28 →
+ParameterAveragingTrainingMaster; SURVEY.md §2.4, §5.8).
+
+The reference scales out with Spark: serialize net to executors, fit per
+partition, tree-aggregate parameters over TCP. The TPU-native equivalent is
+jax.distributed: every host runs THIS SAME program, ``initialize()`` wires the
+processes into one runtime, and the Mesh then spans all hosts' devices — the
+parameter averaging becomes the same in-program all-reduce, riding ICI within
+a slice and DCN across slices. No parameter shipping, no driver/executor
+asymmetry.
+
+Preemption-safe checkpointing (beyond the reference, required for TPU pods —
+SURVEY.md §5.3 'treat as greenfield'): CheckpointManager saves atomically on
+an interval from process 0 and every process restores identically.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+from pathlib import Path
+from typing import Optional
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """jax.distributed.initialize with env-var fallbacks
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID); no-op single-host."""
+    import jax
+    coordinator_address = coordinator_address or \
+        os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes or os.environ.get("NUM_PROCESSES", 1)),
+        process_id=int(process_id or os.environ.get("PROCESS_ID", 0)))
+
+
+def global_mesh(axis_names=("data",), shape=None):
+    """Mesh over ALL processes' devices (call after initialize())."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if shape is None:
+        shape = (len(devs),)
+    return Mesh(np.array(devs).reshape(shape), axis_names)
+
+
+class CheckpointManager:
+    """Interval-based atomic checkpointing for preemption-safe resume."""
+
+    def __init__(self, directory, interval_seconds: float = 600.0,
+                 keep: int = 3):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.interval = float(interval_seconds)
+        self.keep = int(keep)
+        self._last = 0.0
+
+    def maybe_save(self, net, normalizer=None, force: bool = False) -> bool:
+        import jax
+        if jax.process_index() != 0:
+            return False
+        now = time.monotonic()
+        if not force and now - self._last < self.interval:
+            return False
+        self._last = now
+        from ..utils.serializer import ModelSerializer
+        tag = f"checkpoint_iter{net.iteration}.zip"
+        tmp_fd, tmp_path = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        os.close(tmp_fd)
+        try:
+            ModelSerializer.write_model(net, tmp_path, save_updater=True,
+                                        normalizer=normalizer)
+            os.replace(tmp_path, self.dir / tag)   # atomic publish
+        finally:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
+        self._gc()
+        return True
+
+    def _gc(self):
+        ckpts = sorted(self.dir.glob("checkpoint_iter*.zip"),
+                       key=lambda p: int(p.stem.split("iter")[1]))
+        for p in ckpts[:-self.keep]:
+            p.unlink()
+
+    def latest(self) -> Optional[Path]:
+        ckpts = sorted(self.dir.glob("checkpoint_iter*.zip"),
+                       key=lambda p: int(p.stem.split("iter")[1]))
+        return ckpts[-1] if ckpts else None
+
+    def restore_latest(self, graph: bool = False):
+        from ..utils.serializer import ModelSerializer
+        path = self.latest()
+        if path is None:
+            return None
+        if graph:
+            return ModelSerializer.restore_computation_graph(path)
+        return ModelSerializer.restore_multi_layer_network(path)

@@ -1,0 +1,112 @@
+"""Sequence/context parallelism: ring attention over the ICI ring.
+
+The reference's only long-sequence mechanism is truncated BPTT (SURVEY.md
+§5.7); ring attention is the TPU-era extension the survey prescribes
+("designed fresh over ICI collective-permute"). Implementation:
+
+- sequences are sharded over the mesh's ``sp`` axis (each device holds a
+  [B, T/n, H, D] chunk of q/k/v);
+- each device computes blockwise attention of its q chunk against the
+  currently-held k/v chunk with a streaming (flash-style) softmax — running
+  max ``m``, running denominator ``l``, running numerator ``o``;
+- k/v chunks rotate around the ring with ``lax.ppermute`` (ICI
+  neighbour-to-neighbour traffic, overlapping compute with transfer), n steps
+  until every q block has seen every k/v block;
+- causal masking uses the global position offsets implied by each chunk's
+  ring position.
+
+``ring_self_attention`` is the public entry; on a 1-device mesh it reduces to
+ordinary attention, and the CPU-mesh test asserts exact equivalence against
+the single-device reference implementation."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def attention_reference(q, k, v, causal: bool = False):
+    """Plain single-device attention: q/k/v [B, T, H, D] → [B, T, H, D]."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_attend(q, k, v, m, l, o, q_offset, k_offset, causal):
+    """One streaming-softmax block update. q [B,Tq,H,D], k/v [B,Tk,H,D];
+    m/l [B,H,Tq], o [B,Tq,H,D] are the running max/denominator/numerator."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale    # [B,H,Tq,Tk]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_offset + jnp.arange(tq)
+        kpos = k_offset + jnp.arange(tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    block_max = jnp.max(logits, axis=-1)                    # [B,H,Tq]
+    new_m = jnp.maximum(m, block_max)
+    # guard fully-masked blocks (all -inf)
+    new_m_safe = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    p = jnp.exp(logits - new_m_safe[..., None])
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - new_m_safe), 0.0)
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    new_o = o * jnp.transpose(correction, (0, 2, 1))[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                        causal: bool = False):
+    """Ring attention: q/k/v [B, T, H, D] sharded over ``axis`` on dim 1.
+    Returns [B, T, H, D] with the same sharding."""
+    n_dev = mesh.shape[axis]
+
+    def ring(ql, kl, vl):
+        b, t_local, h, d = ql.shape
+        my_idx = lax.axis_index(axis)
+        m = jnp.full((b, h, t_local), -jnp.inf, ql.dtype)
+        l = jnp.zeros((b, h, t_local), ql.dtype)
+        o = jnp.zeros_like(ql)
+        q_offset = my_idx * t_local
+
+        def body(step, carry):
+            m, l, o, k_cur, v_cur = carry
+            # chunk currently held originated from device (my_idx - step)
+            src = (my_idx - step) % n_dev
+            k_offset = src * t_local
+            m, l, o = _block_attend(ql, k_cur, v_cur, m, l, o,
+                                    q_offset, k_offset, causal)
+            # rotate: receive the next chunk from the ring neighbour
+            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            k_next = lax.ppermute(k_cur, axis, perm)
+            v_next = lax.ppermute(v_cur, axis, perm)
+            return m, l, o, k_next, v_next
+
+        m, l, o, _, _ = lax.fori_loop(
+            0, n_dev, body, (m, l, o, kl, vl)) if n_dev > 1 else \
+            body(0, (m, l, o, kl, vl))
+        denom = jnp.transpose(jnp.maximum(l, 1e-20), (0, 2, 1))[..., None]
+        return o / denom
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def sequence_sharded(mesh: Mesh, x, axis: str = "sp"):
+    """Place [B, T, ...] with T sharded over the mesh axis."""
+    from jax.sharding import NamedSharding
+    spec = P(*([None, axis] + [None] * (x.ndim - 2)))
+    return jax.device_put(x, NamedSharding(mesh, spec))

@@ -119,27 +119,30 @@ class ParallelWrapper:
             step = net._make_train_step(False)
             rep = NamedSharding(mesh, P())
 
-            def sharded_step(params, upd, state, feats, labels, iteration,
-                             empty_rnn):
-                return step(params, upd, state, feats, labels, None, None,
+            data = NamedSharding(mesh, P("data"))
+
+            def sharded_step(params, upd, state, feats, labels, fmask, lmask,
+                             iteration, empty_rnn):
+                return step(params, upd, state, feats, labels, fmask, lmask,
                             iteration, empty_rnn)
 
             self._jit_sync = jax.jit(
                 sharded_step,
-                in_shardings=(rep, rep, rep,
-                              NamedSharding(mesh, P("data")),
-                              NamedSharding(mesh, P("data")), None, rep),
+                in_shardings=(rep, rep, rep, data, data, data, data, None,
+                              rep),
                 out_shardings=(rep, rep, rep, rep),
                 donate_argnums=(0, 1, 2))
         empty_rnn = [{} for _ in getattr(net, "layers", [])]
         for ds in iterator:
-            feats, labels = self._pad_to_devices(ds)
+            feats, labels, fmask, lmask = self._pad_to_devices(ds)
+            cd = net.compute_dtype
             net.params, net.updater_state, net.state, score = self._jit_sync(
                 net.params, net.updater_state, net.state,
-                jnp.asarray(feats, net.compute_dtype),
-                jnp.asarray(labels, net.compute_dtype),
+                jnp.asarray(feats, cd), jnp.asarray(labels, cd),
+                None if fmask is None else jnp.asarray(fmask, cd),
+                None if lmask is None else jnp.asarray(lmask, cd),
                 net.iteration, empty_rnn)
-            net.score_value = float(score)
+            net.score_value = score   # device scalar; sync deferred to reader
             net.iteration += 1
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration)
@@ -219,6 +222,11 @@ class ParallelWrapper:
 
     def _run_round(self, batches: List[DataSet]):
         net = self.net
+        if any(b.features_mask is not None or b.labels_mask is not None
+               for b in batches):
+            raise NotImplementedError(
+                "averaging_frequency > 1 does not support mask arrays yet; "
+                "use averaging_frequency=1 (sync DP) for masked sequences")
         k = len(batches)
         n_dev = self.num_workers
         feats = np.stack([self._pad_to_devices(b)[0] for b in batches])
@@ -231,7 +239,7 @@ class ParallelWrapper:
             sp, su, ss, jnp.asarray(feats, net.compute_dtype),
             jnp.asarray(labels, net.compute_dtype), net.iteration)
         self._stacked = (sp, su, ss)
-        net.score_value = float(score)
+        net.score_value = score   # device scalar; sync deferred to reader
         net.iteration += k
         for lst in net.listeners:
             lst.iteration_done(net, net.iteration)
@@ -239,12 +247,14 @@ class ParallelWrapper:
     def _pad_to_devices(self, ds: DataSet):
         """Pad the batch so it divides evenly across devices (the reference
         round-robins leftovers; padding with repeated rows keeps SPMD shapes
-        static)."""
+        static). Returns (features, labels, features_mask, labels_mask)."""
         n = ds.num_examples()
         n_dev = self.num_workers
         rem = n % n_dev
         if rem == 0:
-            return ds.features, ds.labels
+            return ds.features, ds.labels, ds.features_mask, ds.labels_mask
         pad = n_dev - rem
         idx = np.concatenate([np.arange(n), np.arange(pad) % n])
-        return ds.features[idx], ds.labels[idx]
+        take = lambda a: None if a is None else a[idx]
+        return (ds.features[idx], take(ds.labels), take(ds.features_mask),
+                take(ds.labels_mask))

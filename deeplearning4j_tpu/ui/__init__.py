@@ -1,0 +1,11 @@
+"""Observability (reference deeplearning4j-ui-parent; SURVEY.md §2.8, §5.5):
+StatsListener → StatsStorage backends → web UI server + remote push."""
+
+from .stats import StatsListener, SparkStyntheticPhaseTimer, profiler_trace
+from .storage import (StatsStorage, InMemoryStatsStorage, FileStatsStorage,
+                      SqliteStatsStorage)
+from .server import UIServer, RemoteStatsRouter
+
+__all__ = ["StatsListener", "SparkStyntheticPhaseTimer", "profiler_trace",
+           "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
+           "SqliteStatsStorage", "UIServer", "RemoteStatsRouter"]

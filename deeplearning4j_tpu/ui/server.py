@@ -1,0 +1,191 @@
+"""Training UI web server (reference deeplearning4j-play PlayUIServer with
+UIModule routes — train overview / model / system tabs; SURVEY.md §2.8).
+
+Play framework → stdlib http.server: JSON endpoints over a StatsStorage plus
+a single-page overview rendering score & throughput charts (inline SVG, no
+external assets — the environment has no egress).
+
+    UIServer.get_instance().attach(storage)   # then open http://host:9000
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import urlparse, parse_qs
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>tpu-dl4j training UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} .card{background:#fff;border:1px solid #ddd;
+border-radius:6px;padding:12px;margin:12px 0}
+svg{width:100%;height:220px} .axis{stroke:#999;stroke-width:1}
+.line{fill:none;stroke:#d7301f;stroke-width:1.5}
+.line2{fill:none;stroke:#2b8cbe;stroke-width:1.5}
+table{border-collapse:collapse} td,th{border:1px solid #ccc;padding:4px 8px}
+</style></head><body>
+<h1>Training overview</h1>
+<div class=card><b>Session:</b> <span id=sess></span>
+<table id=info></table></div>
+<div class=card><b>Score vs iteration</b><svg id=score></svg></div>
+<div class=card><b>Iterations/sec</b><svg id=rate></svg></div>
+<script>
+function draw(svgId, xs, ys, cls) {
+  const svg = document.getElementById(svgId);
+  svg.innerHTML = '';
+  if (xs.length < 2) return;
+  const W = svg.clientWidth, H = svg.clientHeight, P = 30;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const sx = x => P + (x - xmin) / (xmax - xmin || 1) * (W - 2 * P);
+  const sy = y => H - P - (y - ymin) / (ymax - ymin || 1) * (H - 2 * P);
+  let d = 'M' + xs.map((x, i) => sx(x) + ',' + sy(ys[i])).join(' L');
+  svg.innerHTML =
+    `<line class=axis x1=${P} y1=${H - P} x2=${W - P} y2=${H - P}/>` +
+    `<line class=axis x1=${P} y1=${P} x2=${P} y2=${H - P}/>` +
+    `<path class=${cls} d="${d}"/>` +
+    `<text x=${P} y=12 font-size=11>${ymax.toPrecision(4)}</text>` +
+    `<text x=${P} y=${H - P + 14} font-size=11>${ymin.toPrecision(4)}</text>`;
+}
+async function refresh() {
+  const sessions = await (await fetch('/train/sessions')).json();
+  if (!sessions.length) return;
+  const s = sessions[sessions.length - 1];
+  document.getElementById('sess').textContent = s;
+  const info = await (await fetch('/train/info?session=' + s)).json();
+  if (info) {
+    document.getElementById('info').innerHTML =
+      `<tr><th>model</th><td>${info.model_class}</td></tr>` +
+      `<tr><th>params</th><td>${info.num_params}</td></tr>` +
+      `<tr><th>layers</th><td>${info.num_layers}</td></tr>`;
+  }
+  const ups = await (await fetch('/train/updates?session=' + s)).json();
+  draw('score', ups.map(u => u.iteration), ups.map(u => u.score), 'line');
+  const rated = ups.filter(u => u.iterations_per_sec);
+  draw('rate', rated.map(u => u.iteration),
+       rated.map(u => u.iterations_per_sec), 'line2');
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage = None
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        storage = type(self).storage
+        if url.path in ("/", "/train", "/train/overview"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/train/sessions":
+            self._json(storage.list_sessions() if storage else [])
+        elif url.path == "/train/updates":
+            session = q.get("session", [""])[0]
+            ups = storage.get_updates(session) if storage else []
+            slim = [{k: u.get(k) for k in
+                     ("iteration", "score", "iterations_per_sec", "epoch",
+                      "timestamp", "max_rss_mb")} for u in ups]
+            self._json(slim)
+        elif url.path == "/train/info":
+            session = q.get("session", [""])[0]
+            info = storage.get_static_info(session) if storage else None
+            self._json(info)
+        elif url.path == "/train/histograms":
+            session = q.get("session", [""])[0]
+            ups = storage.get_updates(session) if storage else []
+            hists = [u for u in ups if "param_histograms" in u]
+            self._json(hists[-1] if hists else {})
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def do_POST(self):
+        # remote listener push (reference RemoteReceiverModule /
+        # ui-remote-iterationlisteners): POST /remote/receive with a record
+        url = urlparse(self.path)
+        if url.path == "/remote/receive" and type(self).storage is not None:
+            length = int(self.headers.get("Content-Length", 0))
+            record = json.loads(self.rfile.read(length) or b"{}")
+            if record.get("type") == "init":
+                type(self).storage.put_static_info(record)
+            else:
+                type(self).storage.put_update(record)
+            self._json({"ok": True})
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+
+class UIServer:
+    """Singleton server (reference UIServer.getInstance().attach(storage))."""
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage):
+        _Handler.storage = storage
+        if self._server is None:
+            self._server = ThreadingHTTPServer(("0.0.0.0", self.port),
+                                               _Handler)
+            self.port = self._server.server_address[1]
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+        UIServer._instance = None
+
+
+class RemoteStatsRouter:
+    """Client side of the remote listener path (reference
+    remote-iterationlisteners' WebReporter): a StatsStorage router that POSTs
+    records to a UIServer over HTTP."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/") + "/remote/receive"
+
+    def _post(self, record):
+        import urllib.request
+        req = urllib.request.Request(
+            self.url, json.dumps(record).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def put_update(self, record):
+        self._post(record)
+
+    def put_static_info(self, record):
+        self._post(record)

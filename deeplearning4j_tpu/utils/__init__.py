@@ -1,0 +1,5 @@
+"""Utilities: model serialization/guessing (reference util/; SURVEY.md §2.1)."""
+
+from .serializer import ModelSerializer, ModelGuesser
+
+__all__ = ["ModelSerializer", "ModelGuesser"]

@@ -1,0 +1,156 @@
+"""Core tooling tests: clustering/trees/t-SNE, DataVec bridge, solvers,
+native loader (reference deeplearning4j-core test areas; SURVEY.md §2.3)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KMeansClustering, KDTree, VPTree, Tsne
+from deeplearning4j_tpu.datasets import (
+    CollectionRecordReader, CollectionSequenceRecordReader,
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator)
+from deeplearning4j_tpu.optimize import ConjugateGradient, LBFGS, Solver
+
+
+def _blobs(rng, k=3, per=50, d=4, spread=5.0):
+    centers = rng.normal(0, spread, (k, d))
+    pts = np.concatenate([centers[i] + rng.normal(0, 0.3, (per, d))
+                          for i in range(k)])
+    labels = np.repeat(np.arange(k), per)
+    return pts.astype(np.float32), labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, rng_np):
+        pts, labels = _blobs(rng_np)
+        km = KMeansClustering.setup(3, max_iterations=50)
+        assign, centers = km.apply_to(pts)
+        # every true cluster maps to exactly one k-means cluster
+        for c in range(3):
+            vals, counts = np.unique(assign[labels == c], return_counts=True)
+            assert counts.max() / counts.sum() > 0.95
+        assert centers.shape == (3, 4)
+        pred = km.predict(pts[:10])
+        assert (pred == assign[:10]).all()
+
+
+class TestTrees:
+    def test_kdtree_knn_matches_bruteforce(self, rng_np):
+        pts = rng_np.normal(size=(200, 5))
+        tree = KDTree(pts)
+        q = rng_np.normal(size=5)
+        d = np.linalg.norm(pts - q, axis=1)
+        expect = set(np.argsort(d)[:5])
+        got = {i for i, _ in tree.knn(q, 5)}
+        assert got == expect
+        nn_idx, nn_d = tree.nn(q)
+        assert nn_idx == int(np.argmin(d))
+
+    def test_vptree_knn_matches_bruteforce(self, rng_np):
+        pts = rng_np.normal(size=(150, 4))
+        tree = VPTree(pts)
+        q = rng_np.normal(size=4)
+        d = np.linalg.norm(pts - q, axis=1)
+        expect = set(np.argsort(d)[:4])
+        got = {i for i, _ in tree.knn(q, 4)}
+        assert got == expect
+
+
+class TestTsne:
+    def test_separates_blobs(self, rng_np):
+        pts, labels = _blobs(rng_np, k=2, per=30, d=10, spread=8.0)
+        ts = Tsne.Builder().perplexity(10).learning_rate(100.0) \
+            .set_max_iter(400).build()
+        Y = ts.calculate(pts)
+        assert Y.shape == (60, 2)
+        c0 = Y[labels == 0].mean(axis=0)
+        c1 = Y[labels == 1].mean(axis=0)
+        intra = np.mean(np.linalg.norm(Y[labels == 0] - c0, axis=1))
+        inter = np.linalg.norm(c0 - c1)
+        assert inter > 2 * intra
+        assert np.isfinite(ts.kl_divergence_)
+
+
+class TestDataVec:
+    def test_classification_iterator(self, rng_np):
+        records = [[1.0, 2.0, 0], [3.0, 4.0, 1], [5.0, 6.0, 2],
+                   [7.0, 8.0, 1]]
+        it = RecordReaderDataSetIterator(CollectionRecordReader(records),
+                                         batch_size=2, label_index=2,
+                                         num_classes=3)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0].features.shape == (2, 2)
+        assert batches[0].labels.shape == (2, 3)
+        np.testing.assert_allclose(batches[0].labels[1],
+                                   [0, 1, 0])
+
+    def test_regression_iterator(self):
+        records = [[1.0, 2.0, 0.5], [3.0, 4.0, 1.5]]
+        it = RecordReaderDataSetIterator(CollectionRecordReader(records),
+                                         batch_size=2, label_index=2,
+                                         regression=True)
+        ds = next(iter(it))
+        np.testing.assert_allclose(ds.labels[:, 0], [0.5, 1.5])
+
+    def test_sequence_iterator_masks(self):
+        seqs = [
+            [[1.0, 0], [2.0, 1], [3.0, 0]],       # length 3
+            [[4.0, 1]],                            # length 1
+        ]
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionSequenceRecordReader(seqs), batch_size=2,
+            label_index=1, num_classes=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 1)
+        np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [1, 0, 0]])
+        np.testing.assert_allclose(ds.labels[0, 1], [0, 1])
+
+    def test_multi_dataset_iterator(self):
+        r1 = CollectionRecordReader([[1, 2, 0], [3, 4, 1]])
+        it = (RecordReaderMultiDataSetIterator.Builder(2)
+              .add_reader("in", r1)
+              .add_input("in", 0, 1)
+              .add_output_one_hot("in", 2, 2)
+              .build())
+        mds = next(iter(it))
+        assert mds.features[0].shape == (2, 2)
+        assert mds.labels[0].shape == (2, 2)
+
+
+class TestSolvers:
+    def _small_net(self, algo):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        conf = (NeuralNetConfiguration.Builder().seed(4)
+                .optimization_algo(algo).learning_rate(0.1)
+                .weight_init("xavier").activation("tanh").list()
+                .layer(DenseLayer(n_out=6))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        return MultiLayerNetwork(conf, compute_dtype=jnp.float64).init()
+
+    def test_cg_and_lbfgs_reduce_loss(self, rng_np):
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        X = rng_np.normal(size=(40, 3))
+        W = rng_np.normal(size=(3, 2))
+        y = np.eye(2)[np.argmax(X @ W, axis=1)]
+        ds = DataSet(X, y)
+        for algo, solver_cls in [("conjugate_gradient", ConjugateGradient),
+                                 ("lbfgs", LBFGS)]:
+            net = self._small_net(algo)
+            loss0 = net.score(ds)
+            loss = solver_cls(max_iterations=30).optimize(net, ds)
+            assert loss < loss0 * 0.5, (algo, loss0, loss)
+
+    def test_solver_builder_dispatch(self, rng_np):
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        X = rng_np.normal(size=(20, 3))
+        y = np.eye(2)[rng_np.integers(0, 2, 20)]
+        net = self._small_net("lbfgs")
+        s = Solver.Builder().model(net).build()
+        loss = s.optimize(DataSet(X, y), max_iterations=10)
+        assert np.isfinite(loss)

@@ -1,0 +1,171 @@
+"""Keras HDF5 import end-to-end (reference KerasModelEndToEndTest pattern:
+stored HDF5 fixture → import → compare predictions; SURVEY.md §4). Fixtures
+are generated in-test with h5py in the Keras-2 storage layout."""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras import KerasModelImport
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+
+def _write_keras2_h5(path, model_config, layer_weights):
+    """layer_weights: {layer_name: [(weight_name, array), ...]}"""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        mw = f.create_group("model_weights")
+        for lname, weights in layer_weights.items():
+            lg = mw.create_group(lname)
+            names = []
+            for wname, arr in weights:
+                full = f"{lname}/{wname}"
+                lg.create_dataset(full.split("/", 1)[1], data=arr)
+                names.append(full.encode())
+            lg.attrs["weight_names"] = names
+
+
+def _dense_cfg(name, units, activation, input_shape=None):
+    cfg = {"name": name, "units": units, "activation": activation,
+           "use_bias": True}
+    if input_shape:
+        cfg["batch_input_shape"] = [None] + list(input_shape)
+    return {"class_name": "Dense", "config": cfg}
+
+
+class TestSequentialImport:
+    def test_dense_mlp_predictions_match(self, tmp_path, rng_np):
+        W1 = rng_np.normal(size=(4, 8)).astype(np.float32)
+        b1 = rng_np.normal(size=(8,)).astype(np.float32)
+        W2 = rng_np.normal(size=(8, 3)).astype(np.float32)
+        b2 = rng_np.normal(size=(3,)).astype(np.float32)
+        model_config = {
+            "class_name": "Sequential",
+            "config": {"layers": [
+                _dense_cfg("dense_1", 8, "relu", input_shape=[4]),
+                _dense_cfg("dense_2", 3, "softmax"),
+            ]}}
+        path = tmp_path / "mlp.h5"
+        _write_keras2_h5(path, model_config, {
+            "dense_1": [("kernel:0", W1), ("bias:0", b1)],
+            "dense_2": [("kernel:0", W2), ("bias:0", b2)]})
+        net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        assert isinstance(net, MultiLayerNetwork)
+        X = rng_np.normal(size=(5, 4)).astype(np.float32)
+        out = net.output(X)
+        h = np.maximum(X @ W1 + b1, 0)
+        logits = h @ W2 + b2
+        expect = np.exp(logits - logits.max(-1, keepdims=True))
+        expect /= expect.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_cnn_import_shapes(self, tmp_path, rng_np):
+        K = rng_np.normal(size=(3, 3, 1, 4)).astype(np.float32)  # HWIO
+        bK = np.zeros(4, np.float32)
+        W = rng_np.normal(size=(4 * 13 * 13, 2)).astype(np.float32)
+        b = np.zeros(2, np.float32)
+        model_config = {
+            "class_name": "Sequential",
+            "config": {"layers": [
+                {"class_name": "Conv2D", "config": {
+                    "name": "conv", "filters": 4, "kernel_size": [3, 3],
+                    "strides": [1, 1], "padding": "valid",
+                    "activation": "relu", "use_bias": True,
+                    "batch_input_shape": [None, 28, 28, 1]}},
+                {"class_name": "MaxPooling2D", "config": {
+                    "name": "pool", "pool_size": [2, 2], "strides": [2, 2],
+                    "padding": "valid"}},
+                {"class_name": "Flatten", "config": {"name": "flat"}},
+                _dense_cfg("fc", 2, "softmax"),
+            ]}}
+        path = tmp_path / "cnn.h5"
+        _write_keras2_h5(path, model_config, {
+            "conv": [("kernel:0", K), ("bias:0", bK)],
+            "fc": [("kernel:0", W), ("bias:0", b)]})
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        X = rng_np.normal(size=(2, 28, 28, 1)).astype(np.float32)
+        out = net.output(X)
+        assert out.shape == (2, 2)
+        np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+    def test_lstm_import(self, tmp_path, rng_np):
+        n_in, units = 3, 5
+        kernel = rng_np.normal(size=(n_in, 4 * units)).astype(np.float32)
+        rec = rng_np.normal(size=(units, 4 * units)).astype(np.float32)
+        bias = rng_np.normal(size=(4 * units,)).astype(np.float32)
+        W = rng_np.normal(size=(units, 2)).astype(np.float32)
+        b = np.zeros(2, np.float32)
+        model_config = {
+            "class_name": "Sequential",
+            "config": {"layers": [
+                {"class_name": "LSTM", "config": {
+                    "name": "lstm", "units": units, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "return_sequences": True,
+                    "batch_input_shape": [None, 7, n_in]}},
+                {"class_name": "GlobalMaxPooling1D",
+                 "config": {"name": "gmp"}},
+                _dense_cfg("fc", 2, "softmax"),
+            ]}}
+        path = tmp_path / "lstm.h5"
+        _write_keras2_h5(path, model_config, {
+            "lstm": [("kernel:0", kernel), ("recurrent_kernel:0", rec),
+                     ("bias:0", bias)],
+            "fc": [("kernel:0", W), ("bias:0", b)]})
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        np.testing.assert_allclose(np.asarray(net.params[0]["W"]), kernel)
+        X = rng_np.normal(size=(2, 7, n_in)).astype(np.float32)
+        assert net.output(X).shape == (2, 2)
+
+
+class TestFunctionalImport:
+    def test_two_branch_add(self, tmp_path, rng_np):
+        W1 = rng_np.normal(size=(4, 6)).astype(np.float32)
+        W2 = rng_np.normal(size=(4, 6)).astype(np.float32)
+        W3 = rng_np.normal(size=(6, 2)).astype(np.float32)
+        zeros6 = np.zeros(6, np.float32)
+        model_config = {
+            "class_name": "Model",
+            "config": {
+                "layers": [
+                    {"class_name": "InputLayer", "name": "inp",
+                     "config": {"name": "inp",
+                                "batch_input_shape": [None, 4]},
+                     "inbound_nodes": []},
+                    {"class_name": "Dense", "name": "d1",
+                     "config": {"name": "d1", "units": 6,
+                                "activation": "relu", "use_bias": True},
+                     "inbound_nodes": [[["inp", 0, 0, {}]]]},
+                    {"class_name": "Dense", "name": "d2",
+                     "config": {"name": "d2", "units": 6,
+                                "activation": "relu", "use_bias": True},
+                     "inbound_nodes": [[["inp", 0, 0, {}]]]},
+                    {"class_name": "Add", "name": "add",
+                     "config": {"name": "add"},
+                     "inbound_nodes": [[["d1", 0, 0, {}],
+                                        ["d2", 0, 0, {}]]]},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "units": 2,
+                                "activation": "softmax", "use_bias": True},
+                     "inbound_nodes": [[["add", 0, 0, {}]]]},
+                ],
+                "input_layers": [["inp", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            }}
+        path = tmp_path / "func.h5"
+        _write_keras2_h5(path, model_config, {
+            "d1": [("kernel:0", W1), ("bias:0", zeros6)],
+            "d2": [("kernel:0", W2), ("bias:0", zeros6)],
+            "out": [("kernel:0", W3), ("bias:0", np.zeros(2, np.float32))]})
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        assert isinstance(net, ComputationGraph)
+        X = rng_np.normal(size=(3, 4)).astype(np.float32)
+        out = net.output(X)[0]
+        h = np.maximum(X @ W1, 0) + np.maximum(X @ W2, 0)
+        logits = h @ W3
+        expect = np.exp(logits - logits.max(-1, keepdims=True))
+        expect /= expect.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,355 @@
+"""Behavior tests for MultiLayerNetwork/ComputationGraph features:
+serialization round-trip (regression-test pattern, SURVEY.md §4), early
+stopping, transfer learning, TBPTT + rnnTimeStep, eval suite, listeners."""
+
+import os
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, OutputLayer,
+                                               GravesLSTM, RnnOutputLayer,
+                                               AutoEncoder,
+                                               VariationalAutoencoder)
+from deeplearning4j_tpu.nn.graph import (ComputationGraph, MergeVertex,
+                                         ElementWiseVertex, LastTimeStepVertex,
+                                         StackVertex, UnstackVertex,
+                                         L2NormalizeVertex)
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.utils.serializer import ModelSerializer, ModelGuesser
+from deeplearning4j_tpu.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, DataSetLossCalculator,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition, InMemoryModelSaver)
+from deeplearning4j_tpu.nn.transfer import (TransferLearning,
+                                            FineTuneConfiguration,
+                                            TransferLearningHelper)
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.eval import (Evaluation, RegressionEvaluation, ROC,
+                                     EvaluationBinary)
+
+
+def _mlp(n_in=4, n_hidden=8, n_out=3, seed=42, updater="adam"):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+            .updater(updater).weight_init("xavier").activation("tanh").list()
+            .layer(DenseLayer(n_out=n_hidden))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _cls_data(rng, n=64, n_in=4, n_out=3):
+    X = rng.normal(size=(n, n_in)).astype(np.float32)
+    W = np.random.default_rng(7).normal(size=(n_in, n_out))
+    y = np.eye(n_out)[np.argmax(X @ W, axis=1)].astype(np.float32)
+    return DataSet(X, y)
+
+
+class TestSerialization:
+    def test_roundtrip_params_and_updater(self, tmp_path, rng_np):
+        net = _mlp()
+        ds = _cls_data(rng_np)
+        net.fit(ds, num_epochs=3)
+        path = tmp_path / "model.zip"
+        ModelSerializer.write_model(net, path)
+        net2 = ModelSerializer.restore_multi_layer_network(path)
+        np.testing.assert_allclose(net.params_flat(), net2.params_flat())
+        assert net2.iteration == net.iteration
+        # same predictions
+        np.testing.assert_allclose(net.output(ds.features),
+                                   net2.output(ds.features), rtol=1e-5)
+        # resume training continues identically (updater state preserved)
+        net.fit(ds, num_epochs=1)
+        net2.fit(ds, num_epochs=1)
+        np.testing.assert_allclose(net.params_flat(), net2.params_flat(),
+                                   rtol=1e-5)
+
+    def test_model_guesser(self, tmp_path, rng_np):
+        net = _mlp()
+        path = tmp_path / "m.zip"
+        ModelSerializer.write_model(net, path)
+        loaded = ModelGuesser.load_model_guess_type(path)
+        assert isinstance(loaded, MultiLayerNetwork)
+
+    def test_graph_roundtrip(self, tmp_path, rng_np):
+        g = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+             .updater("sgd").weight_init("xavier").activation("relu")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_out=6), "in")
+             .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                           activation="softmax"), "d")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4)).build())
+        net = ComputationGraph(g).init()
+        ds = _cls_data(rng_np, n_out=2)
+        net.fit_batch(ds)
+        path = tmp_path / "g.zip"
+        ModelSerializer.write_model(net, path)
+        net2 = ModelSerializer.restore_computation_graph(path)
+        np.testing.assert_allclose(net.params_flat(), net2.params_flat())
+
+
+class TestEarlyStopping:
+    def test_max_epochs_and_best_model(self, rng_np):
+        net = _mlp()
+        ds = _cls_data(rng_np)
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                ListDataSetIterator([ds])),
+            epoch_terminations=[MaxEpochsTerminationCondition(5)])
+        result = EarlyStoppingTrainer(es, net, [ds]).fit()
+        assert result.total_epochs <= 5
+        assert result.best_model is not None
+        assert result.best_model_score <= result.score_vs_epoch[0] + 1e-9
+
+    def test_patience(self, rng_np):
+        net = _mlp(updater="sgd")
+        net.layers[0].learning_rate = 0.0   # nothing improves
+        net.layers[1].learning_rate = 0.0
+        ds = _cls_data(rng_np)
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ListDataSetIterator([ds])),
+            epoch_terminations=[
+                ScoreImprovementEpochTerminationCondition(patience=2),
+                MaxEpochsTerminationCondition(50)])
+        result = EarlyStoppingTrainer(es, net, [ds]).fit()
+        assert result.total_epochs <= 6
+        assert result.termination_details == \
+            "ScoreImprovementEpochTerminationCondition"
+
+    def test_invalid_score_bailout(self, rng_np):
+        cond = InvalidScoreIterationTerminationCondition()
+        assert cond.terminate(0, float("nan"))
+        assert cond.terminate(0, float("inf"))
+        assert not cond.terminate(0, 1.0)
+
+    def test_max_score_bailout(self, rng_np):
+        from deeplearning4j_tpu.earlystopping import \
+            MaxScoreIterationTerminationCondition
+        net = _mlp(updater="sgd")
+        for l in net.layers:
+            l.learning_rate = 1e6   # guaranteed divergence
+        ds = _cls_data(rng_np)
+        es = EarlyStoppingConfiguration(
+            score_calculator=None,
+            iteration_terminations=[
+                MaxScoreIterationTerminationCondition(1e4),
+                InvalidScoreIterationTerminationCondition()],
+            epoch_terminations=[MaxEpochsTerminationCondition(200)])
+        result = EarlyStoppingTrainer(es, net, [ds] * 20).fit()
+        assert result.termination_reason == "IterationTermination"
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_head(self, rng_np):
+        net = _mlp(n_out=3)
+        ds = _cls_data(rng_np)
+        net.fit(ds, num_epochs=2)
+        frozen_w = np.asarray(net.params[0]["W"]).copy()
+        new_net = (TransferLearning.Builder(net)
+                   .fine_tune_configuration(
+                       FineTuneConfiguration(learning_rate=0.01,
+                                             updater="sgd"))
+                   .set_feature_extractor(0)
+                   .remove_output_layer()
+                   .add_layer(OutputLayer(n_out=5, loss="mcxent",
+                                          activation="softmax"))
+                   .build())
+        assert new_net.layers[-1].n_out == 5
+        y5 = np.eye(5)[rng_np.integers(0, 5, 64)].astype(np.float32)
+        new_net.fit(DataSet(ds.features, y5), num_epochs=2)
+        # frozen layer unchanged (lr=0)
+        np.testing.assert_allclose(np.asarray(new_net.params[0]["W"]),
+                                   frozen_w, rtol=1e-6)
+
+    def test_featurize_helper(self, rng_np):
+        net = _mlp()
+        helper = TransferLearningHelper(net, frozen_until=0)
+        ds = _cls_data(rng_np)
+        feat = helper.featurize(ds)
+        assert feat.features.shape == (64, 8)
+
+    def test_nout_replace(self, rng_np):
+        net = _mlp()
+        new_net = (TransferLearning.Builder(net)
+                   .n_out_replace(0, 16).build())
+        assert new_net.layers[0].n_out == 16
+        assert new_net.layers[1].n_in == 16
+        out = new_net.output(_cls_data(rng_np).features)
+        assert out.shape == (64, 3)
+
+
+class TestRnnFeatures:
+    def _rnn_net(self, tbptt=False):
+        b = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.05)
+             .updater("adam").weight_init("xavier").list()
+             .layer(GravesLSTM(n_out=6, activation="tanh"))
+             .layer(RnnOutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax")))
+        if tbptt:
+            b.tbptt_fwd_length(4).tbptt_back_length(4)
+        conf = b.set_input_type(InputType.recurrent(2)).build()
+        return MultiLayerNetwork(conf).init()
+
+    def test_tbptt_runs_and_learns(self, rng_np):
+        net = self._rnn_net(tbptt=True)
+        X = rng_np.normal(size=(4, 12, 2)).astype(np.float32)
+        y = np.eye(3)[rng_np.integers(0, 3, (4, 12))].astype(np.float32)
+        ds = DataSet(X, y)
+        s0 = net.score(ds)
+        net.fit(ds, num_epochs=5)
+        assert net.iteration == 5 * 3  # 12 steps / window 4 = 3 per epoch
+        assert net.score(ds) < s0
+
+    def test_rnn_time_step_matches_full_forward(self, rng_np):
+        net = self._rnn_net()
+        X = rng_np.normal(size=(2, 5, 2)).astype(np.float32)
+        full = net.output(X)
+        net.rnn_clear_previous_state()
+        stepped = [net.rnn_time_step(X[:, t, :]) for t in range(5)]
+        for t in range(5):
+            np.testing.assert_allclose(stepped[t], full[:, t, :], rtol=1e-4,
+                                       atol=1e-5)
+        # state reset changes the result
+        net.rnn_clear_previous_state()
+        again = net.rnn_time_step(X[:, 0, :])
+        np.testing.assert_allclose(again, stepped[0], rtol=1e-5)
+
+
+class TestPretrain:
+    def test_autoencoder_pretrain_reduces_loss(self, rng_np):
+        conf = (NeuralNetConfiguration.Builder().seed(5).learning_rate(0.05)
+                .updater("adam").weight_init("xavier").activation("sigmoid")
+                .list()
+                .layer(AutoEncoder(n_out=6, corruption_level=0.2, loss="mse"))
+                .layer(OutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(10)).build())
+        net = MultiLayerNetwork(conf).init()
+        X = rng_np.normal(size=(32, 10)).astype(np.float32)
+        ds = DataSet(X, np.eye(3)[rng_np.integers(0, 3, 32)].astype(np.float32))
+        net.pretrain([ds], num_epochs=1)
+        first = net.score_value
+        net.pretrain([ds], num_epochs=10)
+        assert net.score_value < first
+
+    def test_vae_pretrain(self, rng_np):
+        layer = VariationalAutoencoder(
+            n_in=8, n_out=3, encoder_layer_sizes=[12],
+            decoder_layer_sizes=[12], activation="tanh",
+            reconstruction_distribution="gaussian", weight_init="xavier")
+        import jax
+        params = layer.init_params(jax.random.PRNGKey(0))
+        X = jnp.asarray(rng_np.normal(size=(16, 8)).astype(np.float32))
+        loss = layer.pretrain_loss(params, X, jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+        g = jax.grad(lambda p: layer.pretrain_loss(p, X,
+                                                   jax.random.PRNGKey(1)))(params)
+        assert all(bool(jnp.all(jnp.isfinite(v))) for v in g.values())
+
+
+class TestGraphVertices:
+    def test_rnn_graph_last_timestep(self, rng_np):
+        g = (NeuralNetConfiguration.Builder().seed(2).learning_rate(0.05)
+             .updater("adam").weight_init("xavier")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("lstm", GravesLSTM(n_out=5, activation="tanh"), "in")
+             .add_vertex("last", LastTimeStepVertex(), "lstm")
+             .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                           activation="softmax"), "last")
+             .set_outputs("out")
+             .set_input_types(InputType.recurrent(3, 6)).build())
+        net = ComputationGraph(g).init()
+        X = rng_np.normal(size=(4, 6, 3)).astype(np.float32)
+        y = np.eye(2)[rng_np.integers(0, 2, 4)].astype(np.float32)
+        ds = DataSet(X, y)
+        s0 = net.score(ds)
+        for _ in range(30):
+            net.fit_batch(ds)
+        assert net.score(ds) < s0
+
+    def test_stack_unstack_l2norm(self, rng_np):
+        g = (NeuralNetConfiguration.Builder().seed(2).learning_rate(0.05)
+             .updater("sgd").weight_init("xavier")
+             .graph_builder()
+             .add_inputs("a", "b")
+             .add_vertex("stack", StackVertex(), "a", "b")
+             .add_layer("d", DenseLayer(n_out=4, activation="relu"), "stack")
+             .add_vertex("u0", UnstackVertex(index=0, num_stacks=2), "d")
+             .add_vertex("u1", UnstackVertex(index=1, num_stacks=2), "d")
+             .add_vertex("sum", ElementWiseVertex(op="add"), "u0", "u1")
+             .add_vertex("norm", L2NormalizeVertex(), "sum")
+             .add_layer("out", OutputLayer(n_out=2, loss="mse",
+                                           activation="identity"), "norm")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(3),
+                              InputType.feed_forward(3)).build())
+        net = ComputationGraph(g).init()
+        from deeplearning4j_tpu.ops.dataset import MultiDataSet
+        a = rng_np.normal(size=(6, 3)).astype(np.float32)
+        b = rng_np.normal(size=(6, 3)).astype(np.float32)
+        y = rng_np.normal(size=(6, 2)).astype(np.float32)
+        mds = MultiDataSet([a, b], [y])
+        net.fit_batch(mds)
+        assert np.isfinite(net.score_value)
+
+
+class TestEvalSuite:
+    def test_evaluation_metrics(self):
+        ev = Evaluation()
+        labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+        preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]
+        ev.eval(labels, preds)
+        assert ev.accuracy() == pytest.approx(4 / 6)
+        assert ev.true_positives(1) == 2
+        assert ev.false_positives(1) == 1
+        assert "Accuracy" in ev.stats()
+
+    def test_regression_eval(self, rng_np):
+        re = RegressionEvaluation()
+        y = rng_np.normal(size=(100, 2))
+        p = y + rng_np.normal(0, 0.1, size=(100, 2))
+        re.eval(y, p)
+        assert re.r_squared(0) > 0.9
+        assert re.mean_squared_error(0) < 0.05
+        assert re.pearson_correlation(1) > 0.9
+
+    def test_roc_auc(self, rng_np):
+        roc = ROC()
+        scores = rng_np.uniform(0, 1, 500)
+        labels = (scores + rng_np.normal(0, 0.2, 500) > 0.5).astype(float)
+        roc.eval(labels, scores)
+        auc = roc.calculate_auc()
+        assert 0.8 < auc <= 1.0
+        # random scores -> AUC ~ 0.5
+        roc2 = ROC()
+        roc2.eval(rng_np.integers(0, 2, 500).astype(float),
+                  rng_np.uniform(0, 1, 500))
+        assert 0.4 < roc2.calculate_auc() < 0.6
+
+    def test_evaluation_binary(self):
+        eb = EvaluationBinary()
+        labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]], float)
+        preds = np.array([[0.9, 0.2], [0.8, 0.9], [0.3, 0.1], [0.6, 0.7]],
+                         float)
+        eb.eval(labels, preds)
+        assert eb.accuracy(0) == pytest.approx(3 / 4)
+        assert eb.recall(1) == pytest.approx(1.0)
+
+
+class TestListeners:
+    def test_score_and_collect(self, rng_np, capsys):
+        from deeplearning4j_tpu.optimize import (ScoreIterationListener,
+                                                 CollectScoresIterationListener)
+        net = _mlp()
+        collect = CollectScoresIterationListener()
+        net.set_listeners(ScoreIterationListener(2), collect)
+        ds = _cls_data(rng_np)
+        net.fit([ds] * 6)
+        assert len(collect.scores) == 6
+        assert "Score at iteration" in capsys.readouterr().out

@@ -1,0 +1,190 @@
+"""NLP + graph embedding tests (reference nlp test strategy: raw_sentences
+corpus → similarity assertions; SURVEY.md §4). Synthetic corpora with planted
+co-occurrence structure are the oracle: words from the same topic must embed
+closer than words from different topics."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    VocabConstructor, build_huffman, Word2Vec, ParagraphVectors, Glove,
+    SequenceVectors, DefaultTokenizerFactory, NGramTokenizerFactory,
+    CommonPreprocessor, CollectionSentenceIterator, BagOfWordsVectorizer,
+    TfidfVectorizer, WordVectorSerializer, StaticWord2Vec)
+from deeplearning4j_tpu.graph_embeddings import (Graph, RandomWalkIterator,
+                                                 WeightedWalkIterator,
+                                                 DeepWalk,
+                                                 GraphVectorSerializer)
+
+
+def _topic_corpus(rng, n_sentences=300, sentence_len=8):
+    """Two topics with disjoint vocabularies → intra-topic words co-occur."""
+    topic_a = [f"alpha{i}" for i in range(8)]
+    topic_b = [f"beta{i}" for i in range(8)]
+    seqs = []
+    for s in range(n_sentences):
+        words = topic_a if s % 2 == 0 else topic_b
+        seqs.append([words[rng.integers(0, len(words))]
+                     for _ in range(sentence_len)])
+    return seqs, topic_a, topic_b
+
+
+class TestVocabHuffman:
+    def test_vocab_build_trim_order(self):
+        seqs = [["a", "a", "a", "b", "b", "c"]] * 2
+        vocab = VocabConstructor(min_word_frequency=3).build(seqs)
+        assert "c" not in vocab           # freq 2 < 3
+        assert vocab.index_of("a") == 0   # most frequent first
+        assert vocab.word_frequency("b") == 4
+
+    def test_huffman_prefix_property(self):
+        freqs = [50, 30, 10, 5, 3, 2]
+        codes, points = build_huffman(freqs)
+        strs = ["".join(map(str, c)) for c in codes]
+        # prefix-free
+        for i, a in enumerate(strs):
+            for j, b in enumerate(strs):
+                if i != j:
+                    assert not b.startswith(a)
+        # frequent words get shorter codes
+        assert len(codes[0]) <= len(codes[-1])
+
+
+class TestWord2Vec:
+    def test_skipgram_hs_topic_similarity(self, rng_np):
+        seqs, topic_a, topic_b = _topic_corpus(rng_np)
+        w2v = (Word2Vec.Builder().layer_size(24).window_size(3)
+               .min_word_frequency(1).learning_rate(0.05).epochs(3)
+               .seed(1).batch_size(512).build())
+        w2v.fit(seqs)
+        intra = w2v.similarity(topic_a[0], topic_a[1])
+        inter = w2v.similarity(topic_a[0], topic_b[0])
+        assert intra > inter, (intra, inter)
+        near = w2v.words_nearest(topic_a[0], n=5)
+        assert sum(w.startswith("alpha") for w in near) >= 3
+
+    def test_negative_sampling_path(self, rng_np):
+        seqs, topic_a, topic_b = _topic_corpus(rng_np, n_sentences=200)
+        w2v = (Word2Vec.Builder().layer_size(16).window_size(3)
+               .negative_sample(5).epochs(10).seed(2).batch_size(256).build())
+        w2v.fit(seqs)
+        assert w2v.similarity(topic_a[0], topic_a[1]) > \
+            w2v.similarity(topic_a[0], topic_b[0])
+
+    def test_serializer_roundtrip(self, tmp_path, rng_np):
+        seqs, topic_a, _ = _topic_corpus(rng_np, n_sentences=50)
+        w2v = (Word2Vec.Builder().layer_size(8).epochs(1).seed(3).build())
+        w2v.fit(seqs)
+        txt = tmp_path / "vecs.txt"
+        WordVectorSerializer.write_word_vectors(w2v, txt)
+        vocab, vecs = WordVectorSerializer.load_txt_vectors(txt)
+        assert len(vocab) == len(w2v.vocab)
+        np.testing.assert_allclose(
+            vecs[vocab.index_of(topic_a[0])],
+            w2v.get_word_vector(topic_a[0]), atol=1e-5)
+        npz = tmp_path / "vecs.npz"
+        WordVectorSerializer.write_word_vectors_binary(w2v, npz)
+        static = StaticWord2Vec.load(npz)
+        np.testing.assert_allclose(static.get_word_vector(topic_a[0]),
+                                   w2v.get_word_vector(topic_a[0]), atol=1e-5)
+
+
+class TestParagraphVectors:
+    def test_dbow_labels_cluster(self, rng_np):
+        seqs, topic_a, topic_b = _topic_corpus(rng_np, n_sentences=100)
+        docs = [(f"doc{i}", s) for i, s in enumerate(seqs[:40])]
+        pv = ParagraphVectors(vector_length=16, epochs=5, seed=4,
+                              learning_rate=0.05)
+        pv.fit_documents(docs)
+        # doc0 (topic a) closer to doc2 (topic a) than doc1 (topic b)
+        d0 = pv.get_doc_vector("doc0")
+        d1 = pv.get_doc_vector("doc1")
+        d2 = pv.get_doc_vector("doc2")
+        cos = lambda a, b: a @ b / (np.linalg.norm(a) * np.linalg.norm(b))
+        assert cos(d0, d2) > cos(d0, d1)
+        v = pv.infer_vector(seqs[0])
+        assert v.shape == (16,)
+
+
+class TestGlove:
+    def test_glove_topic_similarity(self, rng_np):
+        seqs, topic_a, topic_b = _topic_corpus(rng_np, n_sentences=200)
+        glove = Glove(vector_length=16, window=3, epochs=20,
+                      learning_rate=0.05, seed=5)
+        glove.fit(seqs)
+        assert glove.similarity(topic_a[0], topic_a[1]) > \
+            glove.similarity(topic_a[0], topic_b[0])
+
+
+class TestTokenization:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        tokens = tf.create("Hello, World! 123 foo").get_tokens()
+        assert tokens == ["hello", "world", "foo"]
+
+    def test_ngram(self):
+        tf = NGramTokenizerFactory(1, 2)
+        tokens = tf.create("a b c").get_tokens()
+        assert "a b" in tokens and "b c" in tokens and "a" in tokens
+
+    def test_sentence_iterator(self):
+        it = CollectionSentenceIterator(["one two", "three"])
+        assert list(it) == ["one two", "three"]
+
+
+class TestVectorizers:
+    def test_bow(self):
+        bow = BagOfWordsVectorizer()
+        mat = bow.fit_transform(["cat dog cat", "dog bird"])
+        assert mat.shape == (2, 3)
+        cat = bow.vocab.index_of("cat")
+        assert mat[0, cat] == 2.0
+
+    def test_tfidf(self):
+        tfidf = TfidfVectorizer()
+        mat = tfidf.fit_transform(["cat dog", "cat bird", "cat fish"])
+        cat = tfidf.vocab.index_of("cat")
+        bird = tfidf.vocab.index_of("bird")
+        assert mat[1, bird] > mat[1, cat]   # rare word weighted higher
+
+
+class TestDeepWalk:
+    def _two_cluster_graph(self):
+        g = Graph(10)
+        for i in range(5):
+            for j in range(i + 1, 5):
+                g.add_edge(i, j)
+                g.add_edge(i + 5, j + 5)
+        g.add_edge(0, 5)   # single bridge
+        return g
+
+    def test_clusters_embed_together(self):
+        g = self._two_cluster_graph()
+        dw = (DeepWalk.Builder().vector_size(16).window_size(3)
+              .learning_rate(0.05).seed(6).build())
+        dw.fit(g, walk_length=20, walks_per_vertex=8)
+        intra = dw.similarity(1, 2)
+        inter = dw.similarity(1, 7)
+        assert intra > inter, (intra, inter)
+
+    def test_walk_iterators(self):
+        g = self._two_cluster_graph()
+        walks = list(RandomWalkIterator(g, walk_length=5, seed=1))
+        assert len(walks) == 10
+        assert all(len(w) == 6 for w in walks)
+        wg = Graph(3)
+        wg.add_edge(0, 1, weight=100.0)
+        wg.add_edge(0, 2, weight=0.001)
+        heavy = list(WeightedWalkIterator(wg, walk_length=1, seed=2))
+        starts_at_0 = [w for w in heavy if w[0] == 0]
+        assert all(w[1] == 1 for w in starts_at_0)
+
+    def test_serialization(self, tmp_path):
+        g = self._two_cluster_graph()
+        dw = DeepWalk(vector_size=8, seed=7)
+        dw.fit(g, walk_length=10)
+        path = tmp_path / "gv.txt"
+        GraphVectorSerializer.write_graph_vectors(dw, path)
+        vecs = GraphVectorSerializer.load_graph_vectors(path)
+        np.testing.assert_allclose(vecs, np.asarray(dw.vertex_vectors),
+                                   atol=1e-5)

@@ -1,0 +1,86 @@
+"""Ring attention / sequence parallelism tests: exact equivalence of the
+sharded ring path vs single-device attention on the 8-device CPU mesh
+(SURVEY.md §4 'distributed without a cluster' pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.sequence import (ring_self_attention,
+                                                  attention_reference)
+
+
+@pytest.fixture(scope="module")
+def mesh_sp():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:8]), ("sp",))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, mesh_sp, causal, rng_np):
+        b, t, h, d = 2, 32, 4, 8   # t divisible by 8 devices
+        q = jnp.asarray(rng_np.normal(size=(b, t, h, d)), jnp.float32)
+        k = jnp.asarray(rng_np.normal(size=(b, t, h, d)), jnp.float32)
+        v = jnp.asarray(rng_np.normal(size=(b, t, h, d)), jnp.float32)
+        expect = attention_reference(q, k, v, causal=causal)
+        got = ring_self_attention(q, k, v, mesh_sp, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_grads_flow(self, mesh_sp, rng_np):
+        b, t, h, d = 1, 16, 2, 4
+        q = jnp.asarray(rng_np.normal(size=(b, t, h, d)), jnp.float32)
+        k = jnp.asarray(rng_np.normal(size=(b, t, h, d)), jnp.float32)
+        v = jnp.asarray(rng_np.normal(size=(b, t, h, d)), jnp.float32)
+
+        def loss_ring(q):
+            return jnp.sum(ring_self_attention(q, k, v, mesh_sp) ** 2)
+
+        def loss_ref(q):
+            return jnp.sum(attention_reference(q, k, v) ** 2)
+
+        g_ring = jax.grad(loss_ring)(q)
+        g_ref = jax.grad(loss_ref)(q)
+        np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                                   rtol=2e-3, atol=2e-4)
+
+
+class TestAttentionLayer:
+    def test_forward_and_gradcheck(self, rng_np):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import (SelfAttentionLayer,
+                                                       GlobalPoolingLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        conf = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.05)
+                .updater("sgd").weight_init("xavier").activation("identity")
+                .list()
+                .layer(SelfAttentionLayer(n_out=8, num_heads=2))
+                .layer(GlobalPoolingLayer(pooling_type="avg"))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.recurrent(3, 6)).build())
+        net = MultiLayerNetwork(conf, compute_dtype=jnp.float64).init()
+        X = rng_np.normal(size=(2, 6, 3))
+        y = np.eye(2)[rng_np.integers(0, 2, 2)].astype(np.float64)
+        assert check_gradients(net, DataSet(X, y), subsample=60)
+
+    def test_causal_masking(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        layer = SelfAttentionLayer(n_in=4, n_out=8, num_heads=2, causal=True,
+                                   weight_init="xavier")
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng_np.normal(size=(1, 5, 4)), jnp.float32)
+        y1, _ = layer.forward(params, {}, x)
+        # changing future tokens must not affect past outputs
+        x2 = x.at[:, 3:].set(0.0)
+        y2, _ = layer.forward(params, {}, x2)
+        np.testing.assert_allclose(np.asarray(y1[:, :3]),
+                                   np.asarray(y2[:, :3]), rtol=1e-5)

@@ -1,0 +1,115 @@
+"""Observability + parallel-inference tests (reference UI/storage tests and
+ParallelInferenceTest; SURVEY.md §4)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.ui import (StatsListener, InMemoryStatsStorage,
+                                   FileStatsStorage, SqliteStatsStorage,
+                                   UIServer, RemoteStatsRouter)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder().seed(9).learning_rate(0.1)
+            .updater("sgd").weight_init("xavier").activation("tanh").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _ds(rng):
+    X = rng.normal(size=(16, 3)).astype(np.float32)
+    y = np.eye(2)[rng.integers(0, 2, 16)].astype(np.float32)
+    return DataSet(X, y)
+
+
+class TestStatsPipeline:
+    def test_listener_collects(self, rng_np):
+        storage = InMemoryStatsStorage()
+        net = _net()
+        net.set_listeners(StatsListener(storage, session_id="t1",
+                                        histograms_frequency=2))
+        net.fit([_ds(rng_np)] * 6)
+        ups = storage.get_updates("t1")
+        assert len(ups) == 6
+        assert all(np.isfinite(u["score"]) for u in ups)
+        info = storage.get_static_info("t1")
+        assert info["num_params"] == net.num_params()
+        assert any("param_histograms" in u for u in ups)
+
+    def test_file_and_sqlite_storage(self, tmp_path, rng_np):
+        for storage in (FileStatsStorage(tmp_path / "s.jsonl"),
+                        SqliteStatsStorage(tmp_path / "s.db")):
+            net = _net()
+            net.set_listeners(StatsListener(storage, session_id="s2"))
+            net.fit([_ds(rng_np)] * 3)
+            assert len(storage.get_updates("s2")) == 3
+            assert storage.list_sessions() == ["s2"]
+
+    def test_ui_server_endpoints(self, rng_np):
+        storage = InMemoryStatsStorage()
+        net = _net()
+        net.set_listeners(StatsListener(storage, session_id="web"))
+        net.fit([_ds(rng_np)] * 3)
+        server = UIServer(port=0).attach(storage)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            sessions = json.loads(urllib.request.urlopen(
+                base + "/train/sessions", timeout=5).read())
+            assert sessions == ["web"]
+            ups = json.loads(urllib.request.urlopen(
+                base + "/train/updates?session=web", timeout=5).read())
+            assert len(ups) == 3
+            page = urllib.request.urlopen(base + "/", timeout=5).read()
+            assert b"Training overview" in page
+            # remote push path
+            router = RemoteStatsRouter(base)
+            router.put_update({"session": "remote", "type": "update",
+                               "iteration": 1, "score": 0.5})
+            assert "remote" in json.loads(urllib.request.urlopen(
+                base + "/train/sessions", timeout=5).read())
+        finally:
+            server.stop()
+
+
+class TestParallelInference:
+    def test_batched_matches_direct(self, rng_np):
+        from deeplearning4j_tpu.parallel.inference import (ParallelInference,
+                                                           InferenceMode)
+        net = _net()
+        X = rng_np.normal(size=(20, 3)).astype(np.float32)
+        direct = net.output(X)
+        pi = (ParallelInference.Builder(net)
+              .inference_mode(InferenceMode.BATCHED).batch_limit(8).build())
+        out = pi.output(X)
+        np.testing.assert_allclose(out, direct, rtol=1e-5, atol=1e-6)
+        pi2 = (ParallelInference.Builder(net)
+               .inference_mode(InferenceMode.SEQUENTIAL).build())
+        np.testing.assert_allclose(pi2.output(X), direct, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_concurrent_batched(self, rng_np):
+        import threading
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net = _net()
+        pi = ParallelInference.Builder(net).batch_limit(64).build()
+        X = rng_np.normal(size=(4, 3)).astype(np.float32)
+        expect = net.output(X)
+        results = [None] * 8
+        def call(i):
+            results[i] = pi.output(X)
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in results:
+            np.testing.assert_allclose(r, expect, rtol=1e-5, atol=1e-6)
