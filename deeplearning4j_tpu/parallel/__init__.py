@@ -1,11 +1,26 @@
 """Parallel training/inference over device meshes (reference
 deeplearning4j-scaleout; SURVEY.md §2.4): data parallelism (sync sharded-batch
-and local-steps/parameter-averaging modes), ComputationGraph DP trainer,
-parallel inference, multi-host init, sequence parallelism."""
+and local-steps/parameter-averaging modes, matching the reference's
+ParallelWrapper semantics), ComputationGraph DP trainer, parallel inference,
+multi-host init — plus the TPU-era extensions the reference lacks: tensor
+parallelism (tensor.py), pipeline parallelism (pipeline.py), expert
+parallelism / MoE (expert.py), and sequence parallelism via ring attention
+(sequence.py)."""
 
 from .mesh import make_mesh, replicated, batch_sharded
 from .wrapper import ParallelWrapper
 from .graph_wrapper import GraphDataParallelTrainer
+from .tensor import ShardedTrainer, TensorParallelTrainer, tp_param_specs
+from .pipeline import PipelineParallelTrainer, pipeline_apply
+from .expert import (MixtureOfExpertsLayer, ExpertParallelTrainer,
+                     ep_param_specs)
+from .sequence import (ring_self_attention, attention_reference,
+                       SequenceParallelTrainer)
 
 __all__ = ["make_mesh", "replicated", "batch_sharded", "ParallelWrapper",
-           "GraphDataParallelTrainer"]
+           "GraphDataParallelTrainer", "ShardedTrainer",
+           "TensorParallelTrainer", "tp_param_specs",
+           "PipelineParallelTrainer", "pipeline_apply",
+           "MixtureOfExpertsLayer", "ExpertParallelTrainer", "ep_param_specs",
+           "ring_self_attention", "attention_reference",
+           "SequenceParallelTrainer"]

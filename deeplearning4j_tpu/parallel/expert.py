@@ -1,0 +1,151 @@
+"""Expert parallelism: Mixture-of-Experts layer with experts sharded over an
+``ep`` mesh axis.
+
+The reference has no MoE or expert parallelism (SURVEY.md §2.4 taxonomy
+note); this is the TPU-era extension, built the GSPMD way (Switch/T5X
+recipe): routing is expressed as dense one-hot dispatch/combine einsums over
+a capacity-bounded buffer — all static shapes, all MXU work — and the expert
+dimension of the stacked FFN weights is sharded over the mesh. XLA then
+partitions the einsums and inserts the token all-to-alls itself; there is no
+hand-written collective, so the EP program is numerically identical to the
+single-device one (asserted by the CPU-mesh test).
+
+``MixtureOfExpertsLayer`` is an ordinary layer conf: it drops into
+MultiLayerNetwork, is gradient-checkable, and serializes like every other
+layer. ``ep_param_specs`` + the generic ShardedTrainer (tensor.py) activate
+expert parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..nn.conf.input_type import InputType
+from ..nn.conf.serde import register_config
+from ..nn.conf.layers.base import FeedForwardLayerConf
+from .tensor import ShardedTrainer
+from .mesh import make_mesh
+
+
+@register_config
+@dataclasses.dataclass
+class MixtureOfExpertsLayer(FeedForwardLayerConf):
+    """Top-1 (Switch) routed FFN: x [N, n_in] → [N, n_out].
+
+    Tokens are routed to one of ``num_experts`` two-layer FFNs with hidden
+    width ``expert_hidden``; each expert accepts at most
+    ``ceil(N / num_experts * capacity_factor)`` tokens per batch (overflow
+    tokens pass through the residual path with zero expert output — the
+    standard Switch drop policy, shape-static for XLA).
+    """
+    num_experts: int = 4
+    expert_hidden: int = 0          # default 4 * n_in
+    capacity_factor: float = 1.25
+    router_jitter: float = 0.0      # optional routing noise at train time
+
+    def _hidden(self) -> int:
+        return self.expert_hidden or 4 * self.n_in
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        e, d, h = self.num_experts, self.n_in, self._hidden()
+        kg, k1, k2 = jax.random.split(key, 3)
+        return {
+            "Wg": self._winit(kg, (d, e), d, e, dtype),
+            "We1": self._winit(k1, (e, d, h), d, h, dtype),
+            "be1": jnp.zeros((e, h), dtype),
+            "We2": self._winit(k2, (e, h, self.n_out), h, self.n_out, dtype),
+            "be2": jnp.zeros((e, self.n_out), dtype),
+        }
+
+    def regularizable(self):
+        return ("We1", "We2")
+
+    def capacity(self, n_tokens: int) -> int:
+        import math
+        return max(1, int(math.ceil(
+            n_tokens / self.num_experts * self.capacity_factor)))
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        seq = x.ndim == 3
+        if seq:
+            n0, t0, d0 = x.shape
+            x = x.reshape(n0 * t0, d0)
+        n = x.shape[0]
+        e = self.num_experts
+        cap = self.capacity(n)
+
+        logits = x @ params["Wg"]                       # [N, E]
+        if train and self.router_jitter and rng is not None:
+            logits = logits + self.router_jitter * \
+                jax.random.normal(rng, logits.shape, logits.dtype)
+        gates = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(gates, axis=-1)          # [N]
+        gate_val = jnp.max(gates, axis=-1)               # [N]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=x.dtype)   # [N, E]
+        # position of each token within its expert's capacity buffer
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # [N, E]
+        keep = (pos >= 0) & (pos < cap)
+        pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, cap - 1).astype(jnp.int32),
+                                cap, dtype=x.dtype)              # [N, E, C]
+        dispatch = pos_oh * keep.astype(x.dtype)[..., None]      # [N, E, C]
+        combine = dispatch * gate_val[:, None, None]
+
+        # token shuffle in, expert FFN, shuffle out — three MXU einsums;
+        # with We*/be* sharded P("ep",...) GSPMD turns the first/last into
+        # all-to-alls over the expert axis
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)       # [E, C, d]
+        h = self.activation_fn()(
+            jnp.einsum("ecd,edh->ech", expert_in, params["We1"])
+            + params["be1"][:, None, :])
+        expert_out = jnp.einsum("ech,eho->eco", h, params["We2"]) \
+            + params["be2"][:, None, :]
+        y = jnp.einsum("nec,eco->no", combine, expert_out)       # [N, n_out]
+        if seq:
+            y = y.reshape(n0, t0, -1)
+        return y, state
+
+    def load_balance_loss(self, params, x) -> jnp.ndarray:
+        """Switch aux loss: E * sum_e(fraction_tokens_e * mean_prob_e)."""
+        if x.ndim == 3:
+            x = x.reshape(-1, x.shape[-1])
+        gates = jax.nn.softmax(x @ params["Wg"], axis=-1)
+        frac = jnp.mean(jax.nn.one_hot(jnp.argmax(gates, -1),
+                                       self.num_experts, dtype=x.dtype), 0)
+        prob = jnp.mean(gates, axis=0)
+        return self.num_experts * jnp.sum(frac * prob)
+
+
+def ep_param_specs(net, expert_axis: str = "ep") -> List[dict]:
+    """Shard every MoE layer's expert-stacked leaves over ``expert_axis``."""
+    net._ensure_init()
+    specs = []
+    for layer in net.layers:
+        if isinstance(layer, MixtureOfExpertsLayer):
+            specs.append({
+                "We1": P(expert_axis, None, None),
+                "be1": P(expert_axis, None),
+                "We2": P(expert_axis, None, None),
+                "be2": P(expert_axis, None),
+            })
+        else:
+            specs.append({})
+    return specs
+
+
+class ExpertParallelTrainer(ShardedTrainer):
+    """EP (optionally × DP): experts sharded over ``ep``, batch over ``data``."""
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 expert_axis: str = "ep", batch_axis: str = "data"):
+        if mesh is None:
+            mesh = make_mesh(axis_names=("data", "ep"),
+                             shape=(1, len(jax.devices())))
+        net._ensure_init()
+        super().__init__(net, mesh, ep_param_specs(net, expert_axis),
+                         batch_axis)

@@ -1,0 +1,222 @@
+"""Tensor (model) parallelism: parameters sharded over a ``model`` mesh axis.
+
+The reference implements data parallelism only (SURVEY.md §2.4 taxonomy note);
+TP is the TPU-era extension the survey prescribes designing fresh. The design
+is pure GSPMD: we assign a ``PartitionSpec`` to every parameter leaf and jit
+the UNMODIFIED train step with those shardings — XLA partitions the matmuls
+onto the MXU per device and inserts the ICI collectives (all-gather /
+reduce-scatter) itself. No manual collective calls, so the numerics are
+bit-identical to the single-device program (the CPU-mesh test asserts this).
+
+Spec assignment is Megatron-style alternation for dense stacks:
+
+- column-parallel: ``W [in, out]`` → ``P(None, model)``, ``b`` → ``P(model)``
+  (output features sharded, no communication on the forward matmul);
+- the NEXT projection is row-parallel: ``W`` → ``P(model, None)``, ``b``
+  replicated (GSPMD inserts the psum that completes the contraction);
+- convs alternate on the HWIO channel dims the same way; attention shards
+  heads (Wq/Wk/Wv column, Wo row); everything else (BN scales, LSTM gates)
+  is replicated — GSPMD handles mixed layouts.
+
+``ShardedTrainer`` is the generic jit-with-shardings driver; expert
+parallelism (expert.py) reuses it with expert-dim specs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.dataset import DataSet
+from .mesh import make_mesh
+
+
+def _spec_for_layer(layer, col_first: bool, model_axis: str):
+    """(specs_dict, next_col_first). Alternates column/row parallelism."""
+    from ..nn.conf.layers.feedforward import (DenseLayer, OutputLayer,
+                                              EmbeddingLayer)
+    from ..nn.conf.layers.convolution import ConvolutionLayer
+    from ..nn.conf.layers.attention import SelfAttentionLayer
+
+    if isinstance(layer, SelfAttentionLayer):
+        # heads sharded: q/k/v column-parallel, output projection row-parallel
+        specs = {"Wq": P(None, model_axis), "Wk": P(None, model_axis),
+                 "Wv": P(None, model_axis)}
+        if layer.project_out:
+            specs["Wo"] = P(model_axis, None)
+            specs["bo"] = P()
+        return specs, col_first
+    if isinstance(layer, EmbeddingLayer):
+        # output features sharded → downstream dense is row-parallel
+        return {"W": P(None, model_axis), "b": P(model_axis)}, False
+    if isinstance(layer, ConvolutionLayer):       # covers 1D subclass (kIO/HWIO)
+        ndim = 4 if type(layer).__name__ != "Convolution1DLayer" else 3
+        lead = [None] * (ndim - 2)
+        if col_first:
+            return ({"W": P(*lead, None, model_axis), "b": P(model_axis)},
+                    False)
+        return {"W": P(*lead, model_axis, None), "b": P()}, True
+    if isinstance(layer, (DenseLayer,)) and not isinstance(layer, OutputLayer):
+        if col_first:
+            return {"W": P(None, model_axis), "b": P(model_axis)}, False
+        return {"W": P(model_axis, None), "b": P()}, True
+    if isinstance(layer, OutputLayer):
+        # classifier head: row-parallel if the incoming features are sharded
+        if not col_first:
+            return {"W": P(model_axis, None), "b": P()}, True
+        return {}, col_first
+    return {}, col_first
+
+
+def tp_param_specs(net, model_axis: str = "model") -> List[dict]:
+    """Per-layer {param_name: PartitionSpec}; unlisted params replicate."""
+    net._ensure_init()
+    specs = []
+    col = True
+    for layer in net.layers:
+        s, col = _spec_for_layer(layer, col, model_axis)
+        specs.append(s)
+    return specs
+
+
+def _sharding_tree(params, upd_state, specs, mesh):
+    """NamedSharding pytrees for params and (shape-matched) updater state."""
+    def pshard(i, name, leaf):
+        spec = specs[i].get(name, P()) if i < len(specs) else P()
+        if len(spec) > leaf.ndim:
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    p_sh = [{k: pshard(i, k, v) for k, v in layer_p.items()}
+            for i, layer_p in enumerate(params)]
+    u_sh = []
+    for i, layer_u in enumerate(upd_state):
+        layer_p = params[i]
+        out = {}
+        for name, ustate in layer_u.items():
+            pleaf = layer_p[name]
+            sh = p_sh[i][name]
+            out[name] = jax.tree_util.tree_map(
+                lambda s: sh if s.shape == pleaf.shape
+                else NamedSharding(mesh, P()), ustate)
+        u_sh.append(out)
+    return p_sh, u_sh
+
+
+class ShardedTrainer:
+    """Jit the net's train step with explicit parameter/batch shardings.
+
+    ``param_specs``: per-layer {name: PartitionSpec} (default: replicate).
+    ``batch_axis``: mesh axis the batch dim is sharded over (data parallel
+    composes freely with the param sharding — a ("data","model") mesh is
+    DP×TP).
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 param_specs: Optional[List[dict]] = None,
+                 batch_axis: Optional[str] = "data"):
+        self.net = net
+        self.mesh = mesh if mesh is not None else make_mesh()
+        net._ensure_init()
+        self.param_specs = param_specs if param_specs is not None \
+            else [{} for _ in net.layers]
+        self.batch_axis = batch_axis if batch_axis in self.mesh.shape else None
+        self._jit_step = None
+
+    @property
+    def batch_divisor(self) -> int:
+        return self.mesh.shape[self.batch_axis] if self.batch_axis else 1
+
+    def shard_params(self):
+        """Place params/updater state on the mesh per the specs (done once;
+        subsequent steps keep the layout because out_shardings pin it)."""
+        net = self.net
+        p_sh, u_sh = _sharding_tree(net.params, net.updater_state,
+                                    self.param_specs, self.mesh)
+        net.params = jax.tree_util.tree_map(jax.device_put, net.params, p_sh)
+        net.updater_state = jax.tree_util.tree_map(
+            jax.device_put, net.updater_state, u_sh)
+        rep = NamedSharding(self.mesh, P())
+        net.state = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, rep), net.state)
+        return self
+
+    def _build(self, has_fmask, has_lmask):
+        net = self.net
+        mesh = self.mesh
+        step = net._make_train_step(False)
+        rep = NamedSharding(mesh, P())
+        p_sh, u_sh = _sharding_tree(net.params, net.updater_state,
+                                    self.param_specs, mesh)
+        bspec = P(self.batch_axis) if self.batch_axis else P()
+        data = NamedSharding(mesh, bspec)
+
+        def wrapped(params, upd, state, feats, labels, fmask, lmask,
+                    iteration, empty_rnn):
+            return step(params, upd, state, feats, labels, fmask, lmask,
+                        iteration, empty_rnn)
+
+        self._jit_step = jax.jit(
+            wrapped,
+            in_shardings=(p_sh, u_sh, rep, data, data,
+                          data if has_fmask else None,
+                          data if has_lmask else None, None, rep),
+            out_shardings=(p_sh, u_sh, rep, rep),
+            donate_argnums=(0, 1, 2))
+
+    def fit_batch(self, ds: DataSet):
+        net = self.net
+        if self._jit_step is None:
+            self.shard_params()
+            self._build(ds.features_mask is not None,
+                        ds.labels_mask is not None)
+        n = ds.num_examples()
+        ndev = self.batch_divisor
+        feats, labels = ds.features, ds.labels
+        fmask, lmask = ds.features_mask, ds.labels_mask
+        if n % ndev:
+            pad = ndev - n % ndev
+            idx = np.concatenate([np.arange(n), np.arange(pad) % n])
+            take = lambda a: None if a is None else a[idx]
+            feats, labels = feats[idx], take(labels)
+            fmask, lmask = take(fmask), take(lmask)
+        cd = net.compute_dtype
+        empty_rnn = [{} for _ in net.layers]
+        net.params, net.updater_state, net.state, score = self._jit_step(
+            net.params, net.updater_state, net.state,
+            jnp.asarray(feats, cd), jnp.asarray(labels, cd),
+            None if fmask is None else jnp.asarray(fmask, cd),
+            None if lmask is None else jnp.asarray(lmask, cd),
+            net.iteration, empty_rnn)
+        net.score_value = score
+        net.iteration += 1
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration)
+
+    def fit(self, data, num_epochs: int = 1):
+        from ..datasets.iterators import as_iterator, AsyncDataSetIterator
+        for _ in range(num_epochs):
+            it = as_iterator(data)
+            if getattr(it, "async_supported", True):
+                it = AsyncDataSetIterator(it)
+            for ds in it:
+                self.fit_batch(ds)
+            self.net.epoch += 1
+        return self
+
+
+class TensorParallelTrainer(ShardedTrainer):
+    """Megatron-style TP (optionally × DP on a 2-axis mesh)."""
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 model_axis: str = "model", batch_axis: str = "data"):
+        if mesh is None:
+            mesh = make_mesh(axis_names=("data", "model"),
+                             shape=(1, len(jax.devices())))
+        net._ensure_init()
+        super().__init__(net, mesh, tp_param_specs(net, model_axis),
+                         batch_axis)

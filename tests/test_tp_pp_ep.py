@@ -1,0 +1,243 @@
+"""Tensor / pipeline / expert parallelism correctness on the 8-device CPU
+mesh (SURVEY.md §4 'local[n]' analog): every parallel mode must reproduce the
+single-device program's numerics — GSPMD/shard_map shard the arithmetic, they
+must not change it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.parallel import (
+    make_mesh, TensorParallelTrainer, tp_param_specs, ShardedTrainer,
+    PipelineParallelTrainer, pipeline_apply, MixtureOfExpertsLayer,
+    ExpertParallelTrainer, SequenceParallelTrainer, attention_reference)
+from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+
+
+def _dense_net(seed=7, n_in=12, hidden=16, n_out=5, updater="adam"):
+    return (NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+            .updater(updater).weight_init("xavier").activation("relu").list()
+            .layer(DenseLayer(n_out=hidden))
+            .layer(DenseLayer(n_out=hidden))
+            .layer(OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+def _batches(rng, n_batches, b, n_in, n_out):
+    out = []
+    for _ in range(n_batches):
+        X = rng.normal(size=(b, n_in)).astype(np.float32)
+        y = np.eye(n_out)[rng.integers(0, n_out, b)].astype(np.float32)
+        out.append(DataSet(X, y))
+    return out
+
+
+class TestTensorParallel:
+    def test_tp_matches_single_device(self, rng_np):
+        ref = MultiLayerNetwork(_dense_net()).init()
+        tp_net = MultiLayerNetwork(_dense_net()).init()
+        mesh = make_mesh(4, axis_names=("data", "model"), shape=(2, 2))
+        trainer = TensorParallelTrainer(tp_net, mesh)
+        batches = _batches(rng_np, 4, 8, 12, 5)
+        for ds in batches:
+            ref._fit_batch(ds)
+            trainer.fit_batch(ds)
+        for pr, pt in zip(ref.params, tp_net.params):
+            for k in pr:
+                np.testing.assert_allclose(np.asarray(pr[k]),
+                                           np.asarray(pt[k]),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_tp_params_actually_sharded(self):
+        net = MultiLayerNetwork(_dense_net()).init()
+        mesh = make_mesh(4, axis_names=("data", "model"), shape=(1, 4))
+        trainer = TensorParallelTrainer(net, mesh)
+        trainer.shard_params()
+        w0 = net.params[0]["W"]          # column-parallel: sharded on dim 1
+        shards = w0.sharding.shard_shape(w0.shape)
+        assert shards[1] == w0.shape[1] // 4
+        w1 = net.params[1]["W"]          # row-parallel: sharded on dim 0
+        shards1 = w1.sharding.shard_shape(w1.shape)
+        assert shards1[0] == w1.shape[0] // 4
+
+    def test_tp_specs_alternate(self):
+        net = MultiLayerNetwork(_dense_net()).init()
+        specs = tp_param_specs(net)
+        assert specs[0]["W"] == jax.sharding.PartitionSpec(None, "model")
+        assert specs[1]["W"] == jax.sharding.PartitionSpec("model", None)
+        # after col→row the incoming features are replicated again, so the
+        # classifier head stays replicated
+        assert specs[2] == {}
+
+
+class TestPipelineParallel:
+    def test_pipeline_apply_equals_sequential(self, rng_np):
+        mesh = make_mesh(4, axis_names=("pipe",))
+        block = DenseLayer(n_in=10, n_out=10, activation="tanh",
+                           weight_init="xavier")
+        key = jax.random.PRNGKey(0)
+        params = [block.init_params(jax.random.fold_in(key, i))
+                  for i in range(8)]
+        stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *params)
+        x = rng_np.normal(size=(6, 4, 10)).astype(np.float32)  # [M, mb, d]
+
+        def block_fn(p, a):
+            out, _ = block.forward(p, {}, a, train=False, rng=None)
+            return out
+
+        piped = pipeline_apply(block_fn, stacked, jnp.asarray(x), mesh)
+        seq = jnp.asarray(x)
+        for p in params:
+            m, mb, d = seq.shape
+            seq = block_fn(p, seq.reshape(m * mb, d)).reshape(m, mb, d)
+        np.testing.assert_allclose(np.asarray(piped), np.asarray(seq),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pipeline_apply_differentiable(self, rng_np):
+        mesh = make_mesh(2, axis_names=("pipe",))
+        block = DenseLayer(n_in=6, n_out=6, activation="tanh",
+                           weight_init="xavier")
+        key = jax.random.PRNGKey(1)
+        params = [block.init_params(jax.random.fold_in(key, i))
+                  for i in range(4)]
+        stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *params)
+        x = jnp.asarray(rng_np.normal(size=(4, 3, 6)), jnp.float32)
+
+        def block_fn(p, a):
+            out, _ = block.forward(p, {}, a, train=False, rng=None)
+            return out
+
+        def loss_piped(sp):
+            return jnp.mean(pipeline_apply(block_fn, sp, x, mesh) ** 2)
+
+        def loss_seq(sp):
+            act = x.reshape(-1, 6)
+            for i in range(4):
+                act = block_fn(jax.tree_util.tree_map(lambda a: a[i], sp),
+                               act)
+            return jnp.mean(act ** 2)
+
+        gp = jax.grad(loss_piped)(stacked)
+        gs = jax.grad(loss_seq)(stacked)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6), gp, gs)
+
+    def test_pipeline_trainer_learns(self, rng_np):
+        mesh = make_mesh(4, axis_names=("pipe",))
+        block = DenseLayer(n_in=8, n_out=8, activation="tanh",
+                           weight_init="xavier")
+        head = OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                           activation="softmax", weight_init="xavier")
+        tr = PipelineParallelTrainer(block, depth=4, head_conf=head,
+                                     mesh=mesh, num_microbatches=4,
+                                     learning_rate=0.2)
+        X = rng_np.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(3)[(X[:, 0] > 0).astype(int) +
+                      (X[:, 1] > 0).astype(int)].astype(np.float32)
+        ds = DataSet(X, y)
+        tr.fit_batch(ds)
+        first = float(tr.score_value)
+        for _ in range(60):
+            tr.fit_batch(ds)
+        assert float(tr.score_value) < first
+        out = tr.output(X)
+        assert out.shape == (32, 3)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+class TestExpertParallel:
+    def _moe_net(self, seed=11):
+        return (NeuralNetConfiguration.Builder().seed(seed)
+                .learning_rate(0.05).updater("adam").weight_init("xavier")
+                .list()
+                .layer(MixtureOfExpertsLayer(n_out=16, num_experts=4,
+                                             expert_hidden=32,
+                                             activation="relu"))
+                .layer(OutputLayer(n_out=4, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(10)).build())
+
+    def test_moe_forward_shapes_and_capacity(self, rng_np):
+        layer = MixtureOfExpertsLayer(n_in=6, n_out=6, num_experts=3,
+                                      expert_hidden=8, activation="relu",
+                                      weight_init="xavier",
+                                      capacity_factor=1.0)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng_np.normal(size=(9, 6)), jnp.float32)
+        y, _ = layer.forward(p, {}, x)
+        assert y.shape == (9, 6)
+        assert layer.capacity(9) == 3
+        # sequence input
+        xs = jnp.asarray(rng_np.normal(size=(2, 5, 6)), jnp.float32)
+        ys, _ = layer.forward(p, {}, xs)
+        assert ys.shape == (2, 5, 6)
+
+    def test_ep_matches_single_device(self, rng_np):
+        ref = MultiLayerNetwork(self._moe_net()).init()
+        ep_net = MultiLayerNetwork(self._moe_net()).init()
+        mesh = make_mesh(4, axis_names=("data", "ep"), shape=(2, 2))
+        trainer = ExpertParallelTrainer(ep_net, mesh)
+        batches = _batches(rng_np, 3, 16, 10, 4)
+        for ds in batches:
+            ref._fit_batch(ds)
+            trainer.fit_batch(ds)
+        for pr, pt in zip(ref.params, ep_net.params):
+            for k in pr:
+                np.testing.assert_allclose(np.asarray(pr[k]),
+                                           np.asarray(pt[k]),
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_ep_experts_actually_sharded(self):
+        net = MultiLayerNetwork(self._moe_net()).init()
+        mesh = make_mesh(4, axis_names=("data", "ep"), shape=(1, 4))
+        trainer = ExpertParallelTrainer(net, mesh)
+        trainer.shard_params()
+        w = net.params[0]["We1"]
+        assert w.sharding.shard_shape(w.shape)[0] == w.shape[0] // 4
+
+    def test_moe_gradcheck(self, rng_np):
+        """MoE layer is differentiable despite the hard top-1 routing (the
+        routing indicator is piecewise-constant; grads flow through gate
+        values and expert FFNs)."""
+        net = MultiLayerNetwork(self._moe_net()).init()
+        X = rng_np.normal(size=(8, 10)).astype(np.float32)
+        y = np.eye(4)[rng_np.integers(0, 4, 8)].astype(np.float32)
+        grads, score = net.compute_gradient_and_score(DataSet(X, y))
+        assert np.isfinite(score)
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+        assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+
+    def test_load_balance_loss(self, rng_np):
+        layer = MixtureOfExpertsLayer(n_in=6, n_out=6, num_experts=4,
+                                      expert_hidden=8, weight_init="xavier")
+        p = layer.init_params(jax.random.PRNGKey(3))
+        x = jnp.asarray(rng_np.normal(size=(64, 6)), jnp.float32)
+        lb = float(layer.load_balance_loss(p, x))
+        assert lb >= 1.0 - 1e-6      # minimum at perfectly uniform routing
+
+
+class TestSequenceParallelTrainer:
+    def test_sp_step_matches_single_device(self, rng_np):
+        conf = SelfAttentionLayer(n_in=8, n_out=8, num_heads=2, causal=True,
+                                  weight_init="xavier")
+        mesh = make_mesh(4, axis_names=("sp",))
+        sp = SequenceParallelTrainer(conf, mesh, learning_rate=0.1, seed=5)
+        single = SequenceParallelTrainer(
+            conf, make_mesh(1, axis_names=("sp",)), learning_rate=0.1, seed=5)
+        x = rng_np.normal(size=(2, 16, 8)).astype(np.float32)
+        y = rng_np.normal(size=(2, 16, 8)).astype(np.float32)
+        s_sp = sp.fit_batch(x, y)
+        s_1 = single.fit_batch(x, y)
+        assert abs(s_sp - s_1) < 1e-5
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            sp.params, single.params)
