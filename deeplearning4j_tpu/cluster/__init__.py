@@ -1,0 +1,28 @@
+"""Cluster training layer (reference deeplearning4j-scaleout/spark;
+SURVEY.md §2.4, §3.4).
+
+The reference trains over Spark: RDD<DataSet> partitions shipped to
+executors, each worker fits locally, results tree-aggregated and averaged
+per split. Here the same TrainingMaster SPI drives a local partitioned
+dataset executor (Spark ``local[n]`` analog — thread pool with task retry)
+and, on real fleets, the jax.distributed multi-host path (parallel/multihost)
+carries the collective instead of a TCP shuffle.
+"""
+
+from .rdd import DistributedDataSet
+from .api import (TrainingMaster, TrainingWorker, WorkerConfiguration,
+                  Repartition, RepartitionStrategy, RDDTrainingApproach,
+                  TrainingHook)
+from .param_averaging import (ParameterAveragingTrainingMaster,
+                              ParameterAveragingTrainingWorker)
+from .network import ClusterDl4jMultiLayer, ClusterComputationGraph
+from .stats import ClusterTrainingStats, PhaseTimer
+
+__all__ = [
+    "DistributedDataSet", "TrainingMaster", "TrainingWorker",
+    "WorkerConfiguration", "Repartition", "RepartitionStrategy",
+    "RDDTrainingApproach", "TrainingHook",
+    "ParameterAveragingTrainingMaster", "ParameterAveragingTrainingWorker",
+    "ClusterDl4jMultiLayer", "ClusterComputationGraph",
+    "ClusterTrainingStats", "PhaseTimer",
+]

@@ -1,0 +1,74 @@
+"""User-facing cluster wrappers (reference
+spark/impl/multilayer/SparkDl4jMultiLayer.java:582 fit/evaluate/scoreExamples
+and spark/impl/graph/SparkComputationGraph.java; SURVEY.md §2.4)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .api import TrainingMaster
+from .rdd import DistributedDataSet
+
+
+class _ClusterModelBase:
+    def __init__(self, network, training_master: TrainingMaster):
+        network._ensure_init()
+        self.network = network
+        self.training_master = training_master
+
+    def fit(self, data, num_epochs: int = 1):
+        if not isinstance(data, DistributedDataSet):
+            data = DistributedDataSet.from_datasets(list(data))
+        for _ in range(num_epochs):
+            self.training_master.execute_training(self.network, data)
+            self.network.epoch += 1
+        return self.network
+
+    def evaluate(self, data):
+        """Distributed evaluation: per-partition Evaluation merged on the
+        driver (reference SparkDl4jMultiLayer.evaluate merge path)."""
+        from ..eval import Evaluation
+        if not isinstance(data, DistributedDataSet):
+            data = DistributedDataSet.from_datasets(list(data))
+        net = self.network
+
+        def eval_partition(partition):
+            ev = Evaluation()
+            for ds in partition:
+                out = net.output(ds.features)
+                ev.eval(np.asarray(ds.labels), np.asarray(out),
+                        mask=None if ds.labels_mask is None
+                        else np.asarray(ds.labels_mask))
+            return ev
+
+        parts = data.map_partitions(eval_partition)
+        merged = parts[0]
+        for other in parts[1:]:
+            merged.merge(other)
+        return merged
+
+    def score_examples(self, data):
+        """Per-example scores across the cluster (scoreExamples analog)."""
+        if not isinstance(data, DistributedDataSet):
+            data = DistributedDataSet.from_datasets(list(data))
+        net = self.network
+
+        def score_partition(partition):
+            return [net.score(ds) for ds in partition]
+
+        return [s for part in data.map_partitions(score_partition)
+                for s in part]
+
+    def get_score(self) -> Optional[float]:
+        v = self.network.score_value
+        return None if v is None else float(v)
+
+
+class ClusterDl4jMultiLayer(_ClusterModelBase):
+    pass
+
+
+class ClusterComputationGraph(_ClusterModelBase):
+    pass

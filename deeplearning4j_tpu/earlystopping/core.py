@@ -176,6 +176,24 @@ class EarlyStoppingTrainer:
         self.net = net
         self.train_data = train_data
 
+    def _fit_epoch(self):
+        """Train one epoch; return the name of the iteration-termination
+        condition that fired mid-epoch, or None. Subclasses override the
+        training mechanics (e.g. data-parallel over a mesh) while the
+        fit() loop — scoring, saving, epoch terminations — stays shared."""
+        from ..datasets.iterators import as_iterator
+        cfg = self.config
+        for ds in as_iterator(self.train_data):
+            if self.net.conf.backprop_type == "truncated_bptt" and \
+                    ds.features.ndim == 3:
+                self.net._fit_tbptt(ds)
+            else:
+                self.net._fit_batch(ds)
+            for cond in cfg.iteration_terminations:
+                if cond.terminate(self.net.iteration, self.net.score_value):
+                    return type(cond).__name__
+        return None
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         if not cfg.epoch_terminations and not cfg.iteration_terminations:
@@ -188,23 +206,9 @@ class EarlyStoppingTrainer:
         reason, details = "MaxEpochs", ""
         score = math.inf
         while True:
-            stop_iter = False
-            from ..datasets.iterators import as_iterator
-            for ds in as_iterator(self.train_data):
-                if self.net.conf.backprop_type == "truncated_bptt" and \
-                        ds.features.ndim == 3:
-                    self.net._fit_tbptt(ds)
-                else:
-                    self.net._fit_batch(ds)
-                for cond in cfg.iteration_terminations:
-                    if cond.terminate(self.net.iteration, self.net.score_value):
-                        reason = "IterationTermination"
-                        details = type(cond).__name__
-                        stop_iter = True
-                        break
-                if stop_iter:
-                    break
-            if stop_iter:
+            stop_cond = self._fit_epoch()
+            if stop_cond is not None:
+                reason, details = "IterationTermination", stop_cond
                 break
             if epoch % cfg.evaluate_every_n_epochs == 0:
                 score = cfg.score_calculator.calculate_score(self.net) \

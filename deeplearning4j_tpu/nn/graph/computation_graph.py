@@ -359,9 +359,12 @@ class ComputationGraph:
         import copy as _copy
         net = ComputationGraph(_copy.deepcopy(self.conf), self.compute_dtype)
         net.init()
-        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
-        net.updater_state = jax.tree_util.tree_map(lambda a: a,
+        # fresh buffers: the jitted train step donates params/updater/state,
+        # so sharing arrays would let a fit() on either net delete the
+        # other's (see MultiLayerNetwork.clone)
+        net.params = jax.tree_util.tree_map(jnp.copy, self.params)
+        net.state = jax.tree_util.tree_map(jnp.copy, self.state)
+        net.updater_state = jax.tree_util.tree_map(jnp.copy,
                                                    self.updater_state)
         net.iteration = self.iteration
         return net

@@ -491,9 +491,12 @@ class MultiLayerNetwork:
         import copy as _copy
         net = MultiLayerNetwork(_copy.deepcopy(self.conf), self.compute_dtype)
         net.init()
-        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-        net.state = jax.tree_util.tree_map(lambda a: a, self.state)
-        net.updater_state = jax.tree_util.tree_map(lambda a: a,
+        # materialize fresh device buffers: the jitted train step DONATES
+        # params/updater/state, so sharing buffers with the clone would let
+        # a fit() on either net delete the other's arrays
+        net.params = jax.tree_util.tree_map(jnp.copy, self.params)
+        net.state = jax.tree_util.tree_map(jnp.copy, self.state)
+        net.updater_state = jax.tree_util.tree_map(jnp.copy,
                                                    self.updater_state)
         net.iteration = self.iteration
         return net

@@ -16,6 +16,11 @@ from .expert import (MixtureOfExpertsLayer, ExpertParallelTrainer,
                      ep_param_specs)
 from .sequence import (ring_self_attention, attention_reference,
                        SequenceParallelTrainer)
+from .param_server import (InMemoryParameterServer, ParameterServerNode,
+                           ParameterServerClient, ParameterServerTrainer,
+                           ParameterServerParallelWrapper)
+from .early_stopping_parallel import EarlyStoppingParallelTrainer
+from .magic_queue import MagicQueue
 
 __all__ = ["make_mesh", "replicated", "batch_sharded", "ParallelWrapper",
            "GraphDataParallelTrainer", "ShardedTrainer",
@@ -23,4 +28,7 @@ __all__ = ["make_mesh", "replicated", "batch_sharded", "ParallelWrapper",
            "PipelineParallelTrainer", "pipeline_apply",
            "MixtureOfExpertsLayer", "ExpertParallelTrainer", "ep_param_specs",
            "ring_self_attention", "attention_reference",
-           "SequenceParallelTrainer"]
+           "SequenceParallelTrainer", "InMemoryParameterServer",
+           "ParameterServerNode", "ParameterServerClient",
+           "ParameterServerTrainer", "ParameterServerParallelWrapper",
+           "EarlyStoppingParallelTrainer", "MagicQueue"]

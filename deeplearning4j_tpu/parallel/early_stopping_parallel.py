@@ -1,0 +1,34 @@
+"""Early stopping on top of ParallelWrapper (reference
+parallelism/EarlyStoppingParallelTrainer.java; SURVEY.md §2.4).
+
+Subclasses the serial :class:`EarlyStoppingTrainer`, overriding only the
+epoch-training hook: each epoch runs data-parallel over the mesh via
+:class:`~deeplearning4j_tpu.parallel.wrapper.ParallelWrapper`, with iteration
+terminations checked once per epoch (the wrapper runs the whole epoch as
+compiled rounds, so mid-epoch hooks would force host sync every step —
+the reference's listener-based checks have the same per-fit granularity).
+"""
+
+from __future__ import annotations
+
+from ..earlystopping.core import (EarlyStoppingConfiguration,
+                                  EarlyStoppingTrainer)
+from .wrapper import ParallelWrapper
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_data,
+                 mesh=None, averaging_frequency: int = 1,
+                 average_updaters: bool = True):
+        super().__init__(config, net, train_data)
+        self.wrapper = ParallelWrapper(
+            net, mesh=mesh, averaging_frequency=averaging_frequency,
+            average_updaters=average_updaters)
+
+    def _fit_epoch(self):
+        self.wrapper.fit(self.train_data, num_epochs=1)
+        for cond in self.config.iteration_terminations:
+            if cond.terminate(self.net.iteration,
+                              float(self.net.score_value)):
+                return type(cond).__name__
+        return None

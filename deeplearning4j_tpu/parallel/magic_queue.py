@@ -1,0 +1,65 @@
+"""Device-affine data queue (reference core/parallelism/MagicQueue.java).
+
+The reference's MagicQueue is a multi-headed blocking queue: ``add`` hashes a
+DataSet to a per-device sub-queue and a background thread relocates the
+arrays to that device's memory ahead of the consumer, so each worker thread
+polls batches that already live on its GPU.
+
+TPU analog: per-device queues whose producer side eagerly ``jax.device_put``s
+the batch onto the target device — the host→HBM copy overlaps with compute on
+the other replicas (the AsyncDataSetIterator analog covers the single-device
+case; MagicQueue covers the one-queue-per-device fan-out used by
+ParallelWrapper's round-robin dispatch, ParallelWrapper.java:364-375).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+
+
+class MagicQueue:
+    def __init__(self, num_devices: Optional[int] = None, capacity: int = 8,
+                 mode: str = "sequential"):
+        devs = jax.devices()
+        self.num_devices = num_devices or len(devs)
+        self._devices = [devs[i % len(devs)] for i in range(self.num_devices)]
+        self._queues: List[queue.Queue] = [
+            queue.Queue(maxsize=capacity) for _ in range(self.num_devices)]
+        self._next = 0
+        self._lock = threading.Lock()
+        self.mode = mode  # "sequential" round-robin | "broadcast" (THREADED)
+
+    def _put_on_device(self, ds, dev):
+        from ..ops.dataset import DataSet
+        put = lambda a: None if a is None else jax.device_put(a, dev)
+        return DataSet(put(ds.features), put(ds.labels),
+                       put(ds.features_mask), put(ds.labels_mask))
+
+    def add(self, ds) -> None:
+        if self.mode == "broadcast":
+            for i, q in enumerate(self._queues):
+                q.put(self._put_on_device(ds, self._devices[i]))
+            return
+        with self._lock:
+            i = self._next
+            self._next = (self._next + 1) % self.num_devices
+        self._queues[i].put(self._put_on_device(ds, self._devices[i]))
+
+    def poll(self, device_index: int, timeout: Optional[float] = None):
+        """Non-blocking when ``timeout`` is None (reference MagicQueue.poll
+        contract: empty queue → null), else bounded wait."""
+        try:
+            if timeout is None:
+                return self._queues[device_index].get_nowait()
+            return self._queues[device_index].get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def size(self, device_index: Optional[int] = None) -> int:
+        if device_index is not None:
+            return self._queues[device_index].qsize()
+        return sum(q.qsize() for q in self._queues)

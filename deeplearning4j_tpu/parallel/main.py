@@ -1,0 +1,81 @@
+"""CLI entry for data-parallel training (reference
+parallelism/main/ParallelWrapperMain.java; SURVEY.md §2.4, §5.6 — the only
+CLI the reference ships).
+
+Usage:
+    python -m deeplearning4j_tpu.parallel.main \
+        --model-path model.zip \
+        --iterator-factory mypkg.mymod:make_iterator \
+        --workers 8 --averaging-frequency 5 --epochs 1 \
+        --output-path trained.zip
+
+``--iterator-factory`` names a ``module:callable`` returning a
+DataSetIterator (the reference's dataSetIteratorFactoryClazz arg).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+
+def _load_factory(spec: str):
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit("--iterator-factory must be module:callable")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, attr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="parallel-wrapper",
+                                 description=__doc__.split("\n")[0])
+    ap.add_argument("--model-path", required=True,
+                    help="checkpoint zip saved by ModelSerializer")
+    ap.add_argument("--iterator-factory", required=True,
+                    help="module:callable returning a DataSetIterator")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="devices to use (default: all)")
+    ap.add_argument("--averaging-frequency", type=int, default=1)
+    ap.add_argument("--no-average-updaters", action="store_true")
+    ap.add_argument("--prefetch-buffer", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--output-path", default=None,
+                    help="where to save the trained model zip")
+    ap.add_argument("--mode", choices=["wrapper", "param-server"],
+                    default="wrapper",
+                    help="sync mesh DP or async parameter-server DP")
+    ap.add_argument("--push-frequency", type=int, default=1,
+                    help="param-server mode: push every N batches")
+    args = ap.parse_args(argv)
+
+    from ..utils.serializer import ModelGuesser, ModelSerializer
+    net = ModelGuesser.load_model_guess_type(args.model_path)
+    iterator = _load_factory(args.iterator_factory)()
+
+    if args.mode == "param-server":
+        from .param_server import ParameterServerParallelWrapper
+        pw = ParameterServerParallelWrapper(
+            net, num_workers=args.workers or 2,
+            push_frequency=args.push_frequency)
+        pw.fit(iterator, num_epochs=args.epochs)
+    else:
+        from .mesh import make_mesh
+        from .wrapper import ParallelWrapper
+        mesh = make_mesh(args.workers) if args.workers else None
+        pw = ParallelWrapper(
+            net, mesh=mesh,
+            averaging_frequency=args.averaging_frequency,
+            average_updaters=not args.no_average_updaters,
+            prefetch_buffer=args.prefetch_buffer)
+        pw.fit(iterator, num_epochs=args.epochs)
+
+    out = args.output_path or args.model_path
+    ModelSerializer.write_model(net, out)
+    print(f"trained {args.epochs} epoch(s); model saved to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+"""Asynchronous parameter-server data parallelism.
+
+Reference surface (SURVEY.md §2.4, §5.8): ND4J's ``VoidParameterServer`` over
+Aeron UDP with ``ParameterServerClient.pushNDArray(model.params())`` /
+``getNDArray`` driven by ``ParameterServerTrainer``
+(parallelism/parameterserver/ParameterServerTrainer.java:33,:46,:63) — workers
+asynchronously push their full flattened parameter vector to a server that
+aggregates, and pull the aggregate back.
+
+TPU-first redesign: the *compute* stays on-device (each worker runs the jitted
+train step of its replica), while the PS plane is a host-side store — the role
+Aeron played. Two transports:
+
+- ``InMemoryParameterServer``: lock-guarded in-process store (single host,
+  threads) — the common case on a TPU VM where workers are replica threads.
+- ``ParameterServerNode`` / ``ParameterServerClient``: the same protocol over
+  TCP with a length-prefixed numpy payload, for multi-process / multi-host
+  layouts where DCN carries pushes (the Aeron RoutedTransport analog).
+
+Aggregation follows the reference's soft-sync semantics: the server keeps a
+running average — ``new = (1 - alpha) * current + alpha * pushed`` with
+``alpha = 1/num_workers`` by default (equal-weight staleness-tolerant
+averaging); ``alpha=1.0`` degrades to last-writer-wins like a raw push.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+# --------------------------------------------------------------------- store
+class InMemoryParameterServer:
+    """Host-side aggregate store for flattened parameter vectors."""
+
+    def __init__(self, initial: np.ndarray, alpha: Optional[float] = None,
+                 num_workers: int = 1):
+        self._lock = threading.Lock()
+        self._params = np.array(initial, dtype=np.float32, copy=True)
+        self._alpha = float(alpha) if alpha is not None \
+            else 1.0 / max(1, num_workers)
+        self.pushes = 0
+
+    def push(self, vector: np.ndarray) -> None:
+        v = np.asarray(vector, dtype=np.float32)
+        with self._lock:
+            if v.shape != self._params.shape:
+                raise ValueError(
+                    f"push shape {v.shape} != server {self._params.shape}")
+            self._params += self._alpha * (v - self._params)
+            self.pushes += 1
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._params.copy()
+
+    # reference naming aliases (ParameterServerClient.pushNDArray/getNDArray)
+    push_ndarray = push
+    get_ndarray = pull
+
+
+# ----------------------------------------------------------------- transport
+def _send_array(sock: socket.socket, op: bytes, arr: Optional[np.ndarray]):
+    buf = io.BytesIO()
+    if arr is not None:
+        np.save(buf, np.asarray(arr, dtype=np.float32), allow_pickle=False)
+    payload = buf.getvalue()
+    sock.sendall(op + struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_array(sock: socket.socket):
+    op = _recv_exact(sock, 1)
+    (ln,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    payload = _recv_exact(sock, ln) if ln else b""
+    arr = np.load(io.BytesIO(payload), allow_pickle=False) if ln else None
+    return op, arr
+
+
+class ParameterServerNode:
+    """TCP front-end around :class:`InMemoryParameterServer`.
+
+    Protocol: 1-byte opcode (``P`` push, ``G`` get, ``Q`` quit) + u64 length +
+    ``np.save`` payload; ``G`` answers with the same framing.
+    """
+
+    def __init__(self, initial: np.ndarray, host: str = "127.0.0.1",
+                 port: int = 0, **kw):
+        self.store = InMemoryParameterServer(initial, **kw)
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+        self._srv.close()
+
+    def _handle(self, conn: socket.socket):
+        with conn:
+            while True:
+                try:
+                    op, arr = _recv_array(conn)
+                except (ConnectionError, struct.error):
+                    return
+                try:
+                    if op == b"P":
+                        if arr is None:
+                            raise ValueError("push without payload")
+                        self.store.push(arr)
+                    elif op == b"G":
+                        _send_array(conn, b"R", self.store.pull())
+                    elif op == b"Q":
+                        return
+                except (ValueError, TypeError) as e:
+                    # bad frame must not kill the handler; drop the op and
+                    # keep serving (push is fire-and-forget by protocol)
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "parameter server rejected %s op: %s", op, e)
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class ParameterServerClient:
+    """Socket client mirroring ND4J's ``ParameterServerClient`` API."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._lock = threading.Lock()
+
+    def push_ndarray(self, vector: np.ndarray) -> None:
+        with self._lock:
+            _send_array(self._sock, b"P", vector)
+
+    def get_ndarray(self) -> np.ndarray:
+        with self._lock:
+            _send_array(self._sock, b"G", None)
+            _, arr = _recv_array(self._sock)
+        return arr
+
+    def close(self):
+        try:
+            with self._lock:
+                _send_array(self._sock, b"Q", None)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+# ------------------------------------------------------------------ trainer
+class ParameterServerTrainer:
+    """One async worker: fit replica on polled batches, push/pull params.
+
+    Mirrors ParameterServerTrainer.java — ``feedDataSet`` → replica.fit →
+    ``pushNDArray(model.params())`` then pull the aggregate back into the
+    replica (staleness-tolerant HOGWILD-style DP; SURVEY.md §5.2 notes the
+    reference tolerates this by design).
+    """
+
+    def __init__(self, replica, server, push_frequency: int = 1):
+        self.replica = replica
+        self.server = server
+        self.push_frequency = max(1, int(push_frequency))
+        self._seen = 0
+
+    def feed_dataset(self, ds) -> None:
+        self.replica.fit([ds])
+        self._seen += 1
+        if self._seen % self.push_frequency == 0:
+            self.server.push_ndarray(self.replica.params_flat())
+            self.replica.set_params_flat(self.server.get_ndarray())
+
+
+class ParameterServerParallelWrapper:
+    """ParallelWrapper variant running N async PS workers (threads).
+
+    The reference wires this through ParallelWrapper with
+    ``trainerContextClass = ParameterServerTrainerContext``; here it is a
+    standalone driver with the same fit(iterator) surface.
+    """
+
+    def __init__(self, net, num_workers: int = 2, push_frequency: int = 1,
+                 alpha: Optional[float] = None):
+        net._ensure_init()
+        self.net = net
+        self.num_workers = int(num_workers)
+        self.server = InMemoryParameterServer(
+            net.params_flat(), alpha=alpha, num_workers=num_workers)
+        self.push_frequency = push_frequency
+
+    def fit(self, data, num_epochs: int = 1):
+        from ..datasets.iterators import as_iterator
+        replicas = [self.net.clone() for _ in range(self.num_workers)]
+        trainers = [ParameterServerTrainer(r, self.server,
+                                           self.push_frequency)
+                    for r in replicas]
+        for _ in range(num_epochs):
+            batches: List = list(as_iterator(data))
+            threads = []
+            for w, tr in enumerate(trainers):
+                shard = batches[w::self.num_workers]
+
+                def run(tr=tr, shard=shard):
+                    for ds in shard:
+                        tr.feed_dataset(ds)
+
+                t = threading.Thread(target=run, daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+        # final aggregate back into the user's net
+        self.net.set_params_flat(self.server.pull())
+        return self

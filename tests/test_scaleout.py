@@ -1,0 +1,255 @@
+"""Scale-out tests: async parameter server, cluster TrainingMaster,
+EarlyStoppingParallelTrainer, MagicQueue, CLI (reference ParallelWrapperTest,
+TestParallelEarlyStopping, spark TestSparkDl4jMultiLayer run with local[n];
+SURVEY.md §4)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.dataset import DataSet
+
+
+def _net(seed=7, lr=0.1):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).learning_rate(lr)
+            .updater("sgd").weight_init("xavier").activation("tanh").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(rng, n=12, b=16):
+    out = []
+    for _ in range(n):
+        X = rng.normal(size=(b, 4)).astype(np.float32)
+        y = np.eye(3)[(np.abs(X).sum(1) * 3).astype(int) % 3]
+        out.append(DataSet(X, y.astype(np.float32)))
+    return out
+
+
+def _fit_score(net, batches):
+    ev = None
+    from deeplearning4j_tpu.eval import Evaluation
+    ev = Evaluation()
+    for ds in batches:
+        ev.eval(np.asarray(ds.labels), np.asarray(net.output(ds.features)))
+    return ev.accuracy()
+
+
+class TestParameterServer:
+    def test_inmemory_push_pull(self):
+        from deeplearning4j_tpu.parallel import InMemoryParameterServer
+        srv = InMemoryParameterServer(np.zeros(4), alpha=0.5)
+        srv.push(np.ones(4))
+        np.testing.assert_allclose(srv.pull(), 0.5 * np.ones(4))
+        srv.push(np.ones(4))
+        np.testing.assert_allclose(srv.pull(), 0.75 * np.ones(4))
+
+    def test_tcp_transport(self):
+        from deeplearning4j_tpu.parallel import (ParameterServerNode,
+                                                 ParameterServerClient)
+        node = ParameterServerNode(np.zeros(8), alpha=1.0)
+        try:
+            clients = [ParameterServerClient(node.host, node.port)
+                       for _ in range(3)]
+            threads = [threading.Thread(
+                target=lambda c=c, i=i: c.push_ndarray(np.full(8, float(i))))
+                for i, c in enumerate(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            got = clients[0].get_ndarray()
+            assert got.shape == (8,)
+            assert node.store.pushes == 3
+            for c in clients:
+                c.close()
+        finally:
+            node.shutdown()
+
+    def test_async_training_learns(self, rng_np):
+        from deeplearning4j_tpu.parallel import ParameterServerParallelWrapper
+        net = _net()
+        batches = _batches(rng_np, n=24)
+        before = _fit_score(net, batches)
+        pw = ParameterServerParallelWrapper(net, num_workers=2,
+                                            push_frequency=2)
+        pw.fit(batches, num_epochs=3)
+        after = _fit_score(net, batches)
+        assert after > before
+
+    def test_push_updates_server(self, rng_np):
+        from deeplearning4j_tpu.parallel import (InMemoryParameterServer,
+                                                 ParameterServerTrainer)
+        net = _net()
+        srv = InMemoryParameterServer(net.params_flat(), num_workers=1)
+        replica = net.clone()
+        tr = ParameterServerTrainer(replica, srv, push_frequency=1)
+        ds = _batches(rng_np, n=1)[0]
+        tr.feed_dataset(ds)
+        assert srv.pushes == 1
+        # replica pulled the aggregate back
+        np.testing.assert_allclose(replica.params_flat(), srv.pull(),
+                                   rtol=1e-6)
+
+
+class TestClusterTraining:
+    def test_param_averaging_master_learns(self, rng_np):
+        from deeplearning4j_tpu.cluster import (
+            ClusterDl4jMultiLayer, DistributedDataSet,
+            ParameterAveragingTrainingMaster)
+        net = _net()
+        batches = _batches(rng_np, n=16)
+        rdd = DistributedDataSet.from_datasets(batches, num_partitions=4,
+                                               num_executors=4)
+        master = ParameterAveragingTrainingMaster(
+            averaging_frequency=2, collect_training_stats=True)
+        cluster_net = ClusterDl4jMultiLayer(net, master)
+        before = _fit_score(net, batches)
+        cluster_net.fit(rdd, num_epochs=3)
+        after = _fit_score(net, batches)
+        assert after > before
+        stats = master.get_training_stats()
+        keys = stats.get_keys()
+        assert "map_partitions" in keys and "fit" in keys
+        assert stats.summary()["fit"]["count"] > 0
+
+    def test_cluster_evaluate_and_score(self, rng_np):
+        from deeplearning4j_tpu.cluster import (
+            ClusterDl4jMultiLayer, DistributedDataSet,
+            ParameterAveragingTrainingMaster)
+        net = _net()
+        batches = _batches(rng_np, n=8)
+        rdd = DistributedDataSet.from_datasets(batches, num_partitions=3)
+        cnet = ClusterDl4jMultiLayer(net,
+                                     ParameterAveragingTrainingMaster())
+        ev = cnet.evaluate(rdd)
+        assert 0.0 <= ev.accuracy() <= 1.0
+        scores = cnet.score_examples(rdd)
+        assert len(scores) == 8 and all(np.isfinite(s) for s in scores)
+
+    def test_task_retry_recomputes(self, rng_np):
+        from deeplearning4j_tpu.cluster import DistributedDataSet
+        rdd = DistributedDataSet.from_datasets(list(range(12)),
+                                               num_partitions=3,
+                                               max_task_retries=2)
+        failures = {"n": 0}
+
+        def injector(idx, attempt):
+            if idx == 1 and attempt == 0:
+                failures["n"] += 1
+                raise RuntimeError("simulated lost task")
+
+        res = rdd.map_partitions(sum, fault_injector=injector)
+        assert failures["n"] == 1
+        assert sum(res) == sum(range(12))
+
+    def test_task_retry_exhausted_fails(self):
+        from deeplearning4j_tpu.cluster import DistributedDataSet
+        rdd = DistributedDataSet.from_datasets(list(range(4)),
+                                               num_partitions=2,
+                                               max_task_retries=1)
+
+        def always_fail(idx, attempt):
+            if idx == 0:
+                raise RuntimeError("permanent failure")
+
+        with pytest.raises(RuntimeError, match="failed after"):
+            rdd.map_partitions(sum, fault_injector=always_fail)
+
+    def test_export_approach(self, rng_np, tmp_path):
+        from deeplearning4j_tpu.cluster import (
+            ClusterDl4jMultiLayer, DistributedDataSet,
+            ParameterAveragingTrainingMaster, RDDTrainingApproach)
+        net = _net()
+        batches = _batches(rng_np, n=6)
+        rdd = DistributedDataSet.from_datasets(batches, num_partitions=2)
+        master = ParameterAveragingTrainingMaster(
+            rdd_training_approach=RDDTrainingApproach.EXPORT,
+            export_directory=str(tmp_path))
+        ClusterDl4jMultiLayer(net, master).fit(rdd)
+        assert list(tmp_path.glob("dataset_*.bin"))
+
+    def test_stats_export(self, rng_np, tmp_path):
+        from deeplearning4j_tpu.cluster import (
+            ClusterDl4jMultiLayer, DistributedDataSet,
+            ParameterAveragingTrainingMaster)
+        net = _net()
+        rdd = DistributedDataSet.from_datasets(_batches(rng_np, n=4))
+        master = ParameterAveragingTrainingMaster(collect_training_stats=True)
+        ClusterDl4jMultiLayer(net, master).fit(rdd)
+        stats = master.get_training_stats()
+        stats.export_json(tmp_path / "stats.json")
+        stats.export_html(tmp_path / "stats.html")
+        assert (tmp_path / "stats.json").stat().st_size > 0
+        assert b"timeline" in (tmp_path / "stats.html").read_bytes()
+
+
+class TestEarlyStoppingParallel:
+    def test_stops_and_returns_best(self, rng_np):
+        from deeplearning4j_tpu.earlystopping.core import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            InMemoryModelSaver, MaxEpochsTerminationCondition)
+        from deeplearning4j_tpu.parallel import (EarlyStoppingParallelTrainer,
+                                                 make_mesh)
+        net = _net()
+        batches = _batches(rng_np, n=8)
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(batches),
+            model_saver=InMemoryModelSaver(),
+            epoch_terminations=[MaxEpochsTerminationCondition(3)])
+        trainer = EarlyStoppingParallelTrainer(cfg, net, batches,
+                                               mesh=make_mesh(4))
+        result = trainer.fit()
+        assert result.total_epochs <= 4
+        assert result.best_model is not None
+        assert np.isfinite(result.best_model_score)
+
+
+class TestMagicQueue:
+    def test_round_robin_and_broadcast(self, rng_np):
+        from deeplearning4j_tpu.parallel import MagicQueue
+        ds = _batches(rng_np, n=1)[0]
+        q = MagicQueue(num_devices=4)
+        for _ in range(8):
+            q.add(ds)
+        assert [q.size(i) for i in range(4)] == [2, 2, 2, 2]
+        got = q.poll(0, timeout=1.0)
+        assert got is not None and got.features.shape == ds.features.shape
+        qb = MagicQueue(num_devices=4, mode="broadcast")
+        qb.add(ds)
+        assert [qb.size(i) for i in range(4)] == [1, 1, 1, 1]
+
+
+class TestParallelWrapperMainCLI:
+    def test_end_to_end(self, rng_np, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.parallel.main import main
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+        net = _net()
+        model_path = tmp_path / "model.zip"
+        out_path = tmp_path / "trained.zip"
+        ModelSerializer.write_model(net, model_path)
+        import sys
+        sys.modules.setdefault("_cli_test_factory", type(sys)(
+            "_cli_test_factory"))
+        mod = sys.modules["_cli_test_factory"]
+        rng = np.random.default_rng(3)
+
+        def make_iterator():
+            from deeplearning4j_tpu.datasets.iterators import \
+                ListDataSetIterator
+            return ListDataSetIterator(_batches(rng, n=4))
+
+        mod.make_iterator = make_iterator
+        rc = main(["--model-path", str(model_path),
+                   "--iterator-factory", "_cli_test_factory:make_iterator",
+                   "--workers", "2", "--epochs", "1",
+                   "--output-path", str(out_path)])
+        assert rc == 0 and out_path.exists()
+        restored = ModelSerializer.restore_multi_layer_network(out_path)
+        assert restored.num_params() == net.num_params()
