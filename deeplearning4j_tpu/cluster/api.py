@@ -32,7 +32,9 @@ class RDDTrainingApproach(enum.Enum):
 
 @dataclass
 class WorkerConfiguration:
-    batch_size_per_worker: int = 32
+    # None = train on the dataset's existing minibatches unchanged;
+    # a number = re-batch each split to that size before fitting
+    batch_size_per_worker: Optional[int] = None
     prefetch_num_batches: int = 2
     collect_training_stats: bool = False
     max_batches_per_worker: Optional[int] = None

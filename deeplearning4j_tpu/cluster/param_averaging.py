@@ -24,6 +24,7 @@ itself should ride ICI whenever the mesh spans it.
 from __future__ import annotations
 
 import functools
+import hashlib
 import os
 import pickle
 import tempfile
@@ -70,7 +71,7 @@ class ParameterAveragingTrainingWorker(TrainingWorker):
 
 
 class ParameterAveragingTrainingMaster(TrainingMaster):
-    def __init__(self, batch_size_per_worker: int = 32,
+    def __init__(self, batch_size_per_worker: Optional[int] = None,
                  averaging_frequency: int = 1,
                  num_workers: Optional[int] = None,
                  average_updaters: bool = True,
@@ -108,6 +109,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
     # ------------------------------------------------------------- training
     def execute_training(self, network, data: DistributedDataSet) -> None:
+        if self.worker_conf.batch_size_per_worker is not None:
+            data = self._rebatch(data,
+                                 self.worker_conf.batch_size_per_worker)
         if self.approach is RDDTrainingApproach.EXPORT:
             data = self._export_and_reload(data)
         n_workers = self.num_workers or data.num_executors
@@ -116,29 +120,43 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 Repartition.NUM_PARTITIONS_WORKERS_DIFFERS
                 and data.num_partitions != n_workers):
             data = data.repartition(n_workers)
-        splits = data.random_split(self.averaging_frequency) \
-            if self.averaging_frequency > 1 else [data]
+        # reference semantics: parameters are averaged after each worker has
+        # fitted ``averaging_frequency`` minibatches — so one split holds
+        # n_workers * averaging_frequency batches and the split count grows
+        # as frequency shrinks (frequency=1 → tightest sync)
+        per_split = n_workers * self.averaging_frequency
+        num_splits = max(1, data.count() // per_split)
+        splits = data.random_split(num_splits) if num_splits > 1 else [data]
         for split in splits:
             self._run_split(network, split)
 
     def _run_split(self, network, split: DistributedDataSet) -> None:
         stats = self.stats
 
+        max_batches = self.worker_conf.max_batches_per_worker
+
         def fit_partition(partition):
+            if not partition:
+                return None      # empty partition: no replica to average in
             # one worker (and thus one PhaseTimer) PER TASK: partitions run
             # concurrently and events must not bleed between results
             worker = self.get_worker(network)
             model = worker.get_initial_model()
-            for i, ds in enumerate(partition):
+            n_fit = len(partition) if max_batches is None \
+                else min(len(partition), max_batches)
+            for i in range(n_fit):
+                ds = partition[i]
                 if isinstance(ds, str):      # export-approach path entry
                     ds = _load_file(ds)
-                worker.process_minibatch(ds, model,
-                                         i == len(partition) - 1)
+                worker.process_minibatch(ds, model, i == n_fit - 1)
             return worker.get_final_result(model)
 
         if stats:
             stats.timer.start("map_partitions")
-        results = split.map_partitions(fit_partition)
+        results = [r for r in split.map_partitions(fit_partition)
+                   if r is not None]
+        if not results:
+            return
         if stats:
             stats.timer.end("map_partitions")
             for r in results:
@@ -175,6 +193,31 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         if stats:
             stats.timer.end("aggregate_average")
 
+    # ---------------------------------------------------------- re-batching
+    @staticmethod
+    def _rebatch(data: DistributedDataSet, bs: int) -> DistributedDataSet:
+        """Concatenate the dataset's examples and re-slice into minibatches
+        of ``batch_size_per_worker`` (the reference worker's re-batching).
+        Masked sequence batches are passed through unchanged — their time
+        dimensions may disagree across batches."""
+        from ..ops.dataset import DataSet
+        flat = [d for p in data.partitions for d in p]
+        if not flat or any(isinstance(d, str) or d.features_mask is not None
+                           or d.labels_mask is not None for d in flat):
+            return data
+        shapes = {d.features.shape[1:] for d in flat}
+        if len(shapes) > 1:
+            return data
+        feats = np.concatenate([d.features for d in flat])
+        labels = None if flat[0].labels is None else \
+            np.concatenate([d.labels for d in flat])
+        batches = [DataSet(feats[i:i + bs],
+                           None if labels is None else labels[i:i + bs])
+                   for i in range(0, len(feats), bs)]
+        return DistributedDataSet.from_datasets(
+            batches, data.num_partitions, num_executors=data.num_executors,
+            max_task_retries=data.max_task_retries)
+
     # ------------------------------------------------------------ export IO
     def _export_and_reload(self, data: DistributedDataSet) \
             -> DistributedDataSet:
@@ -189,13 +232,30 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         n = data.count()
         paths = [os.path.join(outdir, f"dataset_{i:06d}.bin")
                  for i in range(n)]
-        if not all(os.path.exists(p) for p in paths):
+        # content fingerprint guards against silently reusing a stale export
+        # of a DIFFERENT same-sized dataset in the same directory
+        flat = [d for p in data.partitions for d in p]
+        fp = hashlib.sha256()
+        fp.update(str(n).encode())
+        for ds in (flat[0], flat[-1]) if flat else ():
+            fp.update(str(np.asarray(ds.features).shape).encode())
+            fp.update(np.ascontiguousarray(ds.features).tobytes())
+        fingerprint = fp.hexdigest()
+        manifest = os.path.join(outdir, "export_manifest.txt")
+        stale = True
+        if os.path.exists(manifest) and all(os.path.exists(p)
+                                            for p in paths):
+            with open(manifest) as f:
+                stale = f.read().strip() != fingerprint
+        if stale:
             i = 0
             for part in data.partitions:
                 for ds in part:
                     with open(paths[i], "wb") as f:
                         pickle.dump(ds, f)
                     i += 1
+            with open(manifest, "w") as f:
+                f.write(fingerprint)
         return DistributedDataSet.from_datasets(
             paths, data.num_partitions, num_executors=data.num_executors,
             max_task_retries=data.max_task_retries)

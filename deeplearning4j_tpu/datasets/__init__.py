@@ -6,6 +6,8 @@ from .iterators import (DataSetIterator, ListDataSetIterator,
                         MultipleEpochsIterator, SamplingDataSetIterator,
                         as_iterator)
 from .mnist import MnistDataSetIterator, IrisDataSetIterator
+from .fetchers import (CifarDataSetIterator, LFWDataSetIterator,
+                       CurvesDataSetIterator)
 from .datavec import (RecordReader, CSVRecordReader, CollectionRecordReader,
                       CollectionSequenceRecordReader,
                       RecordReaderDataSetIterator,
@@ -15,7 +17,9 @@ from .datavec import (RecordReader, CSVRecordReader, CollectionRecordReader,
 __all__ = ["DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
            "AsyncDataSetIterator", "MultipleEpochsIterator",
            "SamplingDataSetIterator", "as_iterator", "MnistDataSetIterator",
-           "IrisDataSetIterator", "RecordReader", "CSVRecordReader",
+           "IrisDataSetIterator", "CifarDataSetIterator",
+           "LFWDataSetIterator", "CurvesDataSetIterator", "RecordReader",
+           "CSVRecordReader",
            "CollectionRecordReader", "CollectionSequenceRecordReader",
            "RecordReaderDataSetIterator",
            "SequenceRecordReaderDataSetIterator",

@@ -2,6 +2,7 @@
 
 from .importer import KerasModelImport
 from .layers import KerasLayerError, convert_layer, convert_vertex
+from .server import KerasBackendServer
 
 __all__ = ["KerasModelImport", "KerasLayerError", "convert_layer",
-           "convert_vertex"]
+           "convert_vertex", "KerasBackendServer"]

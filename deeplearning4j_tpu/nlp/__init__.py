@@ -13,6 +13,11 @@ from .tokenization import (DefaultTokenizerFactory, NGramTokenizerFactory,
                            StopWords)
 from .vectorizers import (BagOfWordsVectorizer, TfidfVectorizer,
                           WordVectorSerializer, StaticWord2Vec)
+from .word2vec_iterator import Word2VecDataSetIterator, WindowDataSetIterator
+from .cjk import JapaneseTokenizerFactory, KoreanTokenizerFactory
+from .annotators import (Annotation, AnnotatedDocument, SentenceAnnotator,
+                         TokenizerAnnotator, PosTagger, StemmerAnnotator,
+                         AnnotatorPipeline)
 
 __all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
            "apply_huffman", "pad_codes", "SequenceVectors",
@@ -21,4 +26,8 @@ __all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
            "CommonPreprocessor", "CollectionSentenceIterator",
            "LineSentenceIterator", "LabelAwareSentenceIterator", "StopWords",
            "BagOfWordsVectorizer", "TfidfVectorizer", "WordVectorSerializer",
-           "StaticWord2Vec"]
+           "StaticWord2Vec", "Word2VecDataSetIterator",
+           "WindowDataSetIterator", "JapaneseTokenizerFactory",
+           "KoreanTokenizerFactory", "Annotation", "AnnotatedDocument",
+           "SentenceAnnotator", "TokenizerAnnotator", "PosTagger",
+           "StemmerAnnotator", "AnnotatorPipeline"]

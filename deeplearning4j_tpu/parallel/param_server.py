@@ -126,6 +126,14 @@ class ParameterServerNode:
                     op, arr = _recv_array(conn)
                 except (ConnectionError, struct.error):
                     return
+                except ValueError as e:
+                    # corrupt .npy payload: the length-prefixed framing is
+                    # already consumed, so the stream stays in sync — log
+                    # and keep serving
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "parameter server dropped corrupt frame: %s", e)
+                    continue
                 try:
                     if op == b"P":
                         if arr is None:

@@ -1,0 +1,10 @@
+"""Streaming glue (reference dl4j-streaming, 811 LoC: Kafka+Camel routes for
+NDArray pub/sub and model serving — NDArrayKafkaClient, DL4jServeRouteBuilder;
+SURVEY.md §2.4)."""
+
+from .pubsub import (MessageBroker, NDArrayPublisher, NDArraySubscriber,
+                     NDArrayStreamClient)
+from .serving import ModelServingRoute
+
+__all__ = ["MessageBroker", "NDArrayPublisher", "NDArraySubscriber",
+           "NDArrayStreamClient", "ModelServingRoute"]

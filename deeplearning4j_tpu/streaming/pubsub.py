@@ -1,0 +1,134 @@
+"""NDArray pub/sub (reference dl4j-streaming
+kafka/NDArrayKafkaClient.java, NDArrayPublisher, NDArrayConsumer; SURVEY.md
+§2.4).
+
+Kafka's role (durable topic fan-out of serialized NDArrays) is played by a
+broker abstraction with an in-process implementation: named topics, each a
+bounded deque fanned out to subscriber queues. The wire format is the same
+``np.save`` framing the parameter server uses, so a Kafka-backed
+implementation only has to re-implement :class:`MessageBroker` — publishers
+and subscribers are transport-agnostic, mirroring how the reference hides
+Kafka behind Camel routes.
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def serialize_ndarray(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def deserialize_ndarray(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class MessageBroker:
+    """In-process topic broker (Kafka stand-in)."""
+
+    def __init__(self, capacity: int = 1024):
+        self._subs: Dict[str, List[queue.Queue]] = defaultdict(list)
+        self._lock = threading.Lock()
+        self.capacity = capacity
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._lock:
+            subs = list(self._subs[topic])
+        for q in subs:
+            try:
+                q.put_nowait(payload)
+            except queue.Full:
+                # drop-oldest backpressure; every step races subscribers and
+                # other publishers, so both ops tolerate losing the race
+                # (worst case THIS message is the one dropped)
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    q.put_nowait(payload)
+                except queue.Full:
+                    pass
+
+    def subscribe(self, topic: str) -> queue.Queue:
+        q: queue.Queue = queue.Queue(maxsize=self.capacity)
+        with self._lock:
+            self._subs[topic].append(q)
+        return q
+
+    def unsubscribe(self, topic: str, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._subs[topic]:
+                self._subs[topic].remove(q)
+
+
+class NDArrayPublisher:
+    """reference NDArrayPublisher: push arrays onto a topic."""
+
+    def __init__(self, broker: MessageBroker, topic: str):
+        self.broker = broker
+        self.topic = topic
+
+    def publish(self, arr: np.ndarray) -> None:
+        self.broker.publish(self.topic, serialize_ndarray(arr))
+
+
+class NDArraySubscriber:
+    """reference NDArrayConsumer: pull (or callback-drain) arrays."""
+
+    def __init__(self, broker: MessageBroker, topic: str):
+        self.broker = broker
+        self.topic = topic
+        self._q = broker.subscribe(topic)
+        self._stop = threading.Event()
+
+    def poll(self, timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        try:
+            if timeout is None:
+                return deserialize_ndarray(self._q.get_nowait())
+            return deserialize_ndarray(self._q.get(timeout=timeout))
+        except queue.Empty:
+            return None
+
+    def listen(self, callback: Callable[[np.ndarray], None]) \
+            -> threading.Thread:
+        """Background drain thread (Camel consumer-route analog)."""
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    payload = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                callback(deserialize_ndarray(payload))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t
+
+    def close(self):
+        self._stop.set()
+        self.broker.unsubscribe(self.topic, self._q)
+
+
+class NDArrayStreamClient:
+    """Paired publisher/subscriber on one broker (NDArrayKafkaClient
+    analog)."""
+
+    def __init__(self, broker: Optional[MessageBroker] = None):
+        self.broker = broker or MessageBroker()
+
+    def publisher(self, topic: str) -> NDArrayPublisher:
+        return NDArrayPublisher(self.broker, topic)
+
+    def subscriber(self, topic: str) -> NDArraySubscriber:
+        return NDArraySubscriber(self.broker, topic)

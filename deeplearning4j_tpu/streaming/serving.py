@@ -1,0 +1,42 @@
+"""Model-serving route (reference dl4j-streaming
+routes/DL4jServeRouteBuilder.java: Camel route that consumes NDArrays from a
+topic, runs the model, publishes outputs; SURVEY.md §2.4)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .pubsub import MessageBroker, NDArrayPublisher, NDArraySubscriber
+
+
+class ModelServingRoute:
+    """Consume feature arrays from ``input_topic``, publish ``net.output``
+    results to ``output_topic`` — the serve-route the reference builds with
+    Camel. ``start()`` spins the consumer thread; ``stop()`` drains it."""
+
+    def __init__(self, net, broker: MessageBroker,
+                 input_topic: str = "dl4j-input",
+                 output_topic: str = "dl4j-output"):
+        self.net = net
+        self.broker = broker
+        self.sub = NDArraySubscriber(broker, input_topic)
+        self.pub = NDArrayPublisher(broker, output_topic)
+        self._thread: Optional[threading.Thread] = None
+        self.served = 0
+
+    def _serve_one(self, arr: np.ndarray) -> None:
+        out = np.asarray(self.net.output(arr.astype(np.float32)))
+        self.pub.publish(out)
+        self.served += 1
+
+    def start(self) -> "ModelServingRoute":
+        self._thread = self.sub.listen(self._serve_one)
+        return self
+
+    def stop(self) -> None:
+        self.sub.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -1,0 +1,120 @@
+"""Dataset fetcher + word2vec-as-input tests (reference
+CifarDataSetIterator/LFW/Curves fetcher tests and Word2VecDataSetIterator
+usage; SURVEY.md §2.3, §2.5)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import (CifarDataSetIterator,
+                                         CurvesDataSetIterator,
+                                         LFWDataSetIterator)
+from deeplearning4j_tpu.nlp import (Word2Vec, Word2VecDataSetIterator,
+                                    WindowDataSetIterator)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the lazy dog sleeps in the warm sun",
+    "a quick red fox runs past the brown dog",
+    "cats chase the quick mice in the barn",
+    "the warm sun shines over the green field",
+] * 4
+
+
+def _vectors():
+    w2v = (Word2Vec.Builder().layer_size(16).window_size(3)
+           .min_word_frequency(1).epochs(12).learning_rate(0.1).seed(11)
+           .iterate(CORPUS).build())
+    w2v.fit()
+    return w2v
+
+
+class TestFetchers:
+    def test_cifar_shapes(self):
+        it = CifarDataSetIterator(8, num_examples=64)
+        ds = next(iter(it))
+        assert ds.features.shape == (8, 32, 32, 3)
+        assert ds.labels.shape == (8, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        assert np.allclose(ds.labels.sum(1), 1.0)
+
+    def test_cifar_deterministic_classes(self):
+        a = CifarDataSetIterator(16, num_examples=64, shuffle=False, seed=1)
+        b = CifarDataSetIterator(16, num_examples=64, shuffle=False, seed=1)
+        np.testing.assert_array_equal(next(iter(a)).features,
+                                      next(iter(b)).features)
+
+    def test_lfw_shapes(self):
+        it = LFWDataSetIterator(4, num_examples=32, image_size=48,
+                                num_identities=5)
+        ds = next(iter(it))
+        assert ds.features.shape == (4, 48, 48, 3)
+        assert ds.labels.shape == (4, 5)
+
+    def test_curves_autoencoder_target(self):
+        it = CurvesDataSetIterator(10, num_examples=30)
+        ds = next(iter(it))
+        assert ds.features.shape == (10, 784)
+        np.testing.assert_array_equal(ds.features, ds.labels)
+        # curves are sparse strokes
+        assert 0 < ds.features.sum() < 784 * 10 * 0.5
+
+
+class TestWord2VecInput:
+    def test_sequence_datasets(self):
+        w2v = _vectors()
+        labelled = [("the quick fox runs", "animal"),
+                    ("the warm sun shines", "nature"),
+                    ("cats chase mice", "animal"),
+                    ("the green field", "nature")]
+        it = Word2VecDataSetIterator(w2v, labelled, ["animal", "nature"],
+                                     batch_size=2)
+        batches = list(it)
+        assert len(batches) == 2
+        ds = batches[0]
+        n, T, F = ds.features.shape
+        assert n == 2 and F == 16
+        assert ds.labels.shape == (2, T, 2)
+        # label mask marks exactly one (final) step per example
+        assert ds.labels_mask.sum(axis=1).tolist() == [1.0, 1.0]
+        for j in range(n):
+            t_last = int(ds.features_mask[j].sum()) - 1
+            assert ds.labels_mask[j, t_last] == 1.0
+            assert ds.labels[j, t_last].sum() == 1.0
+
+    def test_rnn_trains_on_embeddings(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM,
+                                                       RnnOutputLayer)
+        w2v = _vectors()
+        labelled = [("the quick fox runs past the dog", "animal"),
+                    ("the warm sun shines over the field", "nature")] * 4
+        it = Word2VecDataSetIterator(w2v, labelled, ["animal", "nature"],
+                                     batch_size=8)
+        conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.05)
+                .updater("adam").weight_init("xavier").list()
+                .layer(GravesLSTM(n_out=12, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(16)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, num_epochs=30)
+        ds = next(iter(it))
+        out = np.asarray(net.output(ds.features))
+        # prediction at the last unmasked step should separate the classes
+        correct = 0
+        for j in range(len(labelled)):
+            t_last = int(ds.features_mask[j].sum()) - 1
+            pred = out[j, t_last].argmax()
+            correct += int(ds.labels[j, t_last].argmax() == pred)
+        assert correct >= 6
+
+    def test_window_iterator(self):
+        w2v = _vectors()
+        it = WindowDataSetIterator(w2v, ["the quick brown fox",
+                                         "the lazy dog"],
+                                   window_size=3, batch_size=4)
+        (ds, words) = next(iter(it))
+        assert ds.features.shape == (4, 3 * 16)
+        assert len(words) == 4 and words[0] == "the"
+        total = sum(len(w) for _, w in it)
+        assert total == it.total_examples()

@@ -1,0 +1,176 @@
+"""Interop + streaming + CJK/annotator tests (reference dl4j-streaming
+tests, deeplearning4j-keras Server, nlp-japanese/korean tokenizer tests;
+SURVEY.md §2.4, §2.5, §2.7)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.dataset import DataSet
+
+
+def _net():
+    conf = (NeuralNetConfiguration.Builder().seed(5).learning_rate(0.1)
+            .updater("sgd").weight_init("xavier").activation("tanh").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestCJKTokenizers:
+    def test_japanese_segmentation(self):
+        from deeplearning4j_tpu.nlp import JapaneseTokenizerFactory
+        tf = JapaneseTokenizerFactory()
+        toks = tf.create("私は東京に住んでいます。").get_tokens()
+        assert "東京" in toks          # kanji run kept together
+        assert "は" in toks and "に" in toks   # particles split out
+        # katakana + latin runs
+        toks2 = tf.create("コーヒーをABCで買う").get_tokens()
+        assert "コーヒー" in toks2 and "ABC" in toks2
+
+    def test_korean_josa_stripping(self):
+        from deeplearning4j_tpu.nlp import KoreanTokenizerFactory
+        tf = KoreanTokenizerFactory()
+        toks = tf.create("고양이는 우유를 마신다").get_tokens()
+        assert "고양이" in toks and "는" in toks
+        assert "우유" in toks and "를" in toks
+        assert "마신다" in toks
+
+    def test_factories_drive_word2vec(self):
+        from deeplearning4j_tpu.nlp import JapaneseTokenizerFactory, Word2Vec
+        corpus = ["猫は魚を食べる", "犬は肉を食べる", "猫は牛乳を飲む"] * 5
+        w2v = (Word2Vec.Builder().layer_size(8).window_size(2)
+               .min_word_frequency(1).epochs(2)
+               .tokenizer_factory(JapaneseTokenizerFactory())
+               .iterate(corpus).build())
+        w2v.fit()
+        assert w2v.get_word_vector("猫") is not None
+
+
+class TestAnnotators:
+    def test_pipeline(self):
+        from deeplearning4j_tpu.nlp import AnnotatorPipeline
+        doc = AnnotatorPipeline().process(
+            "The quick fox runs. It jumped over the lazy dog!")
+        sents = doc.select("sentence")
+        assert len(sents) == 2
+        toks = doc.select("token")
+        assert [t.text for t in toks[:3]] == ["The", "quick", "fox"]
+        # spans index back into the source text
+        for t in toks:
+            assert doc.text[t.begin:t.end] == t.text
+        pos = {a.text.lower(): a.features["tag"] for a in doc.select("pos")}
+        assert pos["the"] == "DT" and pos["over"] == "IN"
+
+    def test_stemmer(self):
+        from deeplearning4j_tpu.nlp import (AnnotatorPipeline,
+                                            SentenceAnnotator,
+                                            StemmerAnnotator,
+                                            TokenizerAnnotator)
+        doc = AnnotatorPipeline([SentenceAnnotator(), TokenizerAnnotator(),
+                                 StemmerAnnotator()]).process(
+            "running jumps quickly")
+        stems = {a.text: a.features["stem"] for a in doc.select("stem")}
+        assert stems["running"] == "runn" or stems["running"] == "run"
+        assert stems["jumps"] == "jump"
+
+
+class TestStreaming:
+    def test_pubsub_roundtrip(self):
+        from deeplearning4j_tpu.streaming import NDArrayStreamClient
+        client = NDArrayStreamClient()
+        sub = client.subscriber("t1")
+        pub = client.publisher("t1")
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        pub.publish(arr)
+        got = sub.poll(timeout=1.0)
+        np.testing.assert_array_equal(got, arr)
+        assert sub.poll() is None      # non-blocking empty -> None
+        sub.close()
+
+    def test_model_serving_route(self):
+        from deeplearning4j_tpu.streaming import (MessageBroker,
+                                                  ModelServingRoute,
+                                                  NDArrayPublisher,
+                                                  NDArraySubscriber)
+        net = _net()
+        broker = MessageBroker()
+        out_sub = NDArraySubscriber(broker, "dl4j-output")
+        route = ModelServingRoute(net, broker).start()
+        try:
+            pub = NDArrayPublisher(broker, "dl4j-input")
+            pub.publish(np.random.default_rng(0).normal(
+                size=(4, 3)).astype(np.float32))
+            got = out_sub.poll(timeout=5.0)
+            assert got is not None and got.shape == (4, 2)
+            np.testing.assert_allclose(got.sum(1), 1.0, rtol=1e-4)
+            assert route.served == 1
+        finally:
+            route.stop()
+            out_sub.close()
+
+
+class TestObjectStore:
+    def test_local_fs_store(self, tmp_path):
+        from deeplearning4j_tpu.utils.object_store import \
+            LocalFileSystemObjectStore
+        store = LocalFileSystemObjectStore(tmp_path / "store")
+        src = tmp_path / "a.bin"
+        src.write_bytes(b"hello")
+        store.upload(src, "models", "run1/best.zip")
+        assert store.list_keys("models") == ["run1/best.zip"]
+        dst = tmp_path / "b.bin"
+        store.download("models", "run1/best.zip", dst)
+        assert dst.read_bytes() == b"hello"
+        store.delete("models", "run1/best.zip")
+        assert store.list_keys("models") == []
+
+    def test_fleet_spec(self):
+        from deeplearning4j_tpu.utils.object_store import FleetSpec
+        cmds = FleetSpec(num_workers=2).render_launch_commands()
+        assert len(cmds) == 2 and "tpu-vm create" in cmds[0]
+
+
+class TestKerasBackendServer:
+    def test_http_fit_predict(self, tmp_path):
+        from deeplearning4j_tpu.keras import KerasBackendServer
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+        net = _net()
+        mpath = tmp_path / "m.zip"
+        ModelSerializer.write_model(net, mpath)
+        srv = KerasBackendServer().start()
+        try:
+            base = f"http://{srv.host}:{srv.port}"
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, json.dumps(payload).encode(),
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req) as r:
+                    return json.loads(r.read())
+
+            mid = post("/load", {"path": str(mpath)})["model_id"]
+            rng = np.random.default_rng(1)
+            X = rng.normal(size=(16, 3)).tolist()
+            y = np.eye(2)[rng.integers(0, 2, 16)].tolist()
+            score = post("/fit", {"model_id": mid, "features": X,
+                                  "labels": y, "epochs": 2})["score"]
+            assert np.isfinite(score)
+            out = post("/predict", {"model_id": mid, "features": X})
+            assert np.asarray(out["output"]).shape == (16, 2)
+            ev = post("/evaluate", {"model_id": mid, "features": X,
+                                    "labels": y})
+            assert 0.0 <= ev["accuracy"] <= 1.0
+            post("/save", {"model_id": mid,
+                           "path": str(tmp_path / "out.zip")})
+            assert (tmp_path / "out.zip").exists()
+            with urllib.request.urlopen(base + "/models") as r:
+                assert mid in json.loads(r.read())["models"]
+        finally:
+            srv.shutdown()

@@ -162,6 +162,56 @@ class TestClusterTraining:
         with pytest.raises(RuntimeError, match="failed after"):
             rdd.map_partitions(sum, fault_injector=always_fail)
 
+    def test_single_batch_not_diluted_by_empty_partitions(self, rng_np):
+        """1 batch + 4 executors: empty partitions must NOT average in
+        unfitted replicas (update would shrink by 4x)."""
+        from deeplearning4j_tpu.cluster import (
+            ClusterDl4jMultiLayer, DistributedDataSet,
+            ParameterAveragingTrainingMaster)
+        ds = _batches(rng_np, n=1)[0]
+        serial = _net(seed=7)
+        p0 = serial.params_flat()
+        serial.fit([ds])
+        serial_delta = serial.params_flat() - p0
+        clustered = _net(seed=7)
+        rdd = DistributedDataSet.from_datasets([ds], num_partitions=1,
+                                               num_executors=4)
+        ClusterDl4jMultiLayer(
+            clustered, ParameterAveragingTrainingMaster()).fit(rdd)
+        cluster_delta = clustered.params_flat() - p0
+        np.testing.assert_allclose(cluster_delta, serial_delta,
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_averaging_frequency_counts_batches_per_worker(self, rng_np):
+        """averaging_frequency=k means k minibatches per worker between
+        averages — 16 batches / (4 workers * 2) = 2 averaging rounds."""
+        from deeplearning4j_tpu.cluster import (
+            ClusterDl4jMultiLayer, DistributedDataSet,
+            ParameterAveragingTrainingMaster)
+        net = _net()
+        rdd = DistributedDataSet.from_datasets(_batches(rng_np, n=16),
+                                               num_partitions=4,
+                                               num_executors=4)
+        master = ParameterAveragingTrainingMaster(averaging_frequency=2,
+                                                  num_workers=4)
+        ClusterDl4jMultiLayer(net, master).fit(rdd)
+        assert net.iteration == 2      # one increment per averaging round
+
+    def test_rebatch_and_max_batches(self, rng_np):
+        from deeplearning4j_tpu.cluster import (
+            ClusterDl4jMultiLayer, DistributedDataSet,
+            ParameterAveragingTrainingMaster)
+        net = _net()
+        rdd = DistributedDataSet.from_datasets(_batches(rng_np, n=4, b=16),
+                                               num_partitions=2)
+        master = ParameterAveragingTrainingMaster(batch_size_per_worker=8)
+        rebatched = master._rebatch(rdd, 8)
+        assert rebatched.count() == 8           # 64 examples / 8
+        assert all(d.features.shape[0] == 8
+                   for p in rebatched.partitions for d in p)
+        master.worker_conf.max_batches_per_worker = 1
+        ClusterDl4jMultiLayer(net, master).fit(rdd)   # smoke: cap respected
+
     def test_export_approach(self, rng_np, tmp_path):
         from deeplearning4j_tpu.cluster import (
             ClusterDl4jMultiLayer, DistributedDataSet,
