@@ -30,16 +30,12 @@ from .base import BaseRecurrentLayerConf
 from ...helpers import get_helper
 
 
-def _lstm_scan(conf, W, R, b, peepholes, x, h0, c0, mask, gate_act, cell_act):
-    """Shared scan core. x: [N,T,nIn] → y: [N,T,H], final (h, c)."""
-    n, t, _ = x.shape
-    hsize = R.shape[0]
-    xw = (x.reshape(n * t, -1) @ W).reshape(n, t, 4 * hsize) + b
-    xw_t = jnp.transpose(xw, (1, 0, 2))          # [T, N, 4H] scan order
-    mask_t = None
-    if mask is not None:
-        mask_t = jnp.transpose(mask.astype(x.dtype), (1, 0))[..., None]  # [T,N,1]
-
+def _lstm_recurrence(xw_t, R, peepholes, h0, c0, mask_t, gate_act, cell_act):
+    """The sequential LSTM core from a precomputed input projection.
+    xw_t: [T, N, 4H] (already x@W+b) → (ys [T,N,H], hT, cT). Single source
+    of truth for the gate math — the Pallas kernel's backward pass
+    (kernels/lstm.py) differentiates THIS function, so helper gradients are
+    exactly the built-in path's."""
     pi, pf, po = peepholes if peepholes is not None else (None, None, None)
 
     def step(carry, inputs):
@@ -69,6 +65,20 @@ def _lstm_scan(conf, W, R, b, peepholes, x, h0, c0, mask, gate_act, cell_act):
 
     xs = xw_t if mask_t is None else (xw_t, mask_t)
     (hT, cT), ys = lax.scan(step, (h0, c0), xs)
+    return ys, hT, cT
+
+
+def _lstm_scan(conf, W, R, b, peepholes, x, h0, c0, mask, gate_act, cell_act):
+    """Shared scan core. x: [N,T,nIn] → y: [N,T,H], final (h, c)."""
+    n, t, _ = x.shape
+    hsize = R.shape[0]
+    xw = (x.reshape(n * t, -1) @ W).reshape(n, t, 4 * hsize) + b
+    xw_t = jnp.transpose(xw, (1, 0, 2))          # [T, N, 4H] scan order
+    mask_t = None
+    if mask is not None:
+        mask_t = jnp.transpose(mask.astype(x.dtype), (1, 0))[..., None]  # [T,N,1]
+    ys, hT, cT = _lstm_recurrence(xw_t, R, peepholes, h0, c0, mask_t,
+                                  gate_act, cell_act)
     return jnp.transpose(ys, (1, 0, 2)), hT, cT
 
 

@@ -128,6 +128,20 @@ class ComputationGraph:
                     for n, f in zip(names, features)}
         return {names[0]: jnp.asarray(features, self.compute_dtype)}
 
+    @staticmethod
+    def _strip_rnn_carry(states):
+        """Drop transient rnn h/c before storing: each minibatch starts from
+        zero rnn state (see MultiLayerNetwork._strip_rnn_carry)."""
+        return {name: ({k: v for k, v in s.items() if k not in ("h", "c")}
+                       if isinstance(s, dict) else s)
+                for name, s in states.items()}
+
+    def _inference_state(self):
+        """State minus the transient rnn carry ('h'/'c'): output/score are
+        stateless like the reference; only rnnTimeStep continues from stored
+        state (see MultiLayerNetwork._inference_state)."""
+        return self._strip_rnn_carry(self.state)
+
     def output(self, *features, train: bool = False):
         """Forward pass → list of output activations (reference
         ComputationGraph.output)."""
@@ -144,7 +158,7 @@ class ComputationGraph:
                 return [acts[o] for o in self.conf.network_outputs]
             fn = jax.jit(_out)
             self._jit_cache["output"] = fn
-        outs = fn(self.params, self.state, inputs)
+        outs = fn(self.params, self._inference_state(), inputs)
         return [np.asarray(o) for o in outs]
 
     # -------------------------------------------------------------- training
@@ -286,9 +300,10 @@ class ComputationGraph:
         if step is None:
             step = jax.jit(self._make_train_step(), donate_argnums=(0, 1, 2))
             self._jit_cache["train"] = step
-        self.params, self.updater_state, self.state, score = step(
+        self.params, self.updater_state, new_states, score = step(
             self.params, self.updater_state, self.state, inputs, labels,
             imasks, lmasks, self.iteration)
+        self.state = self._strip_rnn_carry(new_states)
         self.score_value = score  # device scalar; sync deferred to reader
         self.iteration += 1
         for lst in self.listeners:
@@ -303,7 +318,8 @@ class ComputationGraph:
         else:
             inputs = self._inputs_dict(ds.features)
             labels = self._labels_dict(ds.labels)
-        loss, _ = self._loss(self.params, self.state, inputs, labels, None)
+        loss, _ = self._loss(self.params, self._inference_state(), inputs,
+                             labels, None)
         return float(loss)
 
     def compute_gradient_and_score(self, ds):
@@ -312,7 +328,8 @@ class ComputationGraph:
         labels = self._labels_dict(ds.labels)
 
         def lf(p):
-            return self._loss(p, self.state, inputs, labels, None)
+            return self._loss(p, self._inference_state(), inputs, labels,
+                              None)
         (score, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
         return grads, float(score)
 

@@ -147,6 +147,13 @@ class MultiLayerNetwork:
             return act, new_states, reg, act, mask
         return act, new_states, reg
 
+    def _inference_state(self):
+        """State with the transient rnn carry ('h'/'c') removed: like the
+        reference, output/score/evaluate are STATELESS — only rnnTimeStep
+        continues from stored state. BatchNorm running stats etc. remain."""
+        return [{k: v for k, v in s.items() if k not in ("h", "c")}
+                if isinstance(s, dict) else s for s in self.state]
+
     def output(self, x, train: bool = False) -> np.ndarray:
         """Full forward pass (reference MultiLayerNetwork.output)."""
         self._ensure_init()
@@ -158,7 +165,7 @@ class MultiLayerNetwork:
                 return y
             fn = jax.jit(_out)
             self._jit_cache["output"] = fn
-        return np.asarray(fn(self.params, self.state, x))
+        return np.asarray(fn(self.params, self._inference_state(), x))
 
     def feed_forward(self, x, train: bool = False) -> List[np.ndarray]:
         """Per-layer activations (reference feedForward)."""
@@ -166,11 +173,12 @@ class MultiLayerNetwork:
         act = jnp.asarray(x, self.compute_dtype)
         outs = [np.asarray(act)]
         mask = None
+        inf_state = self._inference_state()
         for i, layer in enumerate(self.layers):
             pp = self.conf.preprocessor_for(i)
             if pp is not None:
                 act = pp.pre_process(act, mask)
-            act, _ = layer.forward(self.params[i], self.state[i], act,
+            act, _ = layer.forward(self.params[i], inf_state[i], act,
                                    train=train, rng=None, mask=mask)
             outs.append(np.asarray(act))
         return outs
@@ -303,13 +311,23 @@ class MultiLayerNetwork:
                     lst.on_epoch_end(self)
         return self
 
+    @staticmethod
+    def _strip_rnn_carry(states):
+        """Drop transient rnn h/c from a state list before storing: each
+        minibatch starts from zero rnn state (reference fit semantics; the
+        carry would also break retrace on a batch-size change). BatchNorm
+        running stats etc. are kept. TBPTT threads its carry explicitly."""
+        return [{k: v for k, v in s.items() if k not in ("h", "c")}
+                if isinstance(s, dict) else s for s in states]
+
     def _fit_batch(self, ds: DataSet):
         feats, labels, fmask, lmask = _as_jnp_batch(ds, self.compute_dtype)
         step = self._get_train_step(False)
         empty_rnn = [{} for _ in self.layers]
-        self.params, self.updater_state, self.state, score = step(
+        self.params, self.updater_state, new_states, score = step(
             self.params, self.updater_state, self.state, feats, labels,
             fmask, lmask, self.iteration, empty_rnn)
+        self.state = self._strip_rnn_carry(new_states)
         self.score_value = score  # device scalar; sync deferred to reader
         self.iteration += 1
         for lst in self.listeners:
@@ -339,7 +357,7 @@ class MultiLayerNetwork:
                 {k: v for k, v in st.items() if k in ("h", "c")}
                 if isinstance(self.layers[i], BaseRecurrentLayerConf) else {}
                 for i, st in enumerate(new_states)]
-            self.state = new_states
+            self.state = self._strip_rnn_carry(new_states)
             self.score_value = score  # device scalar; sync deferred to reader
             self.iteration += 1
             for lst in self.listeners:
@@ -397,8 +415,8 @@ class MultiLayerNetwork:
         """Loss on a dataset (reference MultiLayerNetwork.score(DataSet))."""
         self._ensure_init()
         feats, labels, fmask, lmask = _as_jnp_batch(ds, self.compute_dtype)
-        loss, _ = self._loss_fn(self.params, self.state, feats, labels,
-                                fmask, lmask, None)
+        loss, _ = self._loss_fn(self.params, self._inference_state(), feats,
+                                labels, fmask, lmask, None)
         return float(loss)
 
     def compute_gradient_and_score(self, ds: DataSet):
@@ -407,8 +425,8 @@ class MultiLayerNetwork:
         feats, labels, fmask, lmask = _as_jnp_batch(ds, self.compute_dtype)
 
         def lf(p):
-            return self._loss_fn(p, self.state, feats, labels, fmask, lmask,
-                                 None)
+            return self._loss_fn(p, self._inference_state(), feats, labels,
+                                 fmask, lmask, None)
         (score, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
         return grads, float(score)
 

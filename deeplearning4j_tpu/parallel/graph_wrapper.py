@@ -58,9 +58,10 @@ class GraphDataParallelTrainer:
             feats, labels = feats[idx], labels[idx]
         inputs = net._inputs_dict(feats)
         label_d = net._labels_dict(labels)
-        net.params, net.updater_state, net.state, score = self._jit_step(
+        net.params, net.updater_state, new_states, score = self._jit_step(
             net.params, net.updater_state, net.state, inputs, label_d,
             net.iteration)
+        net.state = net._strip_rnn_carry(new_states)
         net.score_value = float(score)
         net.iteration += 1
         for lst in net.listeners:

@@ -186,12 +186,13 @@ class ShardedTrainer:
             fmask, lmask = take(fmask), take(lmask)
         cd = net.compute_dtype
         empty_rnn = [{} for _ in net.layers]
-        net.params, net.updater_state, net.state, score = self._jit_step(
+        net.params, net.updater_state, new_states, score = self._jit_step(
             net.params, net.updater_state, net.state,
             jnp.asarray(feats, cd), jnp.asarray(labels, cd),
             None if fmask is None else jnp.asarray(fmask, cd),
             None if lmask is None else jnp.asarray(lmask, cd),
             net.iteration, empty_rnn)
+        net.state = net._strip_rnn_carry(new_states)
         net.score_value = score
         net.iteration += 1
         for lst in net.listeners:

@@ -136,12 +136,14 @@ class ParallelWrapper:
         for ds in iterator:
             feats, labels, fmask, lmask = self._pad_to_devices(ds)
             cd = net.compute_dtype
-            net.params, net.updater_state, net.state, score = self._jit_sync(
+            net.params, net.updater_state, new_states, score = self._jit_sync(
                 net.params, net.updater_state, net.state,
                 jnp.asarray(feats, cd), jnp.asarray(labels, cd),
                 None if fmask is None else jnp.asarray(fmask, cd),
                 None if lmask is None else jnp.asarray(lmask, cd),
                 net.iteration, empty_rnn)
+            net.state = net._strip_rnn_carry(new_states) \
+                if hasattr(net, "_strip_rnn_carry") else new_states
             net.score_value = score   # device scalar; sync deferred to reader
             net.iteration += 1
             for lst in net.listeners:
@@ -218,7 +220,9 @@ class ParallelWrapper:
         sp, su, ss = self._stacked
         net.params = jax.tree_util.tree_map(lambda a: a[0], sp)
         net.updater_state = jax.tree_util.tree_map(lambda a: a[0], su)
-        net.state = jax.tree_util.tree_map(lambda a: a[0], ss)
+        unstacked = jax.tree_util.tree_map(lambda a: a[0], ss)
+        net.state = net._strip_rnn_carry(unstacked) \
+            if hasattr(net, "_strip_rnn_carry") else unstacked
 
     def _run_round(self, batches: List[DataSet]):
         net = self.net

@@ -90,7 +90,7 @@ class TestWord2VecInput:
                     ("the warm sun shines over the field", "nature")] * 4
         it = Word2VecDataSetIterator(w2v, labelled, ["animal", "nature"],
                                      batch_size=8)
-        conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.05)
+        conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
                 .updater("adam").weight_init("xavier").list()
                 .layer(GravesLSTM(n_out=12, activation="tanh"))
                 .layer(RnnOutputLayer(n_out=2, loss="mcxent",

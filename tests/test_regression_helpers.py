@@ -1,0 +1,180 @@
+"""Checkpoint-format regression tests (reference
+regressiontest/RegressionTest050/060/071.java: load zips produced by earlier
+releases, assert configs+params+predictions; SURVEY.md §4) and
+helper-vs-builtin equivalence tests (reference CuDNNGradientChecks.java /
+TestConvolution.java pattern applied to the Pallas LSTM helper)."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+RES = Path(__file__).parent / "resources"
+
+
+class TestCheckpointRegression:
+    """The committed fixture zips freeze the on-disk format; if a future
+    serializer change can't load them, backward compatibility broke."""
+
+    def test_mln_dense_roundtrip(self):
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+        net = ModelSerializer.restore_multi_layer_network(
+            RES / "regression_mln_v1.zip")
+        x = np.load(RES / "regression_mln_v1_input.npy")
+        expected = np.load(RES / "regression_mln_v1_output.npy")
+        np.testing.assert_allclose(np.asarray(net.output(x)), expected,
+                                   rtol=1e-5, atol=1e-6)
+        # conf fields survived serde
+        assert net.conf.layers[0].n_out == 8
+        assert net.conf.layers[1].loss == "mcxent"
+        # updater state restored: continuing training must not error
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        rng = np.random.default_rng(0)
+        net.fit([DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                         np.eye(3)[rng.integers(0, 3, 8)]
+                         .astype(np.float32))])
+
+    def test_lstm_roundtrip(self):
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+        net = ModelSerializer.restore_multi_layer_network(
+            RES / "regression_lstm_v1.zip")
+        x = np.load(RES / "regression_lstm_v1_input.npy")
+        expected = np.load(RES / "regression_lstm_v1_output.npy")
+        np.testing.assert_allclose(np.asarray(net.output(x)), expected,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_model_guesser_on_fixture(self):
+        from deeplearning4j_tpu.utils.serializer import ModelGuesser
+        net = ModelGuesser.load_model_guess_type(
+            RES / "regression_mln_v1.zip")
+        assert net.num_params() > 0
+
+
+class TestStatelessFit:
+    """Each minibatch starts from zero rnn state (reference fit semantics):
+    no hidden-state bleed between independent batches, and batch-size
+    changes mid-fit must not break (the carried h/c would shape-clash)."""
+
+    def _net(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM,
+                                                       RnnOutputLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(2).learning_rate(0.05)
+                .updater("sgd").weight_init("xavier").list()
+                .layer(GravesLSTM(n_out=5, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(3)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_varying_batch_sizes(self, rng_np):
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        net = self._net()
+        for n in (8, 5, 8, 3):
+            x = rng_np.normal(size=(n, 4, 3)).astype(np.float32)
+            y = np.zeros((n, 4, 2), np.float32)
+            y[..., 0] = 1
+            net.fit([DataSet(x, y)])
+        assert np.isfinite(float(net.score_value))
+
+    def test_output_independent_of_training_state(self, rng_np):
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        x = rng_np.normal(size=(4, 4, 3)).astype(np.float32)
+        y = np.zeros((4, 4, 2), np.float32)
+        y[..., 0] = 1
+        net = self._net()
+        net.fit([DataSet(x, y)], num_epochs=2)
+        out1 = np.asarray(net.output(x))
+        # more fitting on a DIFFERENT batch must not change output(x)
+        # through leaked rnn state — only through the param update itself;
+        # here we just re-run output twice and require determinism
+        out2 = np.asarray(net.output(x))
+        np.testing.assert_array_equal(out1, out2)
+        # state kept for rnn layers carries no h/c after fit
+        for s in net.state:
+            assert "h" not in s and "c" not in s
+
+
+class TestLstmHelperEquivalence:
+    """Pallas fused LSTM vs the pure-scan reference path: forward and
+    gradients must agree exactly (the CuDNN-vs-builtin test template)."""
+
+    def _net(self, peephole: bool):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM, LSTM,
+                                                       RnnOutputLayer)
+        layer = GravesLSTM(n_out=8, activation="tanh") if peephole \
+            else LSTM(n_out=8, activation="tanh")
+        conf = (NeuralNetConfiguration.Builder().seed(4).learning_rate(0.05)
+                .updater("sgd").weight_init("xavier").list()
+                .layer(layer)
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(3)).build())
+        return MultiLayerNetwork(conf).init()
+
+    @pytest.mark.parametrize("peephole", [False, True])
+    def test_forward_and_training_equivalence(self, peephole, rng_np):
+        from deeplearning4j_tpu.kernels import register_lstm_helper
+        from deeplearning4j_tpu.nn.helpers import (disable_helper,
+                                                   enable_helper)
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        register_lstm_helper(platforms=("cpu", "tpu"))
+        enable_helper("lstm")
+        x = rng_np.normal(size=(4, 6, 3)).astype(np.float32)
+        y = np.zeros((4, 6, 2), np.float32)
+        y[:2, :, 0] = 1
+        y[2:, :, 1] = 1
+        try:
+            helper_net = self._net(peephole)
+            out_helper = np.asarray(helper_net.output(x))
+            helper_net.fit([DataSet(x, y)], num_epochs=2)
+            params_helper = helper_net.params_flat()
+
+            disable_helper("lstm")
+            builtin_net = self._net(peephole)
+            out_builtin = np.asarray(builtin_net.output(x))
+            builtin_net.fit([DataSet(x, y)], num_epochs=2)
+            params_builtin = builtin_net.params_flat()
+        finally:
+            disable_helper("lstm")
+        np.testing.assert_allclose(out_helper, out_builtin,
+                                   rtol=1e-5, atol=1e-6)
+        # training through the custom-VJP kernel matches the builtin path
+        np.testing.assert_allclose(params_helper, params_builtin,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_masked_falls_back(self, rng_np):
+        """Masked sequences exercise the scan fallback INSIDE the helper
+        (lstm_helper's mask branch) and must match the builtin path. Fresh
+        nets per path — a shared net would replay its jit cache, comparing
+        the helper against itself."""
+        from deeplearning4j_tpu.kernels import register_lstm_helper
+        from deeplearning4j_tpu.nn.helpers import (disable_helper,
+                                                   enable_helper)
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        x = rng_np.normal(size=(3, 5, 3)).astype(np.float32)
+        y = np.zeros((3, 5, 2), np.float32)
+        y[..., 0] = 1
+        fmask = np.array([[1, 1, 1, 0, 0],
+                          [1, 1, 1, 1, 1],
+                          [1, 1, 0, 0, 0]], np.float32)
+        ds = DataSet(x, y, fmask, fmask.copy())
+        register_lstm_helper(platforms=("cpu", "tpu"))
+        enable_helper("lstm")
+        try:
+            score_h = self._net(peephole=True).score(ds)
+            helper_net = self._net(peephole=True)
+            helper_net.fit([ds])
+            params_h = helper_net.params_flat()
+            disable_helper("lstm")
+            score_b = self._net(peephole=True).score(ds)
+            builtin_net = self._net(peephole=True)
+            builtin_net.fit([ds])
+            params_b = builtin_net.params_flat()
+        finally:
+            disable_helper("lstm")
+        assert abs(score_h - score_b) < 1e-6
+        np.testing.assert_allclose(params_h, params_b, rtol=1e-5, atol=1e-7)

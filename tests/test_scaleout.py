@@ -66,6 +66,11 @@ class TestParameterServer:
                 t.join()
             got = clients[0].get_ndarray()
             assert got.shape == (8,)
+            # pushes are fire-and-forget: poll until the server drains them
+            import time
+            deadline = time.time() + 5.0
+            while node.store.pushes < 3 and time.time() < deadline:
+                time.sleep(0.02)
             assert node.store.pushes == 3
             for c in clients:
                 c.close()
