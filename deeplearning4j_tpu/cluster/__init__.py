@@ -17,6 +17,8 @@ from .param_averaging import (ParameterAveragingTrainingMaster,
                               ParameterAveragingTrainingWorker)
 from .network import ClusterDl4jMultiLayer, ClusterComputationGraph
 from .stats import ClusterTrainingStats, PhaseTimer
+from .ml_pipeline import (Pipeline, PipelineStage, NetworkClassifier,
+                          NormalizerStage)
 
 __all__ = [
     "DistributedDataSet", "TrainingMaster", "TrainingWorker",
@@ -24,5 +26,6 @@ __all__ = [
     "RDDTrainingApproach", "TrainingHook",
     "ParameterAveragingTrainingMaster", "ParameterAveragingTrainingWorker",
     "ClusterDl4jMultiLayer", "ClusterComputationGraph",
-    "ClusterTrainingStats", "PhaseTimer",
+    "ClusterTrainingStats", "PhaseTimer", "Pipeline", "PipelineStage",
+    "NetworkClassifier", "NormalizerStage",
 ]

@@ -3,6 +3,8 @@
 from .evaluation import Evaluation
 from .regression import RegressionEvaluation
 from .roc import ROC, ROCBinary, ROCMultiClass, EvaluationBinary
+from .meta import Prediction, EvaluationWithMetadata
 
 __all__ = ["Evaluation", "RegressionEvaluation", "ROC", "ROCBinary",
-           "ROCMultiClass", "EvaluationBinary"]
+           "ROCMultiClass", "EvaluationBinary", "Prediction",
+           "EvaluationWithMetadata"]

@@ -18,6 +18,7 @@ from .cjk import JapaneseTokenizerFactory, KoreanTokenizerFactory
 from .annotators import (Annotation, AnnotatedDocument, SentenceAnnotator,
                          TokenizerAnnotator, PosTagger, StemmerAnnotator,
                          AnnotatorPipeline)
+from .distributed import DistributedWord2Vec
 
 __all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
            "apply_huffman", "pad_codes", "SequenceVectors",
@@ -30,4 +31,4 @@ __all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
            "WindowDataSetIterator", "JapaneseTokenizerFactory",
            "KoreanTokenizerFactory", "Annotation", "AnnotatedDocument",
            "SentenceAnnotator", "TokenizerAnnotator", "PosTagger",
-           "StemmerAnnotator", "AnnotatorPipeline"]
+           "StemmerAnnotator", "AnnotatorPipeline", "DistributedWord2Vec"]

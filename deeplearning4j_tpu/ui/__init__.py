@@ -5,7 +5,12 @@ from .stats import StatsListener, SparkStyntheticPhaseTimer, profiler_trace
 from .storage import (StatsStorage, InMemoryStatsStorage, FileStatsStorage,
                       SqliteStatsStorage)
 from .server import UIServer, RemoteStatsRouter
+from .legacy_listeners import (HistogramIterationListener,
+                               FlowIterationListener,
+                               ConvolutionalIterationListener)
 
 __all__ = ["StatsListener", "SparkStyntheticPhaseTimer", "profiler_trace",
            "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
-           "SqliteStatsStorage", "UIServer", "RemoteStatsRouter"]
+           "SqliteStatsStorage", "UIServer", "RemoteStatsRouter",
+           "HistogramIterationListener", "FlowIterationListener",
+           "ConvolutionalIterationListener"]

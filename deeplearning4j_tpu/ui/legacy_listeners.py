@@ -1,0 +1,121 @@
+"""Legacy visualization listeners (reference deeplearning4j-ui, 1,461 LoC:
+HistogramIterationListener, FlowIterationListener,
+ConvolutionalIterationListener + their Remote* variants posting via
+WebReporter; SURVEY.md §2.8).
+
+Each listener hooks the IterationListener bus and routes a typed record into
+a StatsStorage backend (their Play-era counterparts rendered to the browser;
+here the web UI in ui/server.py and any storage backend consume the same
+records; Remote* = same listener pointed at a RemoteStatsRouter)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import IterationListener
+from .storage import StatsStorage
+
+
+def _histogram(arr: np.ndarray, bins: int = 20):
+    counts, edges = np.histogram(np.asarray(arr, np.float64).ravel(),
+                                 bins=bins)
+    return {"counts": counts.tolist(),
+            "edges": np.round(edges, 6).tolist()}
+
+
+class HistogramIterationListener(IterationListener):
+    """Per-iteration parameter + gradient-proxy histograms and score
+    (reference HistogramIterationListener)."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: str = "histogram"):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id
+        self._prev_flat: Optional[np.ndarray] = None
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency:
+            return
+        flat = model.params_flat()
+        record = {"session": self.session_id, "type": "histogram",
+                  "iteration": int(iteration),
+                  "score": float(model.score_value)
+                  if model.score_value is not None else None,
+                  "params": _histogram(flat)}
+        # update magnitudes stand in for the gradient histogram, matching
+        # what the reference displays between iterations
+        if self._prev_flat is not None and self._prev_flat.shape == flat.shape:
+            record["updates"] = _histogram(flat - self._prev_flat)
+        self._prev_flat = flat
+        self.storage.put_update(record)
+
+
+class FlowIterationListener(IterationListener):
+    """Network-structure + per-layer activation summary snapshot (reference
+    FlowIterationListener's flow view)."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: str = "flow"):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id
+        self._static_sent = False
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency:
+            return
+        if not self._static_sent:
+            layers = [type(l).__name__ for l in getattr(model, "layers", [])]
+            self.storage.put_static_info(
+                {"session": self.session_id, "type": "flow_static",
+                 "layers": layers})
+            self._static_sent = True
+        sizes = [sum(int(np.prod(v.shape)) for v in p.values())
+                 for p in model.params]
+        self.storage.put_update(
+            {"session": self.session_id, "type": "flow",
+             "iteration": int(iteration),
+             "score": float(model.score_value)
+             if model.score_value is not None else None,
+             "param_counts": sizes})
+
+
+class ConvolutionalIterationListener(IterationListener):
+    """Activation grids for conv layers (reference
+    ConvolutionalIterationListener renders PNG grids; here the grid tensor
+    summary goes to storage and optionally to disk as .npy)."""
+
+    def __init__(self, storage: StatsStorage, sample_input,
+                 frequency: int = 10, session_id: str = "conv",
+                 output_dir=None, max_channels: int = 16):
+        self.storage = storage
+        self.sample = np.asarray(sample_input)
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id
+        self.output_dir = output_dir
+        self.max_channels = max_channels
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency:
+            return
+        acts: List[np.ndarray] = model.feed_forward(self.sample)
+        conv_layers = []
+        for i, a in enumerate(acts[1:]):
+            if a.ndim == 4:         # [N, H, W, C] conv activation
+                grid = a[0, :, :, :self.max_channels]
+                conv_layers.append({"layer": i,
+                                    "shape": list(a.shape),
+                                    "mean": float(a.mean()),
+                                    "std": float(a.std())})
+                if self.output_dir is not None:
+                    from pathlib import Path
+                    d = Path(self.output_dir)
+                    d.mkdir(parents=True, exist_ok=True)
+                    np.save(d / f"iter{iteration:06d}_layer{i}.npy",
+                            np.transpose(grid, (2, 0, 1)))
+        self.storage.put_update(
+            {"session": self.session_id, "type": "convolutional",
+             "iteration": int(iteration), "layers": conv_layers})

@@ -1,0 +1,135 @@
+"""Tests: ML pipeline stages, legacy UI listeners, eval metadata,
+distributed Word2Vec (reference dl4j-spark-ml pipeline tests, ui listener
+tests, eval/meta tests, SparkWord2Vec tests; SURVEY.md §2.4, §2.5, §2.8)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.dataset import DataSet
+
+
+def _net(n_in=4, n_classes=3, seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.1)
+            .updater("adam").weight_init("xavier").activation("tanh").list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=n_classes, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _blob_data(rng, n=120):
+    """3 linearly separable clusters."""
+    centers = np.array([[2, 0, 0, 0], [0, 2, 0, 0], [0, 0, 2, 0]], np.float32)
+    y = rng.integers(0, 3, n)
+    X = centers[y] + rng.normal(0, 0.3, (n, 4)).astype(np.float32)
+    return X.astype(np.float32), y
+
+
+class TestMlPipeline:
+    def test_normalizer_plus_classifier(self, rng_np):
+        from deeplearning4j_tpu.cluster import (NetworkClassifier,
+                                                NormalizerStage, Pipeline)
+        from deeplearning4j_tpu.ops.dataset import NormalizerStandardize
+        X, y = _blob_data(rng_np)
+        pipe = Pipeline([
+            ("standardize", NormalizerStage(NormalizerStandardize())),
+            ("net", NetworkClassifier(_net(), batch_size=30, epochs=30)),
+        ])
+        pipe.fit(X, y)
+        assert pipe.score(X, y) > 0.9
+        proba = pipe.transform(X)
+        assert proba.shape == (len(X), 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-4)
+
+    def test_classifier_with_cluster_master(self, rng_np):
+        from deeplearning4j_tpu.cluster import (NetworkClassifier,
+                                                ParameterAveragingTrainingMaster)
+        X, y = _blob_data(rng_np, n=90)
+        clf = NetworkClassifier(_net(), batch_size=15, epochs=20,
+                                training_master=
+                                ParameterAveragingTrainingMaster())
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.8
+
+    def test_onehot_labels_accepted(self, rng_np):
+        from deeplearning4j_tpu.cluster import NetworkClassifier
+        X, y = _blob_data(rng_np, n=60)
+        clf = NetworkClassifier(_net(), epochs=5)
+        clf.fit(X, np.eye(3)[y])
+        assert clf.predict(X).shape == (60,)
+
+
+class TestLegacyListeners:
+    def test_histogram_and_flow(self, rng_np):
+        from deeplearning4j_tpu.ui import (FlowIterationListener,
+                                           HistogramIterationListener,
+                                           InMemoryStatsStorage)
+        storage = InMemoryStatsStorage()
+        net = _net()
+        net.set_listeners(HistogramIterationListener(storage, frequency=2),
+                          FlowIterationListener(storage))
+        X, y = _blob_data(rng_np, n=32)
+        net.fit([DataSet(X, np.eye(3, dtype=np.float32)[y])], num_epochs=6)
+        hist = [r for r in storage.get_updates("histogram")]
+        assert hist and "params" in hist[0]
+        assert any("updates" in r for r in hist)
+        flow = storage.get_updates("flow")
+        assert flow and flow[0]["param_counts"]
+        static = storage.get_static_info("flow")
+        assert static["layers"] == ["DenseLayer", "OutputLayer"]
+
+    def test_convolutional_listener(self, rng_np, tmp_path):
+        from deeplearning4j_tpu.models import lenet_conf
+        from deeplearning4j_tpu.ui import (ConvolutionalIterationListener,
+                                           InMemoryStatsStorage)
+        storage = InMemoryStatsStorage()
+        net = MultiLayerNetwork(lenet_conf()).init()
+        sample = rng_np.normal(size=(1, 28, 28, 1)).astype(np.float32)
+        net.set_listeners(ConvolutionalIterationListener(
+            storage, sample, frequency=1, output_dir=tmp_path))
+        X = rng_np.normal(size=(8, 28, 28, 1)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng_np.integers(0, 10, 8)]
+        net.fit([DataSet(X, y)])
+        recs = storage.get_updates("conv")
+        assert recs and recs[0]["layers"]          # conv activations seen
+        assert list(tmp_path.glob("iter*_layer*.npy"))
+
+
+class TestEvalMetadata:
+    def test_prediction_errors_traceable(self, rng_np):
+        from deeplearning4j_tpu.eval import EvaluationWithMetadata
+        labels = np.eye(3)[[0, 1, 2, 0]]
+        outputs = np.eye(3)[[0, 2, 2, 0]] * 0.9 + 0.03   # one error (idx 1)
+        meta = ["rec0", "rec1", "rec2", "rec3"]
+        ev = EvaluationWithMetadata()
+        ev.eval(labels, outputs, metadata=meta)
+        errors = ev.get_prediction_errors()
+        assert len(errors) == 1 and errors[0].metadata == "rec1"
+        assert errors[0].actual == 1 and errors[0].predicted == 2
+        cell = ev.get_predictions(actual=1, predicted=2)
+        assert len(cell) == 1
+        assert ev.accuracy() == 0.75
+
+
+class TestDistributedWord2Vec:
+    def test_trains_and_matches_api(self):
+        from deeplearning4j_tpu.nlp import DistributedWord2Vec
+        corpus = [s.split() for s in [
+            "the quick brown fox jumps over the lazy dog",
+            "the lazy dog sleeps in the warm sun",
+            "a quick red fox runs past the brown dog",
+            "the warm sun shines over the green field",
+        ] * 6]
+        dw2v = DistributedWord2Vec(num_workers=2, push_frequency=2,
+                                   vector_length=12, window=3,
+                                   min_word_frequency=1, epochs=2, seed=5)
+        model = dw2v.fit(corpus)
+        assert dw2v.trained_sequences == len(corpus)
+        assert dw2v.server.pushes >= 2
+        v = model.get_word_vector("fox")
+        assert v is not None and v.shape == (12,)
+        # similarity API functional on the aggregated table
+        assert -1.0 <= model.similarity("fox", "dog") <= 1.0
