@@ -35,8 +35,8 @@ class NormalizerStage(PipelineStage):
 
     def transform(self, X):
         from ..ops.dataset import DataSet
-        ds = DataSet(np.asarray(X, np.float32), None)
-        self.normalizer.transform(ds)
+        ds = self.normalizer.transform(
+            DataSet(np.asarray(X, np.float32), None))
         return np.asarray(ds.features)
 
 

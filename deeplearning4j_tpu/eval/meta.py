@@ -32,9 +32,26 @@ class EvaluationWithMetadata:
     def eval(self, labels: np.ndarray, outputs: np.ndarray,
              metadata: Optional[List] = None, mask=None):
         self.evaluation.eval(labels, outputs, mask=mask)
-        actual = np.asarray(labels).argmax(-1).ravel()
-        pred = np.asarray(outputs).argmax(-1).ravel()
-        for j, (a, p) in enumerate(zip(actual, pred)):
+        labels = np.asarray(labels)
+        outputs = np.asarray(outputs)
+        actual = labels.argmax(-1)       # [N] or [N, T]
+        pred = outputs.argmax(-1)
+        if actual.ndim == 2:
+            # time series: metadata indexes records (rows), mask drops padded
+            # timesteps — mirror Evaluation's own masking so the recorded
+            # predictions agree with its counts
+            keep = np.ones(actual.shape, bool) if mask is None \
+                else np.asarray(mask) > 0
+            for i in range(actual.shape[0]):
+                md = metadata[i] if metadata is not None and \
+                    i < len(metadata) else None
+                for t in range(actual.shape[1]):
+                    if keep[i, t]:
+                        self.predictions.append(
+                            Prediction(int(actual[i, t]), int(pred[i, t]),
+                                       md))
+            return
+        for j, (a, p) in enumerate(zip(actual.ravel(), pred.ravel())):
             md = metadata[j] if metadata is not None and j < len(metadata) \
                 else None
             self.predictions.append(Prediction(int(a), int(p), md))

@@ -3,6 +3,10 @@
 from .lenet import lenet_conf
 from .char_rnn import char_rnn_conf, CharacterIterator
 from .resnet import resnet_conf, resnet50_conf, resnet_tiny_conf
+from .vgg16 import (vgg16_conf, VGG16ImagePreProcessor, ImageNetLabels,
+                    TrainedModels)
 
 __all__ = ["lenet_conf", "char_rnn_conf", "CharacterIterator",
-           "resnet_conf", "resnet50_conf", "resnet_tiny_conf"]
+           "resnet_conf", "resnet50_conf", "resnet_tiny_conf",
+           "vgg16_conf", "VGG16ImagePreProcessor", "ImageNetLabels",
+           "TrainedModels"]

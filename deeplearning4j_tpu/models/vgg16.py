@@ -1,0 +1,139 @@
+"""VGG16 trained-model support — parity with the reference's bundled
+trained-models helper (deeplearning4j-modelimport trainedmodels/TrainedModels.java,
+TrainedModelHelper.java, Utils/ImageNetLabels.java): the VGG16 / VGG16NoTop
+architectures, the VGG16 image preprocessor (ImageNet mean-RGB subtraction,
+the role of ND4J's VGG16ImagePreProcessor), and top-5 prediction decoding.
+
+TPU-first: NHWC layout, convs lower straight to MXU; weights come either from
+random init or from a Keras HDF5 file via :mod:`deeplearning4j_tpu.keras`
+(the reference downloads fchollet's vgg16 .h5 the same way,
+TrainedModels.java:49-55 — this environment has no egress, so the file path
+is supplied by the caller instead of fetched)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..nn.conf.config import NeuralNetConfiguration, MultiLayerConfiguration
+from ..nn.conf.input_type import InputType
+from ..nn.conf.layers import (ConvolutionLayer, SubsamplingLayer, DenseLayer,
+                              OutputLayer)
+
+# ImageNet channel means used by the reference's VGG16ImagePreProcessor
+# (RGB order).
+VGG16_MEAN_RGB = (123.68, 116.779, 103.939)
+
+
+def _conv_block(b, n_convs: int, n_out: int):
+    for _ in range(n_convs):
+        b = b.layer(ConvolutionLayer(n_out=n_out, kernel_size=[3, 3],
+                                     stride=[1, 1], convolution_mode="same",
+                                     activation="relu"))
+    return b.layer(SubsamplingLayer(kernel_size=[2, 2], stride=[2, 2],
+                                    pooling_type="max"))
+
+
+def vgg16_conf(num_classes: int = 1000, top: bool = True,
+               height: int = 224, width: int = 224, channels: int = 3,
+               learning_rate: float = 0.01, updater: str = "nesterovs",
+               seed: int = 123) -> MultiLayerConfiguration:
+    """VGG16 (Simonyan & Zisserman) as a MultiLayerConfiguration.
+
+    ``top=False`` gives the VGG16NoTop variant (feature extractor only), the
+    second member of the reference's TrainedModels enum
+    (TrainedModels.java:18)."""
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed).learning_rate(learning_rate)
+         .updater(updater).momentum(0.9)
+         .weight_init("xavier")
+         .regularization(True).l2(5e-4)
+         .list())
+    for n_convs, n_out in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
+        b = _conv_block(b, n_convs, n_out)
+    if top:
+        b = (b.layer(DenseLayer(n_out=4096, activation="relu"))
+             .layer(DenseLayer(n_out=4096, activation="relu"))
+             .layer(OutputLayer(n_out=num_classes, loss="mcxent",
+                                activation="softmax")))
+    return (b.set_input_type(InputType.convolutional(height, width, channels))
+            .build())
+
+
+class VGG16ImagePreProcessor:
+    """DataSet preprocessor subtracting the ImageNet per-channel mean —
+    the role of ND4J's VGG16ImagePreProcessor consumed at
+    TrainedModels.java getPreProcessor. Expects NHWC float features."""
+
+    def pre_process(self, dataset) -> None:
+        mean = np.asarray(VGG16_MEAN_RGB, dtype=np.float32)
+        dataset.features = np.asarray(dataset.features,
+                                      dtype=np.float32) - mean
+
+    __call__ = pre_process
+
+
+class ImageNetLabels:
+    """ImageNet-1k class labels — Utils/ImageNetLabels.java parity.
+
+    The reference fetches a labels JSON from a URL at runtime; here labels
+    load from a local JSON file (list of names, or the Keras
+    ``{"0": ["n01440764", "tench"], ...}`` index format) passed explicitly or
+    found at ``$DL4J_TPU_IMAGENET_LABELS``."""
+
+    def __init__(self, path: Optional[str] = None,
+                 labels: Optional[Sequence[str]] = None):
+        if labels is not None:
+            self._labels = list(labels)
+            return
+        path = path or os.environ.get("DL4J_TPU_IMAGENET_LABELS")
+        if not path or not os.path.exists(path):
+            raise FileNotFoundError(
+                "ImageNet labels file not found; pass path=, labels=, or set "
+                "DL4J_TPU_IMAGENET_LABELS (no-egress environment: the "
+                "reference downloads this file at runtime instead)")
+        with open(path) as f:
+            raw = json.load(f)
+        if isinstance(raw, dict):
+            self._labels = [raw[str(i)][-1] if isinstance(raw[str(i)], list)
+                            else raw[str(i)] for i in range(len(raw))]
+        else:
+            self._labels = list(raw)
+
+    def get_label(self, idx: int) -> str:
+        return self._labels[idx]
+
+    def decode_predictions(self, predictions, top: int = 5) -> List[List[dict]]:
+        """Top-k (label, probability) per row — TrainedModels.decodePredictions
+        parity (returns structured rows rather than a display string)."""
+        p = np.asarray(predictions)
+        out = []
+        for row in p:
+            order = np.argsort(row)[::-1][:top]
+            out.append([{"label": self._labels[int(i)],
+                         "probability": float(row[int(i)])} for i in order])
+        return out
+
+
+class TrainedModels:
+    """Pretrained-model entry — TrainedModels.java parity. ``load_vgg16``
+    builds the conf and (optionally) fills weights from a Keras HDF5 file
+    via the modelimport pipeline."""
+
+    @staticmethod
+    def vgg16(num_classes: int = 1000, top: bool = True,
+              weights_h5: Optional[str] = None):
+        from ..nn.multilayer import MultiLayerNetwork
+        if weights_h5 is not None:
+            from ..keras.importer import KerasModelImport
+            return KerasModelImport.import_keras_sequential_model_and_weights(
+                weights_h5)
+        net = MultiLayerNetwork(vgg16_conf(num_classes=num_classes, top=top))
+        return net.init()
+
+    @staticmethod
+    def get_pre_processor() -> VGG16ImagePreProcessor:
+        return VGG16ImagePreProcessor()

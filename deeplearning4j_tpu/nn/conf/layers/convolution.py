@@ -274,8 +274,21 @@ class BatchNormalization(LayerConf):
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))          # all but channel/feature
         if train:
-            # statistics in f32 even under bf16 compute (running stats must
-            # not accumulate bf16 rounding)
+            helper = get_helper("batchnorm_train")
+            if helper is not None:
+                if not self.lock_gamma_beta and params:
+                    gamma, beta = params["gamma"], params["beta"]
+                else:
+                    gamma = jnp.full((self.n_out,), self.gamma, x.dtype)
+                    beta = jnp.full((self.n_out,), self.beta, x.dtype)
+                y, mean, var = helper(x, gamma, beta, state["mean"],
+                                      self.eps)
+                d = self.decay
+                new_state = {"mean": d * state["mean"] + (1 - d) * mean,
+                             "var": d * state["var"] + (1 - d) * var}
+                return self.activation_fn()(y), new_state
+            # built-in path: statistics in f32 even under bf16 compute
+            # (running stats must not accumulate bf16 rounding)
             xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
             mean = jnp.mean(xf, axis=axes)
             var = jnp.var(xf, axis=axes)

@@ -21,6 +21,15 @@ import jax
 _HELPERS: Dict[str, Tuple[Callable, Tuple[str, ...]]] = {}
 _DISABLED: set = set()
 
+# Lazy default discovery — the analog of the reference's reflective
+# Class.forName("...CudnnConvolutionHelper") at ConvolutionLayer.java:69-76:
+# if a kernel module providing this kind exists, it self-registers on first
+# use; otherwise the built-in path runs.
+_DEFAULT_PROVIDERS: Dict[str, str] = {
+    "batchnorm_train": "deeplearning4j_tpu.kernels.batchnorm",
+}
+_FAILED_PROVIDERS: set = set()
+
 
 def register_helper(kind: str, fn: Callable,
                     platforms: Tuple[str, ...] = ("tpu",)) -> None:
@@ -30,7 +39,23 @@ def register_helper(kind: str, fn: Callable,
 def get_helper(kind: str) -> Optional[Callable]:
     """Return the accelerated impl for ``kind`` if one is registered for the
     default backend platform, else None (caller falls back to pure jnp)."""
-    if kind in _DISABLED or kind not in _HELPERS:
+    if kind in _DISABLED:
+        return None
+    if kind not in _HELPERS and kind in _DEFAULT_PROVIDERS and \
+            kind not in _FAILED_PROVIDERS:
+        import importlib
+        try:
+            importlib.import_module(
+                _DEFAULT_PROVIDERS[kind]).register_default()
+        except ImportError as e:
+            # e.g. an optional kernel dependency missing on this install —
+            # fall back to the built-in path, but say so once
+            _FAILED_PROVIDERS.add(kind)
+            import logging
+            logging.getLogger(__name__).warning(
+                "helper provider for %r unavailable (%s); using built-in",
+                kind, e)
+    if kind not in _HELPERS:
         return None
     fn, platforms = _HELPERS[kind]
     try:

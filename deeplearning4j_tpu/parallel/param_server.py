@@ -214,12 +214,26 @@ class ParameterServerParallelWrapper:
     """
 
     def __init__(self, net, num_workers: int = 2, push_frequency: int = 1,
-                 alpha: Optional[float] = None):
+                 alpha: Optional[float] = None, backend: str = "auto"):
+        """``backend``: 'native' = C++ aggregation core
+        (parallel/native_ps.py, GIL-free pushes), 'python' = in-process
+        store, 'auto' = native when the library builds, else python (the
+        reference's silent-fallback helper policy)."""
         net._ensure_init()
         self.net = net
         self.num_workers = int(num_workers)
-        self.server = InMemoryParameterServer(
-            net.params_flat(), alpha=alpha, num_workers=num_workers)
+        self.server = None
+        if backend in ("auto", "native"):
+            try:
+                from .native_ps import NativeParameterServer
+                self.server = NativeParameterServer(
+                    net.params_flat(), alpha=alpha, num_workers=num_workers)
+            except (ImportError, OSError):
+                if backend == "native":
+                    raise
+        if self.server is None:
+            self.server = InMemoryParameterServer(
+                net.params_flat(), alpha=alpha, num_workers=num_workers)
         self.push_frequency = push_frequency
 
     def fit(self, data, num_epochs: int = 1):

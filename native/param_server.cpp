@@ -1,0 +1,196 @@
+// Native parameter-server transport core — the TPU framework's analog of the
+// reference's Aeron-based VoidParameterServer/RoutedTransport plane
+// (ND4J parameter server consumed at ParameterServerTrainer.java:15,:46 and
+// SparkSequenceVectors.java:292; SURVEY.md §2.9, §5.8 transport (c)).
+//
+// The compute stays on-device (jitted train steps); this is the host-side
+// push/pull aggregation plane. Implemented natively so N worker threads and
+// remote peers can push large flattened parameter vectors concurrently
+// without holding the Python GIL during aggregation or socket IO.
+//
+//   - in-process API: ps_push / ps_pull operate on the shared store directly
+//     (lock-guarded soft-sync running average: p += alpha * (v - p))
+//   - TCP API: a listener thread accepts connections; protocol is
+//     1-byte opcode ('P' push, 'G' get, 'Q' quit) + u64 little-endian byte
+//     length + raw little-endian f32 payload. 'G' answers with an 'R' frame
+//     in the same framing. Malformed/mis-sized frames are dropped, the
+//     connection stays up (push is fire-and-forget, like the reference).
+//
+// Build: make -C native   (compiled into libdl4jtpu_native.so)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct PsStore {
+    std::mutex mu;
+    std::vector<float> params;
+    double alpha = 1.0;
+    std::atomic<int64_t> pushes{0};
+
+    void push(const float* v, int64_t n) {
+        if (n != (int64_t)params.size()) return;  // drop mis-sized frame
+        std::lock_guard<std::mutex> lk(mu);
+        const float a = (float)alpha;
+        float* p = params.data();
+        for (int64_t i = 0; i < n; ++i) p[i] += a * (v[i] - p[i]);
+        pushes.fetch_add(1, std::memory_order_relaxed);
+    }
+
+    void pull(float* out, int64_t n) {
+        if (n != (int64_t)params.size()) return;
+        std::lock_guard<std::mutex> lk(mu);
+        std::memcpy(out, params.data(), sizeof(float) * (size_t)n);
+    }
+};
+
+struct PsServer {
+    PsStore store;
+    int listen_fd = -1;
+    int port = 0;
+    std::atomic<bool> stop{false};
+    std::thread acceptor;
+    std::mutex conn_mu;
+    std::vector<std::thread> handlers;
+
+    ~PsServer() { shutdown(); }
+
+    void shutdown() {
+        bool expected = false;
+        if (!stop.compare_exchange_strong(expected, true)) return;
+        if (listen_fd >= 0) { ::shutdown(listen_fd, SHUT_RDWR); ::close(listen_fd); }
+        if (acceptor.joinable()) acceptor.join();
+        std::lock_guard<std::mutex> lk(conn_mu);
+        for (auto& t : handlers)
+            if (t.joinable()) t.join();
+        handlers.clear();
+    }
+};
+
+bool recv_exact(int fd, void* buf, size_t n) {
+    char* p = (char*)buf;
+    while (n) {
+        ssize_t r = ::recv(fd, p, n, 0);
+        if (r <= 0) return false;
+        p += r;
+        n -= (size_t)r;
+    }
+    return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+    const char* p = (const char*)buf;
+    while (n) {
+        ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+        if (r <= 0) return false;
+        p += r;
+        n -= (size_t)r;
+    }
+    return true;
+}
+
+void handle_conn(PsServer* srv, int fd) {
+    std::vector<float> scratch;
+    for (;;) {
+        char op;
+        uint64_t len;
+        if (!recv_exact(fd, &op, 1) || !recv_exact(fd, &len, 8)) break;
+        if (op == 'Q') break;
+        if (op == 'P') {
+            if (len > (1ull << 33) || len % 4 != 0) break;  // insane frame
+            scratch.resize(len / 4);
+            if (!recv_exact(fd, scratch.data(), len)) break;
+            srv->store.push(scratch.data(), (int64_t)(len / 4));
+        } else if (op == 'G') {
+            if (len != 0) break;
+            std::vector<float> out(srv->store.params.size());
+            srv->store.pull(out.data(), (int64_t)out.size());
+            char rop = 'R';
+            uint64_t rlen = (uint64_t)out.size() * 4;
+            if (!send_all(fd, &rop, 1) || !send_all(fd, &rlen, 8) ||
+                !send_all(fd, out.data(), rlen))
+                break;
+        } else {
+            break;  // unknown op: drop connection (stream no longer framed)
+        }
+    }
+    ::close(fd);
+}
+
+void accept_loop(PsServer* srv) {
+    while (!srv->stop.load()) {
+        int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+        if (fd < 0) {
+            if (srv->stop.load()) break;
+            continue;
+        }
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        std::lock_guard<std::mutex> lk(srv->conn_mu);
+        srv->handlers.emplace_back(handle_conn, srv, fd);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a server. port==0 binds an ephemeral port; serve==0 skips the TCP
+// listener (pure in-process store). Returns opaque handle or null.
+void* ps_create(const float* initial, int64_t n, double alpha, int port,
+                int serve) {
+    auto* srv = new PsServer();
+    srv->store.params.assign(initial, initial + n);
+    srv->store.alpha = alpha;
+    if (serve) {
+        srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (srv->listen_fd < 0) { delete srv; return nullptr; }
+        int one = 1;
+        ::setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
+                     sizeof(one));
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons((uint16_t)port);
+        if (::bind(srv->listen_fd, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+            ::listen(srv->listen_fd, 64) < 0) {
+            ::close(srv->listen_fd);
+            delete srv;
+            return nullptr;
+        }
+        socklen_t alen = sizeof(addr);
+        ::getsockname(srv->listen_fd, (sockaddr*)&addr, &alen);
+        srv->port = ntohs(addr.sin_port);
+        srv->acceptor = std::thread(accept_loop, srv);
+    }
+    return srv;
+}
+
+int ps_port(void* h) { return ((PsServer*)h)->port; }
+
+void ps_push(void* h, const float* v, int64_t n) {
+    ((PsServer*)h)->store.push(v, n);
+}
+
+void ps_pull(void* h, float* out, int64_t n) {
+    ((PsServer*)h)->store.pull(out, n);
+}
+
+int64_t ps_pushes(void* h) {
+    return ((PsServer*)h)->store.pushes.load();
+}
+
+void ps_destroy(void* h) { delete (PsServer*)h; }
+
+}  // extern "C"

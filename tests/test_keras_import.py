@@ -169,3 +169,47 @@ class TestFunctionalImport:
         expect = np.exp(logits - logits.max(-1, keepdims=True))
         expect /= expect.sum(-1, keepdims=True)
         np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestTrainedModels:
+    """trainedmodels/ parity (TrainedModels.java, Utils/ImageNetLabels.java)."""
+
+    def test_vgg16_conf_shapes(self):
+        from deeplearning4j_tpu.models import vgg16_conf
+        conf = vgg16_conf(num_classes=1000)
+        names = [type(l).__name__ for l in conf.layers]
+        assert names.count("ConvolutionLayer") == 13
+        assert names.count("SubsamplingLayer") == 5
+        assert names.count("DenseLayer") == 2
+        notop = vgg16_conf(top=False)
+        assert all(type(l).__name__ != "DenseLayer" for l in notop.layers)
+
+    def test_vgg16_tiny_forward(self, rng_np):
+        # num_classes small + tiny image keeps CI fast; exercises the stack
+        from deeplearning4j_tpu.models import vgg16_conf
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            vgg16_conf(num_classes=4, height=32, width=32)).init()
+        X = rng_np.normal(size=(2, 32, 32, 3)).astype(np.float32)
+        out = net.output(X)
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-4)
+
+    def test_preprocessor_and_labels(self, tmp_path, rng_np):
+        from deeplearning4j_tpu.models import (VGG16ImagePreProcessor,
+                                               ImageNetLabels)
+        from deeplearning4j_tpu.models.vgg16 import VGG16_MEAN_RGB
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        X = rng_np.uniform(0, 255, size=(2, 8, 8, 3)).astype(np.float32)
+        ds = DataSet(X.copy(), np.zeros((2, 2), np.float32))
+        VGG16ImagePreProcessor().pre_process(ds)
+        np.testing.assert_allclose(
+            ds.features, X - np.asarray(VGG16_MEAN_RGB, np.float32), rtol=1e-6)
+
+        import json
+        path = tmp_path / "labels.json"
+        path.write_text(json.dumps(["cat", "dog", "newt"]))
+        labels = ImageNetLabels(path=str(path))
+        preds = np.array([[0.1, 0.7, 0.2]])
+        top = labels.decode_predictions(preds, top=2)[0]
+        assert [t["label"] for t in top] == ["dog", "newt"]

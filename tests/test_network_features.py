@@ -353,3 +353,24 @@ class TestListeners:
         net.fit([ds] * 6)
         assert len(collect.scores) == 6
         assert "Score at iteration" in capsys.readouterr().out
+
+
+class TestEvalMetadataMasking:
+    def test_masked_timesteps_excluded(self):
+        # masked/padded timesteps must not appear as prediction errors
+        # (review finding r1: eval/meta ignored mask + 3-D alignment)
+        from deeplearning4j_tpu.eval import EvaluationWithMetadata
+        labels = np.zeros((2, 3, 2), np.float32)
+        outputs = np.zeros((2, 3, 2), np.float32)
+        labels[:, :, 0] = 1                      # all actual class 0
+        outputs[:, :, 0] = 0.9
+        outputs[:, :, 1] = 0.1
+        # rec1 timestep 2 would be an error, but it's masked out
+        outputs[1, 2] = (0.1, 0.9)
+        mask = np.array([[1, 1, 1], [1, 1, 0]], np.float32)
+        ev = EvaluationWithMetadata()
+        ev.eval(labels, outputs, metadata=["rec0", "rec1"], mask=mask)
+        assert ev.accuracy() == 1.0
+        assert ev.get_prediction_errors() == []
+        assert len(ev.predictions) == 5          # 6 steps - 1 masked
+        assert all(p.metadata in ("rec0", "rec1") for p in ev.predictions)

@@ -40,6 +40,10 @@ class TestMlPipeline:
         ])
         pipe.fit(X, y)
         assert pipe.score(X, y) > 0.9
+        # the normalizer stage must actually standardize (review finding r1:
+        # a silent no-op still passed this test on separable blobs)
+        Xn = pipe.stages[0][1].transform(X)
+        assert abs(float(np.mean(Xn))) < 0.2 and             abs(float(np.std(Xn)) - 1.0) < 0.25
         proba = pipe.transform(X)
         assert proba.shape == (len(X), 3)
         np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-4)

@@ -178,3 +178,106 @@ class TestLstmHelperEquivalence:
             disable_helper("lstm")
         assert abs(score_h - score_b) < 1e-6
         np.testing.assert_allclose(params_h, params_b, rtol=1e-5, atol=1e-7)
+
+
+class TestBnHelperEquivalence:
+    """Fused custom-VJP batch norm vs the built-in jnp path: forward,
+    running stats, and end-to-end training must agree (the
+    CudnnBatchNormalizationHelper-vs-builtin test template, SURVEY.md §4)."""
+
+    def _net(self):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer,
+                                                       BatchNormalization,
+                                                       OutputLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(7).learning_rate(0.05)
+                .updater("sgd").weight_init("xavier").list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=[3, 3],
+                                        stride=[1, 1], activation="identity"))
+                .layer(BatchNormalization(activation="relu"))
+                .layer(OutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 2)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_fused_matches_builtin(self, rng_np):
+        from deeplearning4j_tpu.kernels.batchnorm import register_default
+        from deeplearning4j_tpu.nn.helpers import (disable_helper,
+                                                   enable_helper)
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        register_default(platforms=("cpu", "tpu", "axon"))
+        enable_helper("batchnorm_train")
+        x = rng_np.normal(size=(8, 8, 8, 2)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng_np.integers(0, 3, 8)]
+        try:
+            fused = self._net()
+            fused.fit([DataSet(x, y)], num_epochs=3)
+            out_fused = np.asarray(fused.output(x))
+            params_fused = fused.params_flat()
+
+            disable_helper("batchnorm_train")
+            builtin = self._net()
+            builtin.fit([DataSet(x, y)], num_epochs=3)
+            out_builtin = np.asarray(builtin.output(x))
+            params_builtin = builtin.params_flat()
+
+            np.testing.assert_allclose(params_fused, params_builtin,
+                                       rtol=2e-4, atol=2e-5)
+            np.testing.assert_allclose(out_fused, out_builtin,
+                                       rtol=2e-4, atol=2e-5)
+        finally:
+            enable_helper("batchnorm_train")
+
+    def test_kernel_function_direct(self, rng_np):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.batchnorm import bn_train_fused
+        x = jnp.asarray(rng_np.normal(size=(16, 5)) * 2 + 1, jnp.float32)
+        gamma = jnp.asarray(rng_np.uniform(0.5, 2, 5), jnp.float32)
+        beta = jnp.asarray(rng_np.normal(size=5), jnp.float32)
+        eps = 1e-5
+
+        def ref(x, gamma, beta):
+            mean = jnp.mean(x, axis=0)
+            var = jnp.var(x, axis=0)
+            return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+
+        hint = jnp.zeros(5, jnp.float32)
+        y, mean, var = bn_train_fused(x, gamma, beta, hint, eps)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref(x, gamma, beta)),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(mean),
+                                   np.asarray(jnp.mean(x, axis=0)), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(var),
+                                   np.asarray(jnp.var(x, axis=0)), rtol=1e-4)
+
+        # gradients vs autodiff through the reference formula
+        w = jnp.asarray(rng_np.normal(size=(16, 5)), jnp.float32)
+        g_fused = jax.grad(
+            lambda x, g, b: jnp.sum(bn_train_fused(x, g, b, hint, eps)[0] * w),
+            argnums=(0, 1, 2))(x, gamma, beta)
+        g_ref = jax.grad(
+            lambda x, g, b: jnp.sum(ref(x, g, b) * w),
+            argnums=(0, 1, 2))(x, gamma, beta)
+        for a, b in zip(g_fused, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_large_mean_channels(self, rng_np):
+        # E[x^2]-E[x]^2 would catastrophically cancel here; the two-pass
+        # variance must not (review finding r1)
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.batchnorm import bn_train_fused
+        x = jnp.asarray(rng_np.normal(size=(64, 32, 8)) * 0.1 + 1000.0,
+                        jnp.float32)
+        gamma = jnp.ones(8, jnp.float32)
+        beta = jnp.zeros(8, jnp.float32)
+        # warmed-up running mean as the conditioning shift (what the layer
+        # passes); within O(std) of the true mean
+        hint = jnp.full(8, 999.5, jnp.float32)
+        y, mean, var = bn_train_fused(x, gamma, beta, hint, 1e-5)
+        np.testing.assert_allclose(np.asarray(var),
+                                   np.var(np.asarray(x, np.float64),
+                                          axis=(0, 1)), rtol=1e-3)
+        assert abs(float(np.asarray(y).std()) - 1.0) < 0.05

@@ -308,3 +308,70 @@ class TestParallelWrapperMainCLI:
         assert rc == 0 and out_path.exists()
         restored = ModelSerializer.restore_multi_layer_network(out_path)
         assert restored.num_params() == net.num_params()
+
+
+class TestNativeParameterServer:
+    """C++ transport core (native/param_server.cpp) vs the Python store:
+    same aggregation semantics, GIL-free pushes, raw-f32 TCP protocol
+    (the Aeron VoidParameterServer analog, SURVEY.md §2.9)."""
+
+    def test_aggregation_matches_python_store(self):
+        pytest.importorskip("deeplearning4j_tpu.parallel.native_ps")
+        from deeplearning4j_tpu.parallel.native_ps import (
+            NativeParameterServer, native_available)
+        from deeplearning4j_tpu.parallel import InMemoryParameterServer
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        init = np.zeros(64, np.float32)
+        nat = NativeParameterServer(init, alpha=0.25)
+        py = InMemoryParameterServer(init, alpha=0.25)
+        rng = np.random.default_rng(3)
+        for _ in range(5):
+            v = rng.normal(size=64).astype(np.float32)
+            nat.push(v)
+            py.push(v)
+        np.testing.assert_allclose(nat.pull(), py.pull(), rtol=1e-6)
+        assert nat.pushes == py.pushes == 5
+        nat.shutdown()
+
+    def test_tcp_roundtrip_and_concurrent_pushes(self):
+        from deeplearning4j_tpu.parallel.native_ps import (
+            NativeParameterServer, NativeParameterServerClient,
+            native_available)
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        import threading
+        srv = NativeParameterServer(np.zeros(512, np.float32), alpha=0.5,
+                                    serve=True)
+        try:
+            def worker(val):
+                cli = NativeParameterServerClient(srv.host, srv.port)
+                for _ in range(3):
+                    cli.push_ndarray(np.full(512, val, np.float32))
+                got = cli.get_ndarray()
+                assert got.shape == (512,)
+                cli.close()
+            ts = [threading.Thread(target=worker, args=(float(i + 1),))
+                  for i in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert srv.pushes == 12
+            assert 0.0 < float(srv.pull().mean()) <= 4.0
+        finally:
+            srv.shutdown()
+
+    def test_wrapper_uses_native_backend(self, rng_np):
+        from deeplearning4j_tpu.parallel import ParameterServerParallelWrapper
+        from deeplearning4j_tpu.parallel.native_ps import native_available
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        net = _net()
+        pw = ParameterServerParallelWrapper(net, num_workers=2,
+                                            backend="native")
+        from deeplearning4j_tpu.parallel.native_ps import \
+            NativeParameterServer
+        assert isinstance(pw.server, NativeParameterServer)
+        pw.fit(_batches(rng_np, n=8), num_epochs=1)
+        assert pw.server.pushes >= 8
