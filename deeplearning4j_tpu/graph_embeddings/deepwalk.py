@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nlp.huffman import build_huffman
-from ..nlp.skipgram import skipgram_hs_step, generate_skipgram_pairs
+from ..nlp.skipgram import skipgram_hs_step
 from .graph import Graph
 from .walks import RandomWalkIterator
 
@@ -90,20 +90,36 @@ class DeepWalk:
             self.fit_walks(it)
         return self
 
+    # tokens per vectorized chunk — bounds host memory for the pair set
+    # (walks may be a generator; streaming is preserved chunk by chunk)
+    CHUNK_TOKENS = 2_000_000
+
     def fit_walks(self, walks: Iterable[List[int]]):
+        from ..nlp.skipgram import vectorized_skipgram_pairs
         rng = np.random.default_rng(self.seed)
-        buf_c, buf_t = [], []
-        for walk in walks:
-            c, t = generate_skipgram_pairs(np.asarray(walk, np.int32),
-                                           self.window, rng)
+        # walks as separator-delimited streams, vectorized window extraction
+        # (see nlp/skipgram.py; windows never cross walks)
+        parts, size = [], 0
+        sep = np.array([-1], np.int32)
+
+        def run_chunk():
+            c, t = vectorized_skipgram_pairs(np.concatenate(parts),
+                                             self.window, rng)
             if len(c):
-                buf_c.append(c)
-                buf_t.append(t)
-            if sum(len(x) for x in buf_c) >= self.batch_size:
-                self._flush(np.concatenate(buf_c), np.concatenate(buf_t))
-                buf_c, buf_t = [], []
-        if buf_c:
-            self._flush(np.concatenate(buf_c), np.concatenate(buf_t))
+                perm = rng.permutation(len(c))
+                self._flush(c[perm], t[perm])
+
+        for walk in walks:
+            w = np.asarray(walk, np.int32)
+            if len(w):
+                parts.append(w)
+                parts.append(sep)
+                size += len(w)
+            if size >= self.CHUNK_TOKENS:
+                run_chunk()
+                parts, size = [], 0
+        if parts:
+            run_chunk()
         return self
 
     def _flush(self, centers, targets):

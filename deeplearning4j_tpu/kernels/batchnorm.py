@@ -104,9 +104,77 @@ def _bn_train_fused_fwd(x, gamma, beta, shift_hint, eps):
 bn_train_fused.defvjp(_bn_train_fused_fwd, _bn_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def bn_add_act_train_fused(x, gamma, beta, shift_hint, res, eps, act):
+    """Fused ``act(batchnorm(x) + res)`` training op — the residual-block
+    tail (BN → ElementWise add → ReLU) executed as one HBM pass instead of
+    three, used by the ComputationGraph fusion pass (nn/graph/fusion.py).
+
+    ``act`` is 'relu' or 'identity' (static). Returns ``(y, mean, var)``."""
+    out, _res = _bn_add_act_fwd_impl(x, gamma, beta, shift_hint, res, eps,
+                                     act)
+    return out
+
+
+def _bn_add_act_fwd_impl(x, gamma, beta, shift_hint, res, eps, act):
+    axes = tuple(range(x.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    xf = x.astype(jnp.float32)
+    s = lax.stop_gradient(shift_hint.astype(jnp.float32))
+    d = xf - s
+    m1 = jnp.sum(d, axis=axes) / n
+    m2 = jnp.sum(d * d, axis=axes) / n
+    mean = s + m1
+    var = jnp.maximum(m2 - m1 * m1, 0.0)
+    rstd = lax.rsqrt(var + eps)
+    scale = gamma.astype(jnp.float32) * rstd
+    shift = beta.astype(jnp.float32) - mean * scale
+    y = x * scale.astype(x.dtype) + shift.astype(x.dtype) + res
+    if act == "relu":
+        y = jnp.maximum(y, 0)
+    return (y, mean, var), (x, gamma, mean, rstd, y)
+
+
+def _bn_add_act_bwd(eps, act, resids, cots):
+    dy, _dmean, _dvar = cots
+    x, gamma, mean, rstd, y = resids
+    if act == "relu":
+        dy = jnp.where(y > 0, dy, jnp.zeros_like(dy))
+    dres = dy
+    axes = tuple(range(x.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    dyf = dy.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    xhat = (xf - mean) * rstd
+    dbeta = jnp.sum(dyf, axis=axes)
+    dgamma = jnp.sum(dyf * xhat, axis=axes)
+    g32 = gamma.astype(jnp.float32)
+    k = (g32 * rstd).astype(x.dtype)
+    dx = k * (dy
+              - (dbeta / n).astype(x.dtype)
+              - (xhat * (dgamma / n)).astype(x.dtype))
+    return (dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype),
+            jnp.zeros_like(mean), dres)
+
+
+def _bn_add_act_fused_fwd(x, gamma, beta, shift_hint, res, eps, act):
+    out, resids = _bn_add_act_fwd_impl(x, gamma, beta, shift_hint, res, eps,
+                                       act)
+    return out, resids
+
+
+bn_add_act_train_fused.defvjp(_bn_add_act_fused_fwd, _bn_add_act_bwd)
+
+
 def register_default(platforms=("tpu", "axon")) -> None:
     """Install behind the helper seam (auto-called by the registry's lazy
     discovery on TPU backends; the built-in path stays the default on CPU so
     helper-vs-builtin tests compare against it)."""
     from ..nn.helpers import register_helper
     register_helper("batchnorm_train", bn_train_fused, platforms)
+    register_helper("batchnorm_add_act_train", bn_add_act_train_fused,
+                    platforms)

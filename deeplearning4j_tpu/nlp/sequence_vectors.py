@@ -17,8 +17,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .huffman import apply_huffman, pad_codes
-from .skipgram import (skipgram_hs_step, skipgram_ns_step, cbow_hs_step,
-                       generate_skipgram_pairs)
+from .skipgram import (skipgram_hs_step, skipgram_ns_step,
+                       skipgram_ns_step_rng, cbow_hs_step, cbow_ns_step,
+                       cbow_ns_step_rng, generate_skipgram_pairs,
+                       vectorized_skipgram_pairs, vectorized_cbow_windows)
 from .vocab import VocabCache, VocabConstructor
 
 
@@ -87,74 +89,136 @@ class SequenceVectors:
                                           self.negative)
         return self
 
+    # tokens per vectorized chunk: bounds host memory for the pair set the
+    # way the old streaming buffer did (~chunk * 2*window pairs in flight)
+    CHUNK_TOKENS = 2_000_000
+
+    def _index_chunks(self, sequences: Sequence[List[str]]):
+        """Yield the corpus as int32 index streams with ``-1`` sentence
+        separators (windows never cross a separator), in whole-sentence
+        chunks of ~CHUNK_TOKENS so arbitrarily large corpora stream."""
+        parts: List[np.ndarray] = []
+        size = 0
+        sep = np.array([-1], np.int32)
+        index_of = self.vocab.index_of
+        for seq in sequences:
+            idxs = np.fromiter((index_of(w) for w in seq if w in self.vocab),
+                               np.int32)
+            if len(idxs):
+                parts.append(idxs)
+                parts.append(sep)
+                size += len(idxs)
+            if size >= self.CHUNK_TOKENS:
+                yield np.concatenate(parts)
+                parts, size = [], 0
+        if parts:
+            yield np.concatenate(parts)
+
     def fit(self, sequences: Sequence[List[str]]):
-        """Train over the corpus (reference SequenceVectors.fit)."""
+        """Train over the corpus (reference SequenceVectors.fit).
+
+        The reference's thread pool + native AggregateSkipGram becomes:
+        vectorized corpus-wide window extraction (one numpy pass per window
+        offset), shuffled fixed-size batches, and one jitted scatter step per
+        batch with on-device negative sampling — no per-token Python and no
+        host sync inside the loop."""
         if self.vocab is None:
             self.build_vocab(sequences)
         rng = np.random.default_rng(self.seed)
         keep = self.vocab.subsample_keep_prob(self.sample)
-        total_words = self.vocab.total_word_count * self.epochs
+        total = max(self.vocab.total_word_count * self.epochs, 1)
         seen = 0
-        buf_c, buf_t = [], []
+        loss = None
+        import jax
+        base_key = jax.random.PRNGKey(self.seed)
+        chunk_id = 0
         for epoch in range(self.epochs):
-            for seq in sequences:
-                idxs = np.array([self.vocab.index_of(w) for w in seq
-                                 if w in self.vocab], np.int32)
-                if keep is not None and len(idxs):
-                    idxs = idxs[rng.random(len(idxs)) < keep[idxs]]
-                if len(idxs) < 2:
-                    continue
-                seen += len(idxs)
-                c, t = generate_skipgram_pairs(idxs, self.window, rng)
-                buf_c.append(c)
-                buf_t.append(t)
-                if sum(len(x) for x in buf_c) >= self.batch_size:
-                    self._flush(np.concatenate(buf_c), np.concatenate(buf_t),
-                                seen, total_words, rng)
-                    buf_c, buf_t = [], []
-        if buf_c:
-            self._flush(np.concatenate(buf_c), np.concatenate(buf_t), seen,
-                        total_words, rng)
+            for corpus in self._index_chunks(sequences):
+                if keep is not None and len(corpus):
+                    m = rng.random(len(corpus)) < np.where(
+                        corpus >= 0, keep[np.maximum(corpus, 0)], 1.0)
+                    corpus = corpus[m]
+                ntokens = int((corpus >= 0).sum())
+                nskey = jax.random.fold_in(base_key, chunk_id)
+                chunk_id += 1
+                if self.elements_algorithm == "cbow":
+                    tgt, ctx, cmask = vectorized_cbow_windows(
+                        corpus, self.window, rng)
+                    perm = rng.permutation(len(tgt))
+                    loss = self._run_cbow(tgt[perm], ctx[perm], cmask[perm],
+                                          seen, ntokens, total, nskey)
+                else:
+                    c, t = vectorized_skipgram_pairs(corpus, self.window,
+                                                     rng)
+                    perm = rng.permutation(len(c))
+                    loss = self._run_skipgram(c[perm], t[perm], seen,
+                                              ntokens, total, nskey)
+                seen += ntokens
+        if loss is not None:
+            self._last_loss = float(loss)   # one sync, at the end
         return self
 
-    def _lr_now(self, seen: int, total: int) -> float:
+    def _lr_now(self, seen: float, total: int) -> float:
+        """word2vec linear decay by tokens seen."""
         frac = min(seen / max(total, 1), 1.0)
         return max(self.learning_rate * (1.0 - frac), self.min_learning_rate)
 
-    def _flush(self, centers: np.ndarray, targets: np.ndarray, seen: int,
-               total: int, rng: np.random.Generator):
-        """Run fixed-size jitted batches (pad the tail to keep one compile)."""
-        lr = self._lr_now(seen, total)
+    @staticmethod
+    def _pad(a: np.ndarray, size: int) -> np.ndarray:
+        if len(a) == size:
+            return a
+        pad = np.zeros((size - len(a),) + a.shape[1:], a.dtype)
+        return np.concatenate([a, pad])
+        # padded entries train word 0 on itself once per epoch — negligible,
+        # and shapes stay static for jit
+
+    def _run_skipgram(self, centers, targets, seen, ntokens, total, nskey):
+        import jax
         B = self.batch_size
         lt = self.lookup
-        for i in range(0, len(centers), B):
-            c = centers[i:i + B]
-            t = targets[i:i + B]
-            if len(c) < B:      # pad with self-pairs at lr 0 contribution:
-                pad = B - len(c)
-                c = np.concatenate([c, np.zeros(pad, np.int32)])
-                t = np.concatenate([t, np.zeros(pad, np.int32)])
-                # padded entries train word 0 on itself once — negligible,
-                # and shapes stay static for jit
-            cj = jnp.asarray(c)
-            tj = jnp.asarray(t)
-            if self.elements_algorithm == "cbow":
-                # build context matrix per target from pairs is lossy; for
-                # cbow we reconstruct windows host-side instead (slower path)
-                pass
+        loss = None
+        nb = (len(centers) + B - 1) // B
+        neg_table = jnp.asarray(self._neg_table) if self.negative > 0 \
+            else None
+        for i in range(nb):
+            c = jnp.asarray(self._pad(centers[i * B:(i + 1) * B], B))
+            t = jnp.asarray(self._pad(targets[i * B:(i + 1) * B], B))
+            lr = jnp.float32(self._lr_now(seen + ntokens * i / nb, total))
             if self.use_hs:
                 lt.syn0, lt.syn1, loss = skipgram_hs_step(
-                    lt.syn0, lt.syn1, cj, tj, self._codes[tj],
-                    self._points[tj], self._lengths[tj],
-                    jnp.float32(lr))
+                    lt.syn0, lt.syn1, c, t, self._codes[t],
+                    self._points[t], self._lengths[t], lr)
             if self.negative > 0:
-                negs = self._neg_table[
-                    rng.integers(0, len(self._neg_table),
-                                 (B, self.negative))]
-                lt.syn0, lt.syn1neg, loss = skipgram_ns_step(
-                    lt.syn0, lt.syn1neg, cj, tj, jnp.asarray(negs),
-                    jnp.float32(lr))
-        self._last_loss = float(loss)
+                nskey, sub = jax.random.split(nskey)
+                lt.syn0, lt.syn1neg, loss = skipgram_ns_step_rng(
+                    lt.syn0, lt.syn1neg, c, t, neg_table, sub, lr,
+                    self.negative)
+        return loss
+
+    def _run_cbow(self, targets, contexts, cmasks, seen, ntokens, total,
+                  nskey):
+        import jax
+        B = self.batch_size
+        lt = self.lookup
+        loss = None
+        nb = (len(targets) + B - 1) // B
+        neg_table = jnp.asarray(self._neg_table) if self.negative > 0 \
+            else None
+        for i in range(nb):
+            t = jnp.asarray(self._pad(targets[i * B:(i + 1) * B], B))
+            ctx = jnp.asarray(self._pad(contexts[i * B:(i + 1) * B], B))
+            cm = jnp.asarray(self._pad(cmasks[i * B:(i + 1) * B], B))
+            lr = jnp.float32(self._lr_now(seen + ntokens * i / nb, total))
+            if self.use_hs:
+                lt.syn0, lt.syn1, loss = cbow_hs_step(
+                    lt.syn0, lt.syn1, ctx, cm, t, self._codes[t],
+                    self._points[t], self._lengths[t], lr)
+            if self.negative > 0:
+                nskey, sub = jax.random.split(nskey)
+                lt.syn0, lt.syn1neg, loss = cbow_ns_step_rng(
+                    lt.syn0, lt.syn1neg, ctx, cm, t, neg_table, sub, lr,
+                    self.negative)
+        return loss
 
     # ------------------------------------------------------------ query API
     def get_word_vector(self, word: str) -> Optional[np.ndarray]:

@@ -65,12 +65,7 @@ def skipgram_hs_step(syn0, syn1, centers, targets, codes, points, lengths,
     return syn0, syn1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def skipgram_ns_step(syn0, syn1neg, centers, pos, negs, lr):
-    """Negative-sampling skip-gram batch.
-
-    centers [B], pos [B], negs [B, K] sampled negatives.
-    syn1neg [V, D] output vectors. Returns (syn0, syn1neg, mean_loss)."""
+def _skipgram_ns_core(syn0, syn1neg, centers, pos, negs, lr):
     h = syn0[centers]                              # [B, D]
     tgt = jnp.concatenate([pos[:, None], negs], axis=1)   # [B, 1+K]
     label = jnp.concatenate(
@@ -88,6 +83,15 @@ def skipgram_ns_step(syn0, syn1neg, centers, pos, negs, lr):
     syn1neg = _scatter_mean_add(syn1neg, tgt.reshape(-1),
                                 dv.reshape(-1, dv.shape[-1]), lr)
     return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_ns_step(syn0, syn1neg, centers, pos, negs, lr):
+    """Negative-sampling skip-gram batch.
+
+    centers [B], pos [B], negs [B, K] sampled negatives.
+    syn1neg [V, D] output vectors. Returns (syn0, syn1neg, mean_loss)."""
+    return _skipgram_ns_core(syn0, syn1neg, centers, pos, negs, lr)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -119,6 +123,20 @@ def cbow_hs_step(syn0, syn1, context, context_mask, target, codes, points,
     return syn0, syn1, loss
 
 
+@functools.partial(jax.jit, static_argnames=("k",),
+                   donate_argnums=(0, 1))
+def skipgram_ns_step_rng(syn0, syn1neg, centers, pos, neg_table, key, lr,
+                         k: int):
+    """Negative-sampling step with ON-DEVICE negative draws: the unigram
+    table stays device-resident and negatives are sampled inside the jitted
+    program (one fold of ``key`` per step), removing the host RNG + transfer
+    from the hot loop (the AggregateSkipGram throughput analog,
+    SURVEY.md §7 hard-parts #4)."""
+    negs = neg_table[jax.random.randint(key, (centers.shape[0], k), 0,
+                                        neg_table.shape[0])]
+    return _skipgram_ns_core(syn0, syn1neg, centers, pos, negs, lr)
+
+
 def generate_skipgram_pairs(indexed_seq: np.ndarray, window: int,
                             rng: np.random.Generator,
                             dynamic_window: bool = True
@@ -135,3 +153,120 @@ def generate_skipgram_pairs(indexed_seq: np.ndarray, window: int,
                 centers.append(indexed_seq[i])
                 contexts.append(indexed_seq[j])
     return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+
+
+def vectorized_skipgram_pairs(corpus: np.ndarray, window: int,
+                              rng: np.random.Generator,
+                              dynamic_window: bool = True
+                              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Corpus-wide vectorized pair generation. ``corpus`` is the whole
+    (sub-sampled) token-index stream with ``-1`` sentence separators; one
+    numpy pass per window offset replaces the per-token Python loop of
+    :func:`generate_skipgram_pairs` (~3 orders of magnitude faster on large
+    corpora, same (center, context) multiset given the same window draws)."""
+    corpus = np.asarray(corpus, np.int32)
+    n = len(corpus)
+    if n < 2:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+    b = rng.integers(1, window + 1, n) if dynamic_window \
+        else np.full(n, window)
+    # segment id per position: a pair is valid only within one sentence —
+    # endpoint checks alone would let d>=2 windows jump a short sentence
+    seg = np.cumsum(corpus < 0)
+    centers, contexts = [], []
+    for d in range(1, window + 1):
+        # context d positions to the right of the center...
+        c, t, bb = corpus[:n - d], corpus[d:], b[:n - d]
+        same = seg[:n - d] == seg[d:]
+        valid = (c >= 0) & (t >= 0) & same & (bb >= d)
+        centers.append(c[valid])
+        contexts.append(t[valid])
+        # ...and d positions to the left
+        c, t, bb = corpus[d:], corpus[:n - d], b[d:]
+        valid = (c >= 0) & (t >= 0) & same & (bb >= d)
+        centers.append(c[valid])
+        contexts.append(t[valid])
+    return (np.concatenate(centers), np.concatenate(contexts))
+
+
+def vectorized_cbow_windows(corpus: np.ndarray, window: int,
+                            rng: np.random.Generator,
+                            dynamic_window: bool = True):
+    """Corpus-wide CBOW window extraction: returns (targets [M],
+    context [M, 2*window] zero-padded, context_mask [M, 2*window]).
+    Separator-aware like :func:`vectorized_skipgram_pairs`."""
+    corpus = np.asarray(corpus, np.int32)
+    n = len(corpus)
+    if n < 2:
+        return (np.zeros(0, np.int32),
+                np.zeros((0, 2 * window), np.int32),
+                np.zeros((0, 2 * window), np.float32))
+    b = rng.integers(1, window + 1, n) if dynamic_window \
+        else np.full(n, window)
+    seg = np.cumsum(corpus < 0)     # same-sentence guard as skip-gram pairs
+    ctx = np.full((n, 2 * window), -1, np.int32)
+    slot = 0
+    for d in range(1, window + 1):
+        for sign in (-1, 1):
+            src = np.full(n, -1, np.int32)
+            same = np.zeros(n, bool)
+            if sign < 0:
+                src[d:] = corpus[:n - d]
+                same[d:] = seg[d:] == seg[:n - d]
+            else:
+                src[:n - d] = corpus[d:]
+                same[:n - d] = seg[:n - d] == seg[d:]
+            ctx[:, slot] = np.where((b >= d) & same, src, -1)
+            slot += 1
+    mask = ctx >= 0
+    rows = (corpus >= 0) & mask.any(axis=1)
+    ctx = ctx[rows]
+    mask = mask[rows]
+    return (corpus[rows],
+            np.where(mask, ctx, 0).astype(np.int32),
+            mask.astype(np.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("k",),
+                   donate_argnums=(0, 1))
+def cbow_ns_step_rng(syn0, syn1neg, context, context_mask, target,
+                     neg_table, key, lr, k: int):
+    """CBOW negative-sampling step with on-device negative draws (see
+    skipgram_ns_step_rng)."""
+    negs = neg_table[jax.random.randint(key, (target.shape[0], k), 0,
+                                        neg_table.shape[0])]
+    return _cbow_ns_core(syn0, syn1neg, context, context_mask, target, negs,
+                         lr)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_ns_step(syn0, syn1neg, context, context_mask, target, negs, lr):
+    """CBOW with negative sampling: mean-of-context hidden vector, same
+    pos/neg head as skip-gram NS, gradient distributed over the context."""
+    return _cbow_ns_core(syn0, syn1neg, context, context_mask, target, negs,
+                         lr)
+
+
+def _cbow_ns_core(syn0, syn1neg, context, context_mask, target, negs, lr):
+    cm = context_mask.astype(syn0.dtype)
+    vecs = syn0[context] * cm[..., None]
+    denom = jnp.maximum(jnp.sum(cm, axis=1, keepdims=True), 1.0)
+    h = jnp.sum(vecs, axis=1) / denom
+    tgt = jnp.concatenate([target[:, None], negs], axis=1)
+    label = jnp.concatenate(
+        [jnp.ones_like(target[:, None], dtype=syn0.dtype),
+         jnp.zeros(negs.shape, syn0.dtype)], axis=1)
+    v = syn1neg[tgt]
+    dots = jnp.einsum("bd,bkd->bk", h, v)
+    sig = jax.nn.sigmoid(dots)
+    g = label - sig
+    loss = -jnp.mean(jnp.log(jnp.clip(
+        jnp.where(label > 0.5, sig, 1.0 - sig), 1e-10, 1.0)))
+    dh = jnp.einsum("bk,bkd->bd", g, v)
+    dv = jnp.einsum("bk,bd->bkd", g, h)
+    syn1neg = _scatter_mean_add(syn1neg, tgt.reshape(-1),
+                                dv.reshape(-1, dv.shape[-1]), lr)
+    dctx = (dh / denom)[:, None, :] * cm[..., None]
+    syn0 = _scatter_mean_add(syn0, context.reshape(-1),
+                             dctx.reshape(-1, dctx.shape[-1]), lr)
+    return syn0, syn1neg, loss

@@ -115,7 +115,13 @@ class ParagraphVectors(SequenceVectors):
         self.doc_vectors = None
 
     def fit_documents(self, documents: Sequence[Tuple[str, List[str]]]):
-        """documents: [(label, tokens)]."""
+        """documents: [(label, tokens)].
+
+        Batched like SequenceVectors.fit: (doc, word) pairs for DBOW (the
+        doc vector is the skip-gram center) and word windows for DM are
+        collected corpus-wide, shuffled, and trained in FIXED-size jitted
+        batches — variable per-document shapes would recompile the XLA step
+        for every distinct document length."""
         seqs = [tokens for _, tokens in documents]
         if self.vocab is None:
             self.build_vocab(seqs)
@@ -127,38 +133,54 @@ class ParagraphVectors(SequenceVectors):
             (np.random.default_rng(self.seed + 1)
              .random((D, self.vector_length)) - 0.5) / self.vector_length,
             jnp.float32)
-        total = sum(len(t) for _, t in documents) * self.epochs
-        seen = 0
+        # (doc, word) pairs + word-window pairs, one pass over the corpus
+        doc_c, doc_t, word_parts = [], [], []
+        sep = np.array([-1], np.int32)
+        for label, tokens in documents:
+            didx = self.label_index[label]
+            idxs = np.array([self.vocab.index_of(w) for w in tokens
+                             if w in self.vocab], np.int32)
+            if len(idxs) == 0:
+                continue
+            doc_c.append(np.full(len(idxs), didx, np.int32))
+            doc_t.append(idxs)
+            word_parts.append(idxs)
+            word_parts.append(sep)
+        if not doc_c:
+            return self
+        doc_c = np.concatenate(doc_c)
+        doc_t = np.concatenate(doc_t)
+        total = len(doc_t) * self.epochs
+        B = self.batch_size
         for epoch in range(self.epochs):
-            for label, tokens in documents:
-                didx = self.label_index[label]
-                idxs = np.array([self.vocab.index_of(w) for w in tokens
-                                 if w in self.vocab], np.int32)
-                if len(idxs) == 0:
-                    continue
-                seen += len(idxs)
-                lr = self._lr_now(seen, total)
-                # DBOW: doc vector predicts every word (like skip-gram with
-                # the doc vector as the center)
-                B = len(idxs)
-                centers = np.full(B, didx, np.int32)
-                tj = jnp.asarray(idxs)
-                dv, self.lookup.syn1, _ = skipgram_hs_step(
-                    self.doc_vectors, self.lookup.syn1,
-                    jnp.asarray(centers), tj, self._codes[tj],
-                    self._points[tj], self._lengths[tj], jnp.float32(lr))
-                self.doc_vectors = dv
-                if self.sequence_algorithm == "dm":
-                    # also train word vectors on the same windows
-                    from .skipgram import generate_skipgram_pairs
-                    c, t = generate_skipgram_pairs(idxs, self.window, rng)
-                    if len(c):
-                        cj, tjj = jnp.asarray(c), jnp.asarray(t)
-                        self.lookup.syn0, self.lookup.syn1, _ = \
-                            skipgram_hs_step(
-                                self.lookup.syn0, self.lookup.syn1, cj, tjj,
-                                self._codes[tjj], self._points[tjj],
-                                self._lengths[tjj], jnp.float32(lr))
+            perm = rng.permutation(len(doc_c))
+            dc, dt = doc_c[perm], doc_t[perm]
+            nb = (len(dc) + B - 1) // B
+            for i in range(nb):
+                lr = jnp.float32(self._lr_now(
+                    epoch * len(doc_t) + len(doc_t) * i / max(nb, 1), total))
+                c = jnp.asarray(self._pad(dc[i * B:(i + 1) * B], B))
+                t = jnp.asarray(self._pad(dt[i * B:(i + 1) * B], B))
+                self.doc_vectors, self.lookup.syn1, _ = skipgram_hs_step(
+                    self.doc_vectors, self.lookup.syn1, c, t,
+                    self._codes[t], self._points[t], self._lengths[t], lr)
+            if self.sequence_algorithm == "dm":
+                from .skipgram import vectorized_skipgram_pairs
+                wc, wt = vectorized_skipgram_pairs(
+                    np.concatenate(word_parts), self.window, rng)
+                wperm = rng.permutation(len(wc))
+                wc, wt = wc[wperm], wt[wperm]
+                nb = (len(wc) + B - 1) // B
+                for i in range(nb):
+                    lr = jnp.float32(self._lr_now(
+                        epoch * len(doc_t) + len(doc_t) * i / max(nb, 1),
+                        total))
+                    c = jnp.asarray(self._pad(wc[i * B:(i + 1) * B], B))
+                    t = jnp.asarray(self._pad(wt[i * B:(i + 1) * B], B))
+                    self.lookup.syn0, self.lookup.syn1, _ = skipgram_hs_step(
+                        self.lookup.syn0, self.lookup.syn1, c, t,
+                        self._codes[t], self._points[t], self._lengths[t],
+                        lr)
         return self
 
     def get_doc_vector(self, label: str) -> Optional[np.ndarray]:

@@ -16,9 +16,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops import rng as rngmod
+from ..helpers import get_helper
 from ..multilayer import _nz
 from ...ops.dataset import DataSet, MultiDataSet
 from ...ops.updaters import make_updater, normalize_gradient, schedule_lr
+from .fusion import build_fusion_plan
 from .graph_config import ComputationGraphConfiguration
 from .vertices import LayerVertex
 
@@ -73,6 +75,46 @@ class ComputationGraph:
         if not self._initialized:
             self.init()
 
+    # ---------------------------------------------------------------- fusion
+    def _get_fusion_plan(self):
+        """Cached cross-vertex fusion plan (nn/graph/fusion.py); training
+        path only."""
+        cached = self._jit_cache.get("fusion")
+        if cached is None:
+            cached = build_fusion_plan(self.conf)
+            self._jit_cache["fusion"] = cached
+        return cached
+
+    def _forward_fused(self, fu, params, state, acts, masks, new_state):
+        """Execute one BN->add->act pattern. Falls back to the sequential
+        vertex math when runtime masks are present or the helper was
+        disabled after the plan was cached."""
+        x = acts[fu.bn_input]
+        res = acts[fu.res_input]
+        bn = self.conf.vertices[fu.bn_name].layer
+        helper = get_helper("batchnorm_add_act_train")
+        if helper is not None and masks.get(fu.bn_input) is None and \
+                masks.get(fu.res_input) is None:
+            y, mean, var = helper(x, params[fu.bn_name]["gamma"],
+                                  params[fu.bn_name]["beta"],
+                                  state[fu.bn_name]["mean"], res, bn.eps,
+                                  fu.activation)
+            d = bn.decay
+            new_state[fu.bn_name] = {
+                "mean": d * state[fu.bn_name]["mean"] + (1 - d) * mean,
+                "var": d * state[fu.bn_name]["var"] + (1 - d) * var}
+            masks[fu.act_name] = None
+        else:
+            y, nstate = bn.forward(params[fu.bn_name], state[fu.bn_name], x,
+                                   train=True, mask=masks.get(fu.bn_input))
+            y = y + res
+            if fu.activation == "relu":
+                y = jnp.maximum(y, 0)
+            new_state[fu.bn_name] = nstate
+            masks[fu.act_name] = masks.get(fu.bn_input)
+        acts[fu.act_name] = y
+        new_state[fu.act_name] = state[fu.act_name]
+
     # --------------------------------------------------------------- forward
     def _forward(self, params, state, inputs: Dict[str, jnp.ndarray], *,
                  train, rng, input_masks: Optional[Dict] = None,
@@ -87,7 +129,17 @@ class ComputationGraph:
         last_inputs: Dict[str, jnp.ndarray] = {}
         reg = jnp.asarray(0.0, jnp.float32)
         out_set = set(self.conf.network_outputs) if output_preout else set()
+        fusion_plan, fusion_skip = self._get_fusion_plan() if train \
+            else ({}, set())
         for idx, name in enumerate(self.conf.topological_order):
+            if name in fusion_skip:
+                # computed by a fused pattern at its activation vertex
+                new_state.setdefault(name, state[name])
+                continue
+            if name in fusion_plan:
+                self._forward_fused(fusion_plan[name], params, state, acts,
+                                    masks, new_state)
+                continue
             v = self.conf.vertices[name]
             in_names = self.conf.vertex_inputs[name]
             xs = [acts[i] for i in in_names]

@@ -27,6 +27,7 @@ _DISABLED: set = set()
 # use; otherwise the built-in path runs.
 _DEFAULT_PROVIDERS: Dict[str, str] = {
     "batchnorm_train": "deeplearning4j_tpu.kernels.batchnorm",
+    "batchnorm_add_act_train": "deeplearning4j_tpu.kernels.batchnorm",
 }
 _FAILED_PROVIDERS: set = set()
 

@@ -301,7 +301,8 @@ class MultiLayerNetwork:
                 if self.conf.pretrain:
                     raise ValueError("conf.pretrain=True: call pretrain(data)")
                 if self.conf.backprop_type == "truncated_bptt" and \
-                        ds.features.ndim == 3:
+                        ds.features.ndim == 3 and \
+                        (self.conf.tbptt_fwd_length or 0) > 0:
                     self._fit_tbptt(ds)
                 else:
                     self._fit_batch(ds)

@@ -27,6 +27,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <chrono>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -62,19 +63,39 @@ struct PsServer {
     std::atomic<bool> stop{false};
     std::thread acceptor;
     std::mutex conn_mu;
-    std::vector<std::thread> handlers;
+    std::vector<int> conn_fds;        // open connections (handlers detached)
+    std::atomic<int> active{0};
 
     ~PsServer() { shutdown(); }
+
+    void add_conn(int fd) {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        conn_fds.push_back(fd);
+        active.fetch_add(1);
+    }
+
+    void remove_conn(int fd) {
+        {
+            std::lock_guard<std::mutex> lk(conn_mu);
+            for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it)
+                if (*it == fd) { conn_fds.erase(it); break; }
+        }
+        active.fetch_sub(1);
+    }
 
     void shutdown() {
         bool expected = false;
         if (!stop.compare_exchange_strong(expected, true)) return;
         if (listen_fd >= 0) { ::shutdown(listen_fd, SHUT_RDWR); ::close(listen_fd); }
         if (acceptor.joinable()) acceptor.join();
-        std::lock_guard<std::mutex> lk(conn_mu);
-        for (auto& t : handlers)
-            if (t.joinable()) t.join();
-        handlers.clear();
+        {
+            // force idle handlers out of recv() — they are detached and
+            // decrement `active` on exit
+            std::lock_guard<std::mutex> lk(conn_mu);
+            for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+        }
+        for (int i = 0; i < 200 && active.load() > 0; ++i)
+            std::this_thread::sleep_for(std::chrono::milliseconds(10));
     }
 };
 
@@ -126,6 +147,7 @@ void handle_conn(PsServer* srv, int fd) {
         }
     }
     ::close(fd);
+    srv->remove_conn(fd);
 }
 
 void accept_loop(PsServer* srv) {
@@ -137,8 +159,8 @@ void accept_loop(PsServer* srv) {
         }
         int one = 1;
         ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-        std::lock_guard<std::mutex> lk(srv->conn_mu);
-        srv->handlers.emplace_back(handle_conn, srv, fd);
+        srv->add_conn(fd);
+        std::thread(handle_conn, srv, fd).detach();
     }
 }
 

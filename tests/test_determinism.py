@@ -1,0 +1,80 @@
+"""Deterministic-update tests — SURVEY.md §5.2: the reference tolerates
+HOGWILD-style nondeterminism by construction and ships no determinism tests;
+the TPU build adds them (seeded PRNG threading + pure jitted steps should be
+exactly reproducible on the same backend)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, DropoutLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.ops.dataset import DataSet
+
+
+def _data(rng):
+    X = rng.normal(size=(16, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return [DataSet(X[i:i + 4], y[i:i + 4]) for i in range(0, 16, 4)]
+
+
+def _mln():
+    conf = (NeuralNetConfiguration.Builder().seed(99).learning_rate(0.05)
+            .updater("adam").weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="relu", drop_out=0.8))
+            .layer(DropoutLayer(drop_out=0.9))
+            .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestDeterminism:
+    def test_mln_training_bitwise_reproducible(self, rng_np):
+        data = _data(rng_np)
+        runs = []
+        for _ in range(2):
+            net = _mln()
+            net.fit(data, num_epochs=3)
+            runs.append(net.params_flat())
+        np.testing.assert_array_equal(runs[0], runs[1])
+
+    def test_graph_training_bitwise_reproducible(self, rng_np):
+        from deeplearning4j_tpu.models import resnet_tiny_conf
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        X = rng_np.normal(size=(4, 8, 8, 2)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng_np.integers(0, 4, 4)]
+        runs = []
+        for _ in range(2):
+            net = ComputationGraph(resnet_tiny_conf(
+                num_classes=4, height=8, width=8, channels=2)).init()
+            net.fit([DataSet(X, y)], num_epochs=2)
+            runs.append(net.params_flat())
+        np.testing.assert_array_equal(runs[0], runs[1])
+
+    def test_word2vec_seeded_reproducible(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        corpus = [f"tok{i % 50} tok{(i * 7) % 50} tok{(i * 3) % 50}".split()
+                  for i in range(300)]
+        runs = []
+        for _ in range(2):
+            w = (Word2Vec.Builder().layer_size(16).window_size(2)
+                 .min_word_frequency(1).epochs(1).seed(5).build())
+            w.fit(corpus)
+            runs.append(np.asarray(w.lookup.syn0))
+        np.testing.assert_array_equal(runs[0], runs[1])
+
+    def test_data_parallel_matches_single_device_semantics(self, rng_np):
+        # sync DP with n-way sharded batch must equal the single-program
+        # result (SPMD determinism — no replica-thread racing by design)
+        import jax
+        from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+        data = _data(rng_np)
+        solo = _mln()
+        solo.fit(data, num_epochs=2)
+        net = _mln()
+        pw = (ParallelWrapper.Builder(net).workers(4)
+              .averaging_frequency(1).build())
+        pw.fit(data, num_epochs=2)
+        # same updates in a different reduction order: close, not bitwise
+        np.testing.assert_allclose(net.params_flat(), solo.params_flat(),
+                                   rtol=5e-4, atol=5e-5)

@@ -212,3 +212,49 @@ class TestLayerBehaviors:
         y, _ = layer.forward({}, {}, x)
         manual = np.sqrt(np.sum(np.asarray(x)[0, :2, :2, 0] ** 2))
         np.testing.assert_allclose(float(y[0, 0, 0, 0]), manual, rtol=1e-5)
+
+
+class TestGradientChecksExtended:
+    """Remaining layer families (CenterLoss/VAE/RBM/attention) — completes
+    the reference's gradient-check coverage (VaeGradientCheckTests,
+    GradientCheckTests center-loss cases; SURVEY.md §4)."""
+
+    def test_center_loss_output(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
+        net = _net([DenseLayer(n_out=5),
+                    CenterLossOutputLayer(n_out=3, loss="mcxent",
+                                          activation="softmax",
+                                          lambda_=0.1)],
+                   InputType.feed_forward(4))
+        ds = DataSet(rng_np.normal(size=(6, 4)), _onehot(rng_np, 6, 3))
+        assert check_gradients(net, ds)
+
+    def test_variational_autoencoder_supervised(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import VariationalAutoencoder
+        net = _net([VariationalAutoencoder(n_out=4, encoder_layer_sizes=[6],
+                                           decoder_layer_sizes=[6]),
+                    OutputLayer(n_out=2, loss="mcxent",
+                                activation="softmax")],
+                   InputType.feed_forward(5))
+        ds = DataSet(rng_np.normal(size=(4, 5)), _onehot(rng_np, 4, 2))
+        assert check_gradients(net, ds)
+
+    def test_rbm_supervised(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import RBM
+        net = _net([RBM(n_out=4),
+                    OutputLayer(n_out=2, loss="mcxent",
+                                activation="softmax")],
+                   InputType.feed_forward(3))
+        ds = DataSet(rng_np.normal(size=(5, 3)), _onehot(rng_np, 5, 2))
+        assert check_gradients(net, ds)
+
+    def test_self_attention(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        net = _net([SelfAttentionLayer(n_out=4, num_heads=2),
+                    RnnOutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax")],
+                   InputType.recurrent(3))
+        ds = DataSet(rng_np.normal(size=(2, 5, 3)),
+                     np.eye(2)[rng_np.integers(0, 2, (2, 5))].astype(
+                         np.float64))
+        assert check_gradients(net, ds)

@@ -188,3 +188,51 @@ class TestDeepWalk:
         vecs = GraphVectorSerializer.load_graph_vectors(path)
         np.testing.assert_allclose(vecs, np.asarray(dw.vertex_vectors),
                                    atol=1e-5)
+
+
+class TestVectorizedPairGeneration:
+    """Vectorized corpus-wide window extraction vs the per-sentence loop:
+    identical pair multisets, and no window may cross a sentence separator
+    (review finding r1: endpoint checks alone let d>=2 windows jump a short
+    sentence)."""
+
+    def test_no_cross_separator_pairs(self):
+        from deeplearning4j_tpu.nlp.skipgram import vectorized_skipgram_pairs
+        rng = np.random.default_rng(0)
+        corpus = np.array([5, 6, -1, 7, 8], np.int32)
+        c, t = vectorized_skipgram_pairs(corpus, window=3, rng=rng,
+                                         dynamic_window=False)
+        pairs = set(zip(c.tolist(), t.tolist()))
+        assert pairs == {(5, 6), (6, 5), (7, 8), (8, 7)}
+
+    def test_matches_per_sentence_loop(self):
+        from deeplearning4j_tpu.nlp.skipgram import (
+            generate_skipgram_pairs, vectorized_skipgram_pairs)
+        rng = np.random.default_rng(1)
+        sents = [rng.integers(0, 50, rng.integers(2, 15)).astype(np.int32)
+                 for _ in range(20)]
+        ref = []
+        for s in sents:
+            c, t = generate_skipgram_pairs(s, 4, rng, dynamic_window=False)
+            ref += list(zip(c.tolist(), t.tolist()))
+        parts = []
+        for s in sents:
+            parts.append(s)
+            parts.append(np.array([-1], np.int32))
+        c, t = vectorized_skipgram_pairs(np.concatenate(parts), 4, rng,
+                                         dynamic_window=False)
+        vec = list(zip(c.tolist(), t.tolist()))
+        assert sorted(ref) == sorted(vec)
+
+    def test_cbow_windows_respect_separators(self):
+        from deeplearning4j_tpu.nlp.skipgram import vectorized_cbow_windows
+        rng = np.random.default_rng(0)
+        corpus = np.array([5, 6, -1, 7, 8], np.int32)
+        tgt, ctx, mask = vectorized_cbow_windows(corpus, window=3, rng=rng,
+                                                 dynamic_window=False)
+        for i, tg in enumerate(tgt.tolist()):
+            members = set(ctx[i][mask[i] > 0].tolist())
+            if tg in (5, 6):
+                assert members <= {5, 6}
+            else:
+                assert members <= {7, 8}

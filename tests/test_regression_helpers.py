@@ -228,6 +228,7 @@ class TestBnHelperEquivalence:
                                        rtol=2e-4, atol=2e-5)
         finally:
             enable_helper("batchnorm_train")
+            register_default()       # restore TPU-only platforms (no cpu)
 
     def test_kernel_function_direct(self, rng_np):
         import jax
@@ -281,3 +282,52 @@ class TestBnHelperEquivalence:
                                    np.var(np.asarray(x, np.float64),
                                           axis=(0, 1)), rtol=1e-3)
         assert abs(float(np.asarray(y).std()) - 1.0) < 0.05
+
+
+class TestGraphFusionBnAddRelu:
+    """Graph fusion pass (nn/graph/fusion.py): the BN->add->ReLU residual
+    tail executed as one fused op must train identically to the plain walk."""
+
+    def _resnet(self):
+        from deeplearning4j_tpu.models import resnet_tiny_conf
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph(resnet_tiny_conf(num_classes=4, height=8,
+                                                 width=8, channels=2)).init()
+
+    def test_plan_found_and_training_equivalent(self, rng_np):
+        from deeplearning4j_tpu.kernels.batchnorm import register_default
+        from deeplearning4j_tpu.nn.helpers import (disable_helper,
+                                                   enable_helper)
+        from deeplearning4j_tpu.nn.graph.fusion import build_fusion_plan
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        register_default(platforms=("cpu", "tpu", "axon"))
+        enable_helper("batchnorm_add_act_train")
+        enable_helper("batchnorm_train")
+        x = rng_np.normal(size=(4, 8, 8, 2)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng_np.integers(0, 4, 4)]
+        try:
+            fused = self._resnet()
+            plan, skip = build_fusion_plan(fused.conf)
+            assert len(plan) == 2          # one residual tail per tiny block
+            assert len(skip) == 4
+            fused.fit([DataSet(x, y)], num_epochs=3)
+            out_fused = np.asarray(fused.output(x)[0])
+            params_fused = fused.params_flat()
+
+            disable_helper("batchnorm_add_act_train")
+            disable_helper("batchnorm_train")
+            plain = self._resnet()
+            plan2, _ = build_fusion_plan(plain.conf)
+            assert plan2 == {}             # no helper -> no fusion
+            plain.fit([DataSet(x, y)], num_epochs=3)
+            out_plain = np.asarray(plain.output(x)[0])
+            params_plain = plain.params_flat()
+
+            np.testing.assert_allclose(params_fused, params_plain,
+                                       rtol=3e-4, atol=3e-5)
+            np.testing.assert_allclose(out_fused, out_plain,
+                                       rtol=3e-4, atol=3e-5)
+        finally:
+            enable_helper("batchnorm_add_act_train")
+            enable_helper("batchnorm_train")
+            register_default()       # restore TPU-only platforms (no cpu)
