@@ -111,7 +111,11 @@ class ComputationGraph:
             if fu.activation == "relu":
                 y = jnp.maximum(y, 0)
             new_state[fu.bn_name] = nstate
-            masks[fu.act_name] = masks.get(fu.bn_input)
+            # plain-walk parity: the add vertex propagates its FIRST input's
+            # mask (which may be the residual branch), and the activation
+            # vertex inherits it
+            masks[fu.act_name] = masks.get(
+                self.conf.vertex_inputs[fu.add_name][0])
         acts[fu.act_name] = y
         new_state[fu.act_name] = state[fu.act_name]
 

@@ -1,0 +1,226 @@
+"""Failure detection + elastic recovery + preemption handling.
+
+SURVEY.md §5.3: the reference has NO failure detector, no elastic training,
+and no fault injection — its only resilience is Spark's implicit task
+recomputation (covered here by DistributedDataSet.map_partitions retries)
+and NaN-bailout early stopping. On TPU pods this is not optional: preemption
+is routine and multi-host SPMD jobs die whole. This module is the greenfield
+piece the survey calls for:
+
+- :class:`HeartbeatMonitor` — liveness tracking for named workers with a
+  failure callback after ``timeout`` without a beat (the role a cluster
+  manager's node failure detector plays; transport-agnostic — beats arrive
+  via method call, so threads, processes, or an HTTP endpoint can feed it).
+- :class:`PreemptionHandler` — SIGTERM/SIGINT hook that force-saves through
+  a :class:`..parallel.multihost.CheckpointManager` and flags training loops
+  to drain (TPU maintenance events deliver SIGTERM with a grace window).
+- :func:`run_elastic` — run tasks over a worker pool where a worker dying
+  mid-task does NOT fail the job: its pending work is redistributed over the
+  survivors (elastic degradation), with the failure recorded. This is the
+  single-process analog of elastic cluster training on top of
+  checkpoint/restore.
+"""
+
+from __future__ import annotations
+
+import queue
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+class WorkerLostError(RuntimeError):
+    """Raised by a task to signal its worker is gone (vs a retryable task
+    error)."""
+
+
+class HeartbeatMonitor:
+    """Tracks last-beat times per worker; fires ``on_failure(worker_id)``
+    once per worker that goes silent for ``timeout`` seconds."""
+
+    def __init__(self, timeout: float = 10.0, interval: float = 1.0,
+                 on_failure: Optional[Callable[[str], None]] = None):
+        self.timeout = float(timeout)
+        self.interval = float(interval)
+        self.on_failure = on_failure
+        self._beats: Dict[str, float] = {}
+        self._failed: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, worker_id: str) -> None:
+        with self._lock:
+            self._beats[worker_id] = time.monotonic()
+            self._failed.discard(worker_id)
+
+    def deregister(self, worker_id: str) -> None:
+        with self._lock:
+            self._beats.pop(worker_id, None)
+            self._failed.discard(worker_id)
+
+    def beat(self, worker_id: str) -> None:
+        with self._lock:
+            self._beats[worker_id] = time.monotonic()
+
+    def failed_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._failed)
+
+    def check_once(self) -> List[str]:
+        """Scan now; returns newly failed workers (also fires callback)."""
+        now = time.monotonic()
+        newly = []
+        with self._lock:
+            for wid, t in self._beats.items():
+                if wid not in self._failed and now - t > self.timeout:
+                    self._failed.add(wid)
+                    newly.append(wid)
+        for wid in newly:
+            if self.on_failure is not None:
+                self.on_failure(wid)
+        return newly
+
+    def start(self) -> "HeartbeatMonitor":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self.check_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT → force checkpoint + drain flag.
+
+    Training loops poll ``handler.preempted`` between steps and exit
+    cleanly; on restart, CheckpointManager.restore_latest resumes exactly
+    (updater state included — SURVEY.md §5.4 resume contract)."""
+
+    def __init__(self, checkpoint_manager=None, net=None,
+                 signals: Sequence[int] = (signal.SIGTERM,)):
+        self.checkpoint_manager = checkpoint_manager
+        self.net = net
+        self.signals = tuple(signals)
+        self.preempted = False
+        self._previous: Dict[int, object] = {}
+
+    def install(self) -> "PreemptionHandler":
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def _handle(self, signum, frame):
+        self.preempted = True
+        if self.checkpoint_manager is not None and self.net is not None:
+            try:
+                self.checkpoint_manager.maybe_save(self.net, force=True)
+            except Exception:   # noqa: BLE001 — never die inside a handler
+                pass
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+
+def run_elastic(tasks: Sequence, worker_fn: Callable[[str, object], object],
+                num_workers: int = 4,
+                monitor: Optional[HeartbeatMonitor] = None,
+                max_requeues: int = 3):
+    """Execute ``worker_fn(worker_id, task)`` for every task on a pool of
+    worker threads, surviving worker loss.
+
+    A task raising :class:`WorkerLostError` kills its worker; the task goes
+    back on the queue (up to ``max_requeues`` times per task) and remaining
+    work drains over the survivors. Any other exception propagates (it is a
+    task bug, not a lost node — transient retry belongs to
+    DistributedDataSet.map_partitions). Returns results in task order.
+    Raises RuntimeError if every worker died.
+    """
+    n = len(tasks)
+    results: List = [None] * n
+    done = [False] * n
+    requeues = [0] * n
+    q: "queue.Queue" = queue.Queue()
+    for i in range(n):
+        q.put(i)
+    errors: List[BaseException] = []
+    lock = threading.Lock()
+    in_flight = [0]      # tasks being executed: they may yet be requeued,
+    # so idle survivors must not exit while any are outstanding
+
+    def loop(wid: str):
+        if monitor is not None:
+            monitor.register(wid)
+        try:
+            while True:
+                # claim atomically: dequeue + in_flight increment under one
+                # lock, or an idle peer could observe (empty queue,
+                # in_flight==0) between our get() and increment and exit
+                # while this task may still be requeued
+                with lock:
+                    if errors or all(done):
+                        return
+                    try:
+                        i = q.get_nowait()
+                        in_flight[0] += 1
+                    except queue.Empty:
+                        if in_flight[0] == 0:
+                            return      # nothing queued, nothing pending
+                        i = None
+                if i is None:
+                    time.sleep(0.02)
+                    continue
+                if monitor is not None:
+                    monitor.beat(wid)
+                try:
+                    r = worker_fn(wid, tasks[i])
+                except WorkerLostError:
+                    with lock:
+                        in_flight[0] -= 1
+                        requeues[i] += 1
+                        if requeues[i] > max_requeues:
+                            errors.append(RuntimeError(
+                                f"task {i} requeued more than "
+                                f"{max_requeues} times"))
+                        else:
+                            q.put(i)
+                    return          # this worker is gone
+                except BaseException as e:   # noqa: BLE001 — surface task bugs
+                    with lock:
+                        in_flight[0] -= 1
+                        errors.append(e)
+                    return
+                with lock:
+                    results[i] = r
+                    done[i] = True
+                    in_flight[0] -= 1
+        finally:
+            if monitor is not None:
+                monitor.deregister(wid)
+
+    threads = [threading.Thread(target=loop, args=(f"worker-{w}",),
+                                daemon=True)
+               for w in range(num_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    if not all(done):
+        raise RuntimeError(
+            "all workers lost before the task set drained "
+            f"({sum(done)}/{n} done)")
+    return results

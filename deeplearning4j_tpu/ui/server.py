@@ -72,8 +72,40 @@ refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
 
+_TSNE_PAGE = """<!DOCTYPE html>
+<html><head><title>t-SNE — word vectors</title>
+<style>body{font-family:sans-serif;margin:20px;background:#fafafa}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px}
+svg{width:100%;height:560px}text{font-size:10px;fill:#333}
+circle{fill:#2b8cbe}</style></head><body>
+<h1>t-SNE</h1><div class=card><svg id=plot></svg></div>
+<script>
+// corpus tokens are arbitrary strings ('<s>', '<unk>', ...): escape before
+// injecting into SVG markup
+const esc = s => String(s).replace(/[&<>"']/g, c => ({'&':'&amp;',
+  '<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+async function refresh(){
+  const d = await (await fetch('/tsne/coords')).json();
+  const svg = document.getElementById('plot');
+  if (!d.coords || !d.coords.length) { return; }
+  const W = svg.clientWidth, H = svg.clientHeight, P = 20;
+  const xs = d.coords.map(c => c[0]), ys = d.coords.map(c => c[1]);
+  const xmin=Math.min(...xs),xmax=Math.max(...xs);
+  const ymin=Math.min(...ys),ymax=Math.max(...ys);
+  const sx=x=>P+(x-xmin)/(xmax-xmin||1)*(W-2*P);
+  const sy=y=>H-P-(y-ymin)/(ymax-ymin||1)*(H-2*P);
+  svg.innerHTML = d.coords.map((c,i)=>
+    `<circle cx=${sx(c[0])} cy=${sy(c[1])} r=3></circle>`+
+    `<text x=${sx(c[0])+4} y=${sy(c[1])-4}>${esc(d.labels[i]||'')}</text>`
+  ).join('');
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     storage = None
+    tsne_data = None          # {"labels": [...], "coords": [[x, y], ...]}
 
     def log_message(self, *args):
         pass
@@ -115,6 +147,15 @@ class _Handler(BaseHTTPRequestHandler):
             ups = storage.get_updates(session) if storage else []
             hists = [u for u in ups if "param_histograms" in u]
             self._json(hists[-1] if hists else {})
+        elif url.path == "/tsne":
+            body = _TSNE_PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/tsne/coords":
+            self._json(type(self).tsne_data or {"labels": [], "coords": []})
         else:
             self.send_response(404)
             self.end_headers()
@@ -131,6 +172,25 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 type(self).storage.put_update(record)
             self._json({"ok": True})
+        elif url.path == "/tsne/upload":
+            # reference play tsne module: upload word-vector coordinates.
+            # Accepts {"labels", "coords"} directly, or {"labels",
+            # "vectors"} — high-dimensional vectors are embedded server-side
+            # with Barnes-Hut t-SNE (clustering/tsne.py).
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            coords = payload.get("coords")
+            if coords is None and payload.get("vectors"):
+                import numpy as np
+                from ..clustering.tsne import Tsne
+                vecs = np.asarray(payload["vectors"], np.float32)
+                tsne = Tsne(n_components=2,
+                            perplexity=min(15.0, max(2.0, len(vecs) / 4)),
+                            n_iter=250)
+                coords = np.asarray(tsne.calculate(vecs)).tolist()
+            type(self).tsne_data = {"labels": payload.get("labels", []),
+                                    "coords": coords or []}
+            self._json({"ok": True, "count": len(coords or [])})
         else:
             self.send_response(404)
             self.end_headers()

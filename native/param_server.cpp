@@ -87,15 +87,19 @@ struct PsServer {
         bool expected = false;
         if (!stop.compare_exchange_strong(expected, true)) return;
         if (listen_fd >= 0) { ::shutdown(listen_fd, SHUT_RDWR); ::close(listen_fd); }
-        if (acceptor.joinable()) acceptor.join();
+        if (acceptor.joinable()) acceptor.join();   // no new connections now
         {
-            // force idle handlers out of recv() — they are detached and
-            // decrement `active` on exit
+            // force handlers out of blocking recv()/send(): after SHUT_RDWR
+            // every socket call returns promptly, so each detached handler
+            // reaches its exit path in bounded time
             std::lock_guard<std::mutex> lk(conn_mu);
             for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
         }
-        for (int i = 0; i < 200 && active.load() > 0; ++i)
-            std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        // wait until every handler has exited — must be unbounded: a timed
+        // wait would let ~PsServer free this object under a live handler
+        // (use-after-free). Progress is guaranteed by the SHUT_RDWR above.
+        while (active.load() > 0)
+            std::this_thread::sleep_for(std::chrono::milliseconds(2));
     }
 };
 
@@ -146,8 +150,10 @@ void handle_conn(PsServer* srv, int fd) {
             break;  // unknown op: drop connection (stream no longer framed)
         }
     }
-    ::close(fd);
+    // deregister BEFORE close: once closed, the kernel may reuse this fd
+    // number, and shutdown() iterating conn_fds must never hit a stranger
     srv->remove_conn(fd);
+    ::close(fd);
 }
 
 void accept_loop(PsServer* srv) {

@@ -1,0 +1,128 @@
+"""Failure detection / elastic recovery / preemption (parallel/failures.py)
+— the greenfield resilience layer SURVEY.md §5.3 calls for (absent in the
+reference)."""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.failures import (HeartbeatMonitor,
+                                                  PreemptionHandler,
+                                                  WorkerLostError,
+                                                  run_elastic)
+
+
+class TestHeartbeatMonitor:
+    def test_silent_worker_flagged_once(self):
+        failed = []
+        mon = HeartbeatMonitor(timeout=0.15, interval=0.05,
+                               on_failure=failed.append)
+        mon.register("a")
+        mon.register("b")
+        t_end = time.monotonic() + 0.4
+        while time.monotonic() < t_end:
+            mon.beat("a")               # a stays alive; b goes silent
+            time.sleep(0.03)
+            mon.check_once()
+        assert failed == ["b"]
+        assert mon.failed_workers() == ["b"]
+
+    def test_background_thread(self):
+        failed = []
+        mon = HeartbeatMonitor(timeout=0.1, interval=0.03,
+                               on_failure=failed.append).start()
+        mon.register("w")
+        time.sleep(0.35)
+        mon.stop()
+        assert failed == ["w"]
+
+    def test_reregister_clears_failure(self):
+        mon = HeartbeatMonitor(timeout=0.01)
+        mon.register("w")
+        time.sleep(0.05)
+        mon.check_once()
+        assert mon.failed_workers() == ["w"]
+        mon.register("w")
+        assert mon.failed_workers() == []
+
+
+class TestRunElastic:
+    def test_all_healthy(self):
+        out = run_elastic(list(range(10)),
+                          lambda wid, t: t * 2, num_workers=3)
+        assert out == [t * 2 for t in range(10)]
+
+    def test_worker_loss_redistributes(self):
+        died = threading.Event()
+
+        def work(wid, t):
+            if wid == "worker-0" and not died.is_set():
+                died.set()
+                raise WorkerLostError("simulated node loss")
+            time.sleep(0.005)
+            return (wid, t)
+
+        out = run_elastic(list(range(12)), work, num_workers=3)
+        assert [t for _, t in out] == list(range(12))
+        # the dead worker did no completed work after its loss
+        survivors = {wid for wid, _ in out}
+        assert survivors <= {"worker-0", "worker-1", "worker-2"}
+        assert died.is_set()
+
+    def test_all_workers_lost_raises(self):
+        def work(wid, t):
+            raise WorkerLostError("everyone dies")
+
+        with pytest.raises(RuntimeError):
+            run_elastic(list(range(4)), work, num_workers=2,
+                        max_requeues=1)
+
+    def test_task_bug_propagates(self):
+        def work(wid, t):
+            if t == 3:
+                raise ValueError("task bug")
+            return t
+
+        with pytest.raises(ValueError):
+            run_elastic(list(range(6)), work, num_workers=2)
+
+    def test_monitor_integration(self):
+        mon = HeartbeatMonitor(timeout=5.0)
+        run_elastic(list(range(6)), lambda wid, t: t, num_workers=2,
+                    monitor=mon)
+        assert mon.failed_workers() == []
+
+
+class TestPreemptionHandler:
+    def test_sigterm_saves_and_flags(self, tmp_path, rng_np):
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.parallel.multihost import CheckpointManager
+        from deeplearning4j_tpu.ops.dataset import DataSet
+        conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+                .updater("sgd").weight_init("xavier").list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        ckpt = CheckpointManager(tmp_path, interval_seconds=1e9)
+        handler = PreemptionHandler(ckpt, net).install()
+        try:
+            X = rng_np.normal(size=(8, 3)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, 8)]
+            net.fit([DataSet(X, y)])
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.1)
+            assert handler.preempted
+            assert ckpt.latest() is not None
+            restored = ckpt.restore_latest()
+            np.testing.assert_array_equal(restored.params_flat(),
+                                          net.params_flat())
+        finally:
+            handler.uninstall()

@@ -113,3 +113,44 @@ class TestParallelInference:
             t.join()
         for r in results:
             np.testing.assert_allclose(r, expect, rtol=1e-5, atol=1e-6)
+
+
+class TestTsneModule:
+    """t-SNE UI module (reference play/module/tsne): upload word vectors or
+    precomputed coordinates, serve them back for the scatter tab."""
+
+    def test_upload_coords_and_vectors(self):
+        import json
+        import urllib.request
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage
+        srv = UIServer(port=0).attach(InMemoryStatsStorage())
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, json.dumps(payload).encode(),
+                    {"Content-Type": "application/json"})
+                return json.loads(urllib.request.urlopen(req, timeout=10)
+                                  .read())
+
+            # direct coordinates
+            r = post("/tsne/upload", {"labels": ["a", "b"],
+                                      "coords": [[0, 0], [1, 1]]})
+            assert r["count"] == 2
+            got = json.loads(urllib.request.urlopen(
+                base + "/tsne/coords", timeout=10).read())
+            assert got["labels"] == ["a", "b"]
+            # high-dimensional vectors -> server-side t-SNE
+            rng = np.random.default_rng(0)
+            vecs = np.concatenate([rng.normal(0, 0.05, (6, 8)),
+                                   rng.normal(3, 0.05, (6, 8))]).tolist()
+            r = post("/tsne/upload",
+                     {"labels": [f"w{i}" for i in range(12)],
+                      "vectors": vecs})
+            assert r["count"] == 12
+            page = urllib.request.urlopen(base + "/tsne",
+                                          timeout=10).read().decode()
+            assert "t-SNE" in page
+        finally:
+            srv.stop()
