@@ -8,7 +8,6 @@ replacement for the reference's per-pair threaded updates."""
 from __future__ import annotations
 
 import functools
-from collections import defaultdict
 from typing import Dict, Iterable, List, Sequence, Tuple
 
 import jax
@@ -64,23 +63,49 @@ class Glove:
 
     def fit(self, sequences: Sequence[List[str]]):
         self.vocab = VocabConstructor(self.min_word_frequency).build(sequences)
-        cooc: Dict[Tuple[int, int], float] = defaultdict(float)
+        # vectorized co-occurrence counting (the reference's threaded ring
+        # buffers, models/glove/count/): one separator-delimited index
+        # stream, one numpy pass per window offset, sparse aggregation by
+        # flattened (row, col) key
+        V = len(self.vocab)
+        parts: List[np.ndarray] = []
+        sep = np.array([-1], np.int32)
         for seq in sequences:
-            idxs = [self.vocab.index_of(t) for t in seq if t in self.vocab]
-            for i, wi in enumerate(idxs):
-                for off in range(1, self.window + 1):
-                    j = i + off
-                    if j >= len(idxs):
-                        break
-                    inc = 1.0 / off                  # distance weighting
-                    cooc[(wi, idxs[j])] += inc
-                    if self.symmetric:
-                        cooc[(idxs[j], wi)] += inc
-        if not cooc:
+            idxs = np.fromiter(
+                (self.vocab.index_of(t) for t in seq if t in self.vocab),
+                np.int32)
+            if len(idxs):
+                parts.append(idxs)
+                parts.append(sep)
+        if not parts:
             return self
-        rows = np.array([k[0] for k in cooc], np.int32)
-        cols = np.array([k[1] for k in cooc], np.int32)
-        xij = np.array(list(cooc.values()), np.float32)
+        corpus = np.concatenate(parts)
+        seg = np.cumsum(corpus < 0)
+        n = len(corpus)
+        keys: List[np.ndarray] = []
+        vals: List[np.ndarray] = []
+        for off in range(1, self.window + 1):
+            if off >= n:
+                break
+            a, b = corpus[:n - off], corpus[off:]
+            valid = (a >= 0) & (b >= 0) & (seg[:n - off] == seg[off:])
+            ai, bi = a[valid].astype(np.int64), b[valid].astype(np.int64)
+            inc = np.float32(1.0 / off)              # distance weighting
+            keys.append(ai * V + bi)
+            vals.append(np.full(len(ai), inc, np.float32))
+            if self.symmetric:
+                keys.append(bi * V + ai)
+                vals.append(np.full(len(ai), inc, np.float32))
+        key = np.concatenate(keys) if keys else np.zeros(0, np.int64)
+        if not len(key):
+            return self          # no valid window pair in the corpus
+        val = np.concatenate(vals)
+        uniq, inv = np.unique(key, return_inverse=True)
+        acc = np.bincount(inv, weights=val,
+                          minlength=len(uniq)).astype(np.float32)
+        rows = (uniq // V).astype(np.int32)
+        cols = (uniq % V).astype(np.int32)
+        xij = acc
 
         V, D = len(self.vocab), self.vector_length
         rng = np.random.default_rng(self.seed)

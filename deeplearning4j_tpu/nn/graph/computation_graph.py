@@ -112,10 +112,13 @@ class ComputationGraph:
                 y = jnp.maximum(y, 0)
             new_state[fu.bn_name] = nstate
             # plain-walk parity: the add vertex propagates its FIRST input's
-            # mask (which may be the residual branch), and the activation
-            # vertex inherits it
-            masks[fu.act_name] = masks.get(
-                self.conf.vertex_inputs[fu.add_name][0])
+            # mask, and the activation vertex inherits it. The skipped BN
+            # vertex never wrote masks[bn_name], so when it IS the first
+            # input, substitute what the walk would have assigned there
+            # (its own input's mask)
+            first_in = self.conf.vertex_inputs[fu.add_name][0]
+            masks[fu.act_name] = masks.get(fu.bn_input) \
+                if first_in == fu.bn_name else masks.get(first_in)
         acts[fu.act_name] = y
         new_state[fu.act_name] = state[fu.act_name]
 

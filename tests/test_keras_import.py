@@ -213,3 +213,84 @@ class TestTrainedModels:
         preds = np.array([[0.1, 0.7, 0.2]])
         top = labels.decode_predictions(preds, top=2)[0]
         assert [t["label"] for t in top] == ["dog", "newt"]
+
+
+class TestResidualConvImport:
+    """ResNet-style functional import: Conv2D + BatchNormalization + Add +
+    Activation + GlobalAveragePooling2D + Dense softmax — the layer set
+    config #3 ('ResNet-50 via Keras import') exercises, end-to-end from an
+    HDF5 fixture with running BN statistics."""
+
+    def test_residual_block_predictions(self, tmp_path, rng_np):
+        C = 4
+        kern = rng_np.normal(0, 0.3, (3, 3, C, C)).astype(np.float32)
+        gamma = rng_np.uniform(0.5, 1.5, C).astype(np.float32)
+        beta = rng_np.normal(0, 0.1, C).astype(np.float32)
+        mean = rng_np.normal(0, 0.1, C).astype(np.float32)
+        var = rng_np.uniform(0.5, 1.5, C).astype(np.float32)
+        W = rng_np.normal(0, 0.3, (C, 3)).astype(np.float32)
+
+        def node(name):
+            return [[[name, 0, 0, {}]]]
+
+        model_config = {
+            "class_name": "Model",
+            "config": {
+                "name": "resblock",
+                "layers": [
+                    {"class_name": "InputLayer", "name": "inp",
+                     "config": {"name": "inp",
+                                "batch_input_shape": [None, 8, 8, C]},
+                     "inbound_nodes": []},
+                    {"class_name": "Conv2D", "name": "conv",
+                     "config": {"name": "conv", "filters": C,
+                                "kernel_size": [3, 3], "strides": [1, 1],
+                                "padding": "same", "use_bias": False,
+                                "activation": "linear"},
+                     "inbound_nodes": node("inp")},
+                    {"class_name": "BatchNormalization", "name": "bn",
+                     "config": {"name": "bn", "epsilon": 1e-3},
+                     "inbound_nodes": node("conv")},
+                    {"class_name": "Add", "name": "add",
+                     "config": {"name": "add"},
+                     "inbound_nodes": [[["bn", 0, 0, {}],
+                                        ["inp", 0, 0, {}]]]},
+                    {"class_name": "Activation", "name": "relu",
+                     "config": {"name": "relu", "activation": "relu"},
+                     "inbound_nodes": node("add")},
+                    {"class_name": "GlobalAveragePooling2D", "name": "gap",
+                     "config": {"name": "gap"},
+                     "inbound_nodes": node("relu")},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "units": 3,
+                                "activation": "softmax", "use_bias": True},
+                     "inbound_nodes": node("gap")},
+                ],
+                "input_layers": [["inp", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            }}
+        path = tmp_path / "resblock.h5"
+        _write_keras2_h5(path, model_config, {
+            "conv": [("kernel:0", kern)],
+            "bn": [("gamma:0", gamma), ("beta:0", beta),
+                   ("moving_mean:0", mean), ("moving_variance:0", var)],
+            "out": [("kernel:0", W), ("bias:0", np.zeros(3, np.float32))]})
+
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        assert isinstance(net, ComputationGraph)
+        X = rng_np.normal(size=(2, 8, 8, C)).astype(np.float32)
+        got = net.output(X)[0]
+
+        # NumPy reference of the same block (NHWC, SAME conv)
+        import jax.numpy as jnp
+        from jax import lax
+        conv = np.asarray(lax.conv_general_dilated(
+            jnp.asarray(X), jnp.asarray(kern), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        bn = (conv - mean) / np.sqrt(var + 1e-3) * gamma + beta
+        act = np.maximum(bn + X, 0)
+        pooled = act.mean(axis=(1, 2))
+        logits = pooled @ W
+        expect = np.exp(logits - logits.max(-1, keepdims=True))
+        expect /= expect.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, expect, rtol=2e-3, atol=2e-4)
