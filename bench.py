@@ -43,10 +43,8 @@ def main() -> int:
 
     conf = resnet50_conf(num_classes=1000, height=IMG, width=IMG, channels=3,
                          updater="nesterovs", learning_rate=0.1)
+    # init() keeps f32 master params; activations/backprop run bf16 on MXU
     net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
-    # params in f32 for stable updates; activations/backprop run bf16 on MXU
-    net.params = jax.tree_util.tree_map(
-        lambda a: a.astype(jnp.float32), net.params)
 
     rng = np.random.default_rng(0)
     X = rng.normal(size=(BATCH, IMG, IMG, 3)).astype(np.float32)

@@ -331,30 +331,9 @@ class ComputationGraph:
 
     def fit_batch(self, ds):
         self._ensure_init()
-        if isinstance(ds, MultiDataSet):
-            inputs = self._inputs_dict(ds.features)
-            labels = self._labels_dict(ds.labels)
-            imasks = None
-            if ds.features_masks:
-                imasks = {n: None if m is None else
-                          jnp.asarray(m, self.compute_dtype)
-                          for n, m in zip(self.conf.network_inputs,
-                                          ds.features_masks)}
-            lmasks = None
-            if ds.labels_masks:
-                lmasks = {n: None if m is None else
-                          jnp.asarray(m, self.compute_dtype)
-                          for n, m in zip(self.conf.network_outputs,
-                                          ds.labels_masks)}
-        else:
-            inputs = self._inputs_dict(ds.features)
-            labels = self._labels_dict(ds.labels)
-            imasks = None if ds.features_mask is None else \
-                {self.conf.network_inputs[0]:
-                 jnp.asarray(ds.features_mask, self.compute_dtype)}
-            lmasks = None if ds.labels_mask is None else \
-                {self.conf.network_outputs[0]:
-                 jnp.asarray(ds.labels_mask, self.compute_dtype)}
+        inputs = self._inputs_dict(ds.features)
+        labels = self._labels_dict(ds.labels)
+        imasks, lmasks = self._masks_of(ds)
         step = self._jit_cache.get("train")
         if step is None:
             step = jax.jit(self._make_train_step(), donate_argnums=(0, 1, 2))
@@ -369,26 +348,49 @@ class ComputationGraph:
             lst.iteration_done(self, self.iteration)
 
     # --------------------------------------------------------------- scoring
+    def _masks_of(self, ds):
+        """(input_masks, label_masks) dicts from a DataSet/MultiDataSet."""
+        if isinstance(ds, MultiDataSet):
+            imasks = None
+            if ds.features_masks:
+                imasks = {n: None if m is None else
+                          jnp.asarray(m, self.compute_dtype)
+                          for n, m in zip(self.conf.network_inputs,
+                                          ds.features_masks)}
+            lmasks = None
+            if ds.labels_masks:
+                lmasks = {n: None if m is None else
+                          jnp.asarray(m, self.compute_dtype)
+                          for n, m in zip(self.conf.network_outputs,
+                                          ds.labels_masks)}
+            return imasks, lmasks
+        imasks = None if ds.features_mask is None else \
+            {self.conf.network_inputs[0]:
+             jnp.asarray(ds.features_mask, self.compute_dtype)}
+        lmasks = None if ds.labels_mask is None else \
+            {self.conf.network_outputs[0]:
+             jnp.asarray(ds.labels_mask, self.compute_dtype)}
+        return imasks, lmasks
+
     def score(self, ds) -> float:
         self._ensure_init()
-        if isinstance(ds, MultiDataSet):
-            inputs = self._inputs_dict(ds.features)
-            labels = self._labels_dict(ds.labels)
-        else:
-            inputs = self._inputs_dict(ds.features)
-            labels = self._labels_dict(ds.labels)
+        inputs = self._inputs_dict(ds.features)
+        labels = self._labels_dict(ds.labels)
+        imasks, lmasks = self._masks_of(ds)
         loss, _ = self._loss(self.params, self._inference_state(), inputs,
-                             labels, None)
+                             labels, None, label_masks=lmasks,
+                             input_masks=imasks)
         return float(loss)
 
     def compute_gradient_and_score(self, ds):
         self._ensure_init()
         inputs = self._inputs_dict(ds.features)
         labels = self._labels_dict(ds.labels)
+        imasks, lmasks = self._masks_of(ds)
 
         def lf(p):
             return self._loss(p, self._inference_state(), inputs, labels,
-                              None)
+                              None, label_masks=lmasks, input_masks=imasks)
         (score, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
         return grads, float(score)
 

@@ -194,11 +194,16 @@ def compute_loss(name, labels, preoutput, activation="identity", mask=None,
     total = jnp.sum(per_example)
     if not average:
         return total
-    if mask is not None and jnp.ndim(mask) >= 2 and mask.shape[:2] == labels.shape[:2] \
-            and jnp.ndim(labels) > 2:
-        # Time-series mask: average over present timesteps, matching how the
-        # reference scores masked RNN output (MaskedReductionUtil).
-        count = jnp.maximum(jnp.sum(mask), 1.0)
+    if jnp.ndim(labels) > 2:
+        # Time series: average over present (example, timestep) cells — the
+        # masked case counts mask entries (MaskedReductionUtil parity); the
+        # unmasked case is identical to an all-ones mask, so a sequence
+        # padded with masked steps scores the same as its unpadded original
+        if mask is not None and jnp.ndim(mask) >= 2 and \
+                mask.shape[:2] == labels.shape[:2]:
+            count = jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            count = labels.shape[0] * labels.shape[1]
     else:
         count = labels.shape[0]
     return total / count

@@ -21,8 +21,10 @@ FALLBACK = ("the quick brown fox jumps over the lazy dog. "
 def sample(net, it, seed_text="the ", n=120, temperature=0.8):
     rng = np.random.default_rng(0)
     net.rnn_clear_previous_state()
+    # keep only seed characters the corpus vocabulary knows
+    seed_text = "".join(ch for ch in seed_text if ch in it.char_to_idx) \
+        or it.chars[0]
     out = list(seed_text)
-    x = None
     for ch in seed_text:
         x = np.zeros((1, len(it.chars)), np.float32)
         x[0, it.char_to_idx[ch]] = 1

@@ -33,9 +33,9 @@ def main():
     else:
         conf = resnet50_conf(num_classes=1000)
         batch, img, classes = 128 * ndev, 224, 1000
+    # init() keeps master params in f32; the bf16 cast happens inside the
+    # jitted step
     net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
-    net.params = jax.tree_util.tree_map(
-        lambda a: a.astype(jnp.float32), net.params)
     trainer = GraphDataParallelTrainer(net, make_mesh(ndev))
 
     rng = np.random.default_rng(0)

@@ -1,0 +1,132 @@
+"""Variable-length time series + masking invariants (reference
+TestVariableLengthTS / TestVariableLengthTSCG, TestMasking; SURVEY.md §4):
+padding a sequence with masked timesteps must not change the score or the
+parameter gradients, and masked inputs must not affect other timesteps'
+outputs."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM, LSTM,
+                                               RnnOutputLayer)
+from deeplearning4j_tpu.ops.dataset import DataSet
+
+
+def _rnn_net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+            .updater("sgd").weight_init("xavier").list()
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                  activation="softmax"))
+            .set_input_type(InputType.recurrent(3)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=4, t=5, nin=3, nout=2):
+    X = rng.normal(size=(n, t, nin)).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[rng.integers(0, nout, (n, t))]
+    return X, y
+
+
+class TestVariableLengthTS:
+    def test_padding_does_not_change_score(self, rng_np):
+        X, y = _data(rng_np)
+        n, t = X.shape[:2]
+        net = _rnn_net()
+        base = net.score(DataSet(X, y))
+
+        pad = 3
+        Xp = np.concatenate(
+            [X, rng_np.normal(size=(n, pad, X.shape[2])).astype(np.float32)],
+            axis=1)                     # garbage in the padded region
+        yp = np.concatenate([y, np.zeros((n, pad, y.shape[2]), np.float32)],
+                            axis=1)
+        mask = np.concatenate([np.ones((n, t), np.float32),
+                               np.zeros((n, pad), np.float32)], axis=1)
+        padded = net.score(DataSet(Xp, yp, features_mask=mask,
+                                   labels_mask=mask.copy()))
+        assert abs(base - padded) < 1e-5
+
+    def test_padding_does_not_change_gradients(self, rng_np):
+        X, y = _data(rng_np)
+        n, t = X.shape[:2]
+        net = _rnn_net()
+        g_base, _ = net.compute_gradient_and_score(DataSet(X, y))
+
+        pad = 2
+        Xp = np.concatenate(
+            [X, 99.0 * np.ones((n, pad, X.shape[2]), np.float32)], axis=1)
+        yp = np.concatenate([y, np.zeros((n, pad, y.shape[2]), np.float32)],
+                            axis=1)
+        mask = np.concatenate([np.ones((n, t), np.float32),
+                               np.zeros((n, pad), np.float32)], axis=1)
+        g_pad, _ = net.compute_gradient_and_score(
+            DataSet(Xp, yp, features_mask=mask, labels_mask=mask.copy()))
+
+        import jax
+        flat_base = jax.tree_util.tree_leaves(g_base)
+        flat_pad = jax.tree_util.tree_leaves(g_pad)
+        for a, b in zip(flat_base, flat_pad):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_per_example_mask_lengths(self, rng_np):
+        # different valid lengths per example: training must run and the
+        # fully-masked tail of a short example must not contribute to score
+        net = _rnn_net()
+        n, t, nin, nout = 3, 6, 3, 2
+        X = rng_np.normal(size=(n, t, nin)).astype(np.float32)
+        y = np.eye(nout, dtype=np.float32)[rng_np.integers(0, nout, (n, t))]
+        lengths = [6, 4, 2]
+        mask = np.zeros((n, t), np.float32)
+        for i, L in enumerate(lengths):
+            mask[i, :L] = 1
+        ds = DataSet(X, y, features_mask=mask, labels_mask=mask.copy())
+        s0 = net.score(ds)
+        net.fit([ds], num_epochs=3)
+        assert net.score(ds) < s0
+
+        # corrupting only masked positions must leave the score unchanged
+        X2 = X.copy()
+        X2[1, 4:] = 1e3
+        X2[2, 2:] = -1e3
+        ds2 = DataSet(X2, y, features_mask=mask, labels_mask=mask.copy())
+        assert abs(net.score(ds) - net.score(ds2)) < 1e-5
+
+    def test_graph_masking_parity(self, rng_np):
+        # same invariant through the ComputationGraph executor
+        from deeplearning4j_tpu.nn.graph.graph_config import \
+            ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.05)
+             .updater("sgd").weight_init("xavier").graph_builder()
+             .add_inputs("in"))
+        g.add_layer("lstm", GravesLSTM(n_out=5, activation="tanh"), "in")
+        g.add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent",
+                                          activation="softmax"), "lstm")
+        conf = (g.set_outputs("out")
+                .set_input_types(InputType.recurrent(3)).build())
+        net = ComputationGraph(conf).init()
+
+        X, y = _data(rng_np)
+        n, t = X.shape[:2]
+        base = net.score(DataSet(X, y))
+        pad = 2
+        Xp = np.concatenate(
+            [X, 7.0 * np.ones((n, pad, 3), np.float32)], axis=1)
+        yp = np.concatenate([y, np.zeros((n, pad, 2), np.float32)], axis=1)
+        mask = np.concatenate([np.ones((n, t), np.float32),
+                               np.zeros((n, pad), np.float32)], axis=1)
+        padded_ds = DataSet(Xp, yp, features_mask=mask,
+                            labels_mask=mask.copy())
+        assert abs(base - net.score(padded_ds)) < 1e-4
+
+        # gradients too (compute_gradient_and_score must thread the masks)
+        import jax
+        g_base, _ = net.compute_gradient_and_score(DataSet(X, y))
+        g_pad, _ = net.compute_gradient_and_score(padded_ds)
+        for a, b in zip(jax.tree_util.tree_leaves(g_base),
+                        jax.tree_util.tree_leaves(g_pad)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
