@@ -46,7 +46,14 @@ def bn_train_fused(x, gamma, beta, shift_hint, eps):
     condition the one-pass variance (pass the running mean; zeros degrade to
     flax-BN-level conditioning, never worse). Returns ``(y, mean, var)`` with
     mean/var in f32 (biased var, matching ``jnp.var``'s default used by the
-    built-in path)."""
+    built-in path).
+
+    VJP contract: only the cotangent of ``y`` propagates. The returned
+    ``mean``/``var`` exist for running-statistics updates, which are never
+    differentiated — their incoming cotangents are DISCARDED by the custom
+    backward rule (same for :func:`bn_add_act_train_fused`). Do not
+    differentiate through the statistics outputs; gradients would be
+    silently wrong."""
     out, _res = _bn_fwd_impl(x, gamma, beta, shift_hint, eps)
     return out
 
@@ -73,6 +80,8 @@ def _bn_fwd_impl(x, gamma, beta, shift_hint, eps):
 
 
 def _bn_bwd(eps, res, cots):
+    # _dmean/_dvar deliberately discarded — see the VJP contract in the
+    # bn_train_fused docstring (statistics outputs are non-differentiable).
     dy, _dmean, _dvar = cots
     x, gamma, mean, rstd = res
     axes = tuple(range(x.ndim - 1))

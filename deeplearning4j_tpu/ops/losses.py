@@ -194,16 +194,23 @@ def compute_loss(name, labels, preoutput, activation="identity", mask=None,
     total = jnp.sum(per_example)
     if not average:
         return total
-    if jnp.ndim(labels) > 2:
+    if jnp.ndim(labels) == 3:
         # Time series: average over present (example, timestep) cells — the
         # masked case counts mask entries (MaskedReductionUtil parity); the
         # unmasked case is identical to an all-ones mask, so a sequence
-        # padded with masked steps scores the same as its unpadded original
+        # padded with masked steps scores the same as its unpadded original.
+        # DELIBERATE DIVERGENCE from the reference: BaseOutputLayer.java:103
+        # divides by minibatch size only, so its unmasked-RNN gradients are
+        # T× larger than ours for the same config. Padding-invariance of
+        # both score and training gradient is the contract here (pinned by
+        # tests/test_variable_length.py); to reproduce reference dynamics
+        # exactly, scale the learning rate by the sequence length T.
         if mask is not None and jnp.ndim(mask) >= 2 and \
                 mask.shape[:2] == labels.shape[:2]:
             count = jnp.maximum(jnp.sum(mask), 1.0)
         else:
             count = labels.shape[0] * labels.shape[1]
     else:
+        # 2D and ≥4D labels: minibatch-size averaging, reference parity.
         count = labels.shape[0]
     return total / count

@@ -15,6 +15,7 @@ it from Python.
 from __future__ import annotations
 
 import ctypes
+import logging
 import socket
 import struct
 import subprocess
@@ -34,6 +35,11 @@ def _load_lib():
     if _lib is not None:
         return _lib
     if not _LIB_PATH.exists():
+        # The .so is not shipped in the repo (a committed binary can't be
+        # reviewed against its sources) — build it on first use and say so.
+        logging.getLogger(__name__).info(
+            "building native parameter-server library: make -C %s",
+            _NATIVE_DIR)
         try:
             subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
                            capture_output=True, timeout=120)

@@ -160,13 +160,38 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(404)
             self.end_headers()
 
+    MAX_BODY = 64 * 1024 * 1024       # cap accepted POST bodies
+    MAX_TSNE_VECTORS = 200_000        # bound server-side embedding work
+
+    def _read_json_body(self):
+        """Parse the request body as JSON; returns None (and answers 4xx)
+        on oversized/malformed input instead of raising in the handler."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if length < 0 or length > self.MAX_BODY:
+            self.send_response(413)
+            self.end_headers()
+            return None
+        try:
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, UnicodeDecodeError):
+            body = None
+        if not isinstance(body, dict):
+            self.send_response(400)
+            self.end_headers()
+            return None
+        return body
+
     def do_POST(self):
         # remote listener push (reference RemoteReceiverModule /
         # ui-remote-iterationlisteners): POST /remote/receive with a record
         url = urlparse(self.path)
         if url.path == "/remote/receive" and type(self).storage is not None:
-            length = int(self.headers.get("Content-Length", 0))
-            record = json.loads(self.rfile.read(length) or b"{}")
+            record = self._read_json_body()
+            if record is None:
+                return
             if record.get("type") == "init":
                 type(self).storage.put_static_info(record)
             else:
@@ -177,17 +202,27 @@ class _Handler(BaseHTTPRequestHandler):
             # Accepts {"labels", "coords"} directly, or {"labels",
             # "vectors"} — high-dimensional vectors are embedded server-side
             # with Barnes-Hut t-SNE (clustering/tsne.py).
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-            coords = payload.get("coords")
-            if coords is None and payload.get("vectors"):
-                import numpy as np
-                from ..clustering.tsne import Tsne
-                vecs = np.asarray(payload["vectors"], np.float32)
-                tsne = Tsne(n_components=2,
-                            perplexity=min(15.0, max(2.0, len(vecs) / 4)),
-                            n_iter=250)
-                coords = np.asarray(tsne.calculate(vecs)).tolist()
+            payload = self._read_json_body()
+            if payload is None:
+                return
+            try:
+                coords = payload.get("coords")
+                if coords is None and payload.get("vectors"):
+                    import numpy as np
+                    from ..clustering.tsne import Tsne
+                    vecs = np.asarray(payload["vectors"], np.float32)
+                    if vecs.ndim != 2 or len(vecs) > self.MAX_TSNE_VECTORS:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    tsne = Tsne(n_components=2,
+                                perplexity=min(15.0, max(2.0, len(vecs) / 4)),
+                                n_iter=250)
+                    coords = np.asarray(tsne.calculate(vecs)).tolist()
+            except (ValueError, TypeError):
+                self.send_response(400)
+                self.end_headers()
+                return
             type(self).tsne_data = {"labels": payload.get("labels", []),
                                     "coords": coords or []}
             self._json({"ok": True, "count": len(coords or [])})

@@ -13,8 +13,9 @@
 //   - TCP API: a listener thread accepts connections; protocol is
 //     1-byte opcode ('P' push, 'G' get, 'Q' quit) + u64 little-endian byte
 //     length + raw little-endian f32 payload. 'G' answers with an 'R' frame
-//     in the same framing. Malformed/mis-sized frames are dropped, the
-//     connection stays up (push is fire-and-forget, like the reference).
+//     in the same framing. Malformed or mis-sized frames close the
+//     connection (rejected before any allocation, so a hostile peer cannot
+//     force large buffers); well-formed pushes are fire-and-forget.
 //
 // Build: make -C native   (compiled into libdl4jtpu_native.so)
 
@@ -133,7 +134,12 @@ void handle_conn(PsServer* srv, int fd) {
         if (!recv_exact(fd, &op, 1) || !recv_exact(fd, &len, 8)) break;
         if (op == 'Q') break;
         if (op == 'P') {
-            if (len > (1ull << 33) || len % 4 != 0) break;  // insane frame
+            // The parameter vector size is fixed at ps_create: reject any
+            // other length BEFORE allocating — a loopback client could
+            // otherwise force multi-GiB scratch allocations, and a
+            // bad_alloc thrown in this detached handler thread would
+            // std::terminate the whole host process.
+            if (len != (uint64_t)srv->store.params.size() * 4) break;
             scratch.resize(len / 4);
             if (!recv_exact(fd, scratch.data(), len)) break;
             srv->store.push(scratch.data(), (int64_t)(len / 4));
