@@ -122,11 +122,14 @@ class ROCMultiClass:
 
 
 class EvaluationBinary:
-    """Per-column binary accuracy/precision/recall/F1 at threshold 0.5
-    (reference EvaluationBinary)."""
+    """Per-column (multi-label) binary evaluation at a decision threshold
+    (reference EvaluationBinary: per-label tp/fp/tn/fn counters, counts,
+    MCC, FPR/FNR, averages, stats table, merge)."""
 
-    def __init__(self, threshold: float = 0.5):
+    def __init__(self, threshold: float = 0.5,
+                 label_names: Optional[list] = None):
         self.threshold = float(threshold)
+        self.label_names = label_names
         self.tp = None
         self.fp = None
         self.tn = None
@@ -169,3 +172,94 @@ class EvaluationBinary:
     def f1(self, col: int = 0) -> float:
         p, r = self.precision(col), self.recall(col)
         return 2 * p * r / (p + r) if p + r else 0.0
+
+    # ------------------------------------------------ counts + extra metrics
+    def num_labels(self) -> int:
+        return 0 if self.tp is None else len(self.tp)
+
+    def total_count(self, col: int = 0) -> int:
+        """Observations recorded for a label (reference totalCount)."""
+        return int(self.tp[col] + self.fp[col] + self.tn[col] + self.fn[col])
+
+    def true_positives(self, col: int = 0) -> int:
+        return int(self.tp[col])
+
+    def true_negatives(self, col: int = 0) -> int:
+        return int(self.tn[col])
+
+    def false_positives(self, col: int = 0) -> int:
+        return int(self.fp[col])
+
+    def false_negatives(self, col: int = 0) -> int:
+        return int(self.fn[col])
+
+    def false_positive_rate(self, col: int = 0) -> float:
+        d = self.fp[col] + self.tn[col]
+        return self.fp[col] / d if d else 0.0
+
+    def false_negative_rate(self, col: int = 0) -> float:
+        d = self.fn[col] + self.tp[col]
+        return self.fn[col] / d if d else 0.0
+
+    def matthews_correlation(self, col: int = 0) -> float:
+        tp, tn = float(self.tp[col]), float(self.tn[col])
+        fp, fn = float(self.fp[col]), float(self.fn[col])
+        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return float((tp * tn - fp * fn) / denom) if denom else 0.0
+
+    def g_measure(self, col: int = 0) -> float:
+        return float(np.sqrt(self.precision(col) * self.recall(col)))
+
+    def average_accuracy(self) -> float:
+        n = self.num_labels()
+        return float(np.mean([self.accuracy(i) for i in range(n)])) \
+            if n else 0.0
+
+    def average_precision(self) -> float:
+        n = self.num_labels()
+        return float(np.mean([self.precision(i) for i in range(n)])) \
+            if n else 0.0
+
+    def average_recall(self) -> float:
+        n = self.num_labels()
+        return float(np.mean([self.recall(i) for i in range(n)])) if n else 0.0
+
+    def average_f1(self) -> float:
+        n = self.num_labels()
+        return float(np.mean([self.f1(i) for i in range(n)])) if n else 0.0
+
+    def get_label_name(self, col: int) -> str:
+        names = self.label_names
+        return names[col] if names and col < len(names) else f"label_{col}"
+
+    def stats(self) -> str:
+        """Per-label table (reference EvaluationBinary.stats)."""
+        lines = ["================ EvaluationBinary ================",
+                 f" Threshold: {self.threshold}",
+                 " label: count / acc / precision / recall / f1 / mcc"]
+        for i in range(self.num_labels()):
+            lines.append(
+                f"   {self.get_label_name(i):>10}: {self.total_count(i):>7} "
+                f"/ {self.accuracy(i):.4f} / {self.precision(i):.4f} / "
+                f"{self.recall(i):.4f} / {self.f1(i):.4f} / "
+                f"{self.matthews_correlation(i):.4f}")
+        lines.append(f" Average: acc {self.average_accuracy():.4f}, "
+                     f"precision {self.average_precision():.4f}, "
+                     f"recall {self.average_recall():.4f}, "
+                     f"f1 {self.average_f1():.4f}")
+        return "\n".join(lines)
+
+    def merge(self, other: "EvaluationBinary"):
+        if other.tp is None:
+            return self
+        if self.tp is None:
+            self.tp = other.tp.copy()
+            self.fp = other.fp.copy()
+            self.tn = other.tn.copy()
+            self.fn = other.fn.copy()
+        else:
+            self.tp += other.tp
+            self.fp += other.fp
+            self.tn += other.tn
+            self.fn += other.fn
+        return self

@@ -40,6 +40,7 @@ class ComputationGraph:
         self.score_value = float("nan")
         self._jit_cache: Dict = {}
         self._initialized = False
+        self._rnn_state: Optional[Dict[str, Dict]] = None
 
     # ------------------------------------------------------------------ init
     def init(self) -> "ComputationGraph":
@@ -125,10 +126,14 @@ class ComputationGraph:
     # --------------------------------------------------------------- forward
     def _forward(self, params, state, inputs: Dict[str, jnp.ndarray], *,
                  train, rng, input_masks: Optional[Dict] = None,
-                 output_preout: bool = False):
+                 output_preout: bool = False,
+                 initial_rnn: Optional[Dict] = None):
         """Walk topo order. Returns (activations dict, new_state dict, reg).
         With ``output_preout``, output layer vertices contribute their
-        PRE-activation (for fused losses) in a separate dict."""
+        PRE-activation (for fused losses) in a separate dict.
+        ``initial_rnn``: per-vertex rnn carries (graph TBPTT / rnnTimeStep —
+        reference ComputationGraph.java:2010, :1194-analog); a non-empty
+        entry replaces that vertex's state, like the MLN path."""
         acts: Dict[str, jnp.ndarray] = dict(inputs)
         masks: Dict[str, Optional[jnp.ndarray]] = dict(input_masks or {})
         new_state: Dict[str, Dict] = {}
@@ -152,6 +157,9 @@ class ComputationGraph:
             xs = [acts[i] for i in in_names]
             ms = [masks.get(i) for i in in_names]
             vrng = rngmod.for_layer(rng, idx) if rng is not None else None
+            vstate = state[name]
+            if initial_rnn is not None and initial_rnn.get(name):
+                vstate = initial_rnn[name]
             if isinstance(v, LayerVertex):
                 reg = reg + v.layer.reg_penalty(params[name])
             if name in out_set and isinstance(v, LayerVertex) and \
@@ -168,9 +176,9 @@ class ComputationGraph:
                 last_inputs[name] = x
                 masks[name] = m
                 acts[name] = v.layer.activation_fn()(pre)
-                new_state[name] = state[name]
+                new_state[name] = vstate
             else:
-                y, nstate = v.forward(params[name], state[name], xs,
+                y, nstate = v.forward(params[name], vstate, xs,
                                       train=train, rng=vrng, masks=ms)
                 acts[name] = y
                 new_state[name] = nstate
@@ -231,11 +239,13 @@ class ComputationGraph:
             lambda a: a.astype(cd) if a.dtype == jnp.float32 else a, params)
 
     def _loss(self, params, state, inputs, labels: Dict, rng,
-              label_masks: Optional[Dict] = None, input_masks=None):
+              label_masks: Optional[Dict] = None, input_masks=None,
+              initial_rnn=None):
         params = self._cast_params(params)
         acts, new_state, reg, preouts, masks, last_in = self._forward(
             params, state, inputs, train=True, rng=rng,
-            input_masks=input_masks, output_preout=True)
+            input_masks=input_masks, output_preout=True,
+            initial_rnn=initial_rnn)
         score = reg
         for out_name in self.conf.network_outputs:
             v = self.conf.vertices[out_name]
@@ -251,18 +261,19 @@ class ComputationGraph:
                                                   lmask)
         return score, new_state
 
-    def _make_train_step(self):
+    def _make_train_step(self, with_rnn_carry: bool = False):
         conf = self.conf
 
         def train_step(params, upd_state, state, inputs, labels, input_masks,
-                       label_masks, iteration):
+                       label_masks, iteration, initial_rnn):
             rng = rngmod.for_iteration(
                 rngmod.for_purpose(rngmod.root_key(conf.seed), "dropout"),
                 iteration)
 
             def lf(p):
                 return self._loss(p, state, inputs, labels, rng, label_masks,
-                                  input_masks)
+                                  input_masks,
+                                  initial_rnn if with_rnn_carry else None)
 
             (score, new_state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
@@ -329,23 +340,74 @@ class ComputationGraph:
             self.epoch += 1
         return self
 
+    def _get_train_step(self, with_rnn_carry: bool = False):
+        key = ("train", with_rnn_carry)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                self._make_train_step(with_rnn_carry),
+                donate_argnums=(0, 1, 2))
+        return self._jit_cache[key]
+
     def fit_batch(self, ds):
         self._ensure_init()
         inputs = self._inputs_dict(ds.features)
+        if self.conf.backprop_type == "truncated_bptt" and \
+                (self.conf.tbptt_fwd_length or 0) > 0 and \
+                any(v.ndim == 3 for v in inputs.values()):
+            self._fit_tbptt(ds)
+            return
         labels = self._labels_dict(ds.labels)
         imasks, lmasks = self._masks_of(ds)
-        step = self._jit_cache.get("train")
-        if step is None:
-            step = jax.jit(self._make_train_step(), donate_argnums=(0, 1, 2))
-            self._jit_cache["train"] = step
+        step = self._get_train_step(False)
         self.params, self.updater_state, new_states, score = step(
             self.params, self.updater_state, self.state, inputs, labels,
-            imasks, lmasks, self.iteration)
+            imasks, lmasks, self.iteration, {})
         self.state = self._strip_rnn_carry(new_states)
         self.score_value = score  # device scalar; sync deferred to reader
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
+
+    @staticmethod
+    def _slice_time(d: Optional[Dict], start: int, end: int,
+                    min_ndim: int = 3) -> Optional[Dict]:
+        """Slice every time-distributed array in a name→array dict along
+        axis 1. Masks are [N, T] (min_ndim=2); features/labels [N, T, C]."""
+        if d is None:
+            return None
+        return {k: (v if v is None or v.ndim < min_ndim else v[:, start:end])
+                for k, v in d.items()}
+
+    def _fit_tbptt(self, ds):
+        """Graph truncated BPTT (reference ComputationGraph TBPTT path,
+        the doTruncatedBPTT analog of MultiLayerNetwork.java:1194): slide a
+        tbptt_fwd_length window over time, carrying per-vertex RNN state
+        across windows within the minibatch."""
+        inputs = self._inputs_dict(ds.features)
+        labels = self._labels_dict(ds.labels)
+        imasks, lmasks = self._masks_of(ds)
+        t_total = max(v.shape[1] for v in inputs.values() if v.ndim == 3)
+        window = self.conf.tbptt_fwd_length
+        step = self._get_train_step(True)
+        carry: Dict[str, Dict] = {}
+        for start in range(0, t_total, window):
+            end = min(start + window, t_total)
+            self.params, self.updater_state, new_states, score = step(
+                self.params, self.updater_state, self.state,
+                self._slice_time(inputs, start, end),
+                self._slice_time(labels, start, end),
+                self._slice_time(imasks, start, end, min_ndim=2),
+                self._slice_time(lmasks, start, end, min_ndim=2),
+                self.iteration, carry)
+            # carry only RNN h/c into the next window (detached by design)
+            carry = {name: {k: v for k, v in st.items() if k in ("h", "c")}
+                     for name, st in new_states.items()
+                     if isinstance(st, dict) and ("h" in st or "c" in st)}
+            self.state = self._strip_rnn_carry(new_states)
+            self.score_value = score   # device scalar; sync deferred
+            self.iteration += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
 
     # --------------------------------------------------------------- scoring
     def _masks_of(self, ds):
@@ -393,6 +455,60 @@ class ComputationGraph:
                               None, label_masks=lmasks, input_masks=imasks)
         (score, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
         return grads, float(score)
+
+    # ------------------------------------------------------ rnn / stateful
+    def rnn_time_step(self, *features):
+        """Stateful streaming inference (reference
+        ComputationGraph.rnnTimeStep, ComputationGraph.java:2010): each
+        input may be [N, nIn] (single step) or [N, T, nIn]; per-vertex
+        hidden state persists between calls until
+        rnn_clear_previous_state(). Returns a list of output arrays (one
+        per network output), time-squeezed when inputs were single-step."""
+        self._ensure_init()
+        if len(features) == 1:
+            inputs = self._inputs_dict(features[0])
+        else:
+            inputs = self._inputs_dict(list(features))
+        # Only RECURRENT inputs get the single-step [N, nIn] -> [N, 1, nIn]
+        # expansion; a genuinely-2D static input (e.g. feeding a
+        # DuplicateToTimeSeriesVertex) stays 2D, and outputs are
+        # time-squeezed only when a recurrent input was actually expanded.
+        rec_names = set(self.conf.network_inputs)
+        if self.conf.input_types is not None:
+            rec_names = {n for n, t in zip(self.conf.network_inputs,
+                                           self.conf.input_types)
+                         if getattr(t, "kind", None) == "rnn"}
+        squeeze = any(v.ndim == 2 for k, v in inputs.items()
+                      if k in rec_names)
+        inputs = {k: (v[:, None, :] if v.ndim == 2 and k in rec_names else v)
+                  for k, v in inputs.items()}
+        if self._rnn_state is None:
+            self._rnn_state = {}
+        state = {}
+        for name in self.conf.topological_order:
+            carry = self._rnn_state.get(name)
+            if carry:
+                state[name] = {**self.state[name], **carry}
+            else:
+                state[name] = {k: v for k, v in self.state[name].items()
+                               if k not in ("h", "c")} \
+                    if isinstance(self.state[name], dict) \
+                    else self.state[name]
+        acts, new_state, *_ = self._forward(self.params, state, inputs,
+                                            train=False, rng=None)
+        for name, ns in new_state.items():
+            if isinstance(ns, dict) and ("h" in ns or "c" in ns):
+                self._rnn_state[name] = {k: v for k, v in ns.items()
+                                         if k in ("h", "c")}
+        outs = [np.asarray(acts[o]) for o in self.conf.network_outputs]
+        if squeeze:
+            outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
+        return outs
+
+    def rnn_clear_previous_state(self):
+        """Reset streaming rnn state (reference rnnClearPreviousState,
+        ComputationGraph.java:1999)."""
+        self._rnn_state = None
 
     def evaluate(self, data):
         from ...eval.evaluation import Evaluation

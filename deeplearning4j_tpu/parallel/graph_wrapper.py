@@ -36,7 +36,7 @@ class GraphDataParallelTrainer:
 
         def wrapped(params, upd, state, inputs, labels, iteration):
             return step(params, upd, state, inputs, labels, None, None,
-                        iteration)
+                        iteration, {})
 
         self._jit_step = jax.jit(
             wrapped,

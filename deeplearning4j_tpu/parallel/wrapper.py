@@ -160,26 +160,36 @@ class ParallelWrapper:
             avg_upd = self.average_updaters
 
             def round_fn(stacked_params, stacked_upd, stacked_state,
-                         feats, labels, iteration):
+                         feats, labels, fmask, lmask, iteration):
                 # per-device view: strip the leading device axis
                 params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
                 upd = jax.tree_util.tree_map(lambda a: a[0], stacked_upd)
                 state = jax.tree_util.tree_map(lambda a: a[0], stacked_state)
                 feats = feats[:, 0]       # [k, 1, b, ...] -> [k, b, ...]
                 labels = labels[:, 0]
+                # masks ride the scan exactly like feats/labels (None stays
+                # None: it is an empty pytree, so scan/shard_map pass it
+                # through) — ParallelWrapper.java:333 accepts any DataSet,
+                # including padded variable-length RNN batches
+                fmask = None if fmask is None else fmask[:, 0]
+                lmask = None if lmask is None else lmask[:, 0]
                 empty_rnn = [{} for _ in getattr(net, "layers", [])]
+
+                strip = getattr(net, "_strip_rnn_carry", lambda s: s)
 
                 def body(carry, batch):
                     p, u, s, it = carry
-                    f, l = batch
-                    p, u, s, score = step(p, u, s, f, l, None, None, it,
+                    f, l, fm, lm = batch
+                    p, u, s, score = step(p, u, s, f, l, fm, lm, it,
                                           empty_rnn)
-                    return (p, u, s, it + 1.0), score
+                    # each minibatch starts from zero rnn state (fit
+                    # semantics); also keeps the scan carry structure fixed
+                    return (p, u, strip(s), it + 1.0), score
 
                 (params, upd, state, _), scores = lax.scan(
                     body, (params, upd, state,
                            jnp.asarray(iteration, jnp.float32)),
-                    (feats, labels))
+                    (feats, labels, fmask, lmask))
                 # Nd4j.averageAndPropagate analog over ICI:
                 params = lax.pmean(params, "data")
                 if avg_upd:
@@ -193,6 +203,7 @@ class ParallelWrapper:
             self._jit_round = jax.jit(shard_map(
                 round_fn, mesh=mesh,
                 in_specs=(P("data"), P("data"), P("data"),
+                          P(None, "data"), P(None, "data"),
                           P(None, "data"), P(None, "data"), P()),
                 out_specs=(P("data"), P("data"), P("data"), P()),
                 check_vma=False))
@@ -224,24 +235,44 @@ class ParallelWrapper:
         net.state = net._strip_rnn_carry(unstacked) \
             if hasattr(net, "_strip_rnn_carry") else unstacked
 
+    @staticmethod
+    def _stack_masks(masks, ref_arrays):
+        """Stack per-batch masks into [k, global_b, T...]; batches without a
+        mask get all-ones (identical semantics to no mask)."""
+        if all(m is None for m in masks):
+            return None
+        shape_tail = next(m.shape[1:] for m in masks if m is not None)
+        return np.stack([
+            m if m is not None
+            else np.ones((len(ref),) + shape_tail, np.float32)
+            for m, ref in zip(masks, ref_arrays)])
+
     def _run_round(self, batches: List[DataSet]):
         net = self.net
-        if any(b.features_mask is not None or b.labels_mask is not None
-               for b in batches):
-            raise NotImplementedError(
-                "averaging_frequency > 1 does not support mask arrays yet; "
-                "use averaging_frequency=1 (sync DP) for masked sequences")
         k = len(batches)
         n_dev = self.num_workers
-        feats = np.stack([self._pad_to_devices(b)[0] for b in batches])
-        labels = np.stack([self._pad_to_devices(b)[1] for b in batches])
+        padded = [self._pad_to_devices(b) for b in batches]
+        feats = np.stack([p[0] for p in padded])
+        labels = np.stack([p[1] for p in padded])
+        fmask = self._stack_masks([p[2] for p in padded],
+                                  [p[0] for p in padded])
+        lmask = self._stack_masks([p[3] for p in padded],
+                                  [p[1] for p in padded])
         # [k, global_b, ...] -> [k, n_dev, b, ...]
         feats = feats.reshape((k, n_dev, -1) + feats.shape[2:])
         labels = labels.reshape((k, n_dev, -1) + labels.shape[2:])
+        cd = net.compute_dtype
+        if fmask is not None:
+            fmask = jnp.asarray(
+                fmask.reshape((k, n_dev, -1) + fmask.shape[2:]), cd)
+        if lmask is not None:
+            lmask = jnp.asarray(
+                lmask.reshape((k, n_dev, -1) + lmask.shape[2:]), cd)
         sp, su, ss = self._stacked
         sp, su, ss, score = self._jit_round(
             sp, su, ss, jnp.asarray(feats, net.compute_dtype),
-            jnp.asarray(labels, net.compute_dtype), net.iteration)
+            jnp.asarray(labels, net.compute_dtype), fmask, lmask,
+            net.iteration)
         self._stacked = (sp, su, ss)
         net.score_value = score   # device scalar; sync deferred to reader
         net.iteration += k

@@ -375,3 +375,67 @@ class TestNativeParameterServer:
         assert isinstance(pw.server, NativeParameterServer)
         pw.fit(_batches(rng_np, n=8), num_epochs=1)
         assert pw.server.pushes >= 8
+
+
+class TestLocalStepsMaskedDP:
+    """averaging_frequency > 1 with mask arrays (ParallelWrapper.java:333
+    accepts any DataSet, incl. padded variable-length RNN batches)."""
+
+    @staticmethod
+    def _rnn_net(seed=13):
+        from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .learning_rate(0.05).updater("sgd").weight_init("xavier")
+                .list()
+                .layer(LSTM(n_out=6, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(3)).build())
+        return MultiLayerNetwork(conf).init()
+
+    @staticmethod
+    def _rnn_batches(rng, n_batches, b=8, t=6, masked=True):
+        from deeplearning4j_tpu.ops.dataset import DataSet as DS
+        out = []
+        for _ in range(n_batches):
+            X = rng.normal(size=(b, t, 3)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (b, t))]
+            if masked:
+                mask = np.ones((b, t), np.float32)
+                mask[: b // 2, t // 2:] = 0.0      # half the rows are short
+                out.append(DS(X, y, features_mask=mask,
+                              labels_mask=mask.copy()))
+            else:
+                out.append(DS(X, y))
+        return out
+
+    def test_masked_rnn_trains_with_averaging(self, rng_np):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        net = self._rnn_net()
+        pw = (ParallelWrapper.Builder(net).workers(4)
+              .averaging_frequency(2).build())
+        batches = self._rnn_batches(rng_np, 4)
+        s0 = net.score(batches[0])
+        for _ in range(8):
+            pw.fit(batches)
+        assert np.isfinite(float(net.score_value))
+        assert net.score(batches[0]) < s0
+
+    def test_all_ones_mask_matches_unmasked(self, rng_np):
+        """An all-ones mask must train identically to no mask at all."""
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        from deeplearning4j_tpu.ops.dataset import DataSet as DS
+        plain = self._rnn_batches(rng_np, 2, masked=False)
+        ones = [DS(np.asarray(d.features), np.asarray(d.labels),
+                   features_mask=np.ones(d.features.shape[:2], np.float32),
+                   labels_mask=np.ones(d.labels.shape[:2], np.float32))
+                for d in plain]
+        net_a, net_b = self._rnn_net(seed=5), self._rnn_net(seed=5)
+        pw_a = (ParallelWrapper.Builder(net_a).workers(4)
+                .averaging_frequency(2).build())
+        pw_b = (ParallelWrapper.Builder(net_b).workers(4)
+                .averaging_frequency(2).build())
+        pw_a.fit(plain)
+        pw_b.fit(ones)
+        np.testing.assert_allclose(net_a.params_flat(), net_b.params_flat(),
+                                   rtol=1e-6, atol=1e-7)
