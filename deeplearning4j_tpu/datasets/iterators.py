@@ -97,18 +97,34 @@ class AsyncDataSetIterator(DataSetIterator):
     async_supported = False  # don't double-wrap
 
     def __init__(self, source: DataSetIterator, prefetch: int = 2,
-                 device_put: bool = True):
+                 device_put: bool = True, stage_dtype=None):
         self.source = source
         self.prefetch = max(1, int(prefetch))
         self.device_put = device_put
+        # Cast features/labels on the HOST before the transfer (e.g.
+        # bfloat16 when the net computes in bf16): halves host->device
+        # bytes, which is the binding resource on bandwidth-limited
+        # interconnects. Masks stay in their own dtype.
+        self.stage_dtype = stage_dtype
 
-    @staticmethod
-    def _to_device(ds: DataSet) -> DataSet:
+    def _to_device(self, ds: DataSet) -> DataSet:
         try:
             import jax
-            put = lambda a: None if a is None else jax.device_put(a)
-            return DataSet(put(ds.features), put(ds.labels),
-                           put(ds.features_mask), put(ds.labels_mask))
+            import numpy as _np
+            sd = self.stage_dtype
+            if sd is not None:
+                import ml_dtypes  # noqa: F401  (numpy bfloat16 support)
+
+            def put(a, cast):
+                if a is None:
+                    return None
+                if cast and sd is not None:
+                    a = _np.asarray(a).astype(sd)
+                return jax.device_put(a)
+
+            return DataSet(put(ds.features, True), put(ds.labels, True),
+                           put(ds.features_mask, False),
+                           put(ds.labels_mask, False))
         except Exception:
             return ds   # multi-device/odd-backend cases: defer to the step
 

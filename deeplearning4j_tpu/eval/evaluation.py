@@ -36,7 +36,7 @@ class Evaluation:
         the current size arrives (incremental eval; the reference grows its
         ConfusionMatrix dynamically)."""
         if self.confusion is None:
-            self.num_classes = self.num_classes or n
+            self.num_classes = max(self.num_classes or 0, n) or n
             self.confusion = np.zeros((self.num_classes, self.num_classes),
                                       np.int64)
         elif n > self.num_classes:
@@ -98,37 +98,37 @@ class Evaluation:
         """tp count for class c, or a class→count map (reference
         truePositives())."""
         if c is None:
-            return {i: self.true_positives(i) for i in range(self.num_classes)}
+            return {i: self.true_positives(i) for i in range(self.num_classes or 0)}
         return int(self._cm()[c, c])
 
     def true_negatives(self, c: Optional[int] = None):
         if c is None:
-            return {i: self.true_negatives(i) for i in range(self.num_classes)}
+            return {i: self.true_negatives(i) for i in range(self.num_classes or 0)}
         return int(np.sum(self._cm()) - np.sum(self._cm()[c, :])
                    - np.sum(self._cm()[:, c]) + self._cm()[c, c])
 
     def false_positives(self, c: Optional[int] = None):
         if c is None:
             return {i: self.false_positives(i)
-                    for i in range(self.num_classes)}
+                    for i in range(self.num_classes or 0)}
         return int(np.sum(self._cm()[:, c]) - self._cm()[c, c])
 
     def false_negatives(self, c: Optional[int] = None):
         if c is None:
             return {i: self.false_negatives(i)
-                    for i in range(self.num_classes)}
+                    for i in range(self.num_classes or 0)}
         return int(np.sum(self._cm()[c, :]) - self._cm()[c, c])
 
     def positive(self) -> Dict[int, int]:
         """Actual-positive count per class (reference positive())."""
         return {i: int(np.sum(self._cm()[i, :]))
-                for i in range(self.num_classes)}
+                for i in range(self.num_classes or 0)}
 
     def negative(self) -> Dict[int, int]:
         """Actual-negative count per class (reference negative())."""
         tot = int(np.sum(self._cm()))
         return {i: tot - int(np.sum(self._cm()[i, :]))
-                for i in range(self.num_classes)}
+                for i in range(self.num_classes or 0)}
 
     def class_count(self, c: int) -> int:
         """Number of examples whose actual class is c (reference
@@ -156,7 +156,7 @@ class Evaluation:
         if c is not None:
             tp, fp = self.true_positives(c), self.false_positives(c)
             return tp / (tp + fp) if tp + fp else edge_case
-        vals = [self.precision(i) for i in range(self.num_classes)
+        vals = [self.precision(i) for i in range(self.num_classes or 0)
                 if np.sum(self._cm()[:, i]) +
                 np.sum(self._cm()[i, :]) > 0]
         return float(np.mean(vals)) if vals else 0.0
@@ -166,7 +166,7 @@ class Evaluation:
         if c is not None:
             tp, fn = self.true_positives(c), self.false_negatives(c)
             return tp / (tp + fn) if tp + fn else edge_case
-        vals = [self.recall(i) for i in range(self.num_classes)
+        vals = [self.recall(i) for i in range(self.num_classes or 0)
                 if np.sum(self._cm()[i, :]) > 0]
         return float(np.mean(vals)) if vals else 0.0
 
@@ -215,7 +215,7 @@ class Evaluation:
         macro-averaged (reference matthewsCorrelation)."""
         if c is None:
             vals = [self.matthews_correlation(i)
-                    for i in range(self.num_classes)]
+                    for i in range(self.num_classes or 0)]
             return float(np.mean(vals)) if vals else 0.0
         tp = self.true_positives(c)
         tn = self.true_negatives(c)
@@ -255,7 +255,7 @@ class Evaluation:
         lines.append(f" G-measure: {self.g_measure():.4f}")
         if not suppress_warnings and self.confusion is not None:
             never_pred = [self.get_class_label(i)
-                          for i in range(self.num_classes)
+                          for i in range(self.num_classes or 0)
                           if np.sum(self._cm()[:, i]) == 0
                           and np.sum(self._cm()[i, :]) > 0]
             if never_pred:
@@ -280,7 +280,12 @@ class Evaluation:
         if other.confusion is None:
             return self
         self._ensure(other.num_classes)
-        self.confusion += other.confusion
+        oc = other.confusion
+        if oc.shape[0] < self.num_classes:      # pad the smaller operand
+            grown = np.zeros((self.num_classes, self.num_classes), np.int64)
+            grown[:oc.shape[0], :oc.shape[1]] = oc
+            oc = grown
+        self.confusion += oc
         self.total += other.total
         self.top_n_correct += other.top_n_correct
         return self

@@ -280,9 +280,10 @@ class MultiLayerNetwork:
     def _get_train_step(self, with_rnn_carry: bool = False):
         key = ("train", with_rnn_carry)
         if key not in self._jit_cache:
+            from ..ops.platform import train_donate_argnums
             self._jit_cache[key] = jax.jit(
                 self._make_train_step(with_rnn_carry),
-                donate_argnums=(0, 1, 2))
+                donate_argnums=train_donate_argnums())
         return self._jit_cache[key]
 
     def fit(self, data, num_epochs: int = 1):

@@ -48,12 +48,12 @@ t_bwd = timeit(grad, net.params, net.state)
 # full step (non-donating copy so we can re-run on same buffers)
 step = jax.jit(net._make_train_step())
 t_full = timeit(step, net.params, net.updater_state, net.state, inputs, labels,
-                None, None, 0)
+                None, None, 0, {})
 
 # cost analysis of the full step
 try:
     lowered = jax.jit(net._make_train_step()).lower(
-        net.params, net.updater_state, net.state, inputs, labels, None, None, 0)
+        net.params, net.updater_state, net.state, inputs, labels, None, None, 0, {})
     ca = lowered.compile().cost_analysis()
     if isinstance(ca, list):
         ca = ca[0]

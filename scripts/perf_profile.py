@@ -19,7 +19,7 @@ y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, BATCH)], jn
 inputs, labels = {"input": X}, {"fc": y}
 
 step = jax.jit(net._make_train_step())
-args = (net.params, net.updater_state, net.state, inputs, labels, None, None, 0)
+args = (net.params, net.updater_state, net.state, inputs, labels, None, None, 0, {})
 r = step(*args)
 jax.block_until_ready(r[3])
 
