@@ -54,9 +54,27 @@ N_HOST_BATCHES = int(os.environ.get("BENCH_HOST_BATCHES", "8"))
 
 def _build_net():
     import jax.numpy as jnp
-    from deeplearning4j_tpu.models import resnet50_conf
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
+    if os.environ.get("BENCH_FROM_KERAS") in ("1", "true"):
+        # BASELINE config #3 as written: ResNet-50 ARRIVES via Keras HDF5
+        # import (full 224x224 functional graph + weights), then trains
+        # through the imported ComputationGraph
+        import tempfile
+        from deeplearning4j_tpu.keras.export import export_resnet50_keras_h5
+        from deeplearning4j_tpu.keras.importer import KerasModelImport
+        # cache keyed on the parameters baked into the file, so a config or
+        # exporter change can never silently reuse a stale model
+        path = os.path.join(tempfile.gettempdir(),
+                            f"bench_resnet50_{IMG}x{IMG}_c1000_s7_v2.h5")
+        if not os.path.exists(path):
+            export_resnet50_keras_h5(path, num_classes=1000, height=IMG,
+                                     width=IMG, seed=7)
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        net.compute_dtype = jnp.bfloat16
+        return net
+
+    from deeplearning4j_tpu.models import resnet50_conf
     conf = resnet50_conf(num_classes=1000, height=IMG, width=IMG, channels=3,
                          updater="nesterovs", learning_rate=0.1)
     # init() keeps f32 master params; activations/backprop run bf16 on MXU

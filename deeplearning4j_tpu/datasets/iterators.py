@@ -108,23 +108,23 @@ class AsyncDataSetIterator(DataSetIterator):
         self.stage_dtype = stage_dtype
 
     def _to_device(self, ds: DataSet) -> DataSet:
+        sd = self.stage_dtype
+        if sd is not None:
+            # requested staging must not degrade silently: a failure here
+            # would quietly double the transfer bytes the caller asked to
+            # halve, so cast errors surface
+            import numpy as _np
+
+            def cast(a):
+                return None if a is None else _np.asarray(a).astype(sd)
+
+            ds = DataSet(cast(ds.features), cast(ds.labels),
+                         ds.features_mask, ds.labels_mask)
         try:
             import jax
-            import numpy as _np
-            sd = self.stage_dtype
-            if sd is not None:
-                import ml_dtypes  # noqa: F401  (numpy bfloat16 support)
-
-            def put(a, cast):
-                if a is None:
-                    return None
-                if cast and sd is not None:
-                    a = _np.asarray(a).astype(sd)
-                return jax.device_put(a)
-
-            return DataSet(put(ds.features, True), put(ds.labels, True),
-                           put(ds.features_mask, False),
-                           put(ds.labels_mask, False))
+            put = lambda a: None if a is None else jax.device_put(a)
+            return DataSet(put(ds.features), put(ds.labels),
+                           put(ds.features_mask), put(ds.labels_mask))
         except Exception:
             return ds   # multi-device/odd-backend cases: defer to the step
 

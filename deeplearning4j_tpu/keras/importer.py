@@ -60,6 +60,61 @@ class KerasModelImport:
         return net.conf
 
 
+_KERAS_LOSS = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "kullback_leibler_divergence": "kl_divergence",
+    "poisson": "poisson", "hinge": "hinge", "squared_hinge": "squared_hinge",
+    "cosine_proximity": "cosine_proximity",
+}
+
+
+_KERAS_OPTIMIZER = {"adam": "adam", "nadam": "adam", "adamax": "adamax",
+                    "rmsprop": "rmsprop", "adagrad": "adagrad",
+                    "adadelta": "adadelta"}
+
+
+def _training_config(f):
+    """(loss, opts) from the saved compile() config (reference
+    enforceTrainingConfig path: KerasModel reads training_config to recover
+    the output losses and optimizer settings). ``loss`` is a keras loss
+    string or per-output-name dict (resolved per output by
+    :func:`_loss_for`); ``opts`` holds lr/updater/momentum."""
+    tc = _read_json_attr(f, "training_config")
+    if not tc:
+        return None, {}
+    opts = {}
+    opt = tc.get("optimizer_config") or {}
+    cfg = opt.get("config") or {}
+    for key in ("lr", "learning_rate"):
+        if isinstance(cfg.get(key), (int, float)):
+            opts["lr"] = float(cfg[key])
+            break
+    ocls = (opt.get("class_name") or "").lower()
+    if ocls == "sgd":
+        momentum = float(cfg.get("momentum", 0.0) or 0.0)
+        if momentum > 0:
+            opts["updater"] = "nesterovs" if cfg.get("nesterov") \
+                else "nesterovs"   # DL4J's momentum-SGD rule
+            opts["momentum"] = momentum
+        else:
+            opts["updater"] = "sgd"
+    elif ocls in _KERAS_OPTIMIZER:
+        opts["updater"] = _KERAS_OPTIMIZER[ocls]
+    return tc.get("loss"), opts
+
+
+def _loss_for(loss, name: Optional[str]) -> Optional[str]:
+    """Resolve the loss for one output (per-output dicts keyed by name)."""
+    if isinstance(loss, dict):
+        loss = loss.get(name) if name is not None else \
+            next(iter(loss.values()), None)
+    return _KERAS_LOSS.get(loss) if isinstance(loss, str) else None
+
+
 def _import(path, expect: Optional[str], load_weights: bool = True):
     import h5py
     with h5py.File(path, "r") as f:
@@ -69,15 +124,32 @@ def _import(path, expect: Optional[str], load_weights: bool = True):
         cls = model_config.get("class_name")
         if expect and cls != expect:
             raise KerasLayerError(f"Expected {expect} model, got {cls}")
+        loss, opts = _training_config(f)
         if cls == "Sequential":
-            net = _build_sequential(model_config)
+            net = _build_sequential(model_config, loss=loss, opts=opts)
         elif cls in ("Model", "Functional"):
-            net = _build_functional(model_config)
+            net = _build_functional(model_config, loss=loss, opts=opts)
         else:
             raise KerasLayerError(f"Unsupported Keras model class {cls}")
         if load_weights:
             _load_weights(f, net)
     return net
+
+
+def _as_output_layer(converted, loss: str):
+    """Network-output layer + known training loss → loss-bearing layer
+    (the import becomes trainable via fit, like the reference's
+    enforceTrainingConfig import). Dense → OutputLayer; a standalone
+    Activation ending (the Keras-1 Dense-then-Activation idiom) → LossLayer
+    applying the same activation."""
+    from ..nn.conf.layers import (ActivationLayer, DenseLayer, LossLayer,
+                                  OutputLayer)
+    if type(converted) is DenseLayer:
+        return OutputLayer(n_in=converted.n_in, n_out=converted.n_out,
+                           activation=converted.activation, loss=loss)
+    if type(converted) is ActivationLayer:
+        return LossLayer(activation=converted.activation, loss=loss)
+    return converted
 
 
 def _layer_list(model_config) -> List[dict]:
@@ -95,12 +167,24 @@ def _input_type_from_shape(shape) -> InputType:
     return InputType.feed_forward(dims[0] if dims else 0)
 
 
-def _build_sequential(model_config) -> MultiLayerNetwork:
+def _apply_opts(b, opts):
+    if opts.get("lr") is not None:
+        b = b.learning_rate(opts["lr"])
+    if opts.get("updater"):
+        b = b.updater(opts["updater"])
+    if opts.get("momentum") is not None:
+        b = b.momentum(opts["momentum"])
+    return b
+
+
+def _build_sequential(model_config, loss=None, opts=None) -> MultiLayerNetwork:
     layers_cfg = _layer_list(model_config)
-    builder = (NeuralNetConfiguration.Builder().activation("identity")
-               .weight_init("xavier").list())
+    b = _apply_opts(NeuralNetConfiguration.Builder().activation("identity")
+                    .weight_init("xavier"), opts or {})
+    builder = b.list()
     input_type = None
     keras_names: List[Tuple[str, str, int]] = []   # (keras name, class, our idx)
+    collected: List[Tuple[object, str, str]] = []
     idx = 0
     for lc in layers_cfg:
         cls = lc["class_name"]
@@ -116,8 +200,17 @@ def _build_sequential(model_config) -> MultiLayerNetwork:
         if converted is None:
             continue        # shape-only layers (Flatten/Reshape) handled by
             # the auto-preprocessor system
+        collected.append((converted, conf.get("name", cls), cls))
+    mapped_loss = _loss_for(loss, collected[-1][1] if collected else None)
+    if collected and mapped_loss is not None:
+        # promote the LAST converted layer (Dense, or a trailing standalone
+        # Activation — the Keras-1 Dense-then-Activation ending)
+        converted, kname, kcls = collected[-1]
+        collected[-1] = (_as_output_layer(converted, mapped_loss), kname,
+                         kcls)
+    for converted, kname, kcls in collected:
         builder.layer(converted)
-        keras_names.append((conf.get("name", cls), cls, idx))
+        keras_names.append((kname, kcls, idx))
         idx += 1
     if input_type is not None:
         builder.set_input_type(input_type)
@@ -127,11 +220,15 @@ def _build_sequential(model_config) -> MultiLayerNetwork:
     return net
 
 
-def _build_functional(model_config) -> ComputationGraph:
+def _build_functional(model_config, loss=None, opts=None) -> ComputationGraph:
     cfg = model_config["config"]
     layers_cfg = cfg["layers"]
-    g = (NeuralNetConfiguration.Builder().activation("identity")
-         .weight_init("xavier").graph_builder())
+    out_names = set()
+    for o in cfg.get("output_layers", []):
+        out_names.add(o[0] if isinstance(o, (list, tuple)) else o)
+    nb = _apply_opts(NeuralNetConfiguration.Builder().activation("identity")
+                     .weight_init("xavier"), opts or {})
+    g = nb.graph_builder()
     input_names = []
     input_types = []
     keras_names = []
@@ -177,6 +274,10 @@ def _build_functional(model_config) -> ComputationGraph:
                                  preprocessor=CnnToFeedForwardPreProcessor()),
                              *in_names)
             continue
+        if name in out_names:
+            mapped = _loss_for(loss, name)
+            if mapped is not None:
+                converted = _as_output_layer(converted, mapped)
         g.add_layer(name, converted, *in_names)
         keras_names.append((name, cls, name))
     g.add_inputs(*input_names)

@@ -1,0 +1,106 @@
+"""Multi-host end-to-end: two REAL processes wired by jax.distributed
+(Gloo), a global mesh spanning both, one DP training step over it, and
+CheckpointManager save -> kill -> restore-and-continue (the TrainingMaster
+/ preemption-safe-resume path of parallel/multihost.py; reference
+multi-node semantics via BaseSparkTest.java:89 local[n] analog, SURVEY.md
+§5.3/§5.8)."""
+
+import socket
+import subprocess
+import sys
+
+_WORKER = r'''
+import os, sys
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+         if "host_platform" not in f]
+flags.append("--xla_force_host_platform_device_count=2")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); port = sys.argv[2]; ckdir = sys.argv[3]
+phase = sys.argv[4]
+
+from deeplearning4j_tpu.parallel import multihost
+multihost.initialize(coordinator_address="127.0.0.1:" + port,
+                     num_processes=2, process_id=pid)
+assert jax.process_count() == 2
+mesh = multihost.global_mesh()
+
+import numpy as np
+import jax.numpy as jnp
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.multihost import CheckpointManager
+
+def build():
+    conf = (NeuralNetConfiguration.Builder().seed(5).learning_rate(0.1)
+            .updater("sgd").weight_init("xavier").activation("tanh").list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+ck = CheckpointManager(ckdir, interval_seconds=0.0)
+if phase == "resume":
+    net = ck.restore_latest()
+    assert net is not None, "no checkpoint to restore"
+    start_iter = net.iteration
+    assert start_iter >= 3, start_iter
+else:
+    net = build()
+    start_iter = 0
+
+pw = ParallelWrapper.Builder(net).mesh(mesh).build()
+rng = np.random.default_rng(7)
+X = rng.normal(size=(16, 4)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+for _ in range(3):
+    pw.fit([DataSet(X, y)])
+assert np.isfinite(float(net.score_value))
+assert net.iteration == start_iter + 3
+saved = ck.maybe_save(net, force=True)
+assert saved == (jax.process_index() == 0)
+print("WORKER_OK", pid, phase, net.iteration, flush=True)
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_phase(port, ckdir, phase):
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, str(i), str(port), str(ckdir), phase],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append((p.returncode, out))
+    return outs
+
+
+def test_two_process_train_checkpoint_resume(tmp_path):
+    ckdir = tmp_path / "ckpts"
+    # phase 1: fresh two-process cluster trains 3 steps, proc 0 checkpoints
+    outs = _run_phase(_free_port(), ckdir, "fresh")
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+        assert "WORKER_OK" in out, out[-2000:]
+    assert list(ckdir.glob("checkpoint_iter3.zip"))
+
+    # phase 2: the "restarted-after-preemption" cluster restores the
+    # checkpoint on BOTH processes and keeps training from iteration 3
+    outs = _run_phase(_free_port(), ckdir, "resume")
+    for rc, out in outs:
+        assert rc == 0, out[-2000:]
+        assert "WORKER_OK" in out, out[-2000:]
+    assert list(ckdir.glob("checkpoint_iter6.zip"))
