@@ -63,13 +63,15 @@ def _build_net():
         import tempfile
         from deeplearning4j_tpu.keras.export import export_resnet50_keras_h5
         from deeplearning4j_tpu.keras.importer import KerasModelImport
-        # cache keyed on the parameters baked into the file, so a config or
-        # exporter change can never silently reuse a stale model
+        # cache keyed on the baked-in parameters; written atomically so an
+        # interrupted export can never leave a truncated file to be reused
         path = os.path.join(tempfile.gettempdir(),
                             f"bench_resnet50_{IMG}x{IMG}_c1000_s7_v2.h5")
         if not os.path.exists(path):
-            export_resnet50_keras_h5(path, num_classes=1000, height=IMG,
+            tmp = path + f".tmp{os.getpid()}"
+            export_resnet50_keras_h5(tmp, num_classes=1000, height=IMG,
                                      width=IMG, seed=7)
+            os.replace(tmp, path)
         net = KerasModelImport.import_keras_model_and_weights(path)
         net.compute_dtype = jnp.bfloat16
         return net

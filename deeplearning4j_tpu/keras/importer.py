@@ -97,8 +97,9 @@ def _training_config(f):
     if ocls == "sgd":
         momentum = float(cfg.get("momentum", 0.0) or 0.0)
         if momentum > 0:
-            opts["updater"] = "nesterovs" if cfg.get("nesterov") \
-                else "nesterovs"   # DL4J's momentum-SGD rule
+            # both plain heavy-ball and nesterov=True map to "nesterovs" —
+            # it is the reference's only momentum-SGD updater rule
+            opts["updater"] = "nesterovs"
             opts["momentum"] = momentum
         else:
             opts["updater"] = "sgd"

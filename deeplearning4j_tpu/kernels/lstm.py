@@ -161,7 +161,15 @@ def lstm_helper(conf, params, x, h0, c0, mask):
     cell = conf.activation or "tanh"
     peep = (params["pi"], params["pf"], params["po"]) \
         if getattr(conf, "peephole", False) and "pi" in params else None
-    if mask is not None or gate != "sigmoid" or cell != "tanh":
+    # Auto-select (r2 honest measurements, char-RNN 2x512 B64 T128): the
+    # fused kernel wins by ~5% in f32 (12.5 vs 13.1 ms/step) but loses by
+    # ~6% in bf16 (8.6 vs 8.1) — XLA's scan lowering already keeps h/c
+    # resident and fuses the gate math, and in bf16 its layout choices for
+    # the small per-step [B,4H] recurrent matmul beat the kernel's. So:
+    # low-precision inputs take the scan, f32 takes the kernel.
+    # (f64 — gradient-check precision — also takes the scan)
+    if mask is not None or gate != "sigmoid" or cell != "tanh" \
+            or x.dtype != jnp.float32:
         gate_act, cell_act = conf._acts()
         return _lstm_scan(conf, params["W"], params["R"], params["b"], peep,
                           x, h0, c0, mask, gate_act, cell_act)
@@ -175,8 +183,11 @@ def lstm_helper(conf, params, x, h0, c0, mask):
     return jnp.transpose(y_t, (1, 0, 2)), hT, cT
 
 
-def register_lstm_helper(platforms=("tpu", "cpu")) -> None:
+def register_lstm_helper(platforms=("tpu", "axon", "cpu")) -> None:
     """Install the fused kernel behind the layer helper seam (the analog of
-    dropping deeplearning4j-cuda on the classpath)."""
+    dropping deeplearning4j-cuda on the classpath). OPT-IN only: honest r2
+    measurements showed XLA's scan beats this kernel at char-RNN shapes
+    (BASELINE.md), so it is deliberately absent from the lazy default
+    providers in nn/helpers."""
     from ..nn.helpers import register_helper
     register_helper("lstm", lstm_helper, platforms)

@@ -119,10 +119,15 @@ class GravesLSTM(BaseRecurrentLayerConf):
         x = self.maybe_dropout(x, train=train, rng=rng)
         n = x.shape[0]
         h = self.n_out
-        h0 = state.get("h", jnp.zeros((n, h), x.dtype)) if state else \
-            jnp.zeros((n, h), x.dtype)
-        c0 = state.get("c", jnp.zeros((n, h), x.dtype)) if state else \
-            jnp.zeros((n, h), x.dtype)
+        # carries live in the PROMOTED compute dtype (x ⊗ W): with bf16
+        # inputs against f32 master params (stateful rnn_time_step), the
+        # recurrence computes in f32 — zeros/stored carries must match or
+        # the scan carry dtype flips between calls
+        dt = jnp.promote_types(x.dtype, params["W"].dtype)
+        h0 = state.get("h") if state else None
+        c0 = state.get("c") if state else None
+        h0 = jnp.zeros((n, h), dt) if h0 is None else h0.astype(dt)
+        c0 = jnp.zeros((n, h), dt) if c0 is None else c0.astype(dt)
         gate_act, cell_act = self._acts()
         peep = (params["pi"], params["pf"], params["po"]) \
             if self.peephole and "pi" in params else None
