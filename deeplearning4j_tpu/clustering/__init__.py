@@ -3,7 +3,9 @@ clustering/, 33 files: kmeans, kdtree, vptree, quadtree/sptree for t-SNE;
 SURVEY.md §2.3)."""
 
 from .kmeans import KMeansClustering
-from .trees import KDTree, VPTree
+from .trees import KDTree, QuadTree, SpTree, VPTree
 from .tsne import Tsne
+from .bhtsne import BarnesHutTsne
 
-__all__ = ["KMeansClustering", "KDTree", "VPTree", "Tsne"]
+__all__ = ["KMeansClustering", "KDTree", "VPTree", "Tsne",
+           "BarnesHutTsne", "QuadTree", "SpTree"]

@@ -139,3 +139,116 @@ class VPTree:
 
         visit(self.root)
         return sorted([(i, -d) for d, i in heap], key=lambda t: t[1])
+
+
+class _BHCell:
+    """Shared Barnes-Hut cell logic (center-of-mass + theta traversal) for
+    QuadTree (2-D, reference clustering/quadtree/QuadTree.java) and SpTree
+    (d-dim, reference clustering/sptree/SpTree.java). Each cell's com/size
+    cover every point in its subtree; ``compute_non_edge_forces`` walks
+    with the theta criterion accumulating the t-SNE repulsive numerator,
+    exactly BarnesHutTsne.java's tree pass."""
+
+    def __init__(self, center, half, d):
+        self.center = np.asarray(center, np.float64)
+        self.half = float(half)
+        self.d = int(d)
+        self.com = np.zeros(self.d)
+        self.size = 0
+        self.children = None
+        self.point = None
+        self._leaf = True
+
+    @classmethod
+    def build(cls, points: np.ndarray):
+        points = np.asarray(points, np.float64)
+        lo, hi = points.min(0), points.max(0)
+        center = (lo + hi) / 2
+        half = float(max(hi - lo) / 2 + 1e-9)
+        tree = cls(center, half, points.shape[1])
+        for p in points:
+            tree.insert(p)
+        return tree
+
+    def _make_child(self, key):
+        h = self.half / 2
+        center = self.center + h * (np.asarray(key) * 2 - 1)
+        return type(self)(center, h, self.d)
+
+    def _child_for(self, p):
+        key = tuple(int(p[i] >= self.center[i]) for i in range(self.d))
+        if self.children is None:
+            self.children = {}
+        child = self.children.get(key)
+        if child is None:
+            child = self._make_child(key)
+            self.children[key] = child
+        return child
+
+    def insert(self, p):
+        p = np.asarray(p, np.float64)
+        self.com = (self.com * self.size + p) / (self.size + 1)
+        self.size += 1
+        if self._leaf and self.point is None:
+            self.point = p
+            return
+        if self._leaf:
+            if np.allclose(self.point, p):
+                # duplicate point: aggregate in this cell (com/size already
+                # count it) — subdividing forever would never terminate
+                return
+            old = self.point
+            self.point = None
+            self._leaf = False
+            child = self._child_for(old)
+            # every prior point in this cell is a coincident duplicate of
+            # `old` (a distinct point would have subdivided earlier): move
+            # the FULL mass down, not one copy (self.size already counts p)
+            for _ in range(self.size - 1):
+                child.insert(old)
+        self._child_for(p).insert(p)
+
+    def compute_non_edge_forces(self, point, theta: float = 0.5):
+        """(neg_force [d], sum_q) for one point: Barnes-Hut approximation
+        of Σ_j q²(y−y_j) and Σ_j q with q = 1/(1+‖y−y_j‖²), skipping the
+        query point itself."""
+        point = np.asarray(point, np.float64)
+        neg = np.zeros(self.d)
+        sum_q = 0.0
+        stack = [self]
+        while stack:
+            node = stack.pop()
+            if node.size == 0:
+                continue
+            diff = point - node.com
+            d2 = float(diff @ diff)
+            if node._leaf or (node.half * 2) ** 2 < theta * theta * d2:
+                count = node.size
+                # tolerance, not equality: a leaf's running-average com can
+                # drift from the coincident points by an ulp, which must
+                # still be recognized as the query point's own cell
+                if d2 <= 1e-18:
+                    count -= 1          # the query point (or its duplicate)
+                    if count > 0:
+                        sum_q += count  # coincident points: q = 1
+                    continue
+                q = 1.0 / (1.0 + d2)
+                sum_q += count * q
+                neg += count * q * q * diff
+            else:
+                stack.extend(node.children.values())
+        return neg, sum_q
+
+
+class QuadTree(_BHCell):
+    """2-D Barnes-Hut quadtree (reference clustering/quadtree)."""
+
+    @classmethod
+    def build(cls, points: np.ndarray):
+        points = np.asarray(points, np.float64)
+        assert points.shape[1] == 2, "QuadTree is 2-D; use SpTree"
+        return super().build(points)
+
+
+class SpTree(_BHCell):
+    """d-dimensional Barnes-Hut cell tree (reference clustering/sptree)."""

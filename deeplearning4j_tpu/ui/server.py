@@ -161,7 +161,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
 
     MAX_BODY = 64 * 1024 * 1024       # cap accepted POST bodies
-    MAX_TSNE_VECTORS = 200_000        # bound server-side embedding work
+    # bound server-side embedding to what a blocking HTTP handler can serve
+    # interactively; bigger vocabularies should call
+    # clustering.BarnesHutTsne directly and upload coords
+    MAX_TSNE_VECTORS = 20_000
 
     def _read_json_body(self):
         """Parse the request body as JSON; returns None (and answers 4xx)
@@ -209,16 +212,26 @@ class _Handler(BaseHTTPRequestHandler):
                 coords = payload.get("coords")
                 if coords is None and payload.get("vectors"):
                     import numpy as np
-                    from ..clustering.tsne import Tsne
                     vecs = np.asarray(payload["vectors"], np.float32)
                     if vecs.ndim != 2 or len(vecs) > self.MAX_TSNE_VECTORS:
                         self.send_response(400)
                         self.end_headers()
                         return
-                    tsne = Tsne(n_components=2,
-                                perplexity=min(15.0, max(2.0, len(vecs) / 4)),
-                                n_iter=250)
-                    coords = np.asarray(tsne.calculate(vecs)).tolist()
+                    if len(vecs) > 2000:
+                        # real-vocabulary scale: blocked/sampled BH t-SNE
+                        # (never materializes [N, N]; clustering/bhtsne.py)
+                        from ..clustering.bhtsne import BarnesHutTsne
+                        bh = BarnesHutTsne(
+                            perplexity=min(30.0, max(2.0, len(vecs) / 100)),
+                            n_iter=350)
+                        coords = np.asarray(bh.calculate(vecs)).tolist()
+                    else:
+                        from ..clustering.tsne import Tsne
+                        tsne = Tsne(n_components=2,
+                                    perplexity=min(15.0,
+                                                   max(2.0, len(vecs) / 4)),
+                                    n_iter=250)
+                        coords = np.asarray(tsne.calculate(vecs)).tolist()
             except (ValueError, TypeError):
                 self.send_response(400)
                 self.end_headers()

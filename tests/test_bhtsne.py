@@ -1,0 +1,108 @@
+"""Barnes-Hut t-SNE at vocabulary scale + quadtree/sptree substrate
+(reference plot/BarnesHutTsne.java, clustering/quadtree + clustering/sptree
+— the r1 VERDICT gap: 100k word vectors could not embed through the dense
+O(N²) design)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import BarnesHutTsne, QuadTree, SpTree
+from deeplearning4j_tpu.clustering.bhtsne import (_beta_search, _knn_chunked)
+
+
+def _exact_forces(Y, i):
+    diff = Y[i] - Y
+    d2 = (diff ** 2).sum(1)
+    q = 1.0 / (1.0 + d2)
+    q[i] = 0.0
+    return ((q ** 2)[:, None] * diff).sum(0), q.sum()
+
+
+class TestBHTrees:
+    def test_quadtree_theta0_is_exact(self, rng_np):
+        Y = rng_np.normal(size=(300, 2))
+        tree = QuadTree.build(Y)
+        assert tree.size == 300
+        for i in (0, 99, 299):
+            neg, sq = tree.compute_non_edge_forces(Y[i], theta=0.0)
+            eneg, esq = _exact_forces(Y, i)
+            np.testing.assert_allclose(neg, eneg, atol=1e-8)
+            assert abs(sq - esq) < 1e-8
+
+    def test_quadtree_theta_approximates(self, rng_np):
+        Y = rng_np.normal(size=(500, 2))
+        tree = QuadTree.build(Y)
+        neg, sq = tree.compute_non_edge_forces(Y[3], theta=0.5)
+        eneg, esq = _exact_forces(Y, 3)
+        assert abs(sq - esq) / esq < 0.05      # within 5% of exact
+        assert np.linalg.norm(neg - eneg) / \
+            max(np.linalg.norm(eneg), 1e-9) < 0.25
+
+    def test_quadtree_duplicates_terminate(self):
+        Y = np.zeros((10, 2))
+        Y[5:] = 1.0
+        tree = QuadTree.build(Y)
+        assert tree.size == 10
+        neg, sq = tree.compute_non_edge_forces(Y[0], theta=0.0)
+        # 4 coincident others at q=1 + 5 at d2=2 (q=1/3)
+        assert abs(sq - (4 * 1.0 + 5 / 3)) < 1e-8
+
+    def test_sptree_3d_theta0_exact(self, rng_np):
+        Y = rng_np.normal(size=(200, 3))
+        tree = SpTree.build(Y)
+        neg, sq = tree.compute_non_edge_forces(Y[7], theta=0.0)
+        eneg, esq = _exact_forces(Y, 7)
+        np.testing.assert_allclose(neg, eneg, atol=1e-8)
+        assert abs(sq - esq) < 1e-8
+
+
+class TestBarnesHutTsne:
+    @staticmethod
+    def _clusters(rng, n, d=4, k=3, spread=0.5):
+        centers = rng.normal(0, 4, (k, d)).astype(np.float32)
+        labels = rng.integers(0, k, n)
+        X = centers[labels] + rng.normal(0, spread, (n, d)).astype(np.float32)
+        return X, labels
+
+    @staticmethod
+    def _purity(Y, labels, k):
+        ems = np.array([Y[labels == i].mean(0) for i in range(k)])
+        pred = np.argmin(((Y[:, None, :] - ems[None]) ** 2).sum(-1), 1)
+        return (pred == labels).mean()
+
+    def test_exact_path_separates_clusters(self, rng_np):
+        X, labels = self._clusters(rng_np, 400)
+        Y = BarnesHutTsne(perplexity=20, n_iter=400).calculate(X)
+        assert self._purity(Y, labels, 3) > 0.95
+
+    def test_negative_sampling_path_separates_clusters(self, rng_np):
+        X, labels = self._clusters(rng_np, 500)
+        ts = BarnesHutTsne(perplexity=20, n_iter=400, exact_threshold=0,
+                           negative_samples=96)
+        Y = ts.calculate(X)
+        assert self._purity(Y, labels, 3) > 0.8
+
+    def test_large_n_embeds_without_dense_matrix(self, rng_np):
+        """30k x 32d through the sampled path — the shape class the r1
+        dense design could not represent (would need a 3.6 GB [N, N])."""
+        X, labels = self._clusters(rng_np, 30_000, d=32, k=5)
+        ts = BarnesHutTsne(perplexity=30)
+        Y = ts.calculate(X, n_iter=8)          # scale/memory validation
+        assert Y.shape == (30_000, 2)
+        assert np.isfinite(Y).all()
+
+    def test_builder_parity(self):
+        ts = (BarnesHutTsne.Builder().perplexity(12).theta(0.3)
+              .learning_rate(100).set_max_iter(77).build())
+        assert ts.perplexity == 12 and ts.theta == 0.3
+        assert ts.learning_rate == 100 and ts.n_iter == 77
+
+    def test_knn_and_beta_search(self, rng_np):
+        X = rng_np.normal(size=(120, 6)).astype(np.float32)
+        idx, d2 = _knn_chunked(X, 10, chunk=32)
+        assert idx.shape == (120, 10)
+        assert not np.any(idx == np.arange(120)[:, None])   # self dropped
+        # rows hit the target perplexity
+        p = _beta_search(d2, 8.0)
+        h = -np.sum(p * np.log(np.maximum(p, 1e-12)), axis=1)
+        np.testing.assert_allclose(np.exp(h), 8.0, rtol=0.05)
