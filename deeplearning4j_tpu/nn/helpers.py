@@ -28,6 +28,7 @@ _DISABLED: set = set()
 _DEFAULT_PROVIDERS: Dict[str, str] = {
     "batchnorm_train": "deeplearning4j_tpu.kernels.batchnorm",
     "batchnorm_add_act_train": "deeplearning4j_tpu.kernels.batchnorm",
+    "lrn": "deeplearning4j_tpu.kernels.lrn",
     # "lstm" is deliberately NOT a default provider: honest r2 measurements
     # (BASELINE.md) show XLA's scan lowering beats the Pallas kernel at
     # char-RNN shapes in both f32 (11.5 vs 12.5 ms/step) and bf16 (8.0 vs

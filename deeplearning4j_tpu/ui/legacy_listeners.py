@@ -90,26 +90,42 @@ class ConvolutionalIterationListener(IterationListener):
 
     def __init__(self, storage: StatsStorage, sample_input,
                  frequency: int = 10, session_id: str = "conv",
-                 output_dir=None, max_channels: int = 16):
+                 output_dir=None, max_channels: int = 16,
+                 max_layers: int = 4):
         self.storage = storage
         self.sample = np.asarray(sample_input)
         self.frequency = max(1, int(frequency))
         self.session_id = session_id
         self.output_dir = output_dir
         self.max_channels = max_channels
+        # cap layers carrying pixel grids: each grid is tens of KB per
+        # record, and storage backends are append-only
+        self.max_layers = max_layers
 
     def iteration_done(self, model, iteration: int):
         if iteration % self.frequency:
             return
+        import base64
+
+        from .png import activation_grid, to_uint8
+
         acts: List[np.ndarray] = model.feed_forward(self.sample)
         conv_layers = []
         for i, a in enumerate(acts[1:]):
-            if a.ndim == 4:         # [N, H, W, C] conv activation
+            if a.ndim == 4 and len(conv_layers) < self.max_layers:
                 grid = a[0, :, :, :self.max_channels]
-                conv_layers.append({"layer": i,
-                                    "shape": list(a.shape),
-                                    "mean": float(a.mean()),
-                                    "std": float(a.std())})
+                # normalized uint8 strip travels in the record so the web
+                # UI can render the grid as a PNG (the reference drew AWT
+                # image grids server-side)
+                u8 = to_uint8(activation_grid(grid, self.max_channels))
+                conv_layers.append({
+                    "layer": i,
+                    "shape": list(a.shape),
+                    "mean": float(a.mean()),
+                    "std": float(a.std()),
+                    "grid_shape": list(u8.shape),
+                    "grid_b64": base64.b64encode(u8.tobytes()).decode(),
+                })
                 if self.output_dir is not None:
                     from pathlib import Path
                     d = Path(self.output_dir)

@@ -16,6 +16,26 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import urlparse, parse_qs
 
+_CHART_JS = """
+function draw(svgId, xs, ys, cls) {
+  const svg = document.getElementById(svgId);
+  svg.innerHTML = '';
+  if (xs.length < 2) return;
+  const W = svg.clientWidth, H = svg.clientHeight, P = 30;
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const ymin = Math.min(...ys), ymax = Math.max(...ys);
+  const sx = x => P + (x - xmin) / (xmax - xmin || 1) * (W - 2 * P);
+  const sy = y => H - P - (y - ymin) / (ymax - ymin || 1) * (H - 2 * P);
+  const d = 'M' + xs.map((x, i) => sx(x) + ',' + sy(ys[i])).join(' L');
+  svg.innerHTML =
+    `<line class=axis x1=${P} y1=${H - P} x2=${W - P} y2=${H - P}/>` +
+    `<line class=axis x1=${P} y1=${P} x2=${P} y2=${H - P}/>` +
+    `<path class=${cls} d="${d}"/>` +
+    `<text x=${P} y=12 font-size=11>${ymax.toPrecision(4)}</text>` +
+    `<text x=${P} y=${H - P + 14} font-size=11>${ymin.toPrecision(4)}</text>`;
+}
+"""
+
 _PAGE = """<!DOCTYPE html>
 <html><head><title>tpu-dl4j training UI</title>
 <style>
@@ -32,25 +52,7 @@ table{border-collapse:collapse} td,th{border:1px solid #ccc;padding:4px 8px}
 <table id=info></table></div>
 <div class=card><b>Score vs iteration</b><svg id=score></svg></div>
 <div class=card><b>Iterations/sec</b><svg id=rate></svg></div>
-<script>
-function draw(svgId, xs, ys, cls) {
-  const svg = document.getElementById(svgId);
-  svg.innerHTML = '';
-  if (xs.length < 2) return;
-  const W = svg.clientWidth, H = svg.clientHeight, P = 30;
-  const xmin = Math.min(...xs), xmax = Math.max(...xs);
-  const ymin = Math.min(...ys), ymax = Math.max(...ys);
-  const sx = x => P + (x - xmin) / (xmax - xmin || 1) * (W - 2 * P);
-  const sy = y => H - P - (y - ymin) / (ymax - ymin || 1) * (H - 2 * P);
-  let d = 'M' + xs.map((x, i) => sx(x) + ',' + sy(ys[i])).join(' L');
-  svg.innerHTML =
-    `<line class=axis x1=${P} y1=${H - P} x2=${W - P} y2=${H - P}/>` +
-    `<line class=axis x1=${P} y1=${P} x2=${P} y2=${H - P}/>` +
-    `<path class=${cls} d="${d}"/>` +
-    `<text x=${P} y=12 font-size=11>${ymax.toPrecision(4)}</text>` +
-    `<text x=${P} y=${H - P + 14} font-size=11>${ymin.toPrecision(4)}</text>`;
-}
-async function refresh() {
+<script src="/train/chart.js"></script>\n<script>\nasync function refresh() {
   const sessions = await (await fetch('/train/sessions')).json();
   if (!sessions.length) return;
   const s = sessions[sessions.length - 1];
@@ -69,6 +71,86 @@ async function refresh() {
        rated.map(u => u.iterations_per_sec), 'line2');
 }
 refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_MODEL_PAGE = """<!DOCTYPE html>
+<html><head><title>Model graph</title>
+<style>body{font-family:sans-serif;margin:20px;background:#fafafa}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px}
+.layer{display:inline-block;border:1px solid #2b8cbe;border-radius:4px;
+margin:4px;padding:6px 10px;background:#eef6fb;font-size:12px}
+.layer b{display:block} .arrow{color:#999;margin:0 2px}
+table{border-collapse:collapse;font-size:12px}
+td,th{border:1px solid #ccc;padding:3px 8px}</style></head><body>
+<h1>Model</h1><div class=card id=graph></div>
+<div class=card><b>Per-parameter mean |value|</b><table id=mags></table></div>
+<script>
+const esc = s => String(s).replace(/[&<>"']/g, c => ({'&':'&amp;','<':'&lt;',
+  '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+async function refresh(){
+  const sessions = await (await fetch('/train/sessions')).json();
+  if (!sessions.length) return;
+  const s = sessions[sessions.length - 1];
+  const m = await (await fetch('/train/model?session=' + s)).json();
+  if (!m || !m.layers) return;
+  document.getElementById('graph').innerHTML = m.layers.map(l =>
+    `<span class=layer><b>${esc(l.name)}</b>${esc(l.type)}` +
+    `${l.inputs && m.is_graph ? '<br>&larr; ' + esc(l.inputs.join(', '))
+      : ''}</span>` +
+    (m.is_graph ? '' : '<span class=arrow>&rarr;</span>')
+  ).join('');
+  const rows = Object.entries(m.param_mean_magnitudes || {});
+  document.getElementById('mags').innerHTML =
+    '<tr><th>param</th><th>mean |value|</th></tr>' + rows.map(
+      ([k, v]) => `<tr><td>${esc(k)}</td><td>${v.toExponential(3)}</td></tr>`
+    ).join('');
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+
+_SYSTEM_PAGE = """<!DOCTYPE html>
+<html><head><title>System</title>
+<style>body{font-family:sans-serif;margin:20px;background:#fafafa}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px;
+margin:12px 0} svg{width:100%;height:220px}
+.axis{stroke:#999;stroke-width:1}
+.line{fill:none;stroke:#d7301f;stroke-width:1.5}
+.line2{fill:none;stroke:#2b8cbe;stroke-width:1.5}</style></head><body>
+<h1>System</h1>
+<div class=card><b>Process memory (max RSS, MB)</b><svg id=mem></svg></div>
+<div class=card><b>Iterations/sec</b><svg id=rate></svg></div>
+<script src="/train/chart.js"></script>\n<script>\nasync function refresh(){
+  const sessions = await (await fetch('/train/sessions')).json();
+  if (!sessions.length) return;
+  const s = sessions[sessions.length - 1];
+  const sys = await (await fetch('/train/system?session=' + s)).json();
+  draw('mem', sys.iterations, sys.max_rss_mb, 'line');
+  draw('rate', sys.rate_iterations, sys.iterations_per_sec, 'line2');
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+_ACTIVATIONS_PAGE = """<!DOCTYPE html>
+<html><head><title>Convolutional activations</title>
+<style>body{font-family:sans-serif;margin:20px;background:#fafafa}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px;
+margin:12px 0} img{image-rendering:pixelated;border:1px solid #ccc}
+h3{margin:4px 0;font-size:13px}</style></head><body>
+<h1>Convolutional activations</h1><div id=grids></div>
+<script>
+async function refresh(){
+  const d = await (await fetch('/train/activations')).json();
+  if (!d.layers) return;
+  document.getElementById('grids').innerHTML = d.layers.map(l =>
+    `<div class=card><h3>layer ${l.layer} — shape [${l.shape}] ` +
+    `mean ${l.mean.toFixed(3)} std ${l.std.toFixed(3)}</h3>` +
+    `<img src="/train/activations.png?layer=${l.layer}&it=${d.iteration}"` +
+    ` width="${l.grid_shape[1] * 3}">` + `</div>`).join('');
+}
+refresh(); setInterval(refresh, 5000);
 </script></body></html>"""
 
 
@@ -118,6 +200,26 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _html(self, page: str):
+        body = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _latest_conv_record(self):
+        """Most recent 'convolutional' record across sessions (the conv
+        listener uses its own session id)."""
+        storage = type(self).storage
+        if storage is None:
+            return None
+        for session in reversed(storage.list_sessions()):
+            for u in reversed(storage.get_updates(session)):
+                if u.get("type") == "convolutional":
+                    return u
+        return None
+
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
@@ -147,6 +249,110 @@ class _Handler(BaseHTTPRequestHandler):
             ups = storage.get_updates(session) if storage else []
             hists = [u for u in ups if "param_histograms" in u]
             self._json(hists[-1] if hists else {})
+        elif url.path == "/train/model":
+            # model-graph tab data (reference play train module's model
+            # view): layer/vertex boxes from the stored config_json plus
+            # the latest per-parameter magnitudes
+            session = q.get("session", [""])[0]
+            info = storage.get_static_info(session) if storage else None
+            out = {"layers": [], "is_graph": False,
+                   "param_mean_magnitudes": {}}
+            if info and info.get("config_json"):
+                cfg = json.loads(info["config_json"])
+                if "vertices" in cfg:
+                    out["is_graph"] = True
+                    for name in cfg.get("topological_order",
+                                        list(cfg["vertices"])):
+                        v = cfg["vertices"][name]
+                        layer = v.get("layer") or {}
+                        out["layers"].append({
+                            "name": name,
+                            "type": layer.get("@type", v.get("@type", "?")),
+                            "inputs": cfg.get("vertex_inputs",
+                                              {}).get(name, []),
+                        })
+                else:
+                    for i, layer in enumerate(cfg.get("layers", [])):
+                        out["layers"].append({
+                            "name": layer.get("name") or f"layer_{i}",
+                            "type": layer.get("@type", "?"),
+                            "inputs": [],
+                        })
+            ups = storage.get_updates(session) if storage else []
+            for u in reversed(ups):
+                if "param_mean_magnitudes" in u:
+                    out["param_mean_magnitudes"] = \
+                        u["param_mean_magnitudes"]
+                    break
+            self._json(out)
+        elif url.path == "/train/system":
+            # system tab series (reference play train module's system
+            # view): process memory + iteration rate over time
+            session = q.get("session", [""])[0]
+            ups = storage.get_updates(session) if storage else []
+            mem = [(u["iteration"], u["max_rss_mb"]) for u in ups
+                   if "max_rss_mb" in u]
+            rate = [(u["iteration"], u["iterations_per_sec"]) for u in ups
+                    if "iterations_per_sec" in u]
+            self._json({
+                "iterations": [m[0] for m in mem],
+                "max_rss_mb": [m[1] for m in mem],
+                "rate_iterations": [r[0] for r in rate],
+                "iterations_per_sec": [r[1] for r in rate],
+            })
+        elif url.path == "/train/activations":
+            rec = self._latest_conv_record()
+            if rec:
+                # pixels travel via /train/activations.png, not the JSON
+                # poll — strip the base64 payloads
+                rec = dict(rec)
+                rec["layers"] = [{k: v for k, v in l.items()
+                                  if k != "grid_b64"}
+                                 for l in rec.get("layers", [])]
+            self._json(rec if rec else {})
+        elif url.path == "/train/activations.png":
+            import base64
+
+            import numpy as np
+
+            from .png import encode_gray_png
+            rec = self._latest_conv_record()
+            try:
+                layer = int(q.get("layer", ["-1"])[0])
+            except ValueError:
+                self.send_response(400)
+                self.end_headers()
+                return
+            entry = None
+            for lrec in (rec or {}).get("layers", []):
+                if lrec["layer"] == layer or layer < 0:
+                    entry = lrec
+                    break
+            if entry is None or "grid_b64" not in entry:
+                self.send_response(404)
+                self.end_headers()
+                return
+            u8 = np.frombuffer(base64.b64decode(entry["grid_b64"]),
+                               np.uint8).reshape(entry["grid_shape"])
+            body = encode_gray_png(u8)
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/train/chart.js":
+            body = _CHART_JS.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/javascript")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif url.path == "/train/model.html":
+            self._html(_MODEL_PAGE)
+        elif url.path == "/train/system.html":
+            self._html(_SYSTEM_PAGE)
+        elif url.path == "/train/activations.html":
+            self._html(_ACTIVATIONS_PAGE)
         elif url.path == "/tsne":
             body = _TSNE_PAGE.encode()
             self.send_response(200)

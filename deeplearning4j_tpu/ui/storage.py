@@ -90,8 +90,11 @@ class FileStatsStorage(StatsStorage):
         return sorted({r["session"] for r in self._read()})
 
     def get_updates(self, session: str) -> List[Dict]:
+        # every non-static record type (update/histogram/flow/
+        # convolutional) is an update — filtering to 'update' alone
+        # silently hid the legacy listeners' records from the UI tabs
         return [r for r in self._read()
-                if r["session"] == session and r["type"] == "update"]
+                if r["session"] == session and r.get("type") != "init"]
 
     def get_static_info(self, session: str) -> Optional[Dict]:
         for r in self._read():
@@ -135,8 +138,8 @@ class SqliteStatsStorage(StatsStorage):
     def get_updates(self, session: str) -> List[Dict]:
         with self._conn() as c:
             rows = c.execute(
-                "SELECT payload FROM records WHERE session=? AND type="
-                "'update' ORDER BY iteration", (session,)).fetchall()
+                "SELECT payload FROM records WHERE session=? AND type!="
+                "'init' ORDER BY iteration", (session,)).fetchall()
         return [json.loads(r[0]) for r in rows]
 
     def get_static_info(self, session: str) -> Optional[Dict]:

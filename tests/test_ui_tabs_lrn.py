@@ -1,0 +1,132 @@
+"""UI model/system/activations tabs (reference play TrainModule views +
+ConvolutionalIterationListener rendering) and the fused LRN helper
+(reference CudnnLocalResponseNormalizationHelper equivalence pattern)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               LocalResponseNormalization,
+                                               OutputLayer)
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                   UIServer)
+from deeplearning4j_tpu.ui.legacy_listeners import \
+    ConvolutionalIterationListener
+
+
+def _get(base, path):
+    return json.loads(urllib.request.urlopen(base + path, timeout=10).read())
+
+
+class TestUITabs:
+    @pytest.fixture
+    def served(self, rng_np):
+        storage = InMemoryStatsStorage()
+        conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.05)
+                .updater("adam").weight_init("xavier").activation("relu")
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=[3, 3],
+                                        convolution_mode="same"))
+                .layer(DenseLayer(n_out=8))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        X = rng_np.normal(size=(8, 8, 8, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, 8)]
+        net.set_listeners(
+            StatsListener(storage, session_id="tabs",
+                          histograms_frequency=2),
+            ConvolutionalIterationListener(storage, X[:1], frequency=2))
+        net.fit([DataSet(X, y)] * 6)
+        ui = UIServer(port=0)
+        ui.attach(storage)
+        yield f"http://127.0.0.1:{ui.port}"
+
+    def test_model_tab(self, served):
+        m = _get(served, "/train/model?session=tabs")
+        assert [l["type"] for l in m["layers"]] == \
+            ["ConvolutionLayer", "DenseLayer", "OutputLayer"]
+        assert m["param_mean_magnitudes"]       # magnitudes table filled
+        html = urllib.request.urlopen(served + "/train/model.html",
+                                      timeout=10).read().decode()
+        assert "Model" in html
+
+    def test_system_tab(self, served):
+        s = _get(served, "/train/system?session=tabs")
+        assert len(s["iterations"]) >= 1
+        assert all(v > 0 for v in s["max_rss_mb"])
+        assert len(s["rate_iterations"]) == len(s["iterations_per_sec"])
+        html = urllib.request.urlopen(served + "/train/system.html",
+                                      timeout=10).read().decode()
+        assert "System" in html
+
+    def test_activations_tab_and_png(self, served):
+        a = _get(served, "/train/activations")
+        assert a["layers"], a
+        entry = a["layers"][0]
+        assert entry["grid_shape"][0] > 0
+        assert "grid_b64" not in entry     # pixels ship via the PNG, not JSON
+        png = urllib.request.urlopen(
+            served + f"/train/activations.png?layer={entry['layer']}",
+            timeout=10).read()
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+        assert len(png) > 100
+        html = urllib.request.urlopen(served + "/train/activations.html",
+                                      timeout=10).read().decode()
+        assert "activations" in html.lower()
+
+
+class TestLrnHelper:
+    def test_helper_matches_pure_path_forward_and_grad(self, rng_np):
+        """CuDNN-vs-builtin equivalence pattern (SURVEY.md §4) for LRN."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.helpers import (disable_helper,
+                                                   enable_helper, get_helper)
+        layer = LocalResponseNormalization(k=2.0, n=5, alpha=1e-4, beta=0.75)
+        x = jnp.asarray(rng_np.normal(size=(2, 4, 4, 8)), jnp.float32)
+
+        enable_helper("lrn")
+        assert get_helper("lrn") is not None    # default provider loads
+        y_fast, _ = layer.forward({}, {}, x)
+        g_fast = jax.grad(
+            lambda a: jnp.sum(layer.forward({}, {}, a)[0] ** 2))(x)
+
+        disable_helper("lrn")
+        try:
+            y_ref, _ = layer.forward({}, {}, x)
+            g_ref = jax.grad(
+                lambda a: jnp.sum(layer.forward({}, {}, a)[0] ** 2))(x)
+        finally:
+            enable_helper("lrn")
+        np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_ref),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_lrn_in_network_trains(self, rng_np):
+        conf = (NeuralNetConfiguration.Builder().seed(5).learning_rate(0.05)
+                .updater("adam").weight_init("xavier").activation("relu")
+                .list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=[3, 3],
+                                        convolution_mode="same"))
+                .layer(LocalResponseNormalization())
+                .layer(OutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 2)).build())
+        net = MultiLayerNetwork(conf).init()
+        X = rng_np.normal(size=(16, 8, 8, 2)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng_np.integers(0, 3, 16)]
+        ds = DataSet(X, y)
+        s0 = net.score(ds)
+        for _ in range(20):
+            net.fit(ds)
+        assert net.score(ds) < s0
