@@ -15,6 +15,7 @@ from .vectorizers import (BagOfWordsVectorizer, TfidfVectorizer,
                           WordVectorSerializer, StaticWord2Vec)
 from .word2vec_iterator import Word2VecDataSetIterator, WindowDataSetIterator
 from .cjk import JapaneseTokenizerFactory, KoreanTokenizerFactory
+from .lattice import LatticeJapaneseTokenizerFactory
 from .annotators import (Annotation, AnnotatedDocument, SentenceAnnotator,
                          TokenizerAnnotator, PosTagger, StemmerAnnotator,
                          AnnotatorPipeline)
@@ -29,6 +30,7 @@ __all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
            "BagOfWordsVectorizer", "TfidfVectorizer", "WordVectorSerializer",
            "StaticWord2Vec", "Word2VecDataSetIterator",
            "WindowDataSetIterator", "JapaneseTokenizerFactory",
+           "LatticeJapaneseTokenizerFactory",
            "KoreanTokenizerFactory", "Annotation", "AnnotatedDocument",
            "SentenceAnnotator", "TokenizerAnnotator", "PosTagger",
            "StemmerAnnotator", "AnnotatorPipeline", "DistributedWord2Vec"]

@@ -1,0 +1,86 @@
+"""Vendored miniature Japanese morpheme dictionary for the lattice
+tokenizer (nlp/lattice.py) — the role Kuromoji's bundled IPADIC plays in
+the reference (deeplearning4j-nlp-japanese vendors com/atilika/kuromoji,
+6,786 LoC, with a full dictionary). A full IPADIC is hundreds of
+thousands of entries; this ships the high-frequency closed-class
+morphology (particles, auxiliaries, copula and inflection surfaces) plus
+a seed of common open-class words — enough for the Viterbi lattice to
+segment everyday text correctly, while unknown open-class words are
+handled by the char-class unknown-word model. Users can extend via
+``LatticeJapaneseTokenizerFactory(user_entries=[...])``.
+
+Entry: (surface, pos, cost). Lower cost = preferred. POS inventory:
+noun, particle, verb, aux, adj, adv, symbol, pron, suffix.
+"""
+
+# -- closed-class: particles (助詞) ------------------------------------
+PARTICLES = [
+    "は", "が", "を", "に", "で", "と", "の", "も", "へ", "や", "から",
+    "まで", "より", "ね", "よ", "か", "な", "ば", "ので", "のに", "けど",
+    "し", "たり", "ながら", "って", "だけ", "ほど", "くらい", "など",
+    "しか", "でも", "こそ", "さえ",
+]
+
+# -- closed-class: auxiliaries / copula / inflection surfaces ----------
+AUXILIARIES = [
+    "です", "ます", "ました", "ません", "でした", "だ", "だった", "である",
+    "じゃない", "ではない", "ない", "たい", "た", "て", "ている", "ていた",
+    "てる", "られる", "れる", "せる", "させる", "う", "よう", "でしょう",
+    "だろう", "み", "そう", "らしい", "はず", "べき", "い",
+]
+
+# -- pronouns ----------------------------------------------------------
+PRONOUNS = ["私", "僕", "俺", "あなた", "彼", "彼女", "これ", "それ",
+            "あれ", "どれ", "ここ", "そこ", "あそこ", "どこ", "誰", "何"]
+
+# -- common open-class seed (nouns) ------------------------------------
+NOUNS = [
+    "日本", "東京", "大阪", "京都", "学校", "会社", "先生", "学生", "友達",
+    "時間", "今日", "明日", "昨日", "今", "年", "月", "日", "人", "家",
+    "水", "食べ物", "本", "車", "電車", "駅", "道", "店", "仕事", "言葉",
+    "音楽", "映画", "世界", "国", "町", "山", "川", "海", "空", "雨",
+    "天気", "朝", "昼", "夜", "犬", "猫", "魚", "鳥", "花", "木",
+    "すもも", "もも", "うち", "ラーメン", "寿司", "お茶", "ご飯", "パン",
+    "大学", "研究", "科学", "技術", "計算", "機械", "学習", "データ",
+]
+
+# -- common verbs (dictionary + frequent conjugated surfaces) ----------
+VERBS = [
+    "する", "した", "して", "しない", "します", "ある", "あります", "あった",
+    "いる", "います", "いた", "行く", "行った", "行って", "行きます",
+    "来る", "来た", "来て", "見る", "見た", "見て", "聞く", "聞いた",
+    "話す", "話した", "食べる", "食べた", "食べて", "飲む", "飲んだ",
+    "買う", "買った", "読む", "読んだ", "書く", "書いた", "住む", "住んで",
+    "働く", "働いて", "思う", "思った", "言う", "言った", "知る", "知って",
+    "分かる", "分かった", "使う", "使った", "作る", "作った", "学ぶ",
+]
+
+# -- adjectives / adverbs ---------------------------------------------
+ADJECTIVES = ["大きい", "小さい", "新しい", "古い", "良い", "悪い", "高い",
+              "安い", "美味しい", "楽しい", "難しい", "簡単", "綺麗",
+              "早い", "遅い", "多い", "少ない"]
+ADVERBS = ["とても", "少し", "もう", "まだ", "よく", "すぐ", "また",
+           "たくさん", "ちょっと", "いつも", "今度"]
+SUFFIXES = ["さん", "ちゃん", "君", "様", "たち", "的", "者", "員"]
+
+
+def default_entries():
+    """The vendored dictionary as (surface, pos, cost) tuples."""
+    out = []
+    for w in PARTICLES:
+        out.append((w, "particle", 600 + 100 * max(0, 2 - len(w))))
+    for w in AUXILIARIES:
+        out.append((w, "aux", 700))
+    for w in PRONOUNS:
+        out.append((w, "pron", 1200))
+    for w in NOUNS:
+        out.append((w, "noun", max(400, 2400 - 600 * len(w))))
+    for w in VERBS:
+        out.append((w, "verb", max(500, 2400 - 500 * len(w))))
+    for w in ADJECTIVES:
+        out.append((w, "adj", max(500, 2400 - 500 * len(w))))
+    for w in ADVERBS:
+        out.append((w, "adv", 900))
+    for w in SUFFIXES:
+        out.append((w, "suffix", 900))
+    return out

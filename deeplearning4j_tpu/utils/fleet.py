@@ -1,0 +1,253 @@
+"""Worker-fleet provisioning (reference deeplearning4j-aws:
+aws/ec2/Ec2BoxCreator.java — create()/createSpot()/blockTillAllRunning()/
+getHosts()/blowupBoxes() lifecycle over the EC2 API).
+
+The same lifecycle drives pluggable cloud drivers:
+
+- ``Boto3Ec2Driver``: real EC2 via boto3 (import-gated, like
+  S3ObjectStore), the direct Ec2BoxCreator.java:129 analog;
+- ``GcloudTpuDriver``: TPU VMs via the gcloud CLI (the hardware this
+  framework targets), subsuming FleetSpec.render_launch_commands;
+- ``InMemoryDriver``: a faithful state machine (pending → running →
+  terminated) with no cloud behind it — the local[n]-style test double
+  (SURVEY.md §4: distributed semantics without a cluster).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    host: str = ""
+    state: str = "pending"        # pending | running | terminated
+    spot: bool = False
+
+
+class CloudDriver:
+    def launch(self, count: int, spec: dict, spot: bool) -> List[Instance]:
+        raise NotImplementedError
+
+    def describe(self, ids: List[str]) -> List[Instance]:
+        raise NotImplementedError
+
+    def terminate(self, ids: List[str]) -> None:
+        raise NotImplementedError
+
+
+class InMemoryDriver(CloudDriver):
+    """Cloudless state machine: instances become running after
+    ``startup_delay`` seconds (0 = immediately)."""
+
+    def __init__(self, startup_delay: float = 0.0):
+        self.startup_delay = float(startup_delay)
+        self._instances: Dict[str, Instance] = {}
+        self._launched_at: Dict[str, float] = {}
+
+    def launch(self, count, spec, spot):
+        out = []
+        for i in range(count):
+            iid = f"i-{uuid.uuid4().hex[:12]}"
+            inst = Instance(iid, host=f"10.0.0.{len(self._instances) + 1}",
+                            state="pending", spot=spot)
+            self._instances[iid] = inst
+            self._launched_at[iid] = time.monotonic()
+            out.append(inst)
+        return out
+
+    def describe(self, ids):
+        now = time.monotonic()
+        out = []
+        for iid in ids:
+            inst = self._instances[iid]
+            if inst.state == "pending" and \
+                    now - self._launched_at[iid] >= self.startup_delay:
+                inst.state = "running"
+            out.append(inst)
+        return out
+
+    def terminate(self, ids):
+        for iid in ids:
+            self._instances[iid].state = "terminated"
+
+
+class Boto3Ec2Driver(CloudDriver):
+    """Real EC2 (reference Ec2BoxCreator.create / createSpot / blowupBoxes).
+    boto3 is import-gated exactly like S3ObjectStore."""
+
+    def __init__(self, region: Optional[str] = None, **client_kwargs):
+        try:
+            import boto3
+        except ImportError as e:         # pragma: no cover - env without boto3
+            raise ImportError(
+                "boto3 is required for Boto3Ec2Driver; use InMemoryDriver "
+                "for cloudless tests") from e
+        if region:
+            client_kwargs.setdefault("region_name", region)
+        self._ec2 = boto3.client("ec2", **client_kwargs)
+
+    def launch(self, count, spec, spot):       # pragma: no cover - needs AWS
+        kwargs = dict(ImageId=spec["ami_id"], InstanceType=spec["size"],
+                      MinCount=count, MaxCount=count,
+                      SecurityGroupIds=[spec["security_group_id"]],
+                      KeyName=spec["key_pair"])
+        if spot:
+            kwargs["InstanceMarketOptions"] = {"MarketType": "spot"}
+        resp = self._ec2.run_instances(**kwargs)
+        return [Instance(i["InstanceId"], state="pending", spot=spot)
+                for i in resp["Instances"]]
+
+    def describe(self, ids):                   # pragma: no cover - needs AWS
+        resp = self._ec2.describe_instances(InstanceIds=ids)
+        out = []
+        for r in resp["Reservations"]:
+            for i in r["Instances"]:
+                out.append(Instance(
+                    i["InstanceId"],
+                    host=i.get("PublicIpAddress") or
+                    i.get("PrivateIpAddress", ""),
+                    state=i["State"]["Name"]))
+        return out
+
+    def terminate(self, ids):                  # pragma: no cover - needs AWS
+        self._ec2.terminate_instances(InstanceIds=ids)
+
+
+class GcloudTpuDriver(CloudDriver):
+    """TPU-VM fleets via the gcloud CLI (the target hardware; subsumes
+    FleetSpec.render_launch_commands by actually running the commands)."""
+
+    def __init__(self, zone: str = "us-central2-b",
+                 accelerator_type: str = "v5litepod-8",
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 name_prefix: str = "dl4j-tpu-worker", dry_run: bool = False):
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.name_prefix = name_prefix
+        self.dry_run = dry_run
+        self.commands_run: List[str] = []
+
+    def _run(self, cmd: str):
+        self.commands_run.append(cmd)
+        if not self.dry_run:               # pragma: no cover - needs gcloud
+            subprocess.run(cmd.split(), check=True, capture_output=True)
+
+    def launch(self, count, spec, spot):
+        out = []
+        # unique names per launch: a fixed -0..-N scheme collides on the
+        # second launch (create fails; blowup deletes the other fleet)
+        batch = uuid.uuid4().hex[:6]
+        for i in range(count):
+            name = f"{self.name_prefix}-{batch}-{i}"
+            cmd = (f"gcloud compute tpus tpu-vm create {name} "
+                   f"--zone={self.zone} "
+                   f"--accelerator-type={self.accelerator_type} "
+                   f"--version={self.runtime_version}")
+            if spot:
+                cmd += " --spot"
+            self._run(cmd)
+            out.append(Instance(name, host=name,
+                                state="running" if self.dry_run
+                                else "pending", spot=spot))
+        return out
+
+    def describe(self, ids):
+        if self.dry_run:
+            return [Instance(i, host=i, state="running") for i in ids]
+        out = []                           # pragma: no cover - needs gcloud
+        for name in ids:                   # pragma: no cover - needs gcloud
+            r = subprocess.run(
+                ["gcloud", "compute", "tpus", "tpu-vm", "describe", name,
+                 f"--zone={self.zone}", "--format=value(state)"],
+                capture_output=True, text=True)
+            state = r.stdout.strip().lower() if r.returncode == 0 else \
+                "pending"
+            out.append(Instance(
+                name, host=name,
+                state="running" if state == "ready" else state))
+        return out                         # pragma: no cover - needs gcloud
+
+    def terminate(self, ids):
+        for name in ids:
+            self._run(f"gcloud compute tpus tpu-vm delete {name} "
+                      f"--zone={self.zone} --quiet")
+
+
+class Ec2BoxCreator:
+    """Reference-named fleet lifecycle (aws/ec2/Ec2BoxCreator.java):
+
+        creator = Ec2BoxCreator(num_boxes=4, size="c5.xlarge",
+                                security_group_id=..., key_pair=...,
+                                driver=InMemoryDriver())
+        creator.create()                # or create_spot()
+        creator.block_till_all_running()
+        hosts = creator.get_hosts()
+        ...
+        creator.blowup_boxes()          # terminate everything
+    """
+
+    def __init__(self, num_boxes: int, size: str = "c5.xlarge",
+                 security_group_id: str = "", key_pair: str = "",
+                 ami_id: str = "", region: Optional[str] = None,
+                 driver: Optional[CloudDriver] = None):
+        self.num_boxes = int(num_boxes)
+        self.spec = {"size": size, "security_group_id": security_group_id,
+                     "key_pair": key_pair, "ami_id": ami_id}
+        self.region = region
+        self.driver = driver if driver is not None else \
+            Boto3Ec2Driver(region=region)
+        self._boxes: List[Instance] = []
+
+    def set_region(self, region: str):
+        self.region = region
+        return self
+
+    # -- lifecycle (reference method names) ----------------------------
+    def create(self):
+        self._boxes = self.driver.launch(self.num_boxes, self.spec,
+                                         spot=False)
+
+    def create_spot(self):
+        self._boxes = self.driver.launch(self.num_boxes, self.spec,
+                                         spot=True)
+
+    def all_running(self) -> bool:
+        if not self._boxes:
+            return False
+        states = self.driver.describe(self.get_boxes_created())
+        # an empty/partial describe means boxes are unaccounted for, NOT
+        # vacuously running
+        return len(states) == len(self._boxes) and \
+            all(i.state == "running" for i in states)
+
+    def block_till_all_running(self, timeout: float = 300.0,
+                               poll: float = 1.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.all_running():
+                return
+            time.sleep(poll)
+        raise TimeoutError(
+            f"fleet not running within {timeout}s: "
+            f"{[(i.instance_id, i.state) for i in self.driver.describe(self.get_boxes_created())]}")
+
+    def get_boxes_created(self) -> List[str]:
+        return [b.instance_id for b in self._boxes]
+
+    def get_hosts(self) -> List[str]:
+        return [i.host for i in self.driver.describe(
+            self.get_boxes_created())]
+
+    def blowup_boxes(self) -> List[str]:
+        """Terminate every created box (reference blowupBoxes)."""
+        ids = self.get_boxes_created()
+        if ids:
+            self.driver.terminate(ids)
+        return ids

@@ -1,0 +1,54 @@
+"""Fleet provisioning lifecycle (reference aws/ec2/Ec2BoxCreator.java —
+create/createSpot/blockTillAllRunning/getHosts/blowupBoxes), driven
+through the cloudless InMemoryDriver and the gcloud dry-run driver."""
+
+import pytest
+
+from deeplearning4j_tpu.utils.fleet import (Ec2BoxCreator, GcloudTpuDriver,
+                                            InMemoryDriver)
+
+
+class TestEc2BoxCreator:
+    def test_full_lifecycle(self):
+        creator = Ec2BoxCreator(num_boxes=3, size="c5.xlarge",
+                                security_group_id="sg-1", key_pair="kp",
+                                driver=InMemoryDriver())
+        assert not creator.all_running()
+        creator.create()
+        assert len(creator.get_boxes_created()) == 3
+        creator.block_till_all_running(timeout=5, poll=0.05)
+        assert creator.all_running()
+        hosts = creator.get_hosts()
+        assert len(hosts) == 3 and all(h for h in hosts)
+        terminated = creator.blowup_boxes()
+        assert set(terminated) == set(creator.get_boxes_created())
+        assert not creator.all_running()
+
+    def test_spot_and_startup_delay(self):
+        creator = Ec2BoxCreator(num_boxes=2,
+                                driver=InMemoryDriver(startup_delay=0.2))
+        creator.create_spot()
+        assert not creator.all_running()          # still pending
+        creator.block_till_all_running(timeout=5, poll=0.05)
+        assert creator.all_running()
+
+    def test_block_times_out(self):
+        creator = Ec2BoxCreator(num_boxes=1,
+                                driver=InMemoryDriver(startup_delay=60))
+        creator.create()
+        with pytest.raises(TimeoutError):
+            creator.block_till_all_running(timeout=0.3, poll=0.05)
+
+    def test_gcloud_driver_dry_run_renders_commands(self):
+        drv = GcloudTpuDriver(zone="us-central2-b", dry_run=True)
+        creator = Ec2BoxCreator(num_boxes=2, driver=drv)
+        creator.create()
+        creator.block_till_all_running(timeout=2, poll=0.05)
+        assert len(drv.commands_run) == 2
+        assert "tpu-vm create" in drv.commands_run[0]
+        # unique per-launch names: no collision across launches
+        creator2 = Ec2BoxCreator(num_boxes=1, driver=drv)
+        creator2.create()
+        assert len(set(drv.commands_run)) == len(drv.commands_run)
+        creator.blowup_boxes()
+        assert any("delete" in c for c in drv.commands_run)

@@ -1,0 +1,74 @@
+"""Dictionary/lattice Japanese tokenizer (the Kuromoji-class analyzer the
+reference vendors: deeplearning4j-nlp-japanese, com/atilika/kuromoji —
+r1 VERDICT missing item #4: morphological segmentation, not char-class
+approximation)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import LatticeJapaneseTokenizerFactory
+
+
+class TestLatticeTokenizer:
+    def test_classic_garden_path(self):
+        """すもももももももものうち — the canonical lattice test: greedy or
+        char-class segmentation cannot produce this split."""
+        f = LatticeJapaneseTokenizerFactory()
+        assert f.create("すもももももももものうち").get_tokens() == \
+            ["すもも", "も", "もも", "も", "もも", "の", "うち"]
+
+    def test_everyday_sentences(self):
+        f = LatticeJapaneseTokenizerFactory()
+        cases = {
+            "私は東京に住んでいます":
+                ["私", "は", "東京", "に", "住んで", "います"],
+            "東京でラーメンを食べた":
+                ["東京", "で", "ラーメン", "を", "食べた"],
+            "学生が学校で学ぶ": ["学生", "が", "学校", "で", "学ぶ"],
+            "今日はとても良い天気です":
+                ["今日", "は", "とても", "良い", "天気", "です"],
+        }
+        for text, want in cases.items():
+            assert f.create(text).get_tokens() == want, text
+
+    def test_pos_tags(self):
+        f = LatticeJapaneseTokenizerFactory()
+        tagged = f.tokenize_with_pos("私は東京に住んでいます")
+        pos = dict(tagged)
+        assert pos["は"] == "particle"
+        assert pos["東京"] == "noun"
+        assert pos["住んで"] == "verb"
+
+    def test_unknown_words_grouped_by_char_class(self):
+        """Out-of-dictionary words come out as char-class runs, not
+        per-character shrapnel (Kuromoji's unknown-word model)."""
+        f = LatticeJapaneseTokenizerFactory()
+        toks = f.create("ブロックチェーンは技術です").get_tokens()
+        assert "ブロックチェーン" in toks           # unknown katakana run
+        assert toks[-1] == "です" and "は" in toks
+
+    def test_user_entries_extend_dictionary(self):
+        f = LatticeJapaneseTokenizerFactory(
+            user_entries=[("深層学習", "noun", 400)])
+        toks = f.create("深層学習の本を読んだ").get_tokens()
+        assert toks[0] == "深層学習"
+        assert toks[1] == "の"
+
+    def test_word2vec_pipeline_integration(self):
+        """The factory slots into the SequenceVectors pipeline seam."""
+        from deeplearning4j_tpu.nlp import Word2Vec
+        corpus = ["私は東京に住んでいます", "私は学校で学ぶ",
+                  "学生が東京で学ぶ", "先生は学校にいます"] * 8
+        w2v = (Word2Vec.Builder().min_word_frequency(1).layer_size(16)
+               .seed(7).epochs(2).window_size(3)
+               .tokenizer_factory(LatticeJapaneseTokenizerFactory())
+               .iterate(corpus).build())
+        w2v.fit()
+        assert "東京" in w2v.vocab
+        assert "は" in w2v.vocab
+        assert w2v.get_word_vector("東京").shape == (16,)
+
+    def test_nfkc_normalization(self):
+        """Half-width katakana hits the same dictionary entries."""
+        f = LatticeJapaneseTokenizerFactory()
+        toks = f.create("ﾗｰﾒﾝを食べた").get_tokens()
+        assert toks[0] == "ラーメン" and "を" in toks
