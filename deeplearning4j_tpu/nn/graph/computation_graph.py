@@ -495,13 +495,26 @@ class ComputationGraph:
                                if k not in ("h", "c")} \
                     if isinstance(self.state[name], dict) \
                     else self.state[name]
-        acts, new_state, *_ = self._forward(self.params, state, inputs,
-                                            train=False, rng=None)
-        for name, ns in new_state.items():
-            if isinstance(ns, dict) and ("h" in ns or "c" in ns):
-                self._rnn_state[name] = {k: v for k, v in ns.items()
-                                         if k in ("h", "c")}
-        outs = [np.asarray(acts[o]) for o in self.conf.network_outputs]
+        # one jitted program — eager per-vertex dispatch costs seconds per
+        # step through a tunneled device; jax.jit keys on the state pytree
+        # structure, so no-carry and carrying calls each get their trace
+        fn = self._jit_cache.get("rnn_step")
+        if fn is None:
+            def _step(params, state, inputs):
+                acts, new_state, *_ = self._forward(params, state, inputs,
+                                                    train=False, rng=None)
+                carries = {n: {k: v for k, v in ns.items()
+                               if k in ("h", "c")}
+                           for n, ns in new_state.items()
+                           if isinstance(ns, dict)
+                           and ("h" in ns or "c" in ns)}
+                return [acts[o] for o in self.conf.network_outputs], carries
+
+            fn = jax.jit(_step)
+            self._jit_cache["rnn_step"] = fn
+        outs_dev, carries = fn(self.params, state, inputs)
+        self._rnn_state.update(carries)
+        outs = [np.asarray(o) for o in outs_dev]
         if squeeze:
             outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
         return outs

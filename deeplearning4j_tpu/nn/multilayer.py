@@ -445,7 +445,10 @@ class MultiLayerNetwork:
     def rnn_time_step(self, x) -> np.ndarray:
         """Stateful streaming inference (reference rnnTimeStep): x may be
         [N, nIn] (single step) or [N, T, nIn]; hidden state persists between
-        calls until rnn_clear_previous_state()."""
+        calls until rnn_clear_previous_state(). The whole stack runs as ONE
+        jitted program per call — eager per-op dispatch costs seconds per
+        step through a tunneled device (measured 2.36 s/step unjitted vs
+        one dispatch jitted; serving loops live on this)."""
         self._ensure_init()
         x = jnp.asarray(x, self.compute_dtype)
         squeeze = x.ndim == 2
@@ -453,17 +456,29 @@ class MultiLayerNetwork:
             x = x[:, None, :]
         if self._rnn_state is None:
             self._rnn_state = [dict() for _ in self.layers]
-        act = x
-        for i, layer in enumerate(self.layers):
-            pp = self.conf.preprocessor_for(i)
-            if pp is not None:
-                act = pp.pre_process(act)
-            act, nstate = layer.forward(self.params[i],
-                                        self._rnn_state[i] or self.state[i],
-                                        act, train=False, rng=None)
-            if isinstance(layer, BaseRecurrentLayerConf):
-                self._rnn_state[i] = {k: v for k, v in nstate.items()
-                                      if k in ("h", "c")}
+        # jax.jit keys on the argument pytree structure itself, so the
+        # first (no-carry) call and later (h/c-carrying) calls each get
+        # their own trace from ONE cached jit
+        fn = self._jit_cache.get("rnn_step")
+        if fn is None:
+            def _step(params, states, rnn_states, act):
+                new_rnn = []
+                for i, layer in enumerate(self.layers):
+                    pp = self.conf.preprocessor_for(i)
+                    if pp is not None:
+                        act = pp.pre_process(act)
+                    lstate = rnn_states[i] if rnn_states[i] else states[i]
+                    act, nstate = layer.forward(params[i], lstate, act,
+                                                train=False, rng=None)
+                    new_rnn.append(
+                        {k: v for k, v in nstate.items() if k in ("h", "c")}
+                        if isinstance(layer, BaseRecurrentLayerConf) else {})
+                return act, new_rnn
+
+            fn = jax.jit(_step)
+            self._jit_cache["rnn_step"] = fn
+        act, self._rnn_state = fn(self.params, self._inference_state(),
+                                  self._rnn_state, x)
         out = np.asarray(act)
         return out[:, 0] if squeeze and out.ndim == 3 else out
 
