@@ -130,3 +130,32 @@ class TestLrnHelper:
         for _ in range(20):
             net.fit(ds)
         assert net.score(ds) < s0
+
+
+class TestStorageRecordTypes:
+    def test_all_backends_return_non_update_records(self, tmp_path):
+        """File/Sqlite storages must surface histogram/flow/convolutional
+        records from get_updates (the type=='update' filter hid them from
+        every tab on those backends)."""
+        from deeplearning4j_tpu.ui.storage import (FileStatsStorage,
+                                                   InMemoryStatsStorage,
+                                                   SqliteStatsStorage)
+        backends = [InMemoryStatsStorage(),
+                    FileStatsStorage(tmp_path / "s.jsonl"),
+                    SqliteStatsStorage(tmp_path / "s.db")]
+        for st in backends:
+            st.put_static_info({"session": "s", "type": "init",
+                                "iteration": 0})
+            st.put_update({"session": "s", "type": "update", "iteration": 1,
+                           "score": 1.0})
+            st.put_update({"session": "s", "type": "convolutional",
+                           "iteration": 2, "layers": []})
+            st.put_update({"session": "s", "type": "histogram",
+                           "iteration": 3})
+            st.put_update({"session": "s", "type": "flow", "iteration": 4,
+                           "param_counts": []})
+            ups = st.get_updates("s")
+            types = sorted(u["type"] for u in ups)
+            assert types == ["convolutional", "flow", "histogram",
+                             "update"], (type(st).__name__, types)
+            assert st.get_static_info("s")["type"] == "init"
