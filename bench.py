@@ -11,6 +11,9 @@ Modes (BENCH_MODE):
   pipeline host-memory numpy batches fed through AsyncDataSetIterator
            (producer thread overlaps host→device transfer with compute) —
            measures the fit(iterator) path end to end.
+  charrnn  BASELINE config #2: GravesLSTM char-RNN tokens/sec (2x512,
+           vocab 80, batch 64, seq 128, bf16 — the r2-measured fastest
+           RNN dtype).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
@@ -137,7 +140,50 @@ def _pipeline(net) -> float:
     return BATCH * STEPS / (time.perf_counter() - t0)
 
 
+def _charrnn() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import char_rnn_conf
+    from deeplearning4j_tpu.nn import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.dataset import DataSet
+
+    V, B, T = 80, 64, 128
+    # tbptt_length=0 selects the standard (non-TBPTT) batch path
+    conf = char_rnn_conf(vocab_size=V, hidden=512, layers=2, tbptt_length=0)
+    net = MultiLayerNetwork(conf, compute_dtype=jnp.bfloat16).init()
+    rng = np.random.default_rng(0)
+    X = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    y = np.eye(V, dtype=np.float32)[rng.integers(0, V, (B, T))]
+    ds = DataSet(jax.device_put(jnp.asarray(X, jnp.bfloat16)),
+                 jax.device_put(jnp.asarray(y, jnp.bfloat16)))
+    # direct batch path (like _staged): fit(ds) would wrap every call in a
+    # fresh AsyncDataSetIterator, polluting tokens/sec with thread setup
+    for _ in range(WARMUP):
+        net._fit_batch(ds)
+    float(net.score_value)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        net._fit_batch(ds)
+    float(net.score_value)
+    return B * T * STEPS / (time.perf_counter() - t0)
+
+
+CHARRNN_BASELINE = float(
+    os.environ.get("BENCH_CHARRNN_BASELINE", "") or 1_022_705.0)
+
+
 def main() -> int:
+    if MODE == "charrnn":
+        toks = _charrnn()
+        print(json.dumps({
+            "metric": "charrnn_train_tokens_per_sec",
+            "value": round(toks, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(toks / CHARRNN_BASELINE, 4)
+            if CHARRNN_BASELINE > 0 else 1.0,
+        }))
+        return 0
     net = _build_net()
     if MODE == "pipeline":
         imgs_per_sec = _pipeline(net)
