@@ -48,15 +48,27 @@ class ParallelWrapper:
 
     def __init__(self, net, mesh: Optional[Mesh] = None,
                  averaging_frequency: int = 1, average_updaters: bool = True,
-                 prefetch_buffer: int = 2, report_score: bool = True):
+                 prefetch_buffer: int = 2, report_score: bool = True,
+                 gradient_compression: Optional[float] = None):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.average_updaters = average_updaters
         self.prefetch_buffer = prefetch_buffer
         self.report_score = report_score
+        # threshold for encoded delta sharing (EncodedGradientsAccumulator
+        # role — parallel/compression.py); None = dense averaging
+        self.gradient_compression = gradient_compression
+        if gradient_compression is not None and \
+                self.averaging_frequency == 1:
+            raise ValueError(
+                "gradient_compression requires local-steps mode "
+                "(averaging_frequency > 1); synchronous DP all-reduces "
+                "gradients inside GSPMD where threshold encoding does not "
+                "apply")
         self._jit_sync = None
         self._jit_round = None
+        self.last_sent_fraction: Optional[float] = None
         self.listeners: List = []
 
     class Builder:
@@ -66,6 +78,7 @@ class ParallelWrapper:
             self._freq = 1
             self._avg_upd = True
             self._prefetch = 2
+            self._compression = None
 
         def workers(self, n: int):
             self._mesh = make_mesh(n)
@@ -79,6 +92,12 @@ class ParallelWrapper:
             self._freq = int(k)
             return self
 
+        def gradient_compression(self, threshold: float):
+            """Threshold-encoded delta sharing with error feedback (the
+            EncodedGradientsAccumulator role); local-steps mode only."""
+            self._compression = float(threshold)
+            return self
+
         def average_updaters(self, flag: bool):
             self._avg_upd = bool(flag)
             return self
@@ -89,7 +108,8 @@ class ParallelWrapper:
 
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._net, self._mesh, self._freq,
-                                   self._avg_upd, self._prefetch)
+                                   self._avg_upd, self._prefetch,
+                                   gradient_compression=self._compression)
 
     # ------------------------------------------------------------------ fit
     @property
@@ -158,8 +178,10 @@ class ParallelWrapper:
         if self._jit_round is None:
             step = net._make_train_step(False)
             avg_upd = self.average_updaters
+            compress = self.gradient_compression
 
             def round_fn(stacked_params, stacked_upd, stacked_state,
+                         stacked_residual,
                          feats, labels, fmask, lmask, iteration):
                 # per-device view: strip the leading device axis
                 params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
@@ -186,28 +208,63 @@ class ParallelWrapper:
                     # semantics); also keeps the scan carry structure fixed
                     return (p, u, strip(s), it + 1.0), score
 
+                base = params       # identical across replicas at round
+                # start (every round ends replica-synchronized)
                 (params, upd, state, _), scores = lax.scan(
                     body, (params, upd, state,
                            jnp.asarray(iteration, jnp.float32)),
                     (feats, labels, fmask, lmask))
-                # Nd4j.averageAndPropagate analog over ICI:
-                params = lax.pmean(params, "data")
+                residual = jax.tree_util.tree_map(lambda a: a[0],
+                                                  stacked_residual)
+                if compress is not None:
+                    # EncodedGradientsAccumulator role: share the round's
+                    # parameter DELTA threshold-quantized to {-t, 0, +t},
+                    # carry the un-sent remainder per replica, apply the
+                    # replica-mean of the encodings to the shared base
+                    from .compression import sent_fraction, threshold_encode
+                    deltas = jax.tree_util.tree_map(
+                        lambda p, b: p - b, params, base)
+                    enc_res = jax.tree_util.tree_map(
+                        lambda d, r: threshold_encode(d, r, compress),
+                        deltas, residual,
+                        is_leaf=lambda x: isinstance(x, jnp.ndarray))
+                    encoded = jax.tree_util.tree_map(
+                        lambda er: er[0], enc_res,
+                        is_leaf=lambda x: isinstance(x, tuple))
+                    residual = jax.tree_util.tree_map(
+                        lambda er: er[1], enc_res,
+                        is_leaf=lambda x: isinstance(x, tuple))
+                    mean_enc = lax.pmean(encoded, "data")
+                    params = jax.tree_util.tree_map(
+                        lambda b, e: b + e, base, mean_enc)
+                    leaves = jax.tree_util.tree_leaves(encoded)
+                    sent = sum(sent_fraction(l) * l.size for l in leaves) \
+                        / max(sum(l.size for l in leaves), 1)
+                else:
+                    # Nd4j.averageAndPropagate analog over ICI:
+                    params = lax.pmean(params, "data")
+                    sent = jnp.asarray(1.0, jnp.float32)
+                # each replica encoded its own shard: report the mean
+                sent = lax.pmean(sent, "data")
                 if avg_upd:
                     upd = lax.pmean(upd, "data")
                 state = lax.pmean(state, "data")
                 score = lax.pmean(jnp.mean(scores), "data")
                 restack = lambda t: jax.tree_util.tree_map(
                     lambda a: a[None], t)
-                return (restack(params), restack(upd), restack(state), score)
+                return (restack(params), restack(upd), restack(state),
+                        restack(residual), score, sent)
 
             self._jit_round = jax.jit(shard_map(
                 round_fn, mesh=mesh,
-                in_specs=(P("data"), P("data"), P("data"),
+                in_specs=(P("data"), P("data"), P("data"), P("data"),
                           P(None, "data"), P(None, "data"),
                           P(None, "data"), P(None, "data"), P()),
-                out_specs=(P("data"), P("data"), P("data"), P()),
+                out_specs=(P("data"), P("data"), P("data"), P("data"),
+                           P(), P()),
                 check_vma=False))
-            # stack replicas once: [n_dev, ...] per leaf
+            # stack replicas once: [n_dev, ...] per leaf; the residual
+            # (error-feedback carry for compressed sharing) starts at zero
             self._stacked = (
                 jax.tree_util.tree_map(
                     lambda a: jnp.broadcast_to(a, (n_dev,) + a.shape),
@@ -217,7 +274,12 @@ class ParallelWrapper:
                     net.updater_state),
                 jax.tree_util.tree_map(
                     lambda a: jnp.broadcast_to(a, (n_dev,) + a.shape),
-                    net.state))
+                    net.state),
+                # dense mode never touches the residual: an empty pytree
+                # avoids allocating an extra params-sized buffer per device
+                (jax.tree_util.tree_map(
+                    lambda a: jnp.zeros((n_dev,) + a.shape, a.dtype),
+                    net.params) if compress is not None else {}))
 
         buf = []
         for ds in iterator:
@@ -228,7 +290,7 @@ class ParallelWrapper:
         if buf:
             self._run_round(buf)
         # unstack back into the wrapped net
-        sp, su, ss = self._stacked
+        sp, su, ss, _sr = self._stacked
         net.params = jax.tree_util.tree_map(lambda a: a[0], sp)
         net.updater_state = jax.tree_util.tree_map(lambda a: a[0], su)
         unstacked = jax.tree_util.tree_map(lambda a: a[0], ss)
@@ -268,12 +330,13 @@ class ParallelWrapper:
         if lmask is not None:
             lmask = jnp.asarray(
                 lmask.reshape((k, n_dev, -1) + lmask.shape[2:]), cd)
-        sp, su, ss = self._stacked
-        sp, su, ss, score = self._jit_round(
-            sp, su, ss, jnp.asarray(feats, net.compute_dtype),
+        sp, su, ss, sr = self._stacked
+        sp, su, ss, sr, score, sent = self._jit_round(
+            sp, su, ss, sr, jnp.asarray(feats, net.compute_dtype),
             jnp.asarray(labels, net.compute_dtype), fmask, lmask,
             net.iteration)
-        self._stacked = (sp, su, ss)
+        self._stacked = (sp, su, ss, sr)
+        self.last_sent_fraction = sent    # device scalar (1.0 when dense)
         net.score_value = score   # device scalar; sync deferred to reader
         net.iteration += k
         for lst in net.listeners:

@@ -439,3 +439,56 @@ class TestLocalStepsMaskedDP:
         pw_b.fit(ones)
         np.testing.assert_allclose(net_a.params_flat(), net_b.params_flat(),
                                    rtol=1e-6, atol=1e-7)
+
+
+class TestEncodedGradientSharing:
+    """Threshold-encoded delta sharing with error feedback — the
+    EncodedGradientsAccumulator role (parallel/compression.py)."""
+
+    def test_encode_is_lossless_bookkeeping(self, rng_np):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.parallel.compression import (sent_fraction,
+                                                             threshold_encode)
+        v = jnp.asarray(rng_np.normal(0, 0.01, (1000,)).astype(np.float32))
+        r = jnp.zeros_like(v)
+        enc, new_r = threshold_encode(v, r, 0.02)
+        np.testing.assert_allclose(np.asarray(enc + new_r), np.asarray(v),
+                                   rtol=1e-6)
+        nz = np.abs(np.asarray(enc))
+        nz = nz[nz > 0]
+        assert nz.size and np.allclose(nz, 0.02)   # every sent element = ±t
+        assert float(sent_fraction(enc)) < 0.5     # most elements held back
+
+    def test_error_feedback_accumulates_small_updates(self, rng_np):
+        """With a threshold larger than one round's deltas, nothing may be
+        sent at first — but the residual carries, accumulates past the
+        threshold, and the parameters still move (the property that makes
+        threshold encoding lossless over time rather than lossy)."""
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        net = _net(seed=11, lr=0.05)
+        p0 = net.params_flat().copy()
+        pw = (ParallelWrapper.Builder(net).workers(4)
+              .averaging_frequency(2).gradient_compression(0.05).build())
+        batches = _batches(rng_np, 4)
+        for _ in range(20):
+            pw.fit(batches)
+        moved = np.abs(net.params_flat() - p0).max()
+        # the replica-mean of +-threshold encodings moves parameters in
+        # multiples of threshold/n_replicas
+        quantum = 0.05 / 4
+        assert moved >= quantum - 1e-6
+        deltas = (net.params_flat() - p0) / quantum
+        np.testing.assert_allclose(deltas, np.round(deltas), atol=1e-3)
+
+    def test_compressed_training_converges(self, rng_np):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        net = _net(seed=3, lr=0.2)
+        pw = (ParallelWrapper.Builder(net).workers(4)
+              .averaging_frequency(2).gradient_compression(1e-3).build())
+        batches = _batches(rng_np, 4)
+        s0 = net.score(batches[0])
+        for _ in range(15):
+            pw.fit(batches)
+        assert net.score(batches[0]) < s0
+        frac = float(pw.last_sent_fraction)
+        assert 0.0 < frac < 1.0        # genuinely sparse sharing happened
