@@ -77,6 +77,14 @@ class Evaluation:
                 keep = np.asarray(mask).reshape(n * t) > 0
                 labels = labels[keep]
                 predictions = predictions[keep]
+        elif mask is not None:
+            # per-example mask on [N, C] labels (e.g. zero-weight padded
+            # rows): masked rows are excluded, same contract as the loss
+            m = np.asarray(mask).reshape(-1)
+            if m.shape[0] == labels.shape[0]:
+                keep = m > 0
+                labels = labels[keep]
+                predictions = predictions[keep]
         self._ensure(labels.shape[-1])
         actual = np.argmax(labels, axis=-1)
         pred = np.argmax(predictions, axis=-1)

@@ -207,10 +207,22 @@ def compute_loss(name, labels, preoutput, activation="identity", mask=None,
         # exactly, scale the learning rate by the sequence length T.
         if mask is not None and jnp.ndim(mask) >= 2 and \
                 mask.shape[:2] == labels.shape[:2]:
-            count = jnp.maximum(jnp.sum(mask), 1.0)
+            # Count in f32: a bf16 mask sum cannot represent integers >256
+            # exactly, silently drifting the normalization for realistic
+            # RNN batches (e.g. 8×128 cells).
+            count = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
         else:
             count = labels.shape[0] * labels.shape[1]
     else:
-        # 2D and ≥4D labels: minibatch-size averaging, reference parity.
+        # 2D and ≥4D labels: minibatch-size averaging, reference parity —
+        # EXCEPT when a per-example mask ([N] or [N, 1]) is present: then the
+        # present-example count is the denominator, so a batch padded with
+        # zero-weight rows (ParallelWrapper ragged-batch padding) scores and
+        # trains identically to the unpadded batch (same contract as the 3D
+        # masked case above).
         count = labels.shape[0]
+        if mask is not None and (jnp.ndim(mask) == 1 or
+                                 (jnp.ndim(mask) == 2 and
+                                  mask.shape[-1] == 1)):
+            count = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
     return total / count

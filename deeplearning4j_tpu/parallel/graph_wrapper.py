@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.dataset import DataSet
+from ..ops.dataset import DataSet, MultiDataSet
 from .mesh import make_mesh
 
 
@@ -34,13 +34,14 @@ class GraphDataParallelTrainer:
         rep = NamedSharding(mesh, P())
         data = NamedSharding(mesh, P("data"))
 
-        def wrapped(params, upd, state, inputs, labels, iteration):
-            return step(params, upd, state, inputs, labels, None, None,
+        def wrapped(params, upd, state, inputs, labels, imasks, lmasks,
+                    iteration):
+            return step(params, upd, state, inputs, labels, imasks, lmasks,
                         iteration, {})
 
         self._jit_step = jax.jit(
             wrapped,
-            in_shardings=(rep, rep, rep, data, data, None),
+            in_shardings=(rep, rep, rep, data, data, data, data, None),
             out_shardings=(rep, rep, rep, rep),
             donate_argnums=(0, 1, 2))
 
@@ -51,16 +52,49 @@ class GraphDataParallelTrainer:
             self._build()
         n = ds.num_examples()
         n_dev = self.num_workers
-        feats, labels = ds.features, ds.labels
+        multi = isinstance(ds, MultiDataSet)
+        feats = list(ds.features) if multi else [ds.features]
+        labels = list(ds.labels) if multi else [ds.labels]
+        fmasks = list(ds.features_masks or [None] * len(feats)) if multi \
+            else [ds.features_mask]
+        lmasks = list(ds.labels_masks or [None] * len(labels)) if multi \
+            else [ds.labels_mask]
         if n % n_dev:
+            # pad to an even device split with repeated rows that carry ZERO
+            # loss weight (labels mask) — repeating without the mask would
+            # double-weight those examples (see ParallelWrapper
+            # ._pad_to_devices; reference round-robins real examples,
+            # ParallelWrapper.java:333)
             pad = n_dev - n % n_dev
             idx = np.concatenate([np.arange(n), np.arange(pad) % n])
-            feats, labels = feats[idx], labels[idx]
+            take = lambda a: None if a is None else np.asarray(a)[idx]
+            feats = [take(f) for f in feats]
+            fmasks = [take(m) for m in fmasks]
+            padded_l, padded_m = [], []
+            for lab, m in zip(labels, lmasks):
+                if m is None and lab is not None:
+                    m = np.ones(np.shape(lab)[:2] if np.ndim(lab) == 3
+                                else (n,), np.float32)
+                lab, m = take(lab), take(m)
+                if m is not None:
+                    m = np.asarray(m, np.float32).copy()
+                    m[n:] = 0.0
+                padded_l.append(lab)
+                padded_m.append(m)
+            labels, lmasks = padded_l, padded_m
         inputs = net._inputs_dict(feats)
         label_d = net._labels_dict(labels)
+        imask_d = None
+        if any(m is not None for m in fmasks):
+            imask_d = {nm: None if m is None else jnp.asarray(m, jnp.float32)
+                       for nm, m in zip(net.conf.network_inputs, fmasks)}
+        lmask_d = None
+        if any(m is not None for m in lmasks):
+            lmask_d = {nm: None if m is None else jnp.asarray(m, jnp.float32)
+                       for nm, m in zip(net.conf.network_outputs, lmasks)}
         net.params, net.updater_state, new_states, score = self._jit_step(
             net.params, net.updater_state, net.state, inputs, label_d,
-            net.iteration)
+            imask_d, lmask_d, net.iteration)
         net.state = net._strip_rnn_carry(new_states)
         net.score_value = float(score)
         net.iteration += 1

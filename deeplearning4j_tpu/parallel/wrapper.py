@@ -156,11 +156,13 @@ class ParallelWrapper:
         for ds in iterator:
             feats, labels, fmask, lmask = self._pad_to_devices(ds)
             cd = net.compute_dtype
+            # masks stay f32 (stage_dtype policy, datasets/iterators.py):
+            # a bf16 mask makes the masked-loss count drift above 256
             net.params, net.updater_state, new_states, score = self._jit_sync(
                 net.params, net.updater_state, net.state,
                 jnp.asarray(feats, cd), jnp.asarray(labels, cd),
-                None if fmask is None else jnp.asarray(fmask, cd),
-                None if lmask is None else jnp.asarray(lmask, cd),
+                None if fmask is None else jnp.asarray(fmask, jnp.float32),
+                None if lmask is None else jnp.asarray(lmask, jnp.float32),
                 net.iteration, empty_rnn)
             net.state = net._strip_rnn_carry(new_states) \
                 if hasattr(net, "_strip_rnn_carry") else new_states
@@ -323,13 +325,15 @@ class ParallelWrapper:
         # [k, global_b, ...] -> [k, n_dev, b, ...]
         feats = feats.reshape((k, n_dev, -1) + feats.shape[2:])
         labels = labels.reshape((k, n_dev, -1) + labels.shape[2:])
-        cd = net.compute_dtype
+        # masks transfer as f32 regardless of compute dtype (stage_dtype
+        # policy, datasets/iterators.py): summing a bf16 mask for the loss
+        # normalization cannot represent counts above 256 exactly
         if fmask is not None:
             fmask = jnp.asarray(
-                fmask.reshape((k, n_dev, -1) + fmask.shape[2:]), cd)
+                fmask.reshape((k, n_dev, -1) + fmask.shape[2:]), jnp.float32)
         if lmask is not None:
             lmask = jnp.asarray(
-                lmask.reshape((k, n_dev, -1) + lmask.shape[2:]), cd)
+                lmask.reshape((k, n_dev, -1) + lmask.shape[2:]), jnp.float32)
         sp, su, ss, sr = self._stacked
         sp, su, ss, sr, score, sent = self._jit_round(
             sp, su, ss, sr, jnp.asarray(feats, net.compute_dtype),
@@ -343,9 +347,14 @@ class ParallelWrapper:
             lst.iteration_done(net, net.iteration)
 
     def _pad_to_devices(self, ds: DataSet):
-        """Pad the batch so it divides evenly across devices (the reference
-        round-robins leftovers; padding with repeated rows keeps SPMD shapes
-        static). Returns (features, labels, features_mask, labels_mask)."""
+        """Pad the batch so it divides evenly across devices (SPMD shapes
+        must be static; the reference round-robins leftovers,
+        ParallelWrapper.java:333). Padded rows repeat real examples for
+        finite arithmetic but carry ZERO loss weight via the labels mask, so
+        score and gradient match the unpadded batch exactly — repeating rows
+        without the mask would silently double-weight them on every final
+        partial batch of every epoch.
+        Returns (features, labels, features_mask, labels_mask)."""
         n = ds.num_examples()
         n_dev = self.num_workers
         rem = n % n_dev
@@ -354,5 +363,17 @@ class ParallelWrapper:
         pad = n_dev - rem
         idx = np.concatenate([np.arange(n), np.arange(pad) % n])
         take = lambda a: None if a is None else a[idx]
+        lmask = ds.labels_mask
+        if lmask is None and ds.labels is not None:
+            # synthesize: [N, T] ones for time-series labels (masked-RNN
+            # count semantics), else per-example [N]
+            if np.ndim(ds.labels) == 3:
+                lmask = np.ones(np.shape(ds.labels)[:2], np.float32)
+            else:
+                lmask = np.ones((n,), np.float32)
+        lmask = take(lmask)
+        if lmask is not None:
+            lmask = np.asarray(lmask, np.float32).copy()
+            lmask[n:] = 0.0
         return (ds.features[idx], take(ds.labels), take(ds.features_mask),
-                take(ds.labels_mask))
+                lmask)
