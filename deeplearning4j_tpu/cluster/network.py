@@ -28,13 +28,19 @@ class _ClusterModelBase:
 
     def evaluate(self, data):
         """Distributed evaluation: per-partition Evaluation merged on the
-        driver (reference SparkDl4jMultiLayer.evaluate merge path)."""
+        driver (reference SparkDl4jMultiLayer.evaluate merge path). Graph
+        networks route through ComputationGraph.do_evaluation (first output
+        head; use evaluate_outputs for all heads)."""
         from ..eval import Evaluation
         if not isinstance(data, DistributedDataSet):
             data = DistributedDataSet.from_datasets(list(data))
         net = self.network
 
         def eval_partition(partition):
+            if hasattr(net, "do_evaluation"):      # ComputationGraph
+                first = net.conf.network_outputs[0]
+                return net.do_evaluation(partition,
+                                         {first: Evaluation()})[first]
             ev = Evaluation()
             for ds in partition:
                 out = net.output(ds.features)
@@ -47,6 +53,23 @@ class _ClusterModelBase:
         merged = parts[0]
         for other in parts[1:]:
             merged.merge(other)
+        return merged
+
+    def evaluate_outputs(self, data):
+        """Distributed per-output evaluation for multi-output graphs:
+        {output_name: Evaluation}, partition results merged per head
+        (reuses ComputationGraph.do_evaluation)."""
+        if not isinstance(data, DistributedDataSet):
+            data = DistributedDataSet.from_datasets(list(data))
+        net = self.network
+        if not hasattr(net, "evaluate_outputs"):
+            raise TypeError("evaluate_outputs requires a ComputationGraph")
+
+        parts = data.map_partitions(net.evaluate_outputs)
+        merged = parts[0]
+        for other in parts[1:]:
+            for name, ev in other.items():
+                merged[name].merge(ev)
         return merged
 
     def score_examples(self, data):

@@ -201,10 +201,12 @@ class SamplingDataSetIterator(DataSetIterator):
 
 
 def as_iterator(data) -> DataSetIterator:
-    """Normalize DataSet / list / iterator inputs to a DataSetIterator."""
+    """Normalize DataSet / MultiDataSet / list / iterator inputs to a
+    DataSetIterator."""
+    from ..ops.dataset import MultiDataSet
     if isinstance(data, DataSetIterator):
         return data
-    if isinstance(data, DataSet):
+    if isinstance(data, (DataSet, MultiDataSet)):
         return ListDataSetIterator([data])
     if isinstance(data, (list, tuple)):
         return ListDataSetIterator(list(data))

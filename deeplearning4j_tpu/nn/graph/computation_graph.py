@@ -351,6 +351,7 @@ class ComputationGraph:
 
     def fit_batch(self, ds):
         self._ensure_init()
+        self.last_input_batch = ds    # probe data for flow/debug listeners
         inputs = self._inputs_dict(ds.features)
         if self.conf.backprop_type == "truncated_bptt" and \
                 (self.conf.tbptt_fwd_length or 0) > 0 and \
@@ -457,6 +458,76 @@ class ComputationGraph:
         (score, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
         return grads, float(score)
 
+    # ------------------------------------------------------------- pretrain
+    def pretrain(self, data, num_epochs: int = 1):
+        """Greedy layerwise unsupervised pretraining over every pretrainable
+        layer vertex (AutoEncoder/RBM/VAE) in topological order (reference
+        ComputationGraph.pretrain, ComputationGraph.java:540)."""
+        self._ensure_init()
+        for name in self.conf.topological_order:
+            v = self.conf.vertices[name]
+            if isinstance(v, LayerVertex) and \
+                    hasattr(v.layer, "pretrain_loss"):
+                self.pretrain_layer(name, data, num_epochs)
+        return self
+
+    def pretrain_layer(self, layer_name: str, data, num_epochs: int = 1):
+        """Unsupervised pretraining of one named layer vertex (reference
+        ComputationGraph.pretrainLayer, ComputationGraph.java:577): featurize
+        the vertex's input through the graph (upstream vertices already
+        pretrained, inference mode — XLA prunes every vertex the input does
+        not depend on), then fit the layer's reconstruction/ELBO loss."""
+        self._ensure_init()
+        v = self.conf.vertices.get(layer_name)
+        if v is None:
+            raise ValueError(f"Unknown vertex '{layer_name}'")
+        if not (isinstance(v, LayerVertex) and
+                hasattr(v.layer, "pretrain_loss")):
+            raise ValueError(
+                f"Vertex '{layer_name}' is not pretrainable (needs an "
+                "AutoEncoder/RBM/VariationalAutoencoder layer)")
+        from ...datasets.iterators import as_iterator
+        in_name = self.conf.vertex_inputs[layer_name][0]
+        layer = v.layer
+        upd = self.updaters[layer_name]
+        lr = _nz(layer.learning_rate, 0.1)
+        key = ("pretrain", layer_name)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def _ptrain(p, ustate, all_params, state, inputs, it):
+                acts, *_ = self._forward(self._cast_params(all_params),
+                                         state, inputs, train=False,
+                                         rng=None)
+                act = acts[in_name]
+                if v.preprocessor is not None:
+                    act = v.preprocessor.pre_process(act, None)
+                rng = rngmod.for_iteration(
+                    rngmod.for_purpose(rngmod.root_key(self.conf.seed),
+                                       f"pretrain-{layer_name}"), it)
+                loss, grads = jax.value_and_grad(
+                    lambda q: layer.pretrain_loss(q, act, rng))(p)
+                it_f = jnp.asarray(it, jnp.float32)
+                newp, newu = {}, {}
+                for pname, g in grads.items():
+                    s, ns = upd.update(g, ustate[pname], lr, it_f)
+                    newp[pname] = p[pname] - s
+                    newu[pname] = ns
+                return newp, newu, loss
+
+            fn = jax.jit(_ptrain)
+            self._jit_cache[key] = fn
+        for _ in range(num_epochs):
+            for ds in as_iterator(data):
+                inputs = self._inputs_dict(ds.features)
+                self.params[layer_name], self.updater_state[layer_name], \
+                    loss = fn(self.params[layer_name],
+                              self.updater_state[layer_name], self.params,
+                              self._inference_state(), inputs,
+                              self.iteration)
+                self.score_value = float(loss)
+                self.iteration += 1
+        return self
+
     # ------------------------------------------------------ rnn / stateful
     def rnn_time_step(self, *features):
         """Stateful streaming inference (reference
@@ -524,14 +595,63 @@ class ComputationGraph:
         ComputationGraph.java:1999)."""
         self._rnn_state = None
 
-    def evaluate(self, data):
-        from ...eval.evaluation import Evaluation
+    def _eval_batch_parts(self, ds):
+        """(labels list, label-mask list) aligned with network_outputs, from
+        a DataSet or MultiDataSet."""
+        n_out = len(self.conf.network_outputs)
+        if isinstance(ds, MultiDataSet):
+            labels = list(ds.labels)
+            lmasks = list(ds.labels_masks) if ds.labels_masks \
+                else [None] * n_out
+        else:
+            labels = [ds.labels]
+            lmasks = [ds.labels_mask]
+        labels += [None] * (n_out - len(labels))
+        lmasks += [None] * (n_out - len(lmasks))
+        return labels, lmasks
+
+    def do_evaluation(self, data, evaluations: Dict):
+        """Accumulate per-output IEvaluation objects (Evaluation /
+        RegressionEvaluation / ROC family) over a dataset iterator —
+        ``{output_name: evaluation}``. One forward pass per batch feeds
+        every output's evaluator. Reference ComputationGraph.doEvaluation
+        (ComputationGraph.java:2531) throws for graphs with more than one
+        output array; evaluating every head per pass is the TPU-era
+        extension the multi-output vertex set deserves."""
+        self._ensure_init()
         from ...datasets.iterators import as_iterator
-        ev = Evaluation()
+        out_names = self.conf.network_outputs
         for ds in as_iterator(data):
-            out = self.output(ds.features)[0]
-            ev.eval(ds.labels, out, mask=ds.labels_mask)
-        return ev
+            outs = self.output(ds.features)
+            labels, lmasks = self._eval_batch_parts(ds)
+            for i, name in enumerate(out_names):
+                ev = evaluations.get(name)
+                if ev is None or labels[i] is None:
+                    continue
+                ev.eval(np.asarray(labels[i]), np.asarray(outs[i]),
+                        mask=None if lmasks[i] is None
+                        else np.asarray(lmasks[i]))
+        return evaluations
+
+    def evaluate_outputs(self, data) -> Dict[str, object]:
+        """Classification evaluation of EVERY output head →
+        {output_name: Evaluation} (the multi-output path reference
+        ComputationGraph.evaluate(MultiDataSetIterator) lacks)."""
+        from ...eval.evaluation import Evaluation
+        evs = {name: Evaluation() for name in self.conf.network_outputs}
+        return self.do_evaluation(data, evs)
+
+    def evaluate(self, data, labels_list=None, top_n: int = 1):
+        """Single-head classification evaluation (reference
+        ComputationGraph.evaluate(DataSetIterator/MultiDataSetIterator),
+        ComputationGraph.java:2468-2529). Multi-output graphs evaluate
+        output 0 against the first labels array; use evaluate_outputs()/
+        do_evaluation() for every head."""
+        from ...eval.evaluation import Evaluation
+        first = self.conf.network_outputs[0]
+        evs = self.do_evaluation(
+            data, {first: Evaluation(labels=labels_list, top_n=top_n)})
+        return evs[first]
 
     # ----------------------------------------------------------- param utils
     def set_listeners(self, *listeners):

@@ -94,6 +94,37 @@ def topological_sort(vertex_inputs: Dict[str, List[str]],
     return order
 
 
+def infer_graph_shapes(vertices: Dict[str, GraphVertexConf],
+                       vertex_inputs: Dict[str, List[str]],
+                       network_inputs: List[str],
+                       input_types: List[InputType],
+                       order: List[str]) -> Dict[str, InputType]:
+    """Propagate InputTypes through the DAG in topo order: fills each
+    LayerVertex's n_in (``set_n_in`` is a no-op when already set) and
+    auto-assigns preprocessors where the input kind mismatches (reference
+    ComputationGraphConfiguration.addPreProcessors). Shared by the initial
+    GraphBuilder.build and transfer-learning graph surgery."""
+    types: Dict[str, InputType] = dict(zip(network_inputs, input_types))
+    for name in order:
+        v = vertices[name]
+        in_types = [types[i] for i in vertex_inputs[name]]
+        if isinstance(v, LayerVertex):
+            it = in_types[0]
+            needed = v.layer.input_kind()
+            if v.preprocessor is None and needed != "any":
+                pp = auto_preprocessor(it, needed,
+                                       timesteps=it.timesteps or 0)
+                if pp is not None:
+                    v.preprocessor = pp
+            if v.preprocessor is not None:
+                it = v.preprocessor.output_type(it)
+            v.layer.set_n_in(it)
+            types[name] = v.layer.get_output_type(it)
+        else:
+            types[name] = v.output_type(in_types)
+    return types
+
+
 class GraphBuilder:
     """reference ComputationGraphConfiguration.GraphBuilder via
     NeuralNetConfiguration.Builder().graph_builder()."""
@@ -167,25 +198,8 @@ class GraphBuilder:
 
         # shape inference + auto-preprocessors over topo order
         if self._input_types is not None:
-            types: Dict[str, InputType] = dict(zip(self._network_inputs,
-                                                   self._input_types))
-            for name in order:
-                v = vertices[name]
-                in_types = [types[i] for i in self._inputs[name]]
-                if isinstance(v, LayerVertex):
-                    it = in_types[0]
-                    needed = v.layer.input_kind()
-                    if v.preprocessor is None and needed != "any":
-                        pp = auto_preprocessor(it, needed,
-                                               timesteps=it.timesteps or 0)
-                        if pp is not None:
-                            v.preprocessor = pp
-                    if v.preprocessor is not None:
-                        it = v.preprocessor.output_type(it)
-                    v.layer.set_n_in(it)
-                    types[name] = v.layer.get_output_type(it)
-                else:
-                    types[name] = v.output_type(in_types)
+            infer_graph_shapes(vertices, self._inputs, self._network_inputs,
+                               self._input_types, order)
 
         return ComputationGraphConfiguration(
             vertices=vertices,

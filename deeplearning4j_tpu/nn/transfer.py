@@ -2,7 +2,14 @@
 nn/transferlearning/TransferLearning.java (777 LoC), FineTuneConfiguration,
 TransferLearningHelper; SURVEY.md §2.1): freeze layers below a boundary,
 replace/append output layers, override hyperparameters on the rest, and
-featurize through the frozen sub-stack."""
+featurize through the frozen sub-stack.
+
+``TransferLearning.GraphBuilder`` is the ComputationGraph variant
+(reference TransferLearning.java:425): freeze by vertex name (a named
+feature-extractor vertex freezes itself and every ancestor on the path from
+the inputs), remove/replace vertices, append layers/vertices, change
+outputs — the canonical "import Keras ResNet-50, freeze the trunk, replace
+the head, fine-tune" workflow."""
 
 from __future__ import annotations
 
@@ -135,6 +142,231 @@ class TransferLearning:
             new_net.frozen_until = frozen_upto
             return new_net
 
+    class GraphBuilder:
+        """ComputationGraph surgery (reference TransferLearning.java:425
+        GraphBuilder: fineTuneConfiguration :451, setFeatureExtractor :476,
+        nOutReplace :495, removeVertexKeepConnections :608,
+        removeVertexAndConnections :619, addLayer :632, addVertex :662,
+        setOutputs :675, build :701)."""
+
+        def __init__(self, graph):
+            self._graph = graph
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._frozen_names: List[str] = []
+            self._removed: List[tuple] = []        # (name, keep_connections)
+            self._added: List[tuple] = []          # (name, vertex, inputs)
+            self._outputs: Optional[List[str]] = None
+            self._n_out_overrides: Dict[str, int] = {}
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, *vertex_names: str):
+            """Freeze the named vertices and every ancestor on the path from
+            the network inputs (reference setFeatureExtractor semantics)."""
+            self._frozen_names.extend(vertex_names)
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            """Delete the vertex and disconnect it everywhere (reference
+            removeVertexAndConnections): downstream vertices lose it from
+            their input lists; it is dropped from the outputs."""
+            self._removed.append((name, False))
+            return self
+
+        def remove_vertex_keep_connections(self, name: str):
+            """Delete the vertex but keep edges referencing it — a vertex
+            re-added under the same name takes its place (reference
+            removeVertexKeepConnections)."""
+            self._removed.append((name, True))
+            return self
+
+        def add_layer(self, name: str, layer, *inputs: str):
+            from .graph.vertices import LayerVertex
+            return self.add_vertex(name, LayerVertex(layer=layer), *inputs)
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            self._added.append((name, vertex, list(inputs)))
+            return self
+
+        def set_outputs(self, *names: str):
+            self._outputs = list(names)
+            return self
+
+        def n_out_replace(self, vertex_name: str, n_out: int):
+            """Change a layer vertex's nOut, re-initializing it and resetting
+            downstream consumers' nIn (reference nOutReplace)."""
+            self._n_out_overrides[vertex_name] = int(n_out)
+            return self
+
+        def build(self):
+            import jax.numpy as jnp
+
+            from .graph.computation_graph import ComputationGraph
+            from .graph.graph_config import (infer_graph_shapes,
+                                             topological_sort)
+            from .graph.vertices import LayerVertex
+
+            src = self._graph
+            src._ensure_init()
+            conf = copy.deepcopy(src.conf)
+            vertices = dict(conf.vertices)
+            vinputs = {k: list(v) for k, v in conf.vertex_inputs.items()}
+            outputs = list(conf.network_outputs)
+            reinit = set()
+
+            def _reset_downstream_nin(start_names, why):
+                """Clear n_in on every downstream layer consumer (through
+                non-layer vertices) so infer_graph_shapes re-derives it —
+                set_n_in is a no-op once n_in is set."""
+                frontier_q = list(start_names)
+                seen = set()
+                while frontier_q:
+                    cur = frontier_q.pop()
+                    for k, ins in vinputs.items():
+                        if cur not in ins or k in seen:
+                            continue
+                        seen.add(k)
+                        dv = vertices.get(k)
+                        if isinstance(dv, LayerVertex):
+                            if hasattr(dv.layer, "n_in") and dv.layer.n_in:
+                                if not conf.input_types:
+                                    raise ValueError(
+                                        f"{why} changes the input width of "
+                                        f"layer '{k}'; the graph conf needs "
+                                        "input_types for n_in re-inference")
+                                dv.layer.n_in = None
+                                reinit.add(k)
+                        else:
+                            frontier_q.append(k)
+
+            for name, keep in self._removed:
+                if name not in vertices:
+                    raise ValueError(f"Cannot remove unknown vertex '{name}'")
+                vertices.pop(name)
+                vinputs.pop(name)
+                outputs = [o for o in outputs if o != name]
+                if not keep:
+                    affected = [k for k, ins in vinputs.items()
+                                if name in ins]
+                    for k in vinputs:
+                        vinputs[k] = [i for i in vinputs[k] if i != name]
+                    # consumers that lost an input change width (e.g. a
+                    # merge shrinks): their downstream layers re-infer n_in
+                    if affected:
+                        for k in affected:
+                            dv = vertices.get(k)
+                            if isinstance(dv, LayerVertex) and                                     hasattr(dv.layer, "n_in"):
+                                raise ValueError(
+                                    f"removeVertexAndConnections('{name}') "
+                                    f"leaves layer vertex '{k}' without its "
+                                    "input; remove or replace it too")
+                        _reset_downstream_nin(affected,
+                                              f"removing '{name}'")
+
+            for name, n_out in self._n_out_overrides.items():
+                v = vertices.get(name)
+                if not isinstance(v, LayerVertex):
+                    raise ValueError(f"nOutReplace target '{name}' is not a "
+                                     "layer vertex")
+                v.layer.n_out = n_out
+                reinit.add(name)
+                # every downstream layer consumer needs a fresh n_in — also
+                # those reached THROUGH non-layer vertices (Merge/ElementWise
+                # change their output size with the replaced n_out). Clearing
+                # n_in lets infer_graph_shapes recompute it; direct
+                # assignment only works for direct consumers.
+                frontier_q = [name]
+                seen = set()
+                while frontier_q:
+                    cur = frontier_q.pop()
+                    for k, ins in vinputs.items():
+                        if cur not in ins or k in seen:
+                            continue
+                        seen.add(k)
+                        dv = vertices.get(k)
+                        if isinstance(dv, LayerVertex):
+                            if hasattr(dv.layer, "n_in"):
+                                if conf.input_types:
+                                    dv.layer.n_in = None   # re-inferred
+                                elif cur == name:
+                                    dv.layer.n_in = n_out
+                                else:
+                                    raise ValueError(
+                                        f"nOutReplace('{name}') reaches "
+                                        f"layer '{k}' through non-layer "
+                                        "vertices; the graph conf needs "
+                                        "input_types for n_in re-inference")
+                                reinit.add(k)
+                        else:
+                            frontier_q.append(k)
+
+            for name, vconf, ins in self._added:
+                vcopy = copy.deepcopy(vconf)
+                if isinstance(vcopy, LayerVertex) and self._fine_tune:
+                    self._fine_tune.apply(vcopy.layer)
+                vertices[name] = vcopy
+                vinputs[name] = list(ins)
+                reinit.add(name)
+
+            if self._outputs is not None:
+                outputs = list(self._outputs)
+            for out in outputs:
+                if out not in vertices:
+                    raise ValueError(f"Output '{out}' is not a vertex")
+            if not outputs:
+                raise ValueError("Resulting graph has no outputs (call "
+                                 "set_outputs after removing the head)")
+            order = topological_sort(vinputs, conf.network_inputs)
+            if conf.input_types:
+                infer_graph_shapes(vertices, vinputs, conf.network_inputs,
+                                   conf.input_types, order)
+
+            # frozen set = named vertices + all ancestors (path from inputs)
+            frozen = set()
+            stack = list(self._frozen_names)
+            while stack:
+                cur = stack.pop()
+                if cur in frozen or cur in conf.network_inputs:
+                    continue
+                if cur not in vertices:
+                    raise ValueError(f"Feature-extractor vertex '{cur}' "
+                                     "does not exist")
+                frozen.add(cur)
+                stack.extend(vinputs.get(cur, []))
+            for nm in frozen:
+                v = vertices[nm]
+                if isinstance(v, LayerVertex):
+                    v.layer.learning_rate = 0.0    # frozen == zero-lr
+                    if getattr(v.layer, "bias_learning_rate", None):
+                        v.layer.bias_learning_rate = 0.0
+            if self._fine_tune:
+                for nm, v in vertices.items():
+                    if nm in frozen or nm in reinit:
+                        continue
+                    if isinstance(v, LayerVertex):
+                        self._fine_tune.apply(v.layer)
+
+            conf.vertices = vertices
+            conf.vertex_inputs = vinputs
+            conf.network_outputs = outputs
+            conf.topological_order = order
+            if self._fine_tune and self._fine_tune.seed is not None:
+                conf.seed = self._fine_tune.seed
+
+            new_net = ComputationGraph(conf, src.compute_dtype).init()
+            for nm in vertices:
+                if nm not in reinit and nm in src.params:
+                    # fresh buffers: the jitted train step donates params
+                    new_net.params[nm] = jax.tree_util.tree_map(
+                        jnp.copy, src.params[nm])
+                    if nm in src.state:
+                        new_net.state[nm] = jax.tree_util.tree_map(
+                            jnp.copy, src.state[nm])
+            new_net.frozen_vertices = frozen
+            return new_net
+
 
 class TransferLearningHelper:
     """Featurize through the frozen sub-stack once, then train only the
@@ -157,3 +389,151 @@ class TransferLearningHelper:
                                    train=False, rng=None, mask=mask)
         return DataSet(np.asarray(act), ds.labels, ds.features_mask,
                        ds.labels_mask)
+
+
+class GraphTransferLearningHelper:
+    """Graph variant of TransferLearningHelper (reference
+    TransferLearningHelper's ComputationGraph path, TransferLearning.java
+    sibling): split the graph at the frozen frontier, featurize datasets
+    through the frozen subgraph once, and train only the unfrozen subgraph.
+
+    ``frozen`` defaults to the graph's own ``frozen_vertices`` (set by
+    TransferLearning.GraphBuilder); pass vertex names to freeze explicitly
+    (ancestors included, like setFeatureExtractor)."""
+
+    def __init__(self, graph, *frozen: str):
+        self.graph = graph
+        graph._ensure_init()
+        conf = graph.conf
+        if frozen:
+            frz = set()
+            stack = list(frozen)
+            while stack:
+                cur = stack.pop()
+                if cur in frz or cur in conf.network_inputs:
+                    continue
+                frz.add(cur)
+                stack.extend(conf.vertex_inputs.get(cur, []))
+            self.frozen = frz
+        else:
+            self.frozen = set(getattr(graph, "frozen_vertices", set()))
+        if not self.frozen:
+            raise ValueError("No frozen vertices: pass vertex names or build "
+                             "the graph with TransferLearning.GraphBuilder"
+                             ".set_feature_extractor")
+        # frontier = frozen vertices consumed by an unfrozen vertex — they
+        # become the inputs of the unfrozen subgraph
+        self.frontier: List[str] = []
+        for name in conf.topological_order:
+            if name in self.frozen:
+                continue
+            for i in conf.vertex_inputs[name]:
+                if (i in self.frozen or i in conf.network_inputs) and \
+                        i not in self.frontier:
+                    self.frontier.append(i)
+        self._unfrozen = self._build_unfrozen()
+
+    def _build_unfrozen(self):
+        import jax.numpy as jnp
+
+        from .graph.computation_graph import ComputationGraph
+        from .graph.graph_config import (ComputationGraphConfiguration,
+                                         topological_sort)
+        src = self.graph
+        conf = src.conf
+        keep = [n for n in conf.topological_order if n not in self.frozen]
+        vertices = {n: copy.deepcopy(conf.vertices[n]) for n in keep}
+        vinputs = {n: list(conf.vertex_inputs[n]) for n in keep}
+        sub = ComputationGraphConfiguration(
+            vertices=vertices, vertex_inputs=vinputs,
+            network_inputs=list(self.frontier),
+            network_outputs=list(conf.network_outputs),
+            topological_order=topological_sort(vinputs, self.frontier),
+            seed=conf.seed,
+            backprop_type=conf.backprop_type,
+            tbptt_fwd_length=conf.tbptt_fwd_length,
+            tbptt_back_length=conf.tbptt_back_length,
+            lr_policy=conf.lr_policy,
+            lr_policy_decay_rate=conf.lr_policy_decay_rate,
+            lr_policy_steps=conf.lr_policy_steps,
+            lr_policy_power=conf.lr_policy_power,
+            max_iterations=conf.max_iterations,
+            learning_rate_schedule=conf.learning_rate_schedule)
+        net = ComputationGraph(sub, src.compute_dtype).init()
+        for n in keep:
+            net.params[n] = jax.tree_util.tree_map(jnp.copy, src.params[n])
+            net.state[n] = jax.tree_util.tree_map(jnp.copy, src.state[n])
+        return net
+
+    def unfrozen_graph(self):
+        """The trainable subgraph (reference unfrozenGraph())."""
+        return self._unfrozen
+
+    def featurize(self, ds: DataSet):
+        """Run the frozen subgraph once → a MultiDataSet whose features are
+        the frontier activations (reference featurize). Feature masks are
+        PROPAGATED through the frozen subgraph to the frontier (a
+        variable-length mask survives preprocessors/pooling the same way it
+        does in training) and label masks ride along unchanged, so
+        fit_featurized trains padded timesteps/examples at zero weight —
+        identical to fitting the full graph."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.dataset import MultiDataSet
+        g = self.graph
+        fn = getattr(self, "_feat_fn", None)
+        if fn is None:
+            def _feat(params, state, inputs, input_masks):
+                acts, _, _, _, masks, _ = g._forward(
+                    params, state, inputs, train=False, rng=None,
+                    input_masks=input_masks)
+                return ([acts[n] for n in self.frontier],
+                        [masks.get(n) for n in self.frontier])
+            fn = jax.jit(_feat)
+            self._feat_fn = fn
+        inputs = g._inputs_dict(ds.features)
+        imasks, lmasks = g._masks_of(ds)
+        outs, fmasks = fn(g.params, g._inference_state(), inputs,
+                          imasks or {})
+        labels = ds.labels if isinstance(ds.labels, (list, tuple)) \
+            else [ds.labels]
+        lmask_list = None
+        if lmasks:
+            lmask_list = [None if lmasks.get(n) is None
+                          else np.asarray(lmasks[n])
+                          for n in g.conf.network_outputs]
+        fmask_list = [None if m is None else np.asarray(m) for m in fmasks]
+        return MultiDataSet([np.asarray(o) for o in outs],
+                            [None if l is None else np.asarray(l)
+                             for l in labels],
+                            features_masks=fmask_list
+                            if any(m is not None for m in fmask_list)
+                            else None,
+                            labels_masks=lmask_list)
+
+    def fit_featurized(self, data, num_epochs: int = 1):
+        """Train the unfrozen subgraph on featurized data and write the
+        updated params back into the full graph (reference fitFeaturized)."""
+        from ..ops.dataset import MultiDataSet
+        if isinstance(data, MultiDataSet):
+            data = [data]
+        self._unfrozen.fit(data, num_epochs)
+        import jax
+        import jax.numpy as jnp
+        for n in self._unfrozen.conf.topological_order:
+            # fresh buffers: both nets' jitted train steps DONATE their
+            # params/state — sharing arrays would let a later fit on either
+            # net delete the other's (same hazard GraphBuilder.build guards)
+            self.graph.params[n] = jax.tree_util.tree_map(
+                jnp.copy, self._unfrozen.params[n])
+            self.graph.state[n] = jax.tree_util.tree_map(
+                jnp.copy, self._unfrozen.state[n])
+        return self
+
+    def output_from_featurized(self, featurized):
+        """Predictions from featurized inputs (reference
+        outputFromFeaturized)."""
+        return self._unfrozen.output(featurized.features
+                                     if hasattr(featurized, "features")
+                                     else featurized)

@@ -1,0 +1,431 @@
+"""Transfer learning on ComputationGraph + graph pretrain + multi-output
+evaluation (reference TransferLearning.java:425 GraphBuilder,
+ComputationGraph.java:540/:577 pretrain/pretrainLayer,
+ComputationGraph.java:2468-2531 evaluate/doEvaluation)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (NeuralNetConfiguration, InputType,
+                                   MultiLayerNetwork)
+from deeplearning4j_tpu.nn.conf.layers import (AutoEncoder, DenseLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                            GraphTransferLearningHelper,
+                                            TransferLearning)
+from deeplearning4j_tpu.ops.dataset import DataSet, MultiDataSet
+
+
+def _small_graph(seed=7):
+    g = (NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.1)
+         .updater("sgd").weight_init("xavier").activation("tanh")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("d1", DenseLayer(n_out=8), "in")
+         .add_layer("d2", DenseLayer(n_out=6), "d1")
+         .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                       activation="softmax"), "d2")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4)).build())
+    return ComputationGraph(g).init()
+
+
+def _cls_batch(rng, n=16, n_in=4, n_out=3):
+    X = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return DataSet(X, y)
+
+
+def _flat(params_dict):
+    parts = []
+    for k in sorted(params_dict):
+        parts.append(np.asarray(params_dict[k]).reshape(-1))
+    return np.concatenate(parts) if parts else np.zeros(0)
+
+
+class TestGraphTransferBuilder:
+    def test_freeze_replace_head_finetune(self, rng_np):
+        src = _small_graph()
+        src.fit(_cls_batch(rng_np))      # give it some training history
+        new = (TransferLearning.GraphBuilder(src)
+               .fine_tune_configuration(FineTuneConfiguration(
+                   learning_rate=0.05))
+               .set_feature_extractor("d1")
+               .remove_vertex_and_connections("out")
+               .add_layer("new_out", OutputLayer(n_out=2, loss="mcxent",
+                                                 activation="softmax"), "d2")
+               .set_outputs("new_out")
+               .build())
+        assert new.conf.network_outputs == ["new_out"]
+        assert "out" not in new.conf.vertices
+        # appended layer got its n_in inferred from d2
+        assert new.conf.vertices["new_out"].layer.n_in == 6
+        # copied trunk params match the source exactly
+        np.testing.assert_array_equal(_flat(new.params["d1"]),
+                                      _flat(src.params["d1"]))
+        np.testing.assert_array_equal(_flat(new.params["d2"]),
+                                      _flat(src.params["d2"]))
+
+        d1_before = _flat(new.params["d1"]).copy()
+        d2_before = _flat(new.params["d2"]).copy()
+        head_before = _flat(new.params["new_out"]).copy()
+        X = rng_np.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, 16)]
+        for _ in range(4):
+            new.fit_batch(DataSet(X, y))
+        # frozen d1 identical; unfrozen d2 and the new head both moved
+        np.testing.assert_array_equal(_flat(new.params["d1"]), d1_before)
+        assert np.abs(_flat(new.params["d2"]) - d2_before).max() > 1e-6
+        assert np.abs(_flat(new.params["new_out"]) - head_before).max() > 1e-6
+
+    def test_n_out_replace_reinits_and_rewires(self, rng_np):
+        src = _small_graph()
+        new = (TransferLearning.GraphBuilder(src)
+               .n_out_replace("d1", 10)
+               .build())
+        assert new.conf.vertices["d1"].layer.n_out == 10
+        assert new.conf.vertices["d2"].layer.n_in == 10
+        assert new.params["d1"]["W"].shape == (4, 10)
+        assert new.params["d2"]["W"].shape == (10, 6)
+        # out untouched -> params copied
+        np.testing.assert_array_equal(_flat(new.params["out"]),
+                                      _flat(src.params["out"]))
+        new.fit_batch(_cls_batch(rng_np))
+        assert np.isfinite(float(new.score_value))
+
+    def test_remove_keep_connections_and_readd(self, rng_np):
+        src = _small_graph()
+        new = (TransferLearning.GraphBuilder(src)
+               .remove_vertex_keep_connections("d2")
+               .add_layer("d2", DenseLayer(n_out=6, activation="relu"), "d1")
+               .build())
+        assert new.conf.vertices["d2"].layer.activation == "relu"
+        # re-added under the same name -> freshly initialized, not copied
+        assert new.conf.vertex_inputs["out"] == ["d2"]
+        new.fit_batch(_cls_batch(rng_np))
+        assert np.isfinite(float(new.score_value))
+
+    def test_validation_errors(self):
+        src = _small_graph()
+        with pytest.raises(ValueError):
+            (TransferLearning.GraphBuilder(src)
+             .remove_vertex_and_connections("nope").build())
+        with pytest.raises(ValueError):
+            (TransferLearning.GraphBuilder(src)
+             .remove_vertex_and_connections("out").build())   # no outputs
+        with pytest.raises(ValueError):
+            (TransferLearning.GraphBuilder(src)
+             .set_feature_extractor("missing").build())
+
+
+class TestGraphTransferHelper:
+    def test_featurize_and_fit_featurized(self, rng_np):
+        src = _small_graph()
+        new = (TransferLearning.GraphBuilder(src)
+               .set_feature_extractor("d1")
+               .build())
+        helper = GraphTransferLearningHelper(new)
+        assert helper.frontier == ["d1"]
+        sub = helper.unfrozen_graph()
+        assert set(sub.conf.vertices) == {"d2", "out"}
+        ds = _cls_batch(rng_np)
+        feat = helper.featurize(ds)
+        assert isinstance(feat, MultiDataSet)
+        assert feat.features[0].shape == (16, 8)
+        # featurized prediction == full-graph prediction
+        full = new.output(ds.features)[0]
+        from_feat = helper.output_from_featurized(feat)[0]
+        np.testing.assert_allclose(np.asarray(from_feat), np.asarray(full),
+                                   rtol=1e-5, atol=1e-6)
+        d1_before = _flat(new.params["d1"]).copy()
+        out_before = _flat(new.params["out"]).copy()
+        for _ in range(3):
+            helper.fit_featurized(feat)
+        np.testing.assert_array_equal(_flat(new.params["d1"]), d1_before)
+        assert np.abs(_flat(new.params["out"]) - out_before).max() > 1e-6
+
+    def test_explicit_frozen_names(self, rng_np):
+        src = _small_graph()
+        helper = GraphTransferLearningHelper(src, "d2")
+        assert helper.frozen == {"d1", "d2"}
+        assert helper.frontier == ["d2"]
+
+
+class TestGraphPretrain:
+    def _ae_graph(self, seed=9):
+        g = (NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.05)
+             .updater("sgd").weight_init("xavier").activation("sigmoid")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("ae1", AutoEncoder(n_out=6, loss="mse"), "in")
+             .add_layer("ae2", AutoEncoder(n_out=4, loss="mse"), "ae1")
+             .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                           activation="softmax"), "ae2")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(8)).build())
+        return ComputationGraph(g).init()
+
+    def test_pretrain_layer_reduces_reconstruction_loss(self, rng_np):
+        net = self._ae_graph()
+        X = rng_np.normal(size=(32, 8)).astype(np.float32)
+        ds = DataSet(X, np.eye(2, dtype=np.float32)[
+            rng_np.integers(0, 2, 32)])
+        net.pretrain_layer("ae1", [ds])
+        first = float(net.score_value)
+        for _ in range(30):
+            net.pretrain_layer("ae1", [ds])
+        assert float(net.score_value) < first
+
+    def test_pretrain_walks_all_pretrainable_vertices(self, rng_np):
+        net = self._ae_graph()
+        X = rng_np.normal(size=(32, 8)).astype(np.float32)
+        ds = DataSet(X, np.eye(2, dtype=np.float32)[
+            rng_np.integers(0, 2, 32)])
+        p1 = _flat(net.params["ae1"]).copy()
+        p2 = _flat(net.params["ae2"]).copy()
+        out = _flat(net.params["out"]).copy()
+        net.pretrain([ds], num_epochs=3)
+        assert np.abs(_flat(net.params["ae1"]) - p1).max() > 1e-7
+        assert np.abs(_flat(net.params["ae2"]) - p2).max() > 1e-7
+        # supervised head untouched by unsupervised pretraining
+        np.testing.assert_array_equal(_flat(net.params["out"]), out)
+
+    def test_pretrain_layer_rejects_non_pretrainable(self, rng_np):
+        net = _small_graph()
+        with pytest.raises(ValueError):
+            net.pretrain_layer("d1", [])
+        with pytest.raises(ValueError):
+            net.pretrain_layer("missing", [])
+
+
+class TestMultiOutputEvaluation:
+    def _two_head_graph(self, seed=5):
+        g = (NeuralNetConfiguration.Builder().seed(seed).learning_rate(0.1)
+             .updater("sgd").weight_init("xavier").activation("tanh")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("trunk", DenseLayer(n_out=8), "in")
+             .add_layer("head_a", OutputLayer(n_out=3, loss="mcxent",
+                                              activation="softmax"), "trunk")
+             .add_layer("head_b", OutputLayer(n_out=2, loss="mcxent",
+                                              activation="softmax"), "trunk")
+             .set_outputs("head_a", "head_b")
+             .set_input_types(InputType.feed_forward(4)).build())
+        return ComputationGraph(g).init()
+
+    def _mds(self, rng, n=24):
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        ya = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+        yb = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+        return MultiDataSet([X], [ya, yb])
+
+    def test_evaluate_outputs_both_heads(self, rng_np):
+        net = self._two_head_graph()
+        mds = self._mds(rng_np)
+        evs = net.evaluate_outputs([mds])
+        assert set(evs) == {"head_a", "head_b"}
+        assert evs["head_a"].total == 24 and evs["head_b"].total == 24
+        assert evs["head_a"].confusion.shape == (3, 3)
+        assert evs["head_b"].confusion.shape == (2, 2)
+        # accuracy consistent with a manual argmax over the same forward
+        outs = net.output(mds.features[0])
+        acc_a = float(np.mean(np.argmax(outs[0], 1)
+                              == np.argmax(mds.labels[0], 1)))
+        np.testing.assert_allclose(evs["head_a"].accuracy(), acc_a)
+
+    def test_evaluate_single_head_compat(self, rng_np):
+        net = self._two_head_graph()
+        mds = self._mds(rng_np)
+        ev = net.evaluate([mds])
+        assert ev.total == 24 and ev.confusion.shape == (3, 3)
+
+    def test_label_masks_respected(self, rng_np):
+        net = self._two_head_graph()
+        X = rng_np.normal(size=(10, 4)).astype(np.float32)
+        ya = np.eye(3, dtype=np.float32)[rng_np.integers(0, 3, 10)]
+        yb = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, 10)]
+        mask_a = np.concatenate([np.ones(6), np.zeros(4)]).astype(np.float32)
+        mds = MultiDataSet([X], [ya, yb], labels_masks=[mask_a, None])
+        evs = net.evaluate_outputs([mds])
+        assert evs["head_a"].total == 6      # masked rows excluded
+        assert evs["head_b"].total == 10
+
+    def test_cluster_evaluate_outputs_merges(self, rng_np):
+        from deeplearning4j_tpu.cluster.network import ClusterComputationGraph
+        from deeplearning4j_tpu.cluster.param_averaging import \
+            ParameterAveragingTrainingMaster
+        net = self._two_head_graph()
+        master = ParameterAveragingTrainingMaster(num_workers=2,
+                                                  batch_size_per_worker=8)
+        cluster = ClusterComputationGraph(net, master)
+        data = [self._mds(rng_np, n=8) for _ in range(4)]
+        merged = cluster.evaluate_outputs(data)
+        assert merged["head_a"].total == 32
+        assert merged["head_b"].total == 32
+        single = cluster.evaluate(data)
+        assert single.total == 32            # first head via do_evaluation
+
+
+class TestKerasResNetTransfer:
+    """The canonical workflow VERDICT r2 named as the most user-visible gap:
+    import Keras ResNet-50, freeze the trunk, replace the head, fine-tune —
+    only head params may change (reference TransferLearning.java:425 +
+    KerasModelImport)."""
+
+    def test_import_freeze_replace_finetune(self, tmp_path, rng_np):
+        from deeplearning4j_tpu.keras.export import export_resnet50_keras_h5
+        from deeplearning4j_tpu.keras.importer import KerasModelImport
+
+        path = tmp_path / "resnet50.h5"
+        export_resnet50_keras_h5(path, num_classes=16, height=32, width=32,
+                                 seed=11)
+        src = KerasModelImport.import_keras_model_and_weights(path)
+
+        new = (TransferLearning.GraphBuilder(src)
+               .fine_tune_configuration(FineTuneConfiguration(
+                   learning_rate=0.05, updater="sgd"))
+               .set_feature_extractor("avgpool")     # freezes whole trunk
+               .remove_vertex_and_connections("fc")
+               .add_layer("new_fc", OutputLayer(n_out=4, loss="mcxent",
+                                                activation="softmax"),
+                          "avgpool")
+               .set_outputs("new_fc")
+               .build())
+
+        # trunk = every vertex except the new head
+        trunk = [n for n in new.conf.vertices if n != "new_fc"]
+        assert set(trunk) == set(new.frozen_vertices)
+        assert new.conf.vertices["new_fc"].layer.n_in == 2048
+
+        before = {n: _flat(new.params[n]).copy() for n in new.conf.vertices
+                  if new.params[n]}
+        head_before = before.pop("new_fc")
+        X = rng_np.normal(size=(4, 32, 32, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng_np.integers(0, 4, 4)]
+        ds = DataSet(X, y)
+        s0 = new.score(ds)
+        for _ in range(6):
+            new.fit_batch(ds)
+        # ONLY the head params changed
+        for n, p in before.items():
+            np.testing.assert_array_equal(_flat(new.params[n]), p,
+                                          err_msg=f"trunk vertex {n} moved")
+        assert np.abs(_flat(new.params["new_fc"]) - head_before).max() > 1e-6
+        assert new.score(ds) < s0
+
+
+class TestReviewRegressions:
+    """Pins for the r3 code-review findings on this feature set."""
+
+    def test_evaluate_accepts_bare_multidataset(self, rng_np):
+        net = TestMultiOutputEvaluation()._two_head_graph()
+        mds = TestMultiOutputEvaluation()._mds(rng_np)
+        evs = net.evaluate_outputs(mds)          # no list wrapper
+        assert evs["head_a"].total == 24
+        assert net.evaluate(mds).total == 24
+
+    def test_n_out_replace_through_merge_vertex(self, rng_np):
+        from deeplearning4j_tpu.nn.graph import MergeVertex
+        g = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+             .updater("sgd").weight_init("xavier").activation("tanh")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_out=5), "in")
+             .add_layer("b", DenseLayer(n_out=7), "in")
+             .add_vertex("merge", MergeVertex(), "a", "b")
+             .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                           activation="softmax"), "merge")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4)).build())
+        src = ComputationGraph(g).init()
+        new = (TransferLearning.GraphBuilder(src)
+               .n_out_replace("a", 10).build())
+        # out's n_in re-inferred through the merge: 10 + 7
+        assert new.conf.vertices["out"].layer.n_in == 17
+        assert new.params["out"]["W"].shape == (17, 2)
+        new.fit_batch(_cls_batch(rng_np, n_out=2))
+        assert np.isfinite(float(new.score_value))
+
+    def test_featurize_propagates_masks(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+        g = (NeuralNetConfiguration.Builder().seed(13).learning_rate(0.05)
+             .updater("sgd").weight_init("xavier")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("lstm", LSTM(n_out=6, activation="tanh"), "in")
+             .add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent",
+                                              activation="softmax"), "lstm")
+             .set_outputs("out")
+             .set_input_types(InputType.recurrent(3)).build())
+        src = ComputationGraph(g).init()
+        X = rng_np.normal(size=(6, 5, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, (6, 5))]
+        mask = np.ones((6, 5), np.float32)
+        mask[:3, 2:] = 0.0
+        ds = DataSet(X, y, features_mask=mask, labels_mask=mask.copy())
+
+        helper = GraphTransferLearningHelper(src, "lstm")
+        feat = helper.featurize(ds)
+        assert feat.labels_masks is not None
+        np.testing.assert_array_equal(feat.labels_masks[0], mask)
+        assert feat.features_masks is not None     # propagated to frontier
+        np.testing.assert_array_equal(feat.features_masks[0], mask)
+
+        # one featurized step == one full-graph step (lstm frozen via helper
+        # split; full graph comparison uses zero-lr freeze from the builder)
+        frozen_full = (TransferLearning.GraphBuilder(src)
+                       .set_feature_extractor("lstm").build())
+        frozen_full.fit_batch(ds)
+        helper.fit_featurized(feat)
+        np.testing.assert_allclose(
+            _flat(helper.graph.params["out"]),
+            _flat(frozen_full.params["out"]), rtol=1e-5, atol=1e-7)
+
+
+class TestReviewRegressions2:
+    """Pins for the second r3 review round on this feature set."""
+
+    def test_fit_featurized_then_full_graph_fit(self, rng_np):
+        """Write-back must copy buffers: the full graph's donating train
+        step would otherwise delete arrays the helper still references."""
+        src = _small_graph()
+        new = (TransferLearning.GraphBuilder(src)
+               .set_feature_extractor("d1").build())
+        helper = GraphTransferLearningHelper(new)
+        ds = _cls_batch(rng_np)
+        feat = helper.featurize(ds)
+        helper.fit_featurized(feat)
+        new.fit_batch(ds)                      # donates params buffers
+        out = helper.output_from_featurized(feat)    # must not be deleted
+        assert np.all(np.isfinite(np.asarray(out[0])))
+        helper.fit_featurized(feat)            # and training still works
+
+    def test_remove_vertex_through_merge_reinfers_width(self, rng_np):
+        from deeplearning4j_tpu.nn.graph import MergeVertex
+        g = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+             .updater("sgd").weight_init("xavier").activation("tanh")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_out=5), "in")
+             .add_layer("b", DenseLayer(n_out=7), "in")
+             .add_vertex("merge", MergeVertex(), "a", "b")
+             .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                           activation="softmax"), "merge")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4)).build())
+        src = ComputationGraph(g).init()
+        new = (TransferLearning.GraphBuilder(src)
+               .remove_vertex_and_connections("b").build())
+        # merge now carries only a's width; out re-inferred and re-inited
+        assert new.conf.vertices["out"].layer.n_in == 5
+        assert new.params["out"]["W"].shape == (5, 2)
+        new.fit_batch(_cls_batch(rng_np, n_out=2))
+        assert np.isfinite(float(new.score_value))
+
+    def test_remove_direct_layer_input_raises(self):
+        src = _small_graph()
+        with pytest.raises(ValueError):
+            # d2 directly feeds layer "out": removal leaves it inputless
+            (TransferLearning.GraphBuilder(src)
+             .remove_vertex_and_connections("d2").build())
