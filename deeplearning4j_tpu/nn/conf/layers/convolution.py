@@ -325,14 +325,18 @@ class LocalResponseNormalization(LayerConf):
         helper = get_helper("lrn")
         if helper is not None:
             return helper(self, x), state
+        # f32 internal math like the fused helper (kernels/lrn.py), so the
+        # helper-on/helper-off outputs are identical in every compute dtype
+        # (the windowed x² sum underflows/loses bits in bf16)
+        xf = x.astype(jnp.float32)
         half = int(self.n) // 2
-        sq = x * x
+        sq = xf * xf
         # windowed sum over the channel (last) axis
         summed = lax.reduce_window(sq, 0.0, lax.add,
                                    (1, 1, 1, int(self.n)), (1, 1, 1, 1),
                                    ((0, 0), (0, 0), (0, 0), (half, half)))
-        denom = jnp.power(self.k + self.alpha * summed, self.beta)
-        return x / denom, state
+        scale = jnp.power(self.k + self.alpha * summed, -self.beta)
+        return (xf * scale).astype(x.dtype), state
 
 
 @register_config

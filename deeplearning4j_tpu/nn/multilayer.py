@@ -323,6 +323,7 @@ class MultiLayerNetwork:
                 if isinstance(s, dict) else s for s in states]
 
     def _fit_batch(self, ds: DataSet):
+        self.last_input_batch = ds    # probe data for flow/debug listeners
         feats, labels, fmask, lmask = _as_jnp_batch(ds, self.compute_dtype)
         step = self._get_train_step(False)
         empty_rnn = [{} for _ in self.layers]

@@ -58,11 +58,19 @@ class FlowIterationListener(IterationListener):
     FlowIterationListener's flow view)."""
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
-                 session_id: str = "flow"):
+                 session_id: str = "flow",
+                 timing_frequency: Optional[int] = None):
         self.storage = storage
         self.frequency = max(1, int(frequency))
         self.session_id = session_id
         self._static_sent = False
+        # the per-layer timing probe is EAGER (one dispatch + blocking read
+        # per layer — ~100 ms each through a tunneled device): by default it
+        # runs on the first record and then every 10th reported iteration;
+        # records in between reuse the last measured timings
+        self.timing_frequency = max(1, int(timing_frequency)) \
+            if timing_frequency is not None else self.frequency * 10
+        self._last_timings = None
 
     def iteration_done(self, model, iteration: int):
         if iteration % self.frequency:
@@ -75,12 +83,51 @@ class FlowIterationListener(IterationListener):
             self._static_sent = True
         sizes = [sum(int(np.prod(v.shape)) for v in p.values())
                  for p in model.params]
-        self.storage.put_update(
-            {"session": self.session_id, "type": "flow",
-             "iteration": int(iteration),
-             "score": float(model.score_value)
-             if model.score_value is not None else None,
-             "param_counts": sizes})
+        if self._last_timings is None or \
+                iteration % self.timing_frequency == 0:
+            timed = self._time_layers(model)
+            if timed is not None:
+                self._last_timings = timed
+        record = {"session": self.session_id, "type": "flow",
+                  "iteration": int(iteration),
+                  "score": float(model.score_value)
+                  if model.score_value is not None else None,
+                  "param_counts": sizes,
+                  "layer_timings_ms": self._last_timings}
+        self.storage.put_update(record)
+
+    @staticmethod
+    def _time_layers(model, probe_examples: int = 4):
+        """Per-layer forward timing on a probe slice of the last training
+        batch (the reference FlowIterationListener's per-layer boxes carry
+        timing). Eager layer-by-layer execution with a blocking read each
+        step — run at a coarse ``frequency``; None when the model exposes
+        no layers/last batch (e.g. ComputationGraph uses its own path)."""
+        import time
+        ds = getattr(model, "last_input_batch", None)
+        layers = getattr(model, "layers", None)
+        if ds is None or not layers or not getattr(model, "params", None):
+            return None
+        x = np.asarray(ds.features)[:probe_examples]
+        timings = []
+        try:
+            import jax.numpy as jnp
+            act = jnp.asarray(x, model.compute_dtype)
+            mask = None
+            inf_state = model._inference_state()
+            for i, layer in enumerate(layers):
+                pp = model.conf.preprocessor_for(i)
+                t0 = time.perf_counter()
+                if pp is not None:
+                    act = pp.pre_process(act, mask)
+                    mask = pp.feed_forward_mask(mask)
+                act, _ = layer.forward(model.params[i], inf_state[i], act,
+                                       train=False, rng=None, mask=mask)
+                np.asarray(act[:1])          # block: honest per-layer time
+                timings.append(round((time.perf_counter() - t0) * 1e3, 3))
+        except Exception:                    # pragma: no cover - best effort
+            return None
+        return timings
 
 
 class ConvolutionalIterationListener(IterationListener):

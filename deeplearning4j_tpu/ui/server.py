@@ -1,9 +1,12 @@
 """Training UI web server (reference deeplearning4j-play PlayUIServer with
-UIModule routes — train overview / model / system tabs; SURVEY.md §2.8).
+UIModule routes — train overview / model / system / flow tabs; SURVEY.md
+§2.8).
 
 Play framework → stdlib http.server: JSON endpoints over a StatsStorage plus
-a single-page overview rendering score & throughput charts (inline SVG, no
-external assets — the environment has no egress).
+single-page views rendering score & throughput charts (inline SVG, no
+external assets — the environment has no egress). Every tab carries a
+session selector (reference TrainModule keeps a session id per view), so
+earlier attached sessions stay reachable.
 
     UIServer.get_instance().attach(storage)   # then open http://host:9000
 """
@@ -36,33 +39,65 @@ function draw(svgId, xs, ys, cls) {
 }
 """
 
-_PAGE = """<!DOCTYPE html>
-<html><head><title>tpu-dl4j training UI</title>
-<style>
+_SESSIONS_JS = """
+// Shared session selector (reference TrainModule keeps a session id per
+// view): populates <select id=sesssel>, remembers the choice, and calls
+// render(session) on load and on change. Earlier sessions stay reachable.
+const esc = s => String(s).replace(/[&<>"']/g, c => ({'&':'&amp;',
+  '<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+async function initSessions(render) {
+  const sel = document.getElementById('sesssel');
+  const sessions = await (await fetch('/train/sessions')).json();
+  if (!sessions.length) return;
+  const prev = sel.value;
+  sel.innerHTML = sessions.map(s =>
+    `<option value="${encodeURIComponent(s)}">${esc(s)}</option>`).join('');
+  sel.value = sessions.map(encodeURIComponent).includes(prev)
+    ? prev : encodeURIComponent(sessions[sessions.length - 1]);
+  if (!sel.dataset.bound) {
+    sel.dataset.bound = '1';
+    sel.addEventListener('change', () => render(sel.value));
+  }
+  render(sel.value);
+}
+"""
+
+_NAV = ('<div class=nav><a href="/train">overview</a> '
+        '<a href="/train/model.html">model</a> '
+        '<a href="/train/system.html">system</a> '
+        '<a href="/train/flow.html">flow</a> '
+        '<a href="/train/activations.html">activations</a> '
+        '&nbsp; session: <select id=sesssel></select></div>')
+
+_STYLE = """
 body{font-family:sans-serif;margin:20px;background:#fafafa}
 h1{font-size:20px} .card{background:#fff;border:1px solid #ddd;
 border-radius:6px;padding:12px;margin:12px 0}
+.nav{margin:8px 0;font-size:13px} .nav a{margin-right:10px}
 svg{width:100%;height:220px} .axis{stroke:#999;stroke-width:1}
 .line{fill:none;stroke:#d7301f;stroke-width:1.5}
 .line2{fill:none;stroke:#2b8cbe;stroke-width:1.5}
 table{border-collapse:collapse} td,th{border:1px solid #ccc;padding:4px 8px}
-</style></head><body>
+"""
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>tpu-dl4j training UI</title>
+<style>""" + _STYLE + """</style></head><body>
 <h1>Training overview</h1>
-<div class=card><b>Session:</b> <span id=sess></span>
-<table id=info></table></div>
+""" + _NAV + """
+<div class=card><table id=info></table></div>
 <div class=card><b>Score vs iteration</b><svg id=score></svg></div>
 <div class=card><b>Iterations/sec</b><svg id=rate></svg></div>
-<script src="/train/chart.js"></script>\n<script>\nasync function refresh() {
-  const sessions = await (await fetch('/train/sessions')).json();
-  if (!sessions.length) return;
-  const s = sessions[sessions.length - 1];
-  document.getElementById('sess').textContent = s;
+<script src="/train/chart.js"></script>
+<script src="/train/sessions.js"></script>
+<script>
+async function render(s) {
   const info = await (await fetch('/train/info?session=' + s)).json();
   if (info) {
     document.getElementById('info').innerHTML =
-      `<tr><th>model</th><td>${info.model_class}</td></tr>` +
-      `<tr><th>params</th><td>${info.num_params}</td></tr>` +
-      `<tr><th>layers</th><td>${info.num_layers}</td></tr>`;
+      `<tr><th>model</th><td>${esc(info.model_class)}</td></tr>` +
+      `<tr><th>params</th><td>${esc(info.num_params)}</td></tr>` +
+      `<tr><th>layers</th><td>${esc(info.num_layers)}</td></tr>`;
   }
   const ups = await (await fetch('/train/updates?session=' + s)).json();
   draw('score', ups.map(u => u.iteration), ups.map(u => u.score), 'line');
@@ -70,28 +105,25 @@ table{border-collapse:collapse} td,th{border:1px solid #ccc;padding:4px 8px}
   draw('rate', rated.map(u => u.iteration),
        rated.map(u => u.iterations_per_sec), 'line2');
 }
+function refresh(){ initSessions(render); }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
 
 _MODEL_PAGE = """<!DOCTYPE html>
 <html><head><title>Model graph</title>
-<style>body{font-family:sans-serif;margin:20px;background:#fafafa}
-.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px}
+<style>""" + _STYLE + """
 .layer{display:inline-block;border:1px solid #2b8cbe;border-radius:4px;
 margin:4px;padding:6px 10px;background:#eef6fb;font-size:12px}
 .layer b{display:block} .arrow{color:#999;margin:0 2px}
-table{border-collapse:collapse;font-size:12px}
-td,th{border:1px solid #ccc;padding:3px 8px}</style></head><body>
-<h1>Model</h1><div class=card id=graph></div>
+table{font-size:12px} td,th{padding:3px 8px}</style></head><body>
+<h1>Model</h1>
+""" + _NAV + """
+<div class=card id=graph></div>
 <div class=card><b>Per-parameter mean |value|</b><table id=mags></table></div>
+<script src="/train/sessions.js"></script>
 <script>
-const esc = s => String(s).replace(/[&<>"']/g, c => ({'&':'&amp;','<':'&lt;',
-  '>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
-async function refresh(){
-  const sessions = await (await fetch('/train/sessions')).json();
-  if (!sessions.length) return;
-  const s = sessions[sessions.length - 1];
+async function render(s){
   const m = await (await fetch('/train/model?session=' + s)).json();
   if (!m || !m.layers) return;
   document.getElementById('graph').innerHTML = m.layers.map(l =>
@@ -106,50 +138,90 @@ async function refresh(){
       ([k, v]) => `<tr><td>${esc(k)}</td><td>${v.toExponential(3)}</td></tr>`
     ).join('');
 }
+function refresh(){ initSessions(render); }
 refresh(); setInterval(refresh, 5000);
 </script></body></html>"""
 
 
 _SYSTEM_PAGE = """<!DOCTYPE html>
 <html><head><title>System</title>
-<style>body{font-family:sans-serif;margin:20px;background:#fafafa}
-.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px;
-margin:12px 0} svg{width:100%;height:220px}
-.axis{stroke:#999;stroke-width:1}
-.line{fill:none;stroke:#d7301f;stroke-width:1.5}
-.line2{fill:none;stroke:#2b8cbe;stroke-width:1.5}</style></head><body>
+<style>""" + _STYLE + """</style></head><body>
 <h1>System</h1>
+""" + _NAV + """
 <div class=card><b>Process memory (max RSS, MB)</b><svg id=mem></svg></div>
 <div class=card><b>Iterations/sec</b><svg id=rate></svg></div>
-<script src="/train/chart.js"></script>\n<script>\nasync function refresh(){
-  const sessions = await (await fetch('/train/sessions')).json();
-  if (!sessions.length) return;
-  const s = sessions[sessions.length - 1];
+<script src="/train/chart.js"></script>
+<script src="/train/sessions.js"></script>
+<script>
+async function render(s){
   const sys = await (await fetch('/train/system?session=' + s)).json();
   draw('mem', sys.iterations, sys.max_rss_mb, 'line');
   draw('rate', sys.rate_iterations, sys.iterations_per_sec, 'line2');
 }
+function refresh(){ initSessions(render); }
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+_FLOW_PAGE = """<!DOCTYPE html>
+<html><head><title>Flow</title>
+<style>""" + _STYLE + """
+.layer{display:inline-block;border:1px solid #8c6bb1;border-radius:4px;
+margin:4px;padding:6px 10px;background:#f3eef8;font-size:12px;
+text-align:center}
+.layer b{display:block}.t{color:#555}.arrow{color:#999;margin:0 2px}
+</style></head><body>
+<h1>Flow</h1>
+""" + _NAV + """
+<div class=card id=boxes>no flow records — attach a FlowIterationListener
+</div>
+<div class=card><b>Score vs iteration (flow records)</b>
+<svg id=fscore></svg></div>
+<script src="/train/chart.js"></script>
+<script src="/train/sessions.js"></script>
+<script>
+async function render(s){
+  const d = await (await fetch('/train/flow?session=' + s)).json();
+  if (!d.layers || !d.layers.length) return;
+  document.getElementById('boxes').innerHTML = d.layers.map((l, i) =>
+    `<span class=layer><b>${esc(l.name)}</b>` +
+    `<span class=t>${esc(l.params)} params</span><br>` +
+    `<span class=t>${l.time_ms == null ? '–'
+      : Number(l.time_ms).toFixed(2) + ' ms'}</span></span>` +
+    (i < d.layers.length - 1 ? '<span class=arrow>&rarr;</span>' : '')
+  ).join('');
+  draw('fscore', d.iterations, d.scores, 'line');
+}
+function refresh(){ initSessions(render); }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>"""
 
 
 _ACTIVATIONS_PAGE = """<!DOCTYPE html>
 <html><head><title>Convolutional activations</title>
-<style>body{font-family:sans-serif;margin:20px;background:#fafafa}
-.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px;
-margin:12px 0} img{image-rendering:pixelated;border:1px solid #ccc}
+<style>""" + _STYLE + """
+img{image-rendering:pixelated;border:1px solid #ccc}
 h3{margin:4px 0;font-size:13px}</style></head><body>
-<h1>Convolutional activations</h1><div id=grids></div>
+<h1>Convolutional activations</h1>
+""" + _NAV + """
+<div id=grids></div>
+<script src="/train/sessions.js"></script>
 <script>
-async function refresh(){
-  const d = await (await fetch('/train/activations')).json();
+// records arrive over the unauthenticated /remote/receive push: escape
+// every interpolated field (same esc() policy as the model tab)
+async function render(s){
+  const d = await (await fetch('/train/activations?session=' + s)).json();
   if (!d.layers) return;
   document.getElementById('grids').innerHTML = d.layers.map(l =>
-    `<div class=card><h3>layer ${l.layer} — shape [${l.shape}] ` +
-    `mean ${l.mean.toFixed(3)} std ${l.std.toFixed(3)}</h3>` +
-    `<img src="/train/activations.png?layer=${l.layer}&it=${d.iteration}"` +
-    ` width="${l.grid_shape[1] * 3}">` + `</div>`).join('');
+    `<div class=card><h3>layer ${esc(l.layer)} — shape ` +
+    `[${esc(l.shape)}] mean ${Number(l.mean).toFixed(3)} ` +
+    `std ${Number(l.std).toFixed(3)}</h3>` +
+    `<img src="/train/activations.png?session=${esc(s)}&layer=` +
+    `${encodeURIComponent(l.layer)}&it=${encodeURIComponent(d.iteration)}"` +
+    ` width="${Number(l.grid_shape && l.grid_shape[1]) * 3 || 64}">` +
+    `</div>`).join('');
 }
+function refresh(){ initSessions(render); }
 refresh(); setInterval(refresh, 5000);
 </script></body></html>"""
 
@@ -208,44 +280,57 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _latest_conv_record(self):
-        """Most recent 'convolutional' record across sessions (the conv
-        listener uses its own session id)."""
+    def _js(self, script: str):
+        body = script.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/javascript")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _latest_conv_record(self, session: str = ""):
+        """Most recent 'convolutional' record — in ``session`` when given
+        (the conv listener uses its own session id), else across sessions."""
         storage = type(self).storage
         if storage is None:
             return None
-        for session in reversed(storage.list_sessions()):
-            for u in reversed(storage.get_updates(session)):
+        sessions = [session] if session else \
+            list(reversed(storage.list_sessions()))
+        for sess in sessions:
+            for u in reversed(storage.get_updates(sess)):
                 if u.get("type") == "convolutional":
                     return u
+        if session:               # fall back to any session's conv records
+            return self._latest_conv_record("")
         return None
 
     def do_GET(self):
         url = urlparse(self.path)
         q = parse_qs(url.query)
         storage = type(self).storage
+
+        def session_param():
+            # parse_qs already percent-decoded the value once; decoding
+            # again would corrupt ids containing literal %xx sequences
+            return q.get("session", [""])[0]
+
         if url.path in ("/", "/train", "/train/overview"):
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_PAGE)
         elif url.path == "/train/sessions":
             self._json(storage.list_sessions() if storage else [])
         elif url.path == "/train/updates":
-            session = q.get("session", [""])[0]
+            session = session_param()
             ups = storage.get_updates(session) if storage else []
             slim = [{k: u.get(k) for k in
                      ("iteration", "score", "iterations_per_sec", "epoch",
                       "timestamp", "max_rss_mb")} for u in ups]
             self._json(slim)
         elif url.path == "/train/info":
-            session = q.get("session", [""])[0]
+            session = session_param()
             info = storage.get_static_info(session) if storage else None
             self._json(info)
         elif url.path == "/train/histograms":
-            session = q.get("session", [""])[0]
+            session = session_param()
             ups = storage.get_updates(session) if storage else []
             hists = [u for u in ups if "param_histograms" in u]
             self._json(hists[-1] if hists else {})
@@ -253,7 +338,7 @@ class _Handler(BaseHTTPRequestHandler):
             # model-graph tab data (reference play train module's model
             # view): layer/vertex boxes from the stored config_json plus
             # the latest per-parameter magnitudes
-            session = q.get("session", [""])[0]
+            session = session_param()
             info = storage.get_static_info(session) if storage else None
             out = {"layers": [], "is_graph": False,
                    "param_mean_magnitudes": {}}
@@ -288,7 +373,7 @@ class _Handler(BaseHTTPRequestHandler):
         elif url.path == "/train/system":
             # system tab series (reference play train module's system
             # view): process memory + iteration rate over time
-            session = q.get("session", [""])[0]
+            session = session_param()
             ups = storage.get_updates(session) if storage else []
             mem = [(u["iteration"], u["max_rss_mb"]) for u in ups
                    if "max_rss_mb" in u]
@@ -300,15 +385,45 @@ class _Handler(BaseHTTPRequestHandler):
                 "rate_iterations": [r[0] for r in rate],
                 "iterations_per_sec": [r[1] for r in rate],
             })
+        elif url.path == "/train/flow":
+            # flow tab (reference FlowIterationListener's flow view): layer
+            # boxes with param counts + per-layer forward timing from the
+            # latest flow record, plus the score series
+            session = session_param()
+            ups = [u for u in (storage.get_updates(session)
+                               if storage else [])
+                   if u.get("type") == "flow"]
+            static = storage.get_static_info(session) if storage else None
+            names = (static or {}).get("layers") or []
+            out = {"layers": [], "iterations": [], "scores": []}
+            if ups:
+                last = ups[-1]
+                counts = last.get("param_counts") or []
+                timings = last.get("layer_timings_ms") or []
+                n = max(len(names), len(counts), len(timings))
+                for i in range(n):
+                    out["layers"].append({
+                        "name": names[i] if i < len(names) else f"layer_{i}",
+                        "params": counts[i] if i < len(counts) else 0,
+                        "time_ms": timings[i] if i < len(timings) else None,
+                    })
+                pts = [(u["iteration"], u["score"]) for u in ups
+                       if u.get("score") is not None]
+                out["iterations"] = [p[0] for p in pts]
+                out["scores"] = [p[1] for p in pts]
+            self._json(out)
         elif url.path == "/train/activations":
-            rec = self._latest_conv_record()
+            rec = self._latest_conv_record(session_param())
             if rec:
                 # pixels travel via /train/activations.png, not the JSON
                 # poll — strip the base64 payloads
                 rec = dict(rec)
+                layers = rec.get("layers", [])
+                if not isinstance(layers, list):
+                    layers = []
                 rec["layers"] = [{k: v for k, v in l.items()
                                   if k != "grid_b64"}
-                                 for l in rec.get("layers", [])]
+                                 for l in layers if isinstance(l, dict)]
             self._json(rec if rec else {})
         elif url.path == "/train/activations.png":
             import base64
@@ -316,7 +431,7 @@ class _Handler(BaseHTTPRequestHandler):
             import numpy as np
 
             from .png import encode_gray_png
-            rec = self._latest_conv_record()
+            rec = self._latest_conv_record(session_param())
             try:
                 layer = int(q.get("layer", ["-1"])[0])
             except ValueError:
@@ -324,16 +439,34 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 return
             entry = None
-            for lrec in (rec or {}).get("layers", []):
-                if lrec["layer"] == layer or layer < 0:
+            layers = (rec or {}).get("layers", [])
+            if not isinstance(layers, list):
+                layers = []
+            for lrec in layers:
+                if not isinstance(lrec, dict):
+                    continue
+                if lrec.get("layer") == layer or layer < 0:
                     entry = lrec
                     break
             if entry is None or "grid_b64" not in entry:
                 self.send_response(404)
                 self.end_headers()
                 return
-            u8 = np.frombuffer(base64.b64decode(entry["grid_b64"]),
-                               np.uint8).reshape(entry["grid_shape"])
+            # records are remote-pushed: validate structure instead of
+            # letting KeyError/ValueError escape the handler
+            shape = entry.get("grid_shape")
+            try:
+                raw = base64.b64decode(entry["grid_b64"], validate=True)
+            except (ValueError, TypeError):
+                raw = None
+            if (raw is None or not isinstance(shape, (list, tuple))
+                    or len(shape) != 2
+                    or not all(isinstance(s, int) and s > 0 for s in shape)
+                    or shape[0] * shape[1] != len(raw)):
+                self.send_response(400)
+                self.end_headers()
+                return
+            u8 = np.frombuffer(raw, np.uint8).reshape(shape)
             body = encode_gray_png(u8)
             self.send_response(200)
             self.send_header("Content-Type", "image/png")
@@ -341,25 +474,19 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         elif url.path == "/train/chart.js":
-            body = _CHART_JS.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/javascript")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._js(_CHART_JS)
+        elif url.path == "/train/sessions.js":
+            self._js(_SESSIONS_JS)
         elif url.path == "/train/model.html":
             self._html(_MODEL_PAGE)
         elif url.path == "/train/system.html":
             self._html(_SYSTEM_PAGE)
+        elif url.path == "/train/flow.html":
+            self._html(_FLOW_PAGE)
         elif url.path == "/train/activations.html":
             self._html(_ACTIVATIONS_PAGE)
         elif url.path == "/tsne":
-            body = _TSNE_PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_TSNE_PAGE)
         elif url.path == "/tsne/coords":
             self._json(type(self).tsne_data or {"labels": [], "coords": []})
         else:

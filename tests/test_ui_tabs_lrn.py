@@ -159,3 +159,149 @@ class TestStorageRecordTypes:
             assert types == ["convolutional", "flow", "histogram",
                              "update"], (type(st).__name__, types)
             assert st.get_static_info("s")["type"] == "init"
+
+
+class TestLrnDtypeEquivalence:
+    def test_helper_matches_pure_path_bf16(self, rng_np):
+        """Both paths compute in f32 internally, so helper on/off is
+        identical in bf16 too (the docstring contract holds beyond f32)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.helpers import (disable_helper,
+                                                   enable_helper, get_helper)
+        layer = LocalResponseNormalization(k=2.0, n=5, alpha=1e-4, beta=0.75)
+        x = jnp.asarray(rng_np.normal(size=(2, 4, 4, 8)), jnp.bfloat16)
+        enable_helper("lrn")
+        assert get_helper("lrn") is not None
+        y_fast, _ = layer.forward({}, {}, x)
+        disable_helper("lrn")
+        try:
+            y_ref, _ = layer.forward({}, {}, x)
+        finally:
+            enable_helper("lrn")
+        assert y_fast.dtype == jnp.bfloat16 and y_ref.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(y_fast, np.float32), np.asarray(y_ref, np.float32))
+
+
+class TestRemoteRecordHardening:
+    """Remote-pushed records are untrusted (ADVICE r2): the activations tab
+    must escape interpolated fields and activations.png must 400 on
+    malformed structure instead of raising in the handler."""
+
+    @pytest.fixture
+    def server(self):
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        storage = InMemoryStatsStorage()
+        ui = UIServer(port=0)
+        ui.attach(storage)
+        yield f"http://127.0.0.1:{ui.port}", storage
+        ui.stop()
+
+    @staticmethod
+    def _post(base, record):
+        req = urllib.request.Request(
+            base + "/remote/receive", json.dumps(record).encode(),
+            {"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=10)
+
+    def test_activations_page_escapes_fields(self, server):
+        base, _ = server
+        html = urllib.request.urlopen(base + "/train/activations.html",
+                                      timeout=10).read().decode()
+        # every interpolation in the grids markup goes through esc() or
+        # encodeURIComponent — no raw ${l.xxx} left
+        assert "esc(l.layer)" in html and "esc(l.shape)" in html
+        assert "encodeURIComponent(l.layer)" in html
+        import re
+        raw = re.findall(r"\$\{(?!esc\(|encodeURIComponent\(|Number\()[^}]*\}",
+                         html.split("grids').innerHTML")[1].split("join")[0])
+        assert raw == [], raw
+
+    def test_png_rejects_malformed_grid(self, server):
+        import base64
+        base, _ = server
+        # grid_b64 length does not match grid_shape product
+        self._post(base, {"type": "convolutional", "session": "s",
+                          "iteration": 1, "layers": [{
+                              "layer": 0, "shape": [1, 4, 4, 2],
+                              "mean": 0.0, "std": 1.0,
+                              "grid_shape": [4, 4],
+                              "grid_b64": base64.b64encode(
+                                  b"\x00" * 7).decode()}]})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/train/activations.png?layer=0",
+                                   timeout=10)
+        assert e.value.code == 400
+
+    def test_png_rejects_missing_fields(self, server):
+        base, _ = server
+        self._post(base, {"type": "convolutional", "session": "s",
+                          "iteration": 1,
+                          "layers": [{"mean": 0.0, "std": 1.0}]})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/train/activations.png",
+                                   timeout=10)
+        assert e.value.code in (400, 404)
+
+
+class TestFlowTabAndSessions:
+    """Flow tab (reference FlowIterationListener view) + per-view session
+    selector (reference TrainModule session handling): two attached
+    sessions must BOTH stay reachable, and the flow endpoint serves layer
+    boxes with param counts and per-layer forward timings."""
+
+    @pytest.fixture
+    def two_sessions(self, rng_np):
+        from deeplearning4j_tpu.ui.legacy_listeners import \
+            FlowIterationListener
+        storage = InMemoryStatsStorage()
+
+        def train(session, seed):
+            conf = (NeuralNetConfiguration.Builder().seed(seed)
+                    .learning_rate(0.05).updater("sgd").weight_init("xavier")
+                    .activation("tanh").list()
+                    .layer(DenseLayer(n_out=6))
+                    .layer(OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            net = MultiLayerNetwork(conf).init()
+            X = rng_np.normal(size=(8, 4)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, 8)]
+            net.set_listeners(FlowIterationListener(storage,
+                                                    session_id=session))
+            net.fit([DataSet(X, y)] * 3)
+
+        train("run-one", 1)
+        train("run-two", 2)
+        ui = UIServer(port=0)
+        ui.attach(storage)
+        yield f"http://127.0.0.1:{ui.port}"
+        ui.stop()
+
+    def test_flow_tab_serves_layer_timing_boxes(self, two_sessions):
+        d = _get(two_sessions, "/train/flow?session=run-one")
+        assert [l["name"] for l in d["layers"]] == \
+            ["DenseLayer", "OutputLayer"]
+        assert all(l["params"] > 0 for l in d["layers"])
+        # per-layer forward timings measured on the probe batch
+        assert all(isinstance(l["time_ms"], float) and l["time_ms"] >= 0
+                   for l in d["layers"])
+        assert len(d["iterations"]) == len(d["scores"]) >= 1
+        html = urllib.request.urlopen(two_sessions + "/train/flow.html",
+                                      timeout=10).read().decode()
+        assert "Flow" in html and "sesssel" in html
+
+    def test_both_sessions_reachable(self, two_sessions):
+        sessions = _get(two_sessions, "/train/sessions")
+        assert "run-one" in sessions and "run-two" in sessions
+        d1 = _get(two_sessions, "/train/flow?session=run-one")
+        d2 = _get(two_sessions, "/train/flow?session=run-two")
+        assert d1["layers"] and d2["layers"]
+        # every tab page embeds the session selector + nav
+        for page in ("/train", "/train/model.html", "/train/system.html",
+                     "/train/activations.html", "/train/flow.html"):
+            html = urllib.request.urlopen(two_sessions + page,
+                                          timeout=10).read().decode()
+            assert "sesssel" in html, page
+            assert "/train/sessions.js" in html, page
