@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Iterable, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -20,6 +21,7 @@ from .huffman import apply_huffman, pad_codes
 from .skipgram import (skipgram_hs_step, skipgram_ns_step,
                        skipgram_ns_step_rng, cbow_hs_step, cbow_ns_step,
                        cbow_ns_step_rng, generate_skipgram_pairs,
+                       skipgram_hs_corpus_scan, skipgram_ns_corpus_scan,
                        vectorized_skipgram_pairs, vectorized_cbow_windows)
 from .vocab import VocabCache, VocabConstructor
 
@@ -147,12 +149,23 @@ class SequenceVectors:
                     perm = rng.permutation(len(tgt))
                     loss = self._run_cbow(tgt[perm], ctx[perm], cmask[perm],
                                           seen, ntokens, total, nskey)
-                else:
+                elif (self.use_hs and self.negative > 0) or \
+                        ntokens < self.SCAN_MIN_TOKENS:
+                    # combined HS+NS, or a small corpus: per-batch path with
+                    # globally shuffled pairs (better mixing; dispatch
+                    # overhead is irrelevant at this size)
                     c, t = vectorized_skipgram_pairs(corpus, self.window,
                                                      rng)
                     perm = rng.permutation(len(c))
                     loss = self._run_skipgram(c[perm], t[perm], seen,
                                               ntokens, total, nskey)
+                else:
+                    # single-objective skip-gram at scale: the whole chunk
+                    # trains as segmented device programs in corpus order
+                    # (word2vec.c's own order) — per-batch host transfers
+                    # and dispatch round-trips are the bottleneck here
+                    loss = self._run_skipgram_scan(corpus, seen, ntokens,
+                                                   total, nskey)
                 seen += ntokens
         if loss is not None:
             self._last_loss = float(loss)   # one sync, at the end
@@ -171,6 +184,69 @@ class SequenceVectors:
         return np.concatenate([a, pad])
         # padded entries train word 0 on itself once per epoch — negligible,
         # and shapes stay static for jit
+
+    # corpora below this size train via the shuffled per-batch path; the
+    # corpus-scan program pays off only when transfer+dispatch per batch
+    # dominates (large chunks)
+    SCAN_MIN_TOKENS = 100_000
+
+    # scan steps per program dispatch: the (n_steps, p) pair is static, so
+    # EVERY corpus length reuses one compilation — the callers loop
+    # ``start_step`` in SEG-sized segments (compile ~10 s dominated the
+    # end-to-end time; marginal cost is ~2.5 ms/step)
+    SCAN_SEGMENT = 64
+
+    def _run_skipgram_scan(self, corpus, seen, ntokens, total, nskey):
+        """Whole-chunk skip-gram as jitted lax.scan programs: the corpus
+        crosses the host→device boundary once (4 bytes/token) instead of
+        ~2·window·8 bytes of pair traffic plus a dispatch round-trip per
+        batch (the 73k tokens/s bottleneck, BASELINE.md r2/r3).
+
+        Update granularity follows ``batch_size`` exactly like the per-batch
+        path: each scan step covers ~batch_size/(2·window) center positions,
+        so the sqrt-count-normalized update count per epoch is unchanged —
+        one giant step would silently under-train small corpora."""
+        from ..ops.platform import configure_compilation_cache
+        configure_compilation_cache()
+        lt = self.lookup
+        window = self.window
+        p = max(32, self.batch_size // (2 * window))
+        seg = self.SCAN_SEGMENT
+        n = len(corpus)
+        n_steps = max((n + p - 1) // p, 1)
+        n_total = (n_steps + seg - 1) // seg * seg
+        padded = np.full(n_total * p + 2 * window, -1, np.int32)
+        padded[window:window + n] = corpus
+        sep_cum = np.cumsum(padded < 0).astype(np.int32)
+        corpus_d = jnp.asarray(padded)
+        sep_d = jnp.asarray(sep_cum)
+        frac0 = seen / max(total, 1)
+        frac_per_step = (ntokens / max(total, 1)) / n_steps
+        lr0 = jnp.float32(self.learning_rate)
+        lr_min = jnp.float32(self.min_learning_rate)
+        loss_sum = jnp.float32(0.0)
+        cnt = jnp.float32(0.0)
+        if self.negative > 0 and \
+                getattr(self, "_neg_table_dev", None) is None:
+            self._neg_table_dev = jnp.asarray(self._neg_table)
+        for start in range(0, n_total, seg):
+            key = jax.random.fold_in(nskey, start)
+            if self.negative > 0:
+                lt.syn0, lt.syn1neg, ls, c = skipgram_ns_corpus_scan(
+                    lt.syn0, lt.syn1neg, corpus_d, sep_d,
+                    self._neg_table_dev, key, jnp.int32(start), lr0, lr_min,
+                    jnp.float32(frac0), jnp.float32(frac_per_step),
+                    k=self.negative, window=window, n_steps=seg, p=p)
+            else:
+                lt.syn0, lt.syn1, ls, c = skipgram_hs_corpus_scan(
+                    lt.syn0, lt.syn1, corpus_d, sep_d, self._codes,
+                    self._points, self._lengths, key, jnp.int32(start),
+                    lr0, lr_min, jnp.float32(frac0),
+                    jnp.float32(frac_per_step), window=window,
+                    n_steps=seg, p=p)
+            loss_sum = loss_sum + ls
+            cnt = cnt + c
+        return loss_sum / jnp.maximum(cnt, 1.0)   # device scalar; lazy sync
 
     def _run_skipgram(self, centers, targets, seen, ntokens, total, nskey):
         import jax

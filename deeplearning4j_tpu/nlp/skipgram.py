@@ -18,6 +18,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 
@@ -30,9 +31,8 @@ def _scatter_mean_add(table, idx, updates, lr):
     variance growth of accumulated same-direction noise and empirically
     preserves word2vec convergence at standard learning rates across batch
     sizes (see tests/test_nlp_graph.py topic-similarity oracle)."""
-    counts = jnp.zeros((table.shape[0],), table.dtype).at[idx].add(1.0)
-    sums = jnp.zeros_like(table).at[idx].add(updates)
-    return table + lr * sums / jnp.sqrt(jnp.maximum(counts, 1.0))[:, None]
+    return _segment_update(table, idx, updates,
+                           jnp.ones(idx.shape, table.dtype), lr)
 
 @functools.partial(jax.jit, static_argnames=("hs",), donate_argnums=(0, 1))
 def skipgram_hs_step(syn0, syn1, centers, targets, codes, points, lengths,
@@ -135,6 +135,238 @@ def skipgram_ns_step_rng(syn0, syn1neg, centers, pos, neg_table, key, lr,
     negs = neg_table[jax.random.randint(key, (centers.shape[0], k), 0,
                                         neg_table.shape[0])]
     return _skipgram_ns_core(syn0, syn1neg, centers, pos, negs, lr)
+
+
+# bounds for the one-hot matmul segment-sum: the update runs on the MXU
+# (O(B·V) one-hot contraction — duplicate-index scatters serialize on hot
+# zipf rows, the matmul doesn't) only while BOTH the vocab axis and the
+# total one-hot footprint stay small; beyond either bound the one-hot
+# HBM traffic exceeds the scatter cost (e.g. HS updates with B·L rows at a
+# large V would materialize multi-GB one-hots) and the scatter path wins
+ONEHOT_SEGMENT_MAX_V = 32768
+ONEHOT_SEGMENT_MAX_ELEMS = 1 << 28        # bf16 one-hot cap: 512 MB
+
+
+def _segment_update(table, idx, updates, weights, lr):
+    """table[v] += lr * Σ_{i: idx_i=v} updates_i / sqrt(Σ weights_i) — the
+    sqrt-count-normalized segment update behind every embedding table write.
+    MXU one-hot contraction for small problems, scatter-add otherwise."""
+    V = table.shape[0]
+    if V <= ONEHOT_SEGMENT_MAX_V and \
+            int(idx.shape[0]) * V <= ONEHOT_SEGMENT_MAX_ELEMS:
+        oh = jax.nn.one_hot(idx, V, dtype=jnp.bfloat16)          # [B, V]
+        u = jnp.concatenate(
+            [updates.astype(jnp.bfloat16), weights[:, None].astype(
+                jnp.bfloat16)], axis=1)                          # [B, D+1]
+        r = lax.dot_general(oh, u, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [V, D+1]
+        sums = r[:, :-1].astype(table.dtype)
+        counts = r[:, -1].astype(table.dtype)
+    else:
+        counts = jnp.zeros((V,), table.dtype).at[idx].add(weights)
+        sums = jnp.zeros_like(table).at[idx].add(updates)
+    return table + lr * sums / jnp.sqrt(jnp.maximum(counts, 1.0))[:, None]
+
+
+def _masked_ns_update(syn0, syn1neg, centers, ctx, valid, negs, lr, dtype):
+    """Negative-sampling update over a FIXED-SHAPE masked pair block
+    [B] centers, [B] contexts, [B] validity. Invalid pairs contribute zero
+    gradient and zero occurrence count, so padding/out-of-window/cross-
+    sentence slots are exactly neutral."""
+    vm = valid.astype(dtype)
+    c_safe = jnp.where(valid, centers, 0)
+    t_safe = jnp.where(valid, ctx, 0)
+    h = syn0[c_safe]                                    # [B, D]
+    tgt = jnp.concatenate([t_safe[:, None], negs], axis=1)   # [B, 1+K]
+    label = jnp.concatenate(
+        [jnp.ones((len(c_safe), 1), dtype),
+         jnp.zeros(negs.shape, dtype)], axis=1)
+    v = syn1neg[tgt]                                    # [B, 1+K, D]
+    dots = jnp.einsum("bd,bkd->bk", h, v)
+    sig = jax.nn.sigmoid(dots)
+    g = (label - sig) * vm[:, None]
+    loss_sum = -jnp.sum(vm[:, None] * jnp.log(jnp.clip(
+        jnp.where(label > 0.5, sig, 1.0 - sig), 1e-10, 1.0)))
+    dh = jnp.einsum("bk,bkd->bd", g, v)
+    dv = jnp.einsum("bk,bd->bkd", g, h)
+    # sqrt-count normalization counting only VALID occurrences
+    syn0 = _segment_update(syn0, c_safe, dh, vm, lr)
+    syn1neg = _segment_update(
+        syn1neg, tgt.reshape(-1), dv.reshape(-1, dv.shape[-1]),
+        jnp.repeat(vm, tgt.shape[1]), lr)
+    return syn0, syn1neg, loss_sum, jnp.sum(vm)
+
+
+def _masked_ns_update_shared(syn0, syn1neg, centers, ctx, valid, negs, lr,
+                             dtype):
+    """Shared-negative variant: the SAME ``k`` negative rows serve every
+    pair in the block (the BlazingText / GPU-word2vec batching of
+    word2vec.c's per-pair draws). Per-pair expectation of the gradient is
+    unchanged; what changes is covariance within one step. The payoff on
+    TPU is structural: the [B, K, D] row-gather of per-pair negatives (the
+    dominant HBM cost of the scan — ~64 GB per 2M-token chunk) becomes a
+    [B,D]x[D,K] MXU matmul against a K-row table slice.
+
+    negs: [K] shared negative indices."""
+    vm = valid.astype(dtype)
+    c_safe = jnp.where(valid, centers, 0)
+    t_safe = jnp.where(valid, ctx, 0)
+    h = syn0[c_safe]                                    # [B, D]
+    vpos = syn1neg[t_safe]                              # [B, D]
+    vneg = syn1neg[negs]                                # [K, D]
+    dot_pos = jnp.sum(h * vpos, axis=1)                 # [B]
+    dots_neg = h @ vneg.T                               # [B, K] (MXU)
+    sig_pos = jax.nn.sigmoid(dot_pos)
+    sig_neg = jax.nn.sigmoid(dots_neg)
+    g_pos = (1.0 - sig_pos) * vm                        # [B]
+    g_neg = -sig_neg * vm[:, None]                      # [B, K]
+    loss_sum = -(jnp.sum(vm * jnp.log(jnp.clip(sig_pos, 1e-10, 1.0))) +
+                 jnp.sum(vm[:, None] * jnp.log(jnp.clip(1.0 - sig_neg,
+                                                        1e-10, 1.0))))
+    dh = g_pos[:, None] * vpos + g_neg @ vneg           # [B, D]
+    syn0 = _segment_update(syn0, c_safe, dh, vm, lr)
+    # positive rows: per-pair scatter; negative rows: dense [K, D] grad
+    syn1neg = _segment_update(syn1neg, t_safe, g_pos[:, None] * h, vm, lr)
+    dv_neg = g_neg.T @ h                                # [K, D]
+    neg_counts = jnp.full((negs.shape[0],), jnp.sum(vm), dtype)
+    syn1neg = syn1neg.at[negs].add(
+        lr * dv_neg / jnp.sqrt(jnp.maximum(neg_counts, 1.0))[:, None])
+    return syn0, syn1neg, loss_sum, jnp.sum(vm)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "window", "n_steps", "p",
+                                    "shared_negatives"),
+                   donate_argnums=(0, 1))
+def skipgram_ns_corpus_scan(syn0, syn1neg, corpus, sep_cum, neg_table, key,
+                            start_step, lr0, lr_min, frac0, frac_per_step,
+                            k: int, window: int, n_steps: int, p: int,
+                            shared_negatives: bool = True):
+    """Whole-chunk skip-gram NS training as ONE device program (the
+    AggregateSkipGram role, SkipGram.java:271-279, redesigned TPU-first).
+
+    The indexed corpus (−1 sentence separators, padded with −1 so that
+    every step's window read stays in range) is shipped to the device ONCE;
+    a ``lax.scan`` walks it in slices of ``p`` center positions starting at
+    position ``start_step*p``. Each step gathers the 2·window contexts per
+    center, masks them by dynamic-window draw / separator crossing
+    (``sep_cum`` prefix-sum guard) / validity, samples negatives on device,
+    and applies the masked segment-sum update. ``n_steps`` is a FIXED
+    segment size — callers loop ``start_step`` over the corpus, so one
+    compilation serves any corpus length (compile time, not compute, was
+    the end-to-end bottleneck: ~10 s vs ~2.5 ms/step marginal).
+
+    No host transfer or dispatch happens inside the loop; per 32k-pair
+    step this removes ~0.5 MB of pair traffic + a ~100 ms tunnel
+    round-trip (BASELINE.md r2/r3 accounting).
+
+    lr decays linearly in scan progress: lr(i) = max(lr0*(1−frac0−
+    i*frac_per_step), lr_min) — word2vec's schedule by tokens seen.
+    Returns (syn0, syn1neg, loss_sum, pair_count)."""
+    dtype = syn0.dtype
+    offs = jnp.asarray([d * sgn for d in range(1, window + 1)
+                        for sgn in (-1, 1)], jnp.int32)       # [2W]
+    dmag = jnp.asarray([d for d in range(1, window + 1)
+                        for _ in (0, 1)], jnp.int32)          # [2W]
+
+    def body(carry, i):
+        syn0, syn1neg, key, loss_sum, cnt = carry
+        pos = (start_step + i) * p + window + jnp.arange(p)   # [p]
+        centers = corpus[pos]
+        cum_c = sep_cum[pos]
+        key, kb, kn = jax.random.split(key, 3)
+        b = jax.random.randint(kb, (p,), 1, window + 1)
+        idx = pos[:, None] + offs[None, :]                    # [p, 2W]
+        ctx = corpus[idx]
+        valid = ((centers >= 0)[:, None] & (ctx >= 0) &
+                 (sep_cum[idx] == cum_c[:, None]) &
+                 (b[:, None] >= dmag[None, :]))
+        ctx = ctx.reshape(-1)
+        valid = valid.reshape(-1)
+        cflat = jnp.repeat(centers, 2 * window)
+        frac = frac0 + (start_step + i).astype(dtype) * frac_per_step
+        lr = jnp.maximum(lr0 * (1.0 - jnp.minimum(frac, 1.0)), lr_min)
+        if shared_negatives:
+            negs = neg_table[jax.random.randint(
+                kn, (k,), 0, neg_table.shape[0])]
+            syn0, syn1neg, ls, n = _masked_ns_update_shared(
+                syn0, syn1neg, cflat, ctx, valid, negs, lr, dtype)
+        else:
+            negs = neg_table[jax.random.randint(
+                kn, (cflat.shape[0], k), 0, neg_table.shape[0])]
+            syn0, syn1neg, ls, n = _masked_ns_update(
+                syn0, syn1neg, cflat, ctx, valid, negs, lr, dtype)
+        return (syn0, syn1neg, key, loss_sum + ls, cnt + n), None
+
+    (syn0, syn1neg, _, loss_sum, cnt), _ = lax.scan(
+        body, (syn0, syn1neg, key, jnp.asarray(0.0, dtype),
+               jnp.asarray(0.0, dtype)), jnp.arange(n_steps))
+    return syn0, syn1neg, loss_sum, cnt
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("window", "n_steps", "p"),
+                   donate_argnums=(0, 1))
+def skipgram_hs_corpus_scan(syn0, syn1, corpus, sep_cum, codes_tab,
+                            points_tab, lengths_tab, key, start_step,
+                            lr0, lr_min, frac0, frac_per_step,
+                            window: int, n_steps: int, p: int):
+    """Hierarchical-softmax sibling of :func:`skipgram_ns_corpus_scan`:
+    Huffman code/point tables stay device-resident ([V, L]) and are gathered
+    per target inside the scan."""
+    dtype = syn0.dtype
+    L = codes_tab.shape[1]
+    offs = jnp.asarray([d * sgn for d in range(1, window + 1)
+                        for sgn in (-1, 1)], jnp.int32)
+    dmag = jnp.asarray([d for d in range(1, window + 1)
+                        for _ in (0, 1)], jnp.int32)
+
+    def body(carry, i):
+        syn0, syn1, key, loss_sum, cnt = carry
+        pos = (start_step + i) * p + window + jnp.arange(p)
+        centers = corpus[pos]
+        cum_c = sep_cum[pos]
+        key, kb = jax.random.split(key)
+        b = jax.random.randint(kb, (p,), 1, window + 1)
+        idx = pos[:, None] + offs[None, :]
+        ctx = corpus[idx]
+        valid = ((centers >= 0)[:, None] & (ctx >= 0) &
+                 (sep_cum[idx] == cum_c[:, None]) &
+                 (b[:, None] >= dmag[None, :]))
+        ctx = ctx.reshape(-1)
+        valid = valid.reshape(-1)
+        cflat = jnp.repeat(centers, 2 * window)
+        vm = valid.astype(dtype)
+        c_safe = jnp.where(valid, cflat, 0)
+        t_safe = jnp.where(valid, ctx, 0)
+        h = syn0[c_safe]                               # [B, D]
+        codes = codes_tab[t_safe]                      # [B, L]
+        pts = points_tab[t_safe]                       # [B, L]
+        lens = lengths_tab[t_safe]                     # [B]
+        lmask = ((jnp.arange(L)[None, :] < lens[:, None]) &
+                 valid[:, None]).astype(dtype)
+        v = syn1[pts]                                  # [B, L, D]
+        dots = jnp.einsum("bd,bld->bl", h, v)
+        label = 1.0 - codes
+        sig = jax.nn.sigmoid(dots)
+        g = (label - sig) * lmask
+        loss_sum_b = -jnp.sum(lmask * jnp.log(jnp.clip(
+            jnp.where(label > 0.5, sig, 1.0 - sig), 1e-10, 1.0)))
+        dh = jnp.einsum("bl,bld->bd", g, v)
+        dv = jnp.einsum("bl,bd->bld", g, h)
+        frac = frac0 + (start_step + i).astype(dtype) * frac_per_step
+        lr = jnp.maximum(lr0 * (1.0 - jnp.minimum(frac, 1.0)), lr_min)
+        syn0 = _segment_update(syn0, c_safe, dh, vm, lr)
+        syn1 = _segment_update(syn1, pts.reshape(-1),
+                               dv.reshape(-1, dv.shape[-1]),
+                               lmask.reshape(-1), lr)
+        return (syn0, syn1, key, loss_sum + loss_sum_b,
+                cnt + jnp.sum(vm)), None
+
+    (syn0, syn1, _, loss_sum, cnt), _ = lax.scan(
+        body, (syn0, syn1, key, jnp.asarray(0.0, dtype),
+               jnp.asarray(0.0, dtype)), jnp.arange(n_steps))
+    return syn0, syn1, loss_sum, cnt
 
 
 def generate_skipgram_pairs(indexed_seq: np.ndarray, window: int,

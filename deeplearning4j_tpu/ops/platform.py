@@ -25,3 +25,34 @@ def train_donate_argnums(default=(0, 1, 2)):
     except Exception:
         return default
     return () if backend == "axon" else default
+
+
+_CACHE_CONFIGURED = False
+
+
+def configure_compilation_cache(path: str = None) -> bool:
+    """Enable JAX's persistent (on-disk) compilation cache once per process.
+
+    Through the tunneled device, compiling a corpus-scan program costs ~10 s
+    while running it costs ~0.2 s — for short jobs the cache IS the
+    throughput. Safe to call repeatedly; opt out with
+    ``DL4J_TPU_COMPILE_CACHE=0``. Returns True when the cache is active."""
+    global _CACHE_CONFIGURED
+    if _CACHE_CONFIGURED:
+        return True
+    if os.environ.get("DL4J_TPU_COMPILE_CACHE", "").lower() in \
+            ("0", "false", "no"):
+        return False
+    try:
+        import jax
+        cache_dir = path or os.environ.get(
+            "DL4J_TPU_COMPILE_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "dl4j_tpu_xla"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _CACHE_CONFIGURED = True
+        return True
+    except Exception:                      # pragma: no cover - best effort
+        return False

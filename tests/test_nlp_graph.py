@@ -236,3 +236,48 @@ class TestVectorizedPairGeneration:
                 assert members <= {5, 6}
             else:
                 assert members <= {7, 8}
+
+
+class TestCorpusScanPath:
+    """The corpus-scan skip-gram program (skipgram_ns_corpus_scan /
+    skipgram_hs_corpus_scan) must converge like the per-batch path — it is
+    the large-corpus hot path (BASELINE config #4)."""
+
+    def _fit_scan(self, rng_np, negative):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        seqs, topic_a, topic_b = _topic_corpus(rng_np, n_sentences=200)
+        w2v = (Word2Vec.Builder().layer_size(16).window_size(3)
+               .negative_sample(negative).epochs(10).seed(2)
+               .batch_size(256).build())
+        w2v.SCAN_MIN_TOKENS = 0          # force the scan path
+        w2v.fit(seqs)
+        return w2v, topic_a, topic_b
+
+    def test_ns_scan_converges(self, rng_np):
+        w2v, ta, tb = self._fit_scan(rng_np, negative=5)
+        assert w2v.similarity(ta[0], ta[1]) > w2v.similarity(ta[0], tb[0])
+
+    def test_hs_scan_converges(self, rng_np):
+        w2v, ta, tb = self._fit_scan(rng_np, negative=0)
+        assert w2v.similarity(ta[0], ta[1]) > w2v.similarity(ta[0], tb[0])
+
+    def test_scan_respects_sentence_boundaries(self):
+        """A pair crossing a -1 separator must contribute nothing: train on
+        two 'sentences' of mutually-exclusive vocab; cross-words must not
+        become similar through boundary-jumping windows."""
+        import numpy as np
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        rng = np.random.default_rng(7)
+        seqs = []
+        for _ in range(300):
+            seqs.append([f"a{rng.integers(0, 4)}" for _ in range(6)])
+            seqs.append([f"b{rng.integers(0, 4)}" for _ in range(6)])
+        w2v = (Word2Vec.Builder().layer_size(12).window_size(5)
+               .negative_sample(3).epochs(6).seed(3).batch_size(512).build())
+        w2v.SCAN_MIN_TOKENS = 0
+        w2v.fit(seqs)
+        within = np.mean([w2v.similarity("a0", "a1"),
+                          w2v.similarity("b0", "b1")])
+        across = np.mean([w2v.similarity("a0", "b0"),
+                          w2v.similarity("a1", "b2")])
+        assert within > across
