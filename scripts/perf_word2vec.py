@@ -1,0 +1,53 @@
+"""Word2Vec throughput bench — BASELINE config #4 under the r1 conditions:
+10k-word zipfian corpus, 2M tokens, dim 128, window 5, 5 negatives,
+batch 32768 (reference SkipGram.java:271-279 AggregateSkipGram role).
+
+Reports tokens/sec end-to-end (vocab build included, the r2 protocol) and
+training-only. r2 recorded 73k end-to-end / 87k training-only on the
+per-batch path; the corpus-scan path (skipgram_ns_corpus_scan) moves the
+whole chunk through one device program.
+
+Usage: python scripts/perf_word2vec.py [tokens] (default 2_000_000)
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+V = 10_000
+SENT = 20
+
+rng = np.random.default_rng(0)
+# zipfian unigram draw over V words, sentences of ~SENT tokens
+ranks = np.arange(1, V + 1)
+p = 1.0 / ranks
+p /= p.sum()
+tokens = rng.choice(V, size=N, p=p)
+words = np.array([f"w{i}" for i in range(V)])
+seqs = [list(words[tokens[i:i + SENT]]) for i in range(0, N, SENT)]
+print(f"corpus: {N} tokens, {len(seqs)} sentences, vocab<= {V}")
+
+t0 = time.perf_counter()
+w2v = (Word2Vec.Builder().layer_size(128).window_size(5).negative_sample(5)
+       .epochs(1).seed(1).batch_size(32768).min_word_frequency(1).build())
+w2v.build_vocab(seqs)
+t_vocab = time.perf_counter()
+w2v.fit(seqs)
+# the scan path returns a lazy device scalar; force it for honest timing
+print("final loss:", float(w2v._last_loss)
+      if w2v._last_loss is not None else None)
+t1 = time.perf_counter()
+
+print(f"vocab build: {t_vocab - t0:.1f}s")
+print(f"train:       {t1 - t_vocab:.1f}s  "
+      f"({N / (t1 - t_vocab):,.0f} tokens/s training-only)")
+print(f"end-to-end:  {t1 - t0:.1f}s  ({N / (t1 - t0):,.0f} tokens/s)")
+sim = w2v.similarity("w0", "w1")
+print(f"sanity similarity(w0,w1) = {sim:.3f}")
