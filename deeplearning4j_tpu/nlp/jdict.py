@@ -24,8 +24,10 @@ PARTICLES = [
 # -- closed-class: auxiliaries / copula / inflection surfaces ----------
 AUXILIARIES = [
     "です", "ます", "ました", "ません", "でした", "だ", "だった", "である",
+    "ください", "でしょうか",
     "じゃない", "ではない", "ない", "たい", "た", "て", "ている", "ていた",
-    "てる", "られる", "れる", "せる", "させる", "う", "よう", "でしょう",
+    "てる", "いた", "いて", "います", "いました", "いません",
+    "られる", "れる", "せる", "させる", "う", "よう", "でしょう",
     "だろう", "み", "そう", "らしい", "はず", "べき", "い",
 ]
 
@@ -42,6 +44,27 @@ NOUNS = [
     "天気", "朝", "昼", "夜", "犬", "猫", "魚", "鳥", "花", "木",
     "すもも", "もも", "うち", "ラーメン", "寿司", "お茶", "ご飯", "パン",
     "大学", "研究", "科学", "技術", "計算", "機械", "学習", "データ",
+    # r3 expansion: everyday nouns (hand-assembled, no vendored data)
+    "部屋", "窓", "椅子", "机", "写真", "新聞", "雑誌", "手紙", "切符",
+    "お金", "財布", "鍵", "傘", "靴", "服", "帽子", "眼鏡", "荷物",
+    "病院", "銀行", "郵便局", "図書館", "公園", "空港", "ホテル", "レストラン",
+    "喫茶店", "美術館", "教室", "事務所", "工場", "警察", "交番",
+    "バス", "タクシー", "飛行機", "自転車", "地下鉄", "船",
+    "野菜", "果物", "肉", "卵", "牛乳", "塩", "砂糖", "酒", "ビール",
+    "紅茶", "料金", "値段", "品物", "買い物",
+    "父", "母", "兄", "姉", "弟", "妹", "家族", "子供", "夫", "妻",
+    "息子", "娘", "祖父", "祖母", "両親", "男", "女", "大人",
+    "名前", "住所", "番号", "意味", "質問", "答え", "問題", "試験",
+    "宿題", "授業", "休み", "午前", "午後", "週末", "毎日", "毎週",
+    "春", "夏", "秋", "冬", "雪", "風", "星", "太陽", "地図", "旅行",
+    "写真家", "医者", "看護師", "銀行員", "運転手", "歌手", "選手",
+    "電気", "電話", "携帯", "番組", "歴史", "文化", "政治", "経済",
+    "社会", "自然", "環境", "健康", "病気", "薬", "熱", "風邪",
+    "気持ち", "心", "体", "頭", "顔", "目", "耳", "口", "手", "足",
+    "声", "話", "歌", "絵", "字", "色", "形", "音", "味", "匂い",
+    "日本語", "漢字", "会議", "毎朝", "毎年", "寺", "お寺", "近く",
+    "昔", "上手", "元気", "好き", "みんな", "どちら", "この", "その",
+    "あの", "どの",
 ]
 
 # -- common verbs (dictionary + frequent conjugated surfaces) ----------
@@ -65,8 +88,12 @@ SUFFIXES = ["さん", "ちゃん", "君", "様", "たち", "的", "者", "員"]
 
 
 def default_entries():
-    """The vendored dictionary as (surface, pos, cost) tuples."""
-    out = []
+    """The dictionary as (surface, pos, cost) tuples: the hand-assembled
+    seed below plus ~4,300 paradigm-generated inflection surfaces
+    (nlp/jconj.py — verb/adjective conjugation over stem lists, the
+    IPADIC-coverage role without vendoring data)."""
+    from .jconj import generated_entries
+    out = list(generated_entries())
     for w in PARTICLES:
         out.append((w, "particle", 600 + 100 * max(0, 2 - len(w))))
     for w in AUXILIARIES:
