@@ -52,3 +52,168 @@ class TestEc2BoxCreator:
         assert len(set(drv.commands_run)) == len(drv.commands_run)
         creator.blowup_boxes()
         assert any("delete" in c for c in drv.commands_run)
+
+
+class _RecordedEc2Client:
+    """Recorded-response fake of the boto3 EC2 client (response shapes from
+    the EC2 API: run_instances/describe_instances/terminate_instances) so
+    Boto3Ec2Driver's request building and response parsing execute in CI."""
+
+    def __init__(self):
+        self.calls = []
+        self._n = 0
+        self._states = {}
+
+    def run_instances(self, **kwargs):
+        self.calls.append(("run_instances", kwargs))
+        assert kwargs["MinCount"] == kwargs["MaxCount"]
+        out = []
+        for _ in range(kwargs["MinCount"]):
+            iid = f"i-0abc{self._n:08x}"
+            self._n += 1
+            self._states[iid] = "pending"
+            out.append({"InstanceId": iid,
+                        "State": {"Code": 0, "Name": "pending"},
+                        "InstanceType": kwargs["InstanceType"]})
+        return {"Instances": out,
+                "ReservationId": "r-0123456789abcdef0"}
+
+    def describe_instances(self, InstanceIds):
+        self.calls.append(("describe_instances", InstanceIds))
+        for iid in InstanceIds:            # one poll later: running
+            if self._states.get(iid) == "pending":
+                self._states[iid] = "running"
+        instances = [{"InstanceId": iid,
+                      "State": {"Code": 16, "Name": self._states[iid]},
+                      "PublicIpAddress": f"54.1.2.{i + 10}",
+                      "PrivateIpAddress": f"10.0.0.{i + 10}"}
+                     for i, iid in enumerate(InstanceIds)]
+        # EC2 groups instances into reservations: exercise the nested parse
+        return {"Reservations": [
+            {"ReservationId": "r-1", "Instances": instances[:1]},
+            {"ReservationId": "r-2", "Instances": instances[1:]}]}
+
+    def terminate_instances(self, InstanceIds):
+        self.calls.append(("terminate_instances", InstanceIds))
+        for iid in InstanceIds:
+            self._states[iid] = "shutting-down"
+        return {"TerminatingInstances": [
+            {"InstanceId": iid,
+             "CurrentState": {"Name": "shutting-down"}}
+            for iid in InstanceIds]}
+
+
+class TestBoto3DriverRecorded:
+    def test_full_lifecycle_parses_recorded_responses(self):
+        from deeplearning4j_tpu.utils.fleet import (Boto3Ec2Driver,
+                                                    Ec2BoxCreator)
+        client = _RecordedEc2Client()
+        creator = Ec2BoxCreator(
+            num_boxes=3, size="c5.xlarge", security_group_id="sg-123",
+            key_pair="kp", ami_id="ami-42",
+            driver=Boto3Ec2Driver(client=client))
+        creator.create()
+        assert len(creator.get_boxes_created()) == 3
+        creator.block_till_all_running(timeout=5, poll=0.01)
+        hosts = creator.get_hosts()
+        assert hosts == ["54.1.2.10", "54.1.2.11", "54.1.2.12"]
+        ids = creator.blowup_boxes()
+        assert ("terminate_instances", ids) in client.calls
+        run_kwargs = client.calls[0][1]
+        assert run_kwargs["ImageId"] == "ami-42"
+        assert run_kwargs["SecurityGroupIds"] == ["sg-123"]
+        assert "InstanceMarketOptions" not in run_kwargs
+
+    def test_spot_request_shape(self):
+        from deeplearning4j_tpu.utils.fleet import (Boto3Ec2Driver,
+                                                    Ec2BoxCreator)
+        client = _RecordedEc2Client()
+        creator = Ec2BoxCreator(num_boxes=1, ami_id="ami-1",
+                                driver=Boto3Ec2Driver(client=client))
+        creator.create_spot()
+        assert client.calls[0][1]["InstanceMarketOptions"] == \
+            {"MarketType": "spot"}
+
+
+class _RecordedGcloudRunner:
+    """Recorded gcloud CLI outputs: create/delete succeed silently;
+    describe reports CREATING on the first poll, READY afterwards."""
+
+    def __init__(self, fail_create: bool = False):
+        self.argvs = []
+        self.fail_create = fail_create
+        self._described = set()
+
+    def __call__(self, argv):
+        import subprocess as sp
+        self.argvs.append(argv)
+        if "create" in argv:
+            rc = 1 if self.fail_create else 0
+            return sp.CompletedProcess(argv, rc, stdout=b"", stderr=b"boom")
+        if "describe" in argv:
+            name = argv[5]
+            first = name not in self._described
+            self._described.add(name)
+            return sp.CompletedProcess(
+                argv, 0, stdout=b"CREATING\n" if first else b"READY\n",
+                stderr=b"")
+        return sp.CompletedProcess(argv, 0, stdout=b"", stderr=b"")
+
+
+class TestGcloudDriverRecorded:
+    def test_describe_parses_states_and_lifecycle(self):
+        from deeplearning4j_tpu.utils.fleet import (Ec2BoxCreator,
+                                                    GcloudTpuDriver)
+        runner = _RecordedGcloudRunner()
+        drv = GcloudTpuDriver(zone="us-central2-b", runner=runner)
+        creator = Ec2BoxCreator(num_boxes=2, driver=drv)
+        creator.create()
+        # first describe poll: CREATING -> not running yet
+        assert not creator.all_running()
+        creator.block_till_all_running(timeout=5, poll=0.01)
+        assert all(h for h in creator.get_hosts())
+        creator.blowup_boxes()
+        assert any("delete" in a for a in runner.argvs)
+        create_argvs = [a for a in runner.argvs if "create" in a]
+        assert len(create_argvs) == 2
+        assert f"--zone=us-central2-b" in create_argvs[0]
+
+    def test_create_failure_raises(self):
+        from deeplearning4j_tpu.utils.fleet import (Ec2BoxCreator,
+                                                    GcloudTpuDriver)
+        drv = GcloudTpuDriver(runner=_RecordedGcloudRunner(fail_create=True))
+        creator = Ec2BoxCreator(num_boxes=1, driver=drv)
+        with pytest.raises(RuntimeError):
+            creator.create()
+
+
+class TestGcloudFailureSemantics:
+    def test_transient_describe_failure_maps_to_pending(self):
+        """A nonzero describe mid-provisioning must NOT abort the polling
+        loop (production parity: no check=True in the default runner)."""
+        import subprocess as sp
+        from deeplearning4j_tpu.utils.fleet import GcloudTpuDriver
+        calls = {"n": 0}
+
+        def runner(argv):
+            if "describe" in argv:
+                calls["n"] += 1
+                if calls["n"] == 1:        # transient gcloud hiccup
+                    return sp.CompletedProcess(argv, 1, b"", b"transient")
+                return sp.CompletedProcess(argv, 0, b"READY\n", b"")
+            return sp.CompletedProcess(argv, 0, b"", b"")
+
+        drv = GcloudTpuDriver(runner=runner)
+        boxes = drv.launch(1, {}, False)
+        first = drv.describe([boxes[0].instance_id])
+        assert first[0].state == "pending"       # tolerated, not raised
+        second = drv.describe([boxes[0].instance_id])
+        assert second[0].state == "running"
+
+    def test_create_failure_surfaces_stderr(self):
+        import subprocess as sp
+        from deeplearning4j_tpu.utils.fleet import GcloudTpuDriver
+        drv = GcloudTpuDriver(runner=lambda argv: sp.CompletedProcess(
+            argv, 1, b"", b"quota exceeded"))
+        with pytest.raises(RuntimeError, match="quota exceeded"):
+            drv.launch(1, {}, False)
