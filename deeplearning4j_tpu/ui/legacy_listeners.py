@@ -72,17 +72,29 @@ class FlowIterationListener(IterationListener):
             if timing_frequency is not None else self.frequency * 10
         self._last_timings = None
 
+    @staticmethod
+    def _structure(model):
+        """(layer/vertex display names, ordered param dicts) for both model
+        families: MLN keeps a layer list; ComputationGraph keeps
+        name-keyed vertices in topological order."""
+        params = getattr(model, "params", None)
+        if isinstance(params, dict):               # ComputationGraph
+            order = model.conf.topological_order
+            return list(order), [params[n] for n in order]
+        layers = [type(l).__name__ for l in getattr(model, "layers", [])]
+        return layers, list(params or [])
+
     def iteration_done(self, model, iteration: int):
         if iteration % self.frequency:
             return
+        names, param_dicts = self._structure(model)
         if not self._static_sent:
-            layers = [type(l).__name__ for l in getattr(model, "layers", [])]
             self.storage.put_static_info(
                 {"session": self.session_id, "type": "flow_static",
-                 "layers": layers})
+                 "layers": names})
             self._static_sent = True
         sizes = [sum(int(np.prod(v.shape)) for v in p.values())
-                 for p in model.params]
+                 for p in param_dicts]
         if self._last_timings is None or \
                 iteration % self.timing_frequency == 0:
             timed = self._time_layers(model)
@@ -98,20 +110,42 @@ class FlowIterationListener(IterationListener):
 
     @staticmethod
     def _time_layers(model, probe_examples: int = 4):
-        """Per-layer forward timing on a probe slice of the last training
-        batch (the reference FlowIterationListener's per-layer boxes carry
-        timing). Eager layer-by-layer execution with a blocking read each
-        step — run at a coarse ``frequency``; None when the model exposes
-        no layers/last batch (e.g. ComputationGraph uses its own path)."""
+        """Per-layer/vertex forward timing on a probe slice of the last
+        training batch (the reference FlowIterationListener's per-layer
+        boxes carry timing). Eager execution with a blocking read each step
+        — run at a coarse ``timing_frequency``; None when the model exposes
+        no last batch."""
         import time
         ds = getattr(model, "last_input_batch", None)
-        layers = getattr(model, "layers", None)
-        if ds is None or not layers or not getattr(model, "params", None):
+        params = getattr(model, "params", None)
+        if ds is None or not params:
             return None
-        x = np.asarray(ds.features)[:probe_examples]
         timings = []
         try:
+            import jax
             import jax.numpy as jnp
+            if isinstance(params, dict):           # ComputationGraph
+                feats = ds.features
+                probe = [np.asarray(f)[:probe_examples] for f in feats] \
+                    if isinstance(feats, (list, tuple)) \
+                    else np.asarray(feats)[:probe_examples]
+                acts = dict(model._inputs_dict(probe))
+                state = model._inference_state()
+                for name in model.conf.topological_order:
+                    v = model.conf.vertices[name]
+                    xs = [acts[i] for i in model.conf.vertex_inputs[name]]
+                    t0 = time.perf_counter()
+                    y, _ = v.forward(params[name], state[name], xs,
+                                     train=False, rng=None, masks=None)
+                    jax.block_until_ready(y)
+                    acts[name] = y
+                    timings.append(
+                        round((time.perf_counter() - t0) * 1e3, 3))
+                return timings
+            layers = getattr(model, "layers", None)
+            if not layers:
+                return None
+            x = np.asarray(ds.features)[:probe_examples]
             act = jnp.asarray(x, model.compute_dtype)
             mask = None
             inf_state = model._inference_state()

@@ -305,3 +305,34 @@ class TestFlowTabAndSessions:
                                           timeout=10).read().decode()
             assert "sesssel" in html, page
             assert "/train/sessions.js" in html, page
+
+
+class TestFlowListenerComputationGraph:
+    def test_flow_listener_on_graph(self, rng_np):
+        """FlowIterationListener works on ComputationGraph too: vertex
+        names, per-vertex param counts, and per-vertex timings."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.ui.legacy_listeners import \
+            FlowIterationListener
+        storage = InMemoryStatsStorage()
+        g = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+             .updater("sgd").weight_init("xavier").activation("tanh")
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_out=6), "in")
+             .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                           activation="softmax"), "d")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(4)).build())
+        net = ComputationGraph(g).init()
+        net.set_listeners(FlowIterationListener(storage, session_id="gflow"))
+        X = rng_np.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, 8)]
+        for _ in range(2):
+            net.fit_batch(DataSet(X, y))
+        static = storage.get_static_info("gflow")
+        assert static["layers"] == ["d", "out"]
+        ups = [u for u in storage.get_updates("gflow")
+               if u.get("type") == "flow"]
+        assert ups and ups[-1]["param_counts"] == [4 * 6 + 6, 6 * 2 + 2]
+        assert len(ups[-1]["layer_timings_ms"]) == 2
