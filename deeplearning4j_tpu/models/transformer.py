@@ -1,0 +1,118 @@
+"""Decoder-only transformer language model — the TPU-era flagship for the
+long-context story (SURVEY.md §5.7: the reference's only long-sequence
+mechanism is truncated BPTT; ring attention / sequence parallelism are the
+extensions this framework designs fresh). Built entirely from framework
+layers: TokenAndPositionEmbedding → pre-LN blocks (LayerNormalization →
+causal SelfAttentionLayer → residual add → LayerNormalization →
+TransformerFeedForward → residual add) → final LN → RnnOutputLayer with
+next-token cross-entropy.
+
+Sequence-parallel long contexts run the same attention math through the
+ring trainer (parallel/sequence.py) over ICI."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..nn.conf.config import NeuralNetConfiguration
+from ..nn.conf.layers import (LayerNormalization, RnnOutputLayer,
+                              SelfAttentionLayer, TokenAndPositionEmbedding,
+                              TransformerFeedForward)
+from ..nn.graph.computation_graph import ComputationGraph
+from ..nn.graph.vertices import ElementWiseVertex
+
+
+def transformer_lm_conf(vocab_size: int, d_model: int = 128,
+                        num_heads: int = 4, num_layers: int = 2,
+                        ff_mult: int = 4, max_length: int = 256,
+                        drop_out: float = 0.0, learning_rate: float = 3e-4,
+                        seed: int = 42):
+    """ComputationGraphConfiguration for a GPT-style causal LM.
+
+    Input: token ids [N, T] (named input "tokens"); output: next-token
+    distribution [N, T, vocab] (train with labels shifted left one step —
+    see :func:`lm_batch`). ``drop_out`` follows the framework-wide
+    DL4J convention: it is the RETENTION probability (0 disables
+    dropout)."""
+    g = (NeuralNetConfiguration.Builder().seed(seed)
+         .learning_rate(learning_rate).updater("adam").weight_init("xavier")
+         .graph_builder()
+         .add_inputs("tokens"))
+    keep = drop_out      # retention probability, like every layer conf
+    g.add_layer("embed",
+                TokenAndPositionEmbedding(n_in=vocab_size, n_out=d_model,
+                                          max_length=max_length,
+                                          drop_out=keep),
+                "tokens")
+    x = "embed"
+    for i in range(num_layers):
+        g.add_layer(f"ln{i}a",
+                    LayerNormalization(n_in=d_model, n_out=d_model), x)
+        g.add_layer(f"attn{i}",
+                    SelfAttentionLayer(n_in=d_model, n_out=d_model,
+                                       num_heads=num_heads, causal=True,
+                                       drop_out=keep,
+                                       activation="identity"),
+                    f"ln{i}a")
+        g.add_vertex(f"res{i}a", ElementWiseVertex(op="add"), x, f"attn{i}")
+        g.add_layer(f"ln{i}b",
+                    LayerNormalization(n_in=d_model, n_out=d_model),
+                    f"res{i}a")
+        g.add_layer(f"ffn{i}",
+                    TransformerFeedForward(n_in=d_model, n_out=d_model,
+                                           hidden_mult=ff_mult,
+                                           drop_out=keep,
+                                           activation="identity"),
+                    f"ln{i}b")
+        g.add_vertex(f"res{i}b", ElementWiseVertex(op="add"),
+                     f"res{i}a", f"ffn{i}")
+        x = f"res{i}b"
+    g.add_layer("lnf", LayerNormalization(n_in=d_model, n_out=d_model), x)
+    g.add_layer("out",
+                RnnOutputLayer(n_in=d_model, n_out=vocab_size,
+                               loss="mcxent", activation="softmax"), "lnf")
+    g.set_outputs("out")
+    return g.build()
+
+
+def lm_batch(tokens: np.ndarray, vocab_size: int):
+    """(features, one-hot labels) for next-token training from token ids
+    [N, T+1]: inputs are tokens[:, :-1], labels tokens[:, 1:]."""
+    x = np.asarray(tokens[:, :-1], np.int32)
+    y = np.eye(vocab_size, dtype=np.float32)[tokens[:, 1:]]
+    return x, y
+
+
+def generate(net: ComputationGraph, prompt_ids, length: int,
+             temperature: float = 1.0,
+             rng: Optional[np.random.Generator] = None,
+             bucket: Optional[int] = None) -> np.ndarray:
+    """Autoregressive sampling: the context is right-padded to a fixed
+    ``bucket`` length (default: the model's max_length) and the logit at
+    the true last position is read — causal attention never looks right,
+    so padding is invisible and every step reuses ONE compiled program
+    (a growing context would recompile per token: ~10 s each through a
+    tunneled TPU). Greedy when temperature == 0."""
+    rng = rng or np.random.default_rng(0)
+    ids = list(np.asarray(prompt_ids, np.int32).reshape(-1))
+    if bucket is None:
+        embed = net.conf.vertices["embed"].layer
+        bucket = getattr(embed, "max_length", len(ids) + length)
+    for _ in range(length):
+        t = len(ids)
+        if t > bucket:
+            raise ValueError(f"context {t} exceeds bucket {bucket}")
+        ctx = np.zeros((1, bucket), np.int32)
+        ctx[0, :t] = ids
+        probs = np.asarray(net.output(ctx)[0])[0, t - 1]
+        if temperature <= 0:
+            nxt = int(np.argmax(probs))
+        else:
+            logits = np.log(np.maximum(probs, 1e-9)) / temperature
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            nxt = int(rng.choice(len(p), p=p))
+        ids.append(nxt)
+    return np.asarray(ids, np.int32)

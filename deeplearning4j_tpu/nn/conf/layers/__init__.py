@@ -9,7 +9,8 @@ from .convolution import (ConvolutionLayer, Convolution1DLayer,
                           BatchNormalization, LocalResponseNormalization,
                           ZeroPaddingLayer, GlobalPoolingLayer)
 from .recurrent import GravesLSTM, LSTM, GravesBidirectionalLSTM
-from .attention import SelfAttentionLayer
+from .attention import (SelfAttentionLayer, LayerNormalization,
+                        TransformerFeedForward, TokenAndPositionEmbedding)
 from .variational import VariationalAutoencoder
 
 __all__ = [
@@ -20,5 +21,6 @@ __all__ = [
     "SubsamplingLayer", "Subsampling1DLayer", "BatchNormalization",
     "LocalResponseNormalization", "ZeroPaddingLayer", "GlobalPoolingLayer",
     "GravesLSTM", "LSTM", "GravesBidirectionalLSTM", "VariationalAutoencoder",
-    "SelfAttentionLayer",
+    "SelfAttentionLayer", "LayerNormalization",
+    "TransformerFeedForward", "TokenAndPositionEmbedding",
 ]

@@ -77,3 +77,103 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         if self.project_out:
             out = out @ params["Wo"] + params["bo"]
         return self.activation_fn()(out), state
+
+
+@register_config
+@dataclasses.dataclass
+class LayerNormalization(BaseRecurrentLayerConf):
+    """Last-axis layer norm (TPU-era extension; transformers normalize per
+    token, BatchNormalization's batch statistics do not apply to
+    variable-length autoregressive training). Statistics in f32 regardless
+    of compute dtype."""
+    eps: float = 1e-5
+
+    def set_n_in(self, it: InputType) -> None:
+        if not self.n_in:
+            self.n_in = it.size
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return it
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        d = self.n_out or self.n_in
+        return {"gamma": jnp.ones((d,), dtype),
+                "beta": jnp.zeros((d,), dtype)}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        # statistics at >= f32 (bf16 upcast; f64 stays f64 for the
+        # finite-difference gradient oracle)
+        sd = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(sd)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
+        y = (xf - mean) / jnp.sqrt(var + self.eps)
+        y = y * params["gamma"].astype(sd) + params["beta"].astype(sd)
+        return y.astype(x.dtype), state
+
+
+@register_config
+@dataclasses.dataclass
+class TransformerFeedForward(BaseRecurrentLayerConf):
+    """Per-token two-layer MLP (the transformer FFN block): [N, T, C] →
+    gelu(x W1 + b1) W2 + b2 → [N, T, C]. Time-distributed by construction —
+    no reshape preprocessors, the matmul broadcasts over [N, T]."""
+    hidden_mult: int = 4
+
+    def set_n_in(self, it: InputType) -> None:
+        if not self.n_in:
+            self.n_in = it.size
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        h = self.hidden_mult * self.n_in
+        k1, k2 = jax.random.split(key)
+        return {"W1": self._winit(k1, (self.n_in, h), self.n_in, h, dtype),
+                "b1": jnp.zeros((h,), dtype),
+                "W2": self._winit(k2, (h, self.n_out), h, self.n_out, dtype),
+                "b2": jnp.zeros((self.n_out,), dtype)}
+
+    def regularizable(self):
+        return ("W1", "W2")
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        h = jax.nn.gelu(x @ params["W1"] + params["b1"])
+        h = self.maybe_dropout(h, train=train, rng=rng)
+        return h @ params["W2"] + params["b2"], state
+
+
+@register_config
+@dataclasses.dataclass
+class TokenAndPositionEmbedding(BaseRecurrentLayerConf):
+    """Token ids [N, T] → embeddings + learned positions [N, T, n_out]
+    (the transformer input block; reference EmbeddingLayer handles [N]
+    only). ``n_in`` is the vocabulary size; sequences longer than
+    ``max_length`` are rejected at trace time."""
+    max_length: int = 512
+
+    def get_output_type(self, it: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, it.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32) -> Dict:
+        kw, kp = jax.random.split(key)
+        return {"W": jax.random.normal(kw, (self.n_in, self.n_out),
+                                       dtype) * 0.02,
+                "P": jax.random.normal(kp, (self.max_length, self.n_out),
+                                       dtype) * 0.02}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        ids = x.astype(jnp.int32)
+        if ids.ndim == 3:              # one-hot [N, T, V]
+            ids = jnp.argmax(ids, axis=-1)
+        t = ids.shape[1]
+        if t > self.max_length:
+            raise ValueError(f"sequence length {t} > max_length "
+                             f"{self.max_length}")
+        out = params["W"][ids] + params["P"][None, :t]
+        return self.maybe_dropout(out, train=train, rng=rng), state

@@ -184,3 +184,109 @@ class SequenceParallelTrainer:
         self.score_value = score
         self.iteration += 1
         return float(score)
+
+
+def enable_ring_attention(mesh: Mesh, axis: str = "sp",
+                          platforms=("tpu", "axon", "cpu")):
+    """Route every SelfAttentionLayer through ring attention over ``mesh``
+    via the helper seam (nn/helpers kind="attention" — the same registry the
+    cuDNN-style kernels use): with activations sequence-sharded on T, the
+    whole transformer trains sequence-parallel without touching the model.
+    Masked attention is not ring-supported — the helper refuses so the
+    layer's error surfaces instead of silently attending across padding."""
+    from ..nn.helpers import register_helper
+
+    def ring_helper(conf, q, k, v, mask):
+        if mask is not None:
+            raise ValueError("ring attention does not support key masks; "
+                             "train unmasked (LM) sequences or disable the "
+                             "ring helper")
+        return ring_self_attention(q, k, v, mesh, axis, causal=conf.causal)
+
+    register_helper("attention", ring_helper, platforms)
+    # a prior disable_ring_attention() leaves the kind in the disabled set;
+    # re-enabling must clear it or every later trainer silently falls back
+    # to the all-gather path
+    from ..nn.helpers import enable_helper
+    enable_helper("attention")
+
+
+def disable_ring_attention():
+    from ..nn.helpers import disable_helper
+    disable_helper("attention")
+
+
+class GraphSequenceParallelTrainer:
+    """Sequence-parallel training of a whole ComputationGraph (the
+    transformer LM flagship, models/transformer.py): token ids and labels
+    are sharded over the mesh ``sp`` axis on the TIME dimension; LN / FFN /
+    embedding / output-loss are token-local so GSPMD partitions them
+    trivially, and attention runs through ``ring_self_attention`` via the
+    helper seam (``enable_ring_attention``). One jitted program per step —
+    the standard graph train step, resharded.
+
+    The CPU-mesh test asserts one SP step == one single-device step
+    (ring attention is exact, not an approximation)."""
+
+    def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "sp"):
+        from .mesh import make_mesh
+        self.net = net
+        self.mesh = mesh if mesh is not None else \
+            make_mesh(axis_names=("sp",))
+        self.axis = axis
+        enable_ring_attention(self.mesh, axis)
+        self._jit_step = None
+
+    def _build(self):
+        net = self.net
+        mesh, axis = self.mesh, self.axis
+        step = net._make_train_step()
+        from jax.sharding import NamedSharding
+        rep = NamedSharding(mesh, P())
+        seq2 = NamedSharding(mesh, P(None, axis))
+        seq3 = NamedSharding(mesh, P(None, axis, None))
+
+        def wrapped(params, upd, state, inputs, labels, imasks, lmasks,
+                    iteration):
+            return step(params, upd, state, inputs, labels, imasks, lmasks,
+                        iteration, {})
+
+        self._jit_step = jax.jit(
+            wrapped,
+            in_shardings=(rep, rep, rep, seq2, seq3, seq2, seq2, None),
+            out_shardings=(rep, rep, rep, rep),
+            donate_argnums=(0, 1, 2))
+
+    def fit_batch(self, ds):
+        net = self.net
+        net._ensure_init()
+        n_sp = self.mesh.shape[self.axis]
+        t = np.asarray(ds.features).shape[1]
+        if t % n_sp:
+            raise ValueError(f"sequence length {t} not divisible by sp "
+                             f"axis size {n_sp}")
+        if self._jit_step is None:
+            self._build()
+        net.last_input_batch = ds    # probe data for flow/debug listeners
+        inputs = net._inputs_dict(ds.features)
+        labels = net._labels_dict(ds.labels)
+        # label masks ([N, T]) shard over T like the labels; attention KEY
+        # masks are rejected inside the ring helper, but the per-token LOSS
+        # mask is T-local and correct under SP
+        imasks, lmasks = net._masks_of(ds)
+        net.params, net.updater_state, new_states, score = self._jit_step(
+            net.params, net.updater_state, net.state, inputs, labels,
+            imasks, lmasks, net.iteration)
+        net.state = net._strip_rnn_carry(new_states)
+        net.score_value = score
+        net.iteration += 1
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration)
+
+    def fit(self, data, num_epochs: int = 1):
+        from ..datasets.iterators import as_iterator
+        for _ in range(num_epochs):
+            for ds in as_iterator(data):
+                self.fit_batch(ds)
+            self.net.epoch += 1
+        return self

@@ -1,0 +1,226 @@
+"""Decoder-only transformer LM (models/transformer.py) — the TPU-era
+long-context flagship built from framework layers (SURVEY.md §5.7: the
+reference has no transformer; ring attention/SP are the designed-fresh
+extensions this model family rides)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import generate, lm_batch, transformer_lm_conf
+from deeplearning4j_tpu.nn import NeuralNetConfiguration, InputType
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.ops.dataset import DataSet
+
+
+def _tiny_lm(vocab=12, **kw):
+    kw.setdefault("d_model", 32)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("max_length", 32)
+    kw.setdefault("learning_rate", 1e-2)
+    kw.setdefault("seed", 5)
+    return ComputationGraph(transformer_lm_conf(vocab, **kw)).init()
+
+
+def _cyclic_batch(rng, vocab=12, n=16, t=16):
+    starts = rng.integers(0, vocab, (n, 1))
+    seq = (starts + np.arange(t + 1)[None, :]) % vocab
+    x, y = lm_batch(seq, vocab)
+    return DataSet(x, y)
+
+
+class TestTransformerLM:
+    def test_learns_cyclic_language(self, rng_np):
+        net = _tiny_lm()
+        ds = _cyclic_batch(rng_np)
+        s0 = net.score(ds)
+        for _ in range(150):
+            net.fit_batch(ds)
+        s1 = net.score(ds)
+        assert s1 < 0.05 * s0, (s0, s1)
+        # greedy generation continues the cycle exactly
+        out = generate(net, [3], 8, temperature=0)
+        np.testing.assert_array_equal(out, (3 + np.arange(9)) % 12)
+
+    def test_causality(self, rng_np):
+        """Output at position t must not depend on tokens after t."""
+        net = _tiny_lm()
+        a = rng_np.integers(0, 12, (1, 10)).astype(np.int32)
+        b = a.copy()
+        b[0, 6:] = (b[0, 6:] + 5) % 12        # mutate the future
+        oa = np.asarray(net.output(a)[0])
+        ob = np.asarray(net.output(b)[0])
+        np.testing.assert_allclose(oa[0, :6], ob[0, :6],
+                                   rtol=1e-5, atol=1e-6)
+        assert np.abs(oa[0, 6:] - ob[0, 6:]).max() > 1e-6
+
+    def test_serde_roundtrip(self, tmp_path, rng_np):
+        from deeplearning4j_tpu.utils.serializer import ModelSerializer
+        net = _tiny_lm()
+        net.fit_batch(_cyclic_batch(rng_np))
+        path = tmp_path / "lm.zip"
+        ModelSerializer.write_model(net, path)
+        loaded = ModelSerializer.restore_computation_graph(path)
+        x = rng_np.integers(0, 12, (2, 8)).astype(np.int32)
+        np.testing.assert_allclose(np.asarray(net.output(x)[0]),
+                                   np.asarray(loaded.output(x)[0]),
+                                   rtol=1e-6)
+
+    def test_max_length_guard(self):
+        net = _tiny_lm(max_length=8)
+        with pytest.raises(ValueError):
+            net.output(np.zeros((1, 9), np.int32))
+
+
+class TestTransformerLayerGradients:
+    """Finite-difference oracle for the new block layers through the MLN
+    gradient-check harness (SURVEY.md §4)."""
+
+    def _check(self, layers, input_type, ds):
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+        import jax.numpy as jnp
+        conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+                .updater("sgd").weight_init("xavier").list())
+        for l in layers:
+            conf = conf.layer(l)
+        conf = conf.set_input_type(input_type).build()
+        net = MultiLayerNetwork(conf, compute_dtype=jnp.float64).init()
+        return check_gradients(net, ds)
+
+    def test_layernorm_gradients(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import (LayerNormalization,
+                                                       RnnOutputLayer)
+        ds = DataSet(rng_np.normal(size=(2, 5, 3)),
+                     np.eye(2)[rng_np.integers(0, 2, (2, 5))].astype(
+                         np.float64))
+        assert self._check(
+            [LayerNormalization(),
+             RnnOutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+            InputType.recurrent(3), ds)
+
+    def test_ffn_gradients(self, rng_np):
+        from deeplearning4j_tpu.nn.conf.layers import (RnnOutputLayer,
+                                                       TransformerFeedForward)
+        ds = DataSet(rng_np.normal(size=(2, 4, 3)),
+                     np.eye(2)[rng_np.integers(0, 2, (2, 4))].astype(
+                         np.float64))
+        assert self._check(
+            [TransformerFeedForward(hidden_mult=2),
+             RnnOutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+            InputType.recurrent(3), ds)
+
+
+class TestTransformerSequenceParallel:
+    """The flagship LM trains sequence-parallel: T sharded over the 8-device
+    sp axis, attention over the ICI ring via the helper seam — one SP step
+    must equal one single-device step exactly (ring attention is exact)."""
+
+    def test_sp_step_matches_single_device(self, rng_np):
+        import jax
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer, disable_ring_attention)
+        ds = _cyclic_batch(rng_np, n=4, t=16)     # T=16 divisible by 8
+        solo = _tiny_lm()
+        solo.fit_batch(ds)
+        sp_net = _tiny_lm()
+        trainer = GraphSequenceParallelTrainer(
+            sp_net, mesh=make_mesh(axis_names=("sp",)))
+        try:
+            trainer.fit_batch(ds)
+        finally:
+            disable_ring_attention()
+        for name in solo.params:
+            for k in solo.params[name]:
+                # adam divides tiny grads by sqrt(v)+eps, amplifying
+                # reduction-order noise from the ring's streaming softmax
+                np.testing.assert_allclose(
+                    np.asarray(sp_net.params[name][k]),
+                    np.asarray(solo.params[name][k]),
+                    rtol=2e-3, atol=1e-4, err_msg=f"{name}/{k}")
+        assert abs(float(sp_net.score_value) - float(solo.score_value)) < 1e-4
+
+    def test_sp_training_converges(self, rng_np):
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer, disable_ring_attention)
+        net = _tiny_lm()
+        trainer = GraphSequenceParallelTrainer(
+            net, mesh=make_mesh(axis_names=("sp",)))
+        ds = _cyclic_batch(rng_np, n=8, t=16)
+        try:
+            s0 = net.score(ds)
+            for _ in range(60):
+                trainer.fit_batch(ds)
+        finally:
+            disable_ring_attention()
+        assert net.score(ds) < 0.3 * s0
+
+    def test_indivisible_sequence_rejected(self, rng_np):
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer, disable_ring_attention)
+        net = _tiny_lm()
+        trainer = GraphSequenceParallelTrainer(
+            net, mesh=make_mesh(axis_names=("sp",)))
+        try:
+            with pytest.raises(ValueError):
+                trainer.fit_batch(_cyclic_batch(rng_np, n=2, t=11))
+        finally:
+            disable_ring_attention()
+
+
+class TestSPRegressions:
+    def test_ring_helper_reenables_after_disable(self, rng_np):
+        """disable_ring_attention leaves the kind disabled; a later trainer
+        must re-enable it or it silently trains without the ring."""
+        from deeplearning4j_tpu.nn.helpers import get_helper
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer, disable_ring_attention)
+        mesh = make_mesh(axis_names=("sp",))
+        t1 = GraphSequenceParallelTrainer(_tiny_lm(), mesh)
+        disable_ring_attention()
+        assert get_helper("attention") is None
+        t2 = GraphSequenceParallelTrainer(_tiny_lm(), mesh)
+        try:
+            assert get_helper("attention") is not None
+        finally:
+            disable_ring_attention()
+
+    def test_sp_label_mask_matches_single_device(self, rng_np):
+        """Per-token label masks shard over T and must weight the loss
+        exactly like the single-device step."""
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer, disable_ring_attention)
+        ds0 = _cyclic_batch(rng_np, n=4, t=16)
+        mask = np.ones((4, 16), np.float32)
+        mask[:2, 8:] = 0.0                     # half the rows are short
+        ds = DataSet(ds0.features, ds0.labels, labels_mask=mask)
+        solo = _tiny_lm()
+        solo.fit_batch(ds)
+        sp_net = _tiny_lm()
+        trainer = GraphSequenceParallelTrainer(
+            sp_net, mesh=make_mesh(axis_names=("sp",)))
+        try:
+            trainer.fit_batch(ds)
+        finally:
+            disable_ring_attention()
+        assert abs(float(sp_net.score_value) -
+                   float(solo.score_value)) < 1e-4
+        np.testing.assert_allclose(
+            np.asarray(sp_net.params["out"]["W"]),
+            np.asarray(solo.params["out"]["W"]), rtol=2e-3, atol=1e-4)
+
+    def test_generate_uses_fixed_bucket(self, rng_np):
+        """Sampling pads to one bucket shape (one compile, padding invisible
+        to causal attention): bucketed == unbucketed-growing results."""
+        net = _tiny_lm()
+        ds = _cyclic_batch(rng_np)
+        for _ in range(80):
+            net.fit_batch(ds)
+        a = generate(net, [3], 6, temperature=0)            # default bucket
+        b = generate(net, [3], 6, temperature=0, bucket=16)
+        np.testing.assert_array_equal(a, b)
