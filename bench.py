@@ -14,6 +14,9 @@ Modes (BENCH_MODE):
   charrnn  BASELINE config #2: GravesLSTM char-RNN tokens/sec (2x512,
            vocab 80, batch 64, seq 128, bf16 — the r2-measured fastest
            RNN dtype).
+  transformer  r3 flagship: GPT-2-small-ish causal LM (12x768, 12 heads,
+           T=512, vocab 32k, bf16) tokens/sec through the graph train
+           step.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
@@ -173,7 +176,53 @@ CHARRNN_BASELINE = float(
     os.environ.get("BENCH_CHARRNN_BASELINE", "") or 1_022_705.0)
 
 
+def _transformer_lm() -> float:
+    """BASELINE transformer-LM mode: GPT-2-small-ish causal LM (12x768,
+    12 heads, T=512), tokens/sec through the full graph train step."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import lm_batch, transformer_lm_conf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    # batch 32 is the measured sweet spot (r3 sweep: 8→118k, 16→128k,
+    # 32→131k tokens/s)
+    V, B, T = 32_000, int(os.environ.get("BENCH_LM_BATCH", "32")), 512
+    conf = transformer_lm_conf(vocab_size=V, d_model=768, num_heads=12,
+                               num_layers=12, max_length=T,
+                               learning_rate=3e-4)
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, V, (B, T + 1))
+    x, y = lm_batch(toks, V)
+    from deeplearning4j_tpu.ops.dataset import DataSet
+    ds = DataSet(jax.device_put(jnp.asarray(x)),
+                 jax.device_put(jnp.asarray(y, jnp.bfloat16)))
+    for _ in range(WARMUP):
+        net.fit_batch(ds)
+    float(net.score_value)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        net.fit_batch(ds)
+    float(net.score_value)
+    return B * T * STEPS / (time.perf_counter() - t0)
+
+
+TRANSFORMER_BASELINE = float(
+    os.environ.get("BENCH_LM_BASELINE", "") or 131_353.9)
+
+
 def main() -> int:
+    if MODE == "transformer":
+        toks = _transformer_lm()
+        print(json.dumps({
+            "metric": "transformer_lm_train_tokens_per_sec",
+            "value": round(toks, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": round(toks / TRANSFORMER_BASELINE, 4)
+            if TRANSFORMER_BASELINE > 0 else 1.0,
+        }))
+        return 0
     if MODE == "charrnn":
         toks = _charrnn()
         print(json.dumps({
