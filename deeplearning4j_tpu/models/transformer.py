@@ -79,9 +79,13 @@ def transformer_lm_conf(vocab_size: int, d_model: int = 128,
 
 def lm_batch(tokens: np.ndarray, vocab_size: int):
     """(features, one-hot labels) for next-token training from token ids
-    [N, T+1]: inputs are tokens[:, :-1], labels tokens[:, 1:]."""
+    [N, T+1]: inputs are tokens[:, :-1], labels tokens[:, 1:]. The one-hot
+    is built directly (np.eye at vocab 32k would transiently allocate a
+    4 GB identity matrix)."""
     x = np.asarray(tokens[:, :-1], np.int32)
-    y = np.eye(vocab_size, dtype=np.float32)[tokens[:, 1:]]
+    tgt = np.asarray(tokens[:, 1:], np.int64)
+    y = np.zeros(tgt.shape + (vocab_size,), np.float32)
+    np.put_along_axis(y, tgt[..., None], 1.0, axis=-1)
     return x, y
 
 

@@ -185,15 +185,25 @@ class ComputationGraph:
                 masks[name] = ms[0] if ms else None
         return acts, new_state, reg, preouts, masks, last_inputs
 
+    def _to_device_dtype(self, a):
+        """compute_dtype for floats; integer inputs (token ids for
+        embedding gathers) KEEP their dtype — casting ids through bf16
+        (7-bit mantissa) silently corrupts every id >= 257."""
+        a = jnp.asarray(a)
+        if jnp.issubdtype(a.dtype, jnp.integer) or \
+                jnp.issubdtype(a.dtype, jnp.bool_):
+            return a
+        return a.astype(self.compute_dtype)
+
     def _inputs_dict(self, features) -> Dict[str, jnp.ndarray]:
         names = self.conf.network_inputs
         if isinstance(features, dict):
-            return {k: jnp.asarray(v, self.compute_dtype)
+            return {k: self._to_device_dtype(v)
                     for k, v in features.items()}
         if isinstance(features, (list, tuple)):
-            return {n: jnp.asarray(f, self.compute_dtype)
+            return {n: self._to_device_dtype(f)
                     for n, f in zip(names, features)}
-        return {names[0]: jnp.asarray(features, self.compute_dtype)}
+        return {names[0]: self._to_device_dtype(features)}
 
     @staticmethod
     def _strip_rnn_carry(states):
@@ -314,12 +324,12 @@ class ComputationGraph:
     def _labels_dict(self, labels) -> Dict:
         names = self.conf.network_outputs
         if isinstance(labels, dict):
-            return {k: jnp.asarray(v, self.compute_dtype)
+            return {k: self._to_device_dtype(v)
                     for k, v in labels.items()}
         if isinstance(labels, (list, tuple)):
-            return {n: jnp.asarray(l, self.compute_dtype)
+            return {n: self._to_device_dtype(l)
                     for n, l in zip(names, labels)}
-        return {names[0]: jnp.asarray(labels, self.compute_dtype)}
+        return {names[0]: self._to_device_dtype(labels)}
 
     def fit(self, data, num_epochs: int = 1):
         """Train on DataSet / MultiDataSet / iterator thereof (reference
@@ -652,6 +662,44 @@ class ComputationGraph:
         evs = self.do_evaluation(
             data, {first: Evaluation(labels=labels_list, top_n=top_n)})
         return evs[first]
+
+    def evaluate_regression(self, data):
+        """reference ComputationGraph.evaluateRegression (first head; use
+        do_evaluation with a per-output dict for more)."""
+        from ...eval.regression import RegressionEvaluation
+        first = self.conf.network_outputs[0]
+        return self.do_evaluation(
+            data, {first: RegressionEvaluation()})[first]
+
+    def evaluate_roc(self, data, threshold_steps: int = 0):
+        """reference ComputationGraph.evaluateROC."""
+        from ...eval.roc import ROC
+        first = self.conf.network_outputs[0]
+        return self.do_evaluation(data, {first: ROC(threshold_steps)})[first]
+
+    def evaluate_roc_multi_class(self, data, threshold_steps: int = 0):
+        """reference ComputationGraph.evaluateROCMultiClass."""
+        from ...eval.roc import ROCMultiClass
+        first = self.conf.network_outputs[0]
+        return self.do_evaluation(
+            data, {first: ROCMultiClass(threshold_steps)})[first]
+
+    def summary(self) -> str:
+        """Printable vertex table (reference ComputationGraph.summary())."""
+        self._ensure_init()
+        rows = [("vertex", "type", "inputs", "params")]
+        total = 0
+        for name in self.conf.topological_order:
+            v = self.conf.vertices[name]
+            n = sum(int(np.prod(p.shape))
+                    for p in self.params[name].values())
+            total += n
+            vtype = type(v.layer).__name__ if isinstance(v, LayerVertex) \
+                else type(v).__name__
+            rows.append((name, vtype,
+                         ",".join(self.conf.vertex_inputs[name]), f"{n:,}"))
+        from ..multilayer import format_summary_table
+        return format_summary_table(rows, total)
 
     # ----------------------------------------------------------- param utils
     def set_listeners(self, *listeners):

@@ -44,9 +44,29 @@ def _nz(value, default):
     return default if value is None else value
 
 
+def format_summary_table(rows, total: int) -> str:
+    """Shared summary() renderer: header+rows -> aligned table + footer."""
+    widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+    lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths))
+             for r in rows]
+    lines.append(f"Total params: {total:,}")
+    return "\n".join(lines)
+
+
+def _as_device_dtype(a, dtype):
+    """dtype for floats; integer arrays (embedding token ids) keep their
+    dtype — a bf16 round-trip corrupts ids >= 257."""
+    a = jnp.asarray(a)
+    if jnp.issubdtype(a.dtype, jnp.integer) or \
+            jnp.issubdtype(a.dtype, jnp.bool_):
+        return a
+    return a.astype(dtype)
+
+
 def _as_jnp_batch(ds: DataSet, dtype):
-    feats = jnp.asarray(ds.features, dtype)
-    labels = jnp.asarray(ds.labels, dtype) if ds.labels is not None else None
+    feats = _as_device_dtype(ds.features, dtype)
+    labels = _as_device_dtype(ds.labels, dtype) \
+        if ds.labels is not None else None
     fmask = jnp.asarray(ds.features_mask, dtype) \
         if ds.features_mask is not None else None
     lmask = jnp.asarray(ds.labels_mask, dtype) \
@@ -435,12 +455,75 @@ class MultiLayerNetwork:
 
     def evaluate(self, data, batch_size: int = 0):
         from ..eval.evaluation import Evaluation
+        return self.do_evaluation(data, Evaluation())
+
+    def do_evaluation(self, data, evaluation):
+        """Accumulate any IEvaluation (Evaluation / RegressionEvaluation /
+        ROC family) over the data (reference doEvaluation)."""
         from ..datasets.iterators import as_iterator
-        ev = Evaluation()
         for ds in as_iterator(data):
             out = self.output(ds.features)
-            ev.eval(ds.labels, out, mask=ds.labels_mask)
-        return ev
+            evaluation.eval(np.asarray(ds.labels), np.asarray(out),
+                            mask=None if ds.labels_mask is None
+                            else np.asarray(ds.labels_mask))
+        return evaluation
+
+    def evaluate_regression(self, data):
+        """reference MultiLayerNetwork.evaluateRegression."""
+        from ..eval.regression import RegressionEvaluation
+        return self.do_evaluation(data, RegressionEvaluation())
+
+    def evaluate_roc(self, data, threshold_steps: int = 0):
+        """reference evaluateROC (binary ROC on a 2-class/1-unit output)."""
+        from ..eval.roc import ROC
+        return self.do_evaluation(data, ROC(threshold_steps))
+
+    def evaluate_roc_multi_class(self, data, threshold_steps: int = 0):
+        """reference evaluateROCMultiClass (one-vs-all per class)."""
+        from ..eval.roc import ROCMultiClass
+        return self.do_evaluation(data, ROCMultiClass(threshold_steps))
+
+    def score_examples(self, ds: DataSet,
+                       add_regularization: bool = False) -> np.ndarray:
+        """Per-example loss [N] (reference scoreExamples: the score each
+        example contributes, optionally with the l1/l2 penalty added)."""
+        self._ensure_init()
+        from ..ops.losses import get_loss
+        feats, labels, fmask, lmask = _as_jnp_batch(ds, self.compute_dtype)
+        out_layer = self._output_layer()
+        fn = self._jit_cache.get("score_examples")
+        if fn is None:
+            def _scores(params, state, feats, labels, fmask, lmask):
+                params = self._cast_params(params)
+                pre, _, reg, _, out_mask = self._forward(
+                    params, state, feats, train=False, rng=None,
+                    fmask=fmask, last_preoutput=True)
+                mask = lmask if lmask is not None else \
+                    (out_mask if pre.ndim == 3 else None)
+                per = get_loss(out_layer.loss)(
+                    labels, pre, out_layer.activation or "identity", mask)
+                return per, reg
+            fn = jax.jit(_scores)
+            self._jit_cache["score_examples"] = fn
+        per, reg = fn(self.params, self._inference_state(), feats, labels,
+                      fmask, lmask)
+        per = np.asarray(per, np.float64)
+        if add_regularization:
+            per = per + float(reg)
+        return per
+
+    def summary(self) -> str:
+        """Printable layer table (reference MultiLayerNetwork.summary())."""
+        self._ensure_init()
+        rows = [("idx", "layer", "nIn", "nOut", "params")]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            n = sum(int(np.prod(v.shape)) for v in self.params[i].values())
+            total += n
+            rows.append((str(i), type(layer).__name__,
+                         str(getattr(layer, "n_in", "") or ""),
+                         str(getattr(layer, "n_out", "") or ""), f"{n:,}"))
+        return format_summary_table(rows, total)
 
     # ------------------------------------------------------ rnn / stateful
     def rnn_time_step(self, x) -> np.ndarray:
