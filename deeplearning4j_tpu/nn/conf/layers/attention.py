@@ -58,10 +58,10 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         k = (x @ params["Wk"]).reshape(n, t, hcount, hs)
         v = (x @ params["Wv"]).reshape(n, t, hcount, hs)
         helper = get_helper("attention")
-        if helper is not None:
-            out = helper(self, q, k, v, mask)
-        else:
-            from ....parallel.sequence import attention_reference
+        out = helper(self, q, k, v, mask) if helper is not None else None
+        if out is None:
+            # no helper, or the helper declined (e.g. flash kernel below
+            # its min_seq_len): built-in materialized-softmax path
             scale = 1.0 / jnp.sqrt(jnp.asarray(hs, x.dtype))
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
             neg = jnp.asarray(-1e30, x.dtype)

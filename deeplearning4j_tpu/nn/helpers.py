@@ -39,6 +39,16 @@ _FAILED_PROVIDERS: set = set()
 
 def register_helper(kind: str, fn: Callable,
                     platforms: Tuple[str, ...] = ("tpu",)) -> None:
+    prev = _HELPERS.get(kind)
+    if prev is not None and prev[0] is not fn:
+        # one slot per kind: e.g. flash attention and ring attention both
+        # claim "attention" — silent replacement has bitten before
+        # (registering flash mid-SP-training defeats sequence sharding)
+        import warnings
+        warnings.warn(
+            f"helper kind '{kind}' already registered "
+            f"({getattr(prev[0], '__name__', prev[0])}); replacing with "
+            f"{getattr(fn, '__name__', fn)}", stacklevel=2)
     _HELPERS[kind] = (fn, tuple(p.lower() for p in platforms))
 
 

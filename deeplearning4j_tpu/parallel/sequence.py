@@ -43,11 +43,18 @@ def attention_reference(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block_attend(q, k, v, m, l, o, q_offset, k_offset, causal):
+def _block_attend(q, k, v, m, l, o, q_offset, k_offset, causal,
+                  k_keep=None):
     """One streaming-softmax block update. q [B,Tq,H,D], k/v [B,Tk,H,D];
-    m/l [B,H,Tq], o [B,Tq,H,D] are the running max/denominator/numerator."""
+    m/l [B,H,Tq], o [B,Tq,H,D] are the running max/denominator/numerator.
+    ``k_keep`` [B,Tk]: masked keys (0) have their logits REPLACED by −1e30
+    — replacement, not an additive bias, so a fully-masked row degrades to
+    the same uniform average the materialized softmax path produces."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale    # [B,H,Tq,Tk]
+    if k_keep is not None:
+        logits = jnp.where(k_keep[:, None, None, :] > 0, logits,
+                           jnp.asarray(-1e30, logits.dtype))
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         qpos = q_offset + jnp.arange(tq)

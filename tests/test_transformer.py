@@ -224,3 +224,147 @@ class TestSPRegressions:
         a = generate(net, [3], 6, temperature=0)            # default bucket
         b = generate(net, [3], 6, temperature=0, bucket=16)
         np.testing.assert_array_equal(a, b)
+
+
+class TestFlashAttention:
+    """Blockwise flash-style attention kernel (kernels/flash_attention.py)
+    behind the helper seam — numerically identical to the materialized
+    path (the CuDNN-vs-builtin equivalence pattern, SURVEY.md §4), with
+    O(T·block) memory."""
+
+    def test_layer_equivalence_via_helper(self, rng_np):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.flash_attention import \
+            register_flash_attention
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.helpers import disable_helper
+        layer = SelfAttentionLayer(n_in=6, n_out=8, num_heads=2, causal=True,
+                                   weight_init="xavier")
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng_np.normal(size=(2, 12, 6)), jnp.float32)
+        mask = jnp.asarray(
+            np.concatenate([np.ones((2, 9)), np.zeros((2, 3))], 1),
+            jnp.float32)
+        register_flash_attention(block_size=4, min_seq_len=1)
+        try:
+            y_flash, _ = layer.forward(params, {}, x, mask=mask)
+            g_flash = jax.grad(lambda p: jnp.sum(
+                layer.forward(p, {}, x, mask=mask)[0] ** 2))(params)
+        finally:
+            disable_helper("attention")
+        y_ref, _ = layer.forward(params, {}, x, mask=mask)
+        g_ref = jax.grad(lambda p: jnp.sum(
+            layer.forward(p, {}, x, mask=mask)[0] ** 2))(params)
+        np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-6)
+        for k in g_ref:
+            np.testing.assert_allclose(np.asarray(g_flash[k]),
+                                       np.asarray(g_ref[k]),
+                                       rtol=1e-4, atol=1e-6, err_msg=k)
+
+    def test_min_seq_len_fallback(self, rng_np):
+        """Below min_seq_len the helper declines and the built-in path runs
+        (identical outputs either way — this pins the decline contract)."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.flash_attention import \
+            register_flash_attention
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.helpers import disable_helper
+        layer = SelfAttentionLayer(n_in=4, n_out=8, num_heads=2,
+                                   weight_init="xavier")
+        params = layer.init_params(jax.random.PRNGKey(1))
+        x = jnp.asarray(rng_np.normal(size=(1, 5, 4)), jnp.float32)
+        register_flash_attention(min_seq_len=1024)
+        try:
+            y1, _ = layer.forward(params, {}, x)
+        finally:
+            disable_helper("attention")
+        y2, _ = layer.forward(params, {}, x)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+    def test_lm_trains_with_flash(self, rng_np):
+        from deeplearning4j_tpu.kernels.flash_attention import \
+            register_flash_attention
+        from deeplearning4j_tpu.nn.helpers import disable_helper
+        register_flash_attention(block_size=8, min_seq_len=1)
+        try:
+            net = _tiny_lm()
+            ds = _cyclic_batch(rng_np)
+            s0 = net.score(ds)
+            for _ in range(100):
+                net.fit_batch(ds)
+            assert net.score(ds) < 0.1 * s0
+        finally:
+            disable_helper("attention")
+
+
+class TestFlashMaskEdgeCases:
+    def test_leading_padding_equivalence(self, rng_np):
+        """Leading padding: every query row with at least one VISIBLE key
+        matches the materialized -1e30 path exactly; fully-masked rows are
+        degenerate in both paths (each emits a different arbitrary convex
+        combination of v) — the contract there is finite + bounded, and
+        downstream losses mask those rows anyway."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.flash_attention import \
+            flash_attention
+        q = jnp.asarray(rng_np.normal(size=(2, 8, 2, 4)), jnp.float32)
+        k = jnp.asarray(rng_np.normal(size=(2, 8, 2, 4)), jnp.float32)
+        v = jnp.asarray(rng_np.normal(size=(2, 8, 2, 4)), jnp.float32)
+        km = jnp.asarray(np.concatenate(
+            [np.zeros((2, 4)), np.ones((2, 4))], 1), jnp.float32)
+        got = flash_attention(q, k, v, causal=True, block_size=4,
+                              key_mask=km)
+        scale = 1.0 / np.sqrt(4.0)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        neg = jnp.asarray(-1e30, jnp.float32)
+        cm = jnp.tril(jnp.ones((8, 8), bool))
+        logits = jnp.where(cm[None, None], logits, neg)
+        logits = jnp.where(km.astype(bool)[:, None, None, :], logits, neg)
+        want = jnp.einsum("bhqk,bkhd->bqhd",
+                          jax.nn.softmax(logits, -1), v)
+        # causal rows 4..7 see visible keys (>=4): exact equivalence
+        np.testing.assert_allclose(np.asarray(got)[:, 4:],
+                                   np.asarray(want)[:, 4:],
+                                   rtol=1e-4, atol=1e-5)
+        # rows 0..3 (only masked keys visible): finite, bounded by v range
+        head = np.asarray(got)[:, :4]
+        assert np.all(np.isfinite(head))
+        assert head.max() <= float(jnp.max(v)) + 1e-5
+        assert head.min() >= float(jnp.min(v)) - 1e-5
+
+    def test_register_overwrite_warns(self):
+        import warnings
+        from deeplearning4j_tpu.nn.helpers import (disable_helper,
+                                                   register_helper)
+        register_helper("attention", lambda *a: None, ("cpu",))
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                register_helper("attention", lambda *a: 1, ("cpu",))
+            assert any("already registered" in str(x.message) for x in w)
+        finally:
+            disable_helper("attention")
+
+    def test_mln_inference_keeps_integer_ids(self, rng_np):
+        """output()/rnn paths must not round token ids through bf16
+        (training's staging fix extended to inference)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import (NeuralNetConfiguration,
+                                           InputType, MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import (EmbeddingLayer,
+                                                       OutputLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
+                .updater("sgd").weight_init("xavier").list()
+                .layer(EmbeddingLayer(n_in=1000, n_out=8))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(1000)).build())
+        net16 = MultiLayerNetwork(conf, compute_dtype=jnp.bfloat16).init()
+        ids = np.asarray([[300], [301]], np.int32)   # bf16 would merge these
+        o1 = net16.output(ids[:1])
+        o2 = net16.output(ids[1:])
+        assert np.abs(np.asarray(o1) - np.asarray(o2)).max() > 0
