@@ -1,0 +1,303 @@
+"""Pallas flash-attention kernel — the MXU-resident implementation of the
+attention hot op (the prompt's "pallas kernels for the hot ops"; reference
+analog: the cuDNN helpers of SURVEY.md §2.2, here behind the same
+kind="attention" seam as kernels/flash_attention.py's jnp blockwise path).
+
+Why Pallas here: the jnp blockwise path materializes each [T, KB] logits
+block in HBM (measured 5-7 TF/s at LM shapes — bandwidth-bound); this
+kernel keeps the q tile, running max/denominator and the accumulator in
+VMEM across the k/v stream, so the only HBM traffic is q/k/v/o once each.
+
+Layout: [B, T, H, D] folds to [BH, T, D]; grid (BH, T/QB, T/KB) with the
+k dimension innermost ("arbitrary") so VMEM scratch carries the streaming
+softmax across k blocks. Causal masking uses the finite −1e30 replacement
+(identical degenerate-row semantics to the other two paths). Backward is
+the FlashAttention-2 factorization: forward saves the per-row logsumexp;
+dq accumulates over k blocks, dk/dv over q blocks, with the row term
+delta = rowsum(dO·O) computed outside.
+
+Key masks are not supported here — the registered helper declines and the
+layer falls back (masked long-context goes through the jnp blockwise
+path)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                causal, scale, kb, qb):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # under causal masking, blocks strictly in the future contribute
+    # nothing — skip their compute entirely (~2x on long sequences)
+    visible = (ki * kb <= qi * qb + qb - 1) if causal else True
+
+    @pl.when(visible)
+    def _attend():
+        # dots run at the INPUT precision (bf16 hits the full-rate MXU)
+        # with f32 accumulation; only the softmax math is f32
+        q = q_ref[0]                               # [QB, D]
+        k = k_ref[0]                               # [KB, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (qb, kb), 0)
+            kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (qb, kb), 1)
+            s = jnp.where(qpos >= kpos, s, NEG)
+
+        m_prev = m_s[:, :1]                        # [QB, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)            # [QB, 1]
+        p = jnp.exp(s - m_new)                     # [QB, KB]
+        l_new = l_s[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0]                               # [KB, D]
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        l_fin = jnp.maximum(l_s[:, :1], 1e-20)
+        o_ref[0, ...] = (acc_s[...] / l_fin).astype(o_ref.dtype)
+        lse_ref[0, ...] = (m_s[...] + jnp.log(l_fin)).astype(lse_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_s, *, causal, scale, kb, qb):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    visible = (ki * kb <= qi * qb + qb - 1) if causal else True
+
+    @pl.when(visible)
+    def _accum():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]                    # [QB, 1]
+        delta = delta_ref[0][:, :1]                # [QB, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (qb, kb), 0)
+            kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (qb, kb), 1)
+            s = jnp.where(qpos >= kpos, s, NEG)
+        p = jnp.exp(s - lse)                       # [QB, KB]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_s[...] = dq_s[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        dq_ref[0, ...] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_s, dv_s, *, causal, scale, kb, qb):
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    visible = (qi * qb + qb - 1 >= ki * kb) if causal else True
+
+    @pl.when(visible)
+    def _accum():
+        q = q_ref[0]                               # [QB, D]
+        k = k_ref[0]                               # [KB, D]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (qb, kb), 0)
+            kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (qb, kb), 1)
+            s = jnp.where(qpos >= kpos, s, NEG)
+        p = jnp.exp(s - lse)                       # [QB, KB]
+        dv_s[...] = dv_s[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_s[...] = dk_s[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0, ...] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _specs(qb_or_kb, d, which):
+    """BlockSpec for [BH, T, D] tensors blocked on (1, block, D)."""
+    if which == "q":
+        return pl.BlockSpec((1, qb_or_kb, d), lambda bh, qi, ki: (bh, qi, 0))
+    return pl.BlockSpec((1, qb_or_kb, d), lambda bh, qi, ki: (bh, ki, 0))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q3, k3, v3, causal, qb, kb):
+    o, _ = _flash_fwd_impl(q3, k3, v3, causal, qb, kb)
+    return o
+
+
+def _flash_fwd_impl(q3, k3, v3, causal, qb, kb):
+    bh, t, d = q3.shape
+    scale = float(1.0 / np.sqrt(d))
+    grid = (bh, t // qb, t // kb)
+    kern = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                             kb=kb, qb=qb)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        interpret=interpret,
+        in_specs=[_specs(qb, d, "q"), _specs(kb, d, "k"),
+                  _specs(kb, d, "k")],
+        out_specs=[_specs(qb, d, "q"),
+                   pl.BlockSpec((1, qb, 128), lambda bh, qi, ki:
+                                (bh, qi, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, t, 128), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((qb, 128), jnp.float32),
+            pltpu.VMEM((qb, 128), jnp.float32),
+            pltpu.VMEM((qb, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3)
+    return o, lse
+
+
+def _flash_fwd(q3, k3, v3, causal, qb, kb):
+    o, lse = _flash_fwd_impl(q3, k3, v3, causal, qb, kb)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(causal, qb, kb, res, do):
+    q3, k3, v3, o, lse = res
+    bh, t, d = q3.shape
+    scale = float(1.0 / np.sqrt(d))
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                  # [BH, T]
+    delta3 = jnp.broadcast_to(delta[..., None], (bh, t, 128))
+    row = pl.BlockSpec((1, qb, 128), lambda bhi, qi, ki: (bhi, qi, 0))
+    common = [_specs(qb, d, "q"), _specs(kb, d, "k"), _specs(kb, d, "k"),
+              _specs(qb, d, "q"), row, row]
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale,
+                          kb=kb, qb=qb),
+        grid=(bh, t // qb, t // kb),
+        interpret=interpret,
+        in_specs=common,
+        out_specs=_specs(qb, d, "q"),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((qb, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3, do, lse, delta3)
+
+    # dk/dv: k blocks outer ("parallel"), q blocks inner accumulate
+    def kspec(block, which):
+        if which == "k":
+            return pl.BlockSpec((1, block, d),
+                               lambda bhi, ki, qi: (bhi, ki, 0))
+        return pl.BlockSpec((1, block, d),
+                            lambda bhi, ki, qi: (bhi, qi, 0))
+    rowq = pl.BlockSpec((1, qb, 128), lambda bhi, ki, qi: (bhi, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                          kb=kb, qb=qb),
+        grid=(bh, t // kb, t // qb),
+        interpret=interpret,
+        in_specs=[kspec(qb, "q"), kspec(kb, "k"), kspec(kb, "k"),
+                  kspec(qb, "q"), rowq, rowq],
+        out_specs=[kspec(kb, "k"), kspec(kb, "k")],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((kb, d), jnp.float32),
+                        pltpu.VMEM((kb, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3, do, lse, delta3)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def pallas_flash_attention(q, k, v, causal: bool = False,
+                           q_block: int = 512, k_block: int = 512):
+    """[B, T, H, D] attention via the Pallas kernels. T must divide by the
+    block sizes (the helper pads/declines as needed)."""
+    b, t, h, d = q.shape
+    qb = min(q_block, t)
+    kb = min(k_block, t)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out3 = _flash(fold(q), fold(k), fold(v), causal, qb, kb)
+    return out3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def make_pallas_flash_helper(min_seq_len: int = 1024,
+                             q_block: int = 512, k_block: int = 512):
+    def helper(conf, q, k, v, mask):
+        t = q.shape[1]
+        if mask is not None or t < min_seq_len or t % q_block or \
+                t % k_block:
+            return None                      # decline -> layer fallback
+        return pallas_flash_attention(q, k, v, causal=conf.causal,
+                                      q_block=q_block, k_block=k_block)
+    return helper
+
+
+def register_pallas_flash_attention(min_seq_len: int = 1024,
+                                    q_block: int = 512, k_block: int = 512,
+                                    platforms=("tpu", "axon", "cpu")) -> None:
+    from ..nn.helpers import enable_helper, register_helper
+    register_helper("attention",
+                    make_pallas_flash_helper(min_seq_len, q_block, k_block),
+                    platforms)
+    enable_helper("attention")

@@ -368,3 +368,63 @@ class TestFlashMaskEdgeCases:
         o1 = net16.output(ids[:1])
         o2 = net16.output(ids[1:])
         assert np.abs(np.asarray(o1) - np.asarray(o2)).max() > 0
+
+
+class TestPallasFlashAttention:
+    """Pallas flash kernels (kernels/pallas_attention.py) — interpret mode
+    on CPU, real MXU kernels on TPU; equivalence vs the materialized
+    reference is the contract (SURVEY.md §4 CuDNN-vs-builtin pattern)."""
+
+    def test_fwd_and_grads_match_reference(self, rng_np):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.pallas_attention import \
+            pallas_flash_attention
+        from deeplearning4j_tpu.parallel.sequence import attention_reference
+        q = jnp.asarray(rng_np.normal(size=(2, 16, 2, 8)), jnp.float32)
+        k = jnp.asarray(rng_np.normal(size=(2, 16, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng_np.normal(size=(2, 16, 2, 8)), jnp.float32)
+        for causal in (False, True):
+            a = pallas_flash_attention(q, k, v, causal=causal,
+                                       q_block=8, k_block=8)
+            b = attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        ga = jax.grad(lambda q, k, v: jnp.sum(pallas_flash_attention(
+            q, k, v, causal=True, q_block=8, k_block=8) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(lambda q, k, v: jnp.sum(attention_reference(
+            q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for x, y, n in zip(ga, gb, "qkv"):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-4, atol=1e-5, err_msg=n)
+
+    def test_helper_declines_masked_and_short(self, rng_np):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.pallas_attention import \
+            make_pallas_flash_helper
+
+        class Conf:
+            causal = True
+        helper = make_pallas_flash_helper(min_seq_len=16, q_block=8,
+                                          k_block=8)
+        q = jnp.zeros((1, 8, 2, 8))
+        assert helper(Conf(), q, q, q, None) is None      # too short
+        q = jnp.zeros((1, 16, 2, 8))
+        assert helper(Conf(), q, q, q, jnp.ones((1, 16))) is None  # masked
+        assert helper(Conf(), q, q, q, None) is not None
+
+    def test_lm_trains_with_pallas_flash(self, rng_np):
+        from deeplearning4j_tpu.kernels.pallas_attention import \
+            register_pallas_flash_attention
+        from deeplearning4j_tpu.nn.helpers import disable_helper
+        register_pallas_flash_attention(min_seq_len=1, q_block=8, k_block=8)
+        try:
+            net = _tiny_lm()
+            ds = _cyclic_batch(rng_np)
+            s0 = net.score(ds)
+            for _ in range(100):
+                net.fit_batch(ds)
+            assert net.score(ds) < 0.1 * s0
+        finally:
+            disable_helper("attention")
