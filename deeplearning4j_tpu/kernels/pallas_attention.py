@@ -31,6 +31,22 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG = -1e30
+# lse/delta row-scalar carriers travel as [BH, T, ROWW] (ROWW=8 keeps the
+# block 2-D-tileable while costing 1/16 the footprint of a 128-lane row)
+ROWW = 8
+
+
+def _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale):
+    """Scaled q·kᵀ block with the causal −1e30 replacement mask — shared by
+    the forward and both backward kernels so the masking can never
+    diverge between them."""
+    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32, (qb, kb), 0)
+        kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32, (qb, kb), 1)
+        s = jnp.where(qpos >= kpos, s, NEG)
+    return s
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
@@ -53,16 +69,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     def _attend():
         # dots run at the INPUT precision (bf16 hits the full-rate MXU)
         # with f32 accumulation; only the softmax math is f32
-        q = q_ref[0]                               # [QB, D]
-        k = k_ref[0]                               # [KB, D]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (qb, kb), 0)
-            kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (qb, kb), 1)
-            s = jnp.where(qpos >= kpos, s, NEG)
+        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale)
 
         m_prev = m_s[:, :1]                        # [QB, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -80,7 +87,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     def _fin():
         l_fin = jnp.maximum(l_s[:, :1], 1e-20)
         o_ref[0, ...] = (acc_s[...] / l_fin).astype(o_ref.dtype)
-        lse_ref[0, ...] = (m_s[...] + jnp.log(l_fin)).astype(lse_ref.dtype)
+        lse_ref[0, ...] = (m_s[:, :ROWW] +
+                           jnp.log(l_fin)).astype(lse_ref.dtype)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -97,20 +105,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(visible)
     def _accum():
-        q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0][:, :1]                    # [QB, 1]
         delta = delta_ref[0][:, :1]                # [QB, 1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (qb, kb), 0)
-            kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (qb, kb), 1)
-            s = jnp.where(qpos >= kpos, s, NEG)
+        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale)
         p = jnp.exp(s - lse)                       # [QB, KB]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -140,24 +140,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(visible)
     def _accum():
         q = q_ref[0]                               # [QB, D]
-        k = k_ref[0]                               # [KB, D]
-        v = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (qb, kb), 0)
-            kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (qb, kb), 1)
-            s = jnp.where(qpos >= kpos, s, NEG)
+        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale)
         p = jnp.exp(s - lse)                       # [QB, KB]
         dv_s[...] = dv_s[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        v = v_ref[0]
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_s[...] = dk_s[...] + jax.lax.dot_general(
@@ -197,10 +189,10 @@ def _flash_fwd_impl(q3, k3, v3, causal, qb, kb):
         in_specs=[_specs(qb, d, "q"), _specs(kb, d, "k"),
                   _specs(kb, d, "k")],
         out_specs=[_specs(qb, d, "q"),
-                   pl.BlockSpec((1, qb, 128), lambda bh, qi, ki:
+                   pl.BlockSpec((1, qb, ROWW), lambda bh, qi, ki:
                                 (bh, qi, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
-                   jax.ShapeDtypeStruct((bh, t, 128), jnp.float32)],
+                   jax.ShapeDtypeStruct((bh, t, ROWW), jnp.float32)],
         scratch_shapes=[
             pltpu.VMEM((qb, 128), jnp.float32),
             pltpu.VMEM((qb, 128), jnp.float32),
@@ -222,8 +214,8 @@ def _flash_bwd(causal, qb, kb, res, do):
     scale = float(1.0 / np.sqrt(d))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                  # [BH, T]
-    delta3 = jnp.broadcast_to(delta[..., None], (bh, t, 128))
-    row = pl.BlockSpec((1, qb, 128), lambda bhi, qi, ki: (bhi, qi, 0))
+    delta3 = jnp.broadcast_to(delta[..., None], (bh, t, ROWW))
+    row = pl.BlockSpec((1, qb, ROWW), lambda bhi, qi, ki: (bhi, qi, 0))
     common = [_specs(qb, d, "q"), _specs(kb, d, "k"), _specs(kb, d, "k"),
               _specs(qb, d, "q"), row, row]
     interpret = jax.default_backend() not in ("tpu", "axon")
@@ -247,7 +239,7 @@ def _flash_bwd(causal, qb, kb, res, do):
                                lambda bhi, ki, qi: (bhi, ki, 0))
         return pl.BlockSpec((1, block, d),
                             lambda bhi, ki, qi: (bhi, qi, 0))
-    rowq = pl.BlockSpec((1, qb, 128), lambda bhi, ki, qi: (bhi, qi, 0))
+    rowq = pl.BlockSpec((1, qb, ROWW), lambda bhi, ki, qi: (bhi, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale,
                           kb=kb, qb=qb),
@@ -271,11 +263,28 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def pallas_flash_attention(q, k, v, causal: bool = False,
                            q_block: int = 512, k_block: int = 512):
-    """[B, T, H, D] attention via the Pallas kernels. T must divide by the
-    block sizes (the helper pads/declines as needed)."""
+    """[B, T, H, D] attention via the Pallas kernels.
+
+    Non-divisible T: under causal masking, q/k/v are right-padded to the
+    block multiple and the result sliced back (padded keys sit strictly in
+    the future of every real query, so real rows are untouched);
+    non-causal non-divisible inputs route to the jnp blockwise path, whose
+    key-mask machinery handles the padding."""
     b, t, h, d = q.shape
     qb = min(q_block, t)
     kb = min(k_block, t)
+    pad = max((-t) % qb, (-t) % kb)
+    if pad and not causal:
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=False,
+                               block_size=max(qb, kb))
+    if pad:
+        padded = [jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                  for x in (q, k, v)]
+        out = pallas_flash_attention(padded[0], padded[1], padded[2],
+                                     causal=causal, q_block=q_block,
+                                     k_block=k_block)
+        return out[:, :t]
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     out3 = _flash(fold(q), fold(k), fold(v), causal, qb, kb)
     return out3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
@@ -283,11 +292,20 @@ def pallas_flash_attention(q, k, v, causal: bool = False,
 
 def make_pallas_flash_helper(min_seq_len: int = 1024,
                              q_block: int = 512, k_block: int = 512):
+    """Helper chain: Pallas kernels for long unmasked sequences; the jnp
+    blockwise path for long MASKED sequences (declining outright would
+    drop to the layer's materialized O(T²) softmax — which cannot even
+    compile at the very lengths this kernel exists for); decline only
+    below min_seq_len, where materialized is fastest."""
     def helper(conf, q, k, v, mask):
         t = q.shape[1]
-        if mask is not None or t < min_seq_len or t % q_block or \
-                t % k_block:
-            return None                      # decline -> layer fallback
+        if t < min_seq_len:
+            return None                      # short: materialized path wins
+        if mask is not None:
+            from .flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=conf.causal,
+                                   block_size=max(q_block, k_block),
+                                   key_mask=mask)
         return pallas_flash_attention(q, k, v, causal=conf.causal,
                                       q_block=q_block, k_block=k_block)
     return helper

@@ -399,7 +399,10 @@ class TestPallasFlashAttention:
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        rtol=1e-4, atol=1e-5, err_msg=n)
 
-    def test_helper_declines_masked_and_short(self, rng_np):
+    def test_helper_chain(self, rng_np):
+        """Short -> decline (materialized wins); long unmasked -> Pallas;
+        long masked -> jnp blockwise (covered in
+        TestPallasFlashRegressions)."""
         import jax.numpy as jnp
         from deeplearning4j_tpu.kernels.pallas_attention import \
             make_pallas_flash_helper
@@ -411,7 +414,6 @@ class TestPallasFlashAttention:
         q = jnp.zeros((1, 8, 2, 8))
         assert helper(Conf(), q, q, q, None) is None      # too short
         q = jnp.zeros((1, 16, 2, 8))
-        assert helper(Conf(), q, q, q, jnp.ones((1, 16))) is None  # masked
         assert helper(Conf(), q, q, q, None) is not None
 
     def test_lm_trains_with_pallas_flash(self, rng_np):
@@ -428,3 +430,48 @@ class TestPallasFlashAttention:
             assert net.score(ds) < 0.1 * s0
         finally:
             disable_helper("attention")
+
+
+class TestPallasFlashRegressions:
+    def test_non_divisible_t(self, rng_np):
+        """Padding (causal) / jnp fallback (non-causal) keep non-divisible
+        sequence lengths exact — no uninitialized tail rows."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.pallas_attention import \
+            pallas_flash_attention
+        from deeplearning4j_tpu.parallel.sequence import attention_reference
+        q = jnp.asarray(rng_np.normal(size=(2, 13, 2, 8)), jnp.float32)
+        k = jnp.asarray(rng_np.normal(size=(2, 13, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng_np.normal(size=(2, 13, 2, 8)), jnp.float32)
+        for causal in (True, False):
+            a = pallas_flash_attention(q, k, v, causal=causal,
+                                       q_block=8, k_block=8)
+            b = attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_masked_long_falls_back_to_blockwise(self, rng_np):
+        """A masked long sequence must ride the jnp blockwise path, NOT
+        drop to the materialized O(T^2) softmax."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.flash_attention import \
+            flash_attention
+        from deeplearning4j_tpu.kernels.pallas_attention import \
+            make_pallas_flash_helper
+
+        class Conf:
+            causal = True
+        helper = make_pallas_flash_helper(min_seq_len=16, q_block=8,
+                                          k_block=8)
+        q = jnp.asarray(rng_np.normal(size=(1, 16, 2, 8)), jnp.float32)
+        km = jnp.asarray(np.concatenate(
+            [np.ones((1, 12)), np.zeros((1, 4))], 1), jnp.float32)
+        got = helper(Conf(), q, q, q, km)
+        assert got is not None
+        want = flash_attention(q, q, q, causal=True, block_size=8,
+                               key_mask=km)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+        # short sequences still decline to the materialized path
+        qs = jnp.zeros((1, 8, 2, 8))
+        assert helper(Conf(), qs, qs, qs, None) is None
