@@ -37,7 +37,7 @@ class _ClusterModelBase:
         net = self.network
 
         def eval_partition(partition):
-            if hasattr(net, "do_evaluation"):      # ComputationGraph
+            if hasattr(net, "evaluate_outputs"):   # ComputationGraph only
                 first = net.conf.network_outputs[0]
                 return net.do_evaluation(partition,
                                          {first: Evaluation()})[first]

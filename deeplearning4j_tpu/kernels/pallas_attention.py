@@ -319,3 +319,8 @@ def register_pallas_flash_attention(min_seq_len: int = 1024,
                     make_pallas_flash_helper(min_seq_len, q_block, k_block),
                     platforms)
     enable_helper("attention")
+
+
+def register_default() -> None:
+    """Lazy-discovery entry point (nn/helpers._DEFAULT_PROVIDERS)."""
+    register_pallas_flash_attention()

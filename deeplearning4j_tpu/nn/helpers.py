@@ -29,6 +29,12 @@ _DEFAULT_PROVIDERS: Dict[str, str] = {
     "batchnorm_train": "deeplearning4j_tpu.kernels.batchnorm",
     "batchnorm_add_act_train": "deeplearning4j_tpu.kernels.batchnorm",
     "lrn": "deeplearning4j_tpu.kernels.lrn",
+    # long-context attention: Pallas flash kernels above min_seq_len=1024
+    # (2-2.8x measured, BASELINE.md r3), jnp blockwise for masked long
+    # sequences, decline below — the materialized path stays the default
+    # where it wins. Ring attention (enable_ring_attention) replaces this
+    # slot explicitly for sequence-parallel training.
+    "attention": "deeplearning4j_tpu.kernels.pallas_attention",
     # "lstm" is deliberately NOT a default provider: honest r2 measurements
     # (BASELINE.md) show XLA's scan lowering beats the Pallas kernel at
     # char-RNN shapes in both f32 (11.5 vs 12.5 ms/step) and bf16 (8.0 vs
