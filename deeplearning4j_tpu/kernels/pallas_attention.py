@@ -313,14 +313,19 @@ def make_pallas_flash_helper(min_seq_len: int = 1024,
 
 def register_pallas_flash_attention(min_seq_len: int = 1024,
                                     q_block: int = 512, k_block: int = 512,
-                                    platforms=("tpu", "axon", "cpu")) -> None:
+                                    platforms=("tpu", "axon", "cpu"),
+                                    _default: bool = False) -> None:
     from ..nn.helpers import enable_helper, register_helper
     register_helper("attention",
                     make_pallas_flash_helper(min_seq_len, q_block, k_block),
-                    platforms)
+                    platforms, _default=_default)
     enable_helper("attention")
 
 
 def register_default() -> None:
-    """Lazy-discovery entry point (nn/helpers._DEFAULT_PROVIDERS)."""
-    register_pallas_flash_attention()
+    """Lazy-discovery entry point (nn/helpers._DEFAULT_PROVIDERS). TPU-class
+    backends only: on CPU the kernels run in Pallas INTERPRET mode — orders
+    of magnitude slower than the XLA materialized path — so CPU gets flash
+    only by explicit registration (tests do exactly that)."""
+    register_pallas_flash_attention(platforms=("tpu", "axon"),
+                                    _default=True)

@@ -43,18 +43,31 @@ _DEFAULT_PROVIDERS: Dict[str, str] = {
 _FAILED_PROVIDERS: set = set()
 
 
+# kinds whose current registration came from lazy default discovery:
+# replacing those is routine (e.g. ring attention taking the slot from the
+# default flash kernel), so no warning fires for them
+_DEFAULT_REGISTERED: set = set()
+
+
 def register_helper(kind: str, fn: Callable,
-                    platforms: Tuple[str, ...] = ("tpu",)) -> None:
+                    platforms: Tuple[str, ...] = ("tpu",),
+                    _default: bool = False) -> None:
     prev = _HELPERS.get(kind)
-    if prev is not None and prev[0] is not fn:
+    prev_was_default = kind in _DEFAULT_REGISTERED
+    if prev is not None and prev[0] is not fn and not prev_was_default:
         # one slot per kind: e.g. flash attention and ring attention both
         # claim "attention" — silent replacement has bitten before
-        # (registering flash mid-SP-training defeats sequence sharding)
+        # (registering flash mid-SP-training defeats sequence sharding).
+        # Replacing a lazily-discovered DEFAULT is routine and silent.
         import warnings
         warnings.warn(
             f"helper kind '{kind}' already registered "
             f"({getattr(prev[0], '__name__', prev[0])}); replacing with "
             f"{getattr(fn, '__name__', fn)}", stacklevel=2)
+    if _default:
+        _DEFAULT_REGISTERED.add(kind)
+    else:
+        _DEFAULT_REGISTERED.discard(kind)
     _HELPERS[kind] = (fn, tuple(p.lower() for p in platforms))
 
 

@@ -306,10 +306,17 @@ class MultiLayerNetwork:
                 donate_argnums=train_donate_argnums())
         return self._jit_cache[key]
 
-    def fit(self, data, num_epochs: int = 1):
-        """Train (reference MultiLayerNetwork.fit(DataSetIterator)).
-        ``data``: DataSet, DataSetIterator, or list of DataSets."""
+    def fit(self, data, labels=None, num_epochs: int = 1):
+        """Train (reference MultiLayerNetwork.fit(DataSetIterator) and
+        fit(INDArray, INDArray), MultiLayerNetwork.java:1474).
+        ``data``: DataSet, DataSetIterator, list of DataSets — or a
+        features array with ``labels`` supplied separately."""
         self._ensure_init()
+        if isinstance(labels, (int, np.integer)):
+            # old positional form fit(data, num_epochs)
+            num_epochs, labels = int(labels), None
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
         from ..datasets.iterators import as_iterator, AsyncDataSetIterator
         for epoch in range(num_epochs):
             for lst in self.listeners:
@@ -452,6 +459,12 @@ class MultiLayerNetwork:
                                  fmask, lmask, None)
         (score, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
         return grads, float(score)
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class per example (reference MultiLayerNetwork.predict,
+        MultiLayerNetwork.java:1423); time-series outputs predict per
+        step."""
+        return np.argmax(self.output(x), axis=-1)
 
     def evaluate(self, data, batch_size: int = 0):
         from ..eval.evaluation import Evaluation

@@ -374,3 +374,20 @@ class TestEvalMetadataMasking:
         assert ev.get_prediction_errors() == []
         assert len(ev.predictions) == 5          # 6 steps - 1 masked
         assert all(p.metadata in ("rec0", "rec1") for p in ev.predictions)
+
+
+class TestMlnApiSugar:
+    def test_fit_arrays_and_predict(self, rng_np):
+        net = _mlp()
+        X = rng_np.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng_np.integers(0, 3, 16)]
+        net.fit(X, y)                       # fit(INDArray, INDArray) form
+        preds = net.predict(X)
+        assert preds.shape == (16,)
+        assert set(preds.tolist()) <= {0, 1, 2}
+        # the two-array form must train EXACTLY like the DataSet form
+        net2 = _mlp()
+        net2.fit([DataSet(X, y)])
+        np.testing.assert_allclose(net.params_flat(), net2.params_flat(),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(preds, net2.predict(X))
