@@ -169,19 +169,27 @@ def _specs(qb_or_kb, d, which):
     return pl.BlockSpec((1, qb_or_kb, d), lambda bh, qi, ki: (bh, ki, 0))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q3, k3, v3, causal, qb, kb):
-    o, _ = _flash_fwd_impl(q3, k3, v3, causal, qb, kb)
+def _interpret_default():
+    """Whether to run the kernels in Pallas interpret mode. Keyed on the
+    DEFAULT backend — the documented contract: tracing for a non-default
+    backend (e.g. ``jit(..., backend='cpu')`` on a TPU host) must pass
+    ``interpret=`` explicitly, since tracers carry no device placement to
+    derive the lowering platform from."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, causal, qb, kb, interpret):
+    o, _ = _flash_fwd_impl(q3, k3, v3, causal, qb, kb, interpret)
     return o
 
 
-def _flash_fwd_impl(q3, k3, v3, causal, qb, kb):
+def _flash_fwd_impl(q3, k3, v3, causal, qb, kb, interpret):
     bh, t, d = q3.shape
     scale = float(1.0 / np.sqrt(d))
     grid = (bh, t // qb, t // kb)
     kern = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                              kb=kb, qb=qb)
-    interpret = jax.default_backend() not in ("tpu", "axon")
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
@@ -203,12 +211,12 @@ def _flash_fwd_impl(q3, k3, v3, causal, qb, kb):
     return o, lse
 
 
-def _flash_fwd(q3, k3, v3, causal, qb, kb):
-    o, lse = _flash_fwd_impl(q3, k3, v3, causal, qb, kb)
+def _flash_fwd(q3, k3, v3, causal, qb, kb, interpret):
+    o, lse = _flash_fwd_impl(q3, k3, v3, causal, qb, kb, interpret)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash_bwd(causal, qb, kb, res, do):
+def _flash_bwd(causal, qb, kb, interpret, res, do):
     q3, k3, v3, o, lse = res
     bh, t, d = q3.shape
     scale = float(1.0 / np.sqrt(d))
@@ -218,7 +226,6 @@ def _flash_bwd(causal, qb, kb, res, do):
     row = pl.BlockSpec((1, qb, ROWW), lambda bhi, qi, ki: (bhi, qi, 0))
     common = [_specs(qb, d, "q"), _specs(kb, d, "k"), _specs(kb, d, "k"),
               _specs(qb, d, "q"), row, row]
-    interpret = jax.default_backend() not in ("tpu", "axon")
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale,
                           kb=kb, qb=qb),
@@ -262,15 +269,22 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def pallas_flash_attention(q, k, v, causal: bool = False,
-                           q_block: int = 512, k_block: int = 512):
+                           q_block: int = 512, k_block: int = 512,
+                           interpret=None):
     """[B, T, H, D] attention via the Pallas kernels.
 
     Non-divisible T: under causal masking, q/k/v are right-padded to the
     block multiple and the result sliced back (padded keys sit strictly in
     the future of every real query, so real rows are untouched);
     non-causal non-divisible inputs route to the jnp blockwise path, whose
-    key-mask machinery handles the padding."""
+    key-mask machinery handles the padding.
+
+    ``interpret``: None derives Pallas interpret mode from the DEFAULT
+    backend; pass True/False explicitly when tracing for a non-default
+    backend (see :func:`_interpret_default`)."""
     b, t, h, d = q.shape
+    if interpret is None:
+        interpret = _interpret_default()
     qb = min(q_block, t)
     kb = min(k_block, t)
     pad = max((-t) % qb, (-t) % kb)
@@ -283,15 +297,16 @@ def pallas_flash_attention(q, k, v, causal: bool = False,
                   for x in (q, k, v)]
         out = pallas_flash_attention(padded[0], padded[1], padded[2],
                                      causal=causal, q_block=q_block,
-                                     k_block=k_block)
+                                     k_block=k_block, interpret=interpret)
         return out[:, :t]
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    out3 = _flash(fold(q), fold(k), fold(v), causal, qb, kb)
+    out3 = _flash(fold(q), fold(k), fold(v), causal, qb, kb, bool(interpret))
     return out3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
 def make_pallas_flash_helper(min_seq_len: int = 1024,
-                             q_block: int = 512, k_block: int = 512):
+                             q_block: int = 512, k_block: int = 512,
+                             interpret=None):
     """Helper chain: Pallas kernels for long unmasked sequences; the jnp
     blockwise path for long MASKED sequences (declining outright would
     drop to the layer's materialized O(T²) softmax — which cannot even
@@ -307,17 +322,20 @@ def make_pallas_flash_helper(min_seq_len: int = 1024,
                                    block_size=max(q_block, k_block),
                                    key_mask=mask)
         return pallas_flash_attention(q, k, v, causal=conf.causal,
-                                      q_block=q_block, k_block=k_block)
+                                      q_block=q_block, k_block=k_block,
+                                      interpret=interpret)
     return helper
 
 
 def register_pallas_flash_attention(min_seq_len: int = 1024,
                                     q_block: int = 512, k_block: int = 512,
                                     platforms=("tpu", "axon", "cpu"),
+                                    interpret=None,
                                     _default: bool = False) -> None:
     from ..nn.helpers import enable_helper, register_helper
     register_helper("attention",
-                    make_pallas_flash_helper(min_seq_len, q_block, k_block),
+                    make_pallas_flash_helper(min_seq_len, q_block, k_block,
+                                             interpret=interpret),
                     platforms, _default=_default)
     enable_helper("attention")
 

@@ -57,7 +57,9 @@ class SequenceVectors:
                  min_learning_rate: float = 1e-4, epochs: int = 1,
                  negative: int = 0, use_hierarchic_softmax: bool = True,
                  sample: float = 0.0, batch_size: int = 2048,
-                 elements_algorithm: str = "skipgram", seed: int = 42):
+                 elements_algorithm: str = "skipgram", seed: int = 42,
+                 shared_negatives: bool = True,
+                 scan_min_tokens: Optional[int] = None):
         self.vector_length = vector_length
         self.window = window
         self.min_word_frequency = min_word_frequency
@@ -70,6 +72,16 @@ class SequenceVectors:
         self.batch_size = batch_size
         self.elements_algorithm = elements_algorithm
         self.seed = seed
+        # Negative-sampling variance tradeoff: the corpus-scan device program
+        # (used at >= scan_min_tokens) defaults to drawing ONE set of k
+        # negatives per ~32k-pair scan step (shared across the step — cheaper
+        # table gathers, slightly correlated updates), while the per-batch
+        # path draws per-pair negatives. Set shared_negatives=False to force
+        # per-pair draws in the scan too, or scan_min_tokens to move/disable
+        # the corpus-size switchover (word2vec.c itself draws per-pair).
+        self.shared_negatives = bool(shared_negatives)
+        if scan_min_tokens is not None:
+            self.SCAN_MIN_TOKENS = int(scan_min_tokens)
         self.vocab: Optional[VocabCache] = None
         self.lookup: Optional[InMemoryLookupTable] = None
         self._codes = self._points = self._lengths = None
@@ -236,7 +248,8 @@ class SequenceVectors:
                     lt.syn0, lt.syn1neg, corpus_d, sep_d,
                     self._neg_table_dev, key, jnp.int32(start), lr0, lr_min,
                     jnp.float32(frac0), jnp.float32(frac_per_step),
-                    k=self.negative, window=window, n_steps=seg, p=p)
+                    k=self.negative, window=window, n_steps=seg, p=p,
+                    shared_negatives=self.shared_negatives)
             else:
                 lt.syn0, lt.syn1, ls, c = skipgram_hs_corpus_scan(
                     lt.syn0, lt.syn1, corpus_d, sep_d, self._codes,

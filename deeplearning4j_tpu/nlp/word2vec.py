@@ -55,6 +55,20 @@ class Word2Vec(SequenceVectors):
             self._kw["use_hierarchic_softmax"] = (n == 0)
             return self
 
+        def shared_negatives(self, flag):
+            """Negative-draw granularity for the large-corpus scan path:
+            True (default) shares one k-negative draw per scan step (faster,
+            slightly correlated updates), False draws per pair like
+            word2vec.c. See SequenceVectors.__init__."""
+            self._kw["shared_negatives"] = bool(flag)
+            return self
+
+        def scan_min_tokens(self, n):
+            """Corpus size at which fit() switches from shuffled per-batch
+            programs to the corpus-scan device program (default 100k)."""
+            self._kw["scan_min_tokens"] = int(n)
+            return self
+
         def use_hierarchic_softmax(self, flag):
             self._kw["use_hierarchic_softmax"] = bool(flag)
             return self

@@ -51,10 +51,14 @@ _DEFAULT_REGISTERED: set = set()
 
 def register_helper(kind: str, fn: Callable,
                     platforms: Tuple[str, ...] = ("tpu",),
-                    _default: bool = False) -> None:
+                    _default: bool = False, _scoped: bool = False) -> None:
+    """``_scoped``: the caller snapshotted the slot and will restore it
+    (e.g. GraphSequenceParallelTrainer) — deliberate, reversible
+    replacement, so the one-slot-per-kind warning is skipped."""
     prev = _HELPERS.get(kind)
     prev_was_default = kind in _DEFAULT_REGISTERED
-    if prev is not None and prev[0] is not fn and not prev_was_default:
+    if prev is not None and prev[0] is not fn and not prev_was_default \
+            and not _scoped:
         # one slot per kind: e.g. flash attention and ring attention both
         # claim "attention" — silent replacement has bitten before
         # (registering flash mid-SP-training defeats sequence sharding).
@@ -107,3 +111,32 @@ def disable_helper(kind: str) -> None:
 
 def enable_helper(kind: str) -> None:
     _DISABLED.discard(kind)
+
+
+def snapshot_helper(kind: str):
+    """Capture the full registration state of ``kind`` (entry, default flag,
+    disabled flag) so a scoped registration — e.g. a sequence-parallel
+    trainer claiming the "attention" slot — can put back EXACTLY what it
+    displaced via :func:`restore_helper` when it is done."""
+    return (_HELPERS.get(kind), kind in _DEFAULT_REGISTERED,
+            kind in _DISABLED)
+
+
+def restore_helper(kind: str, snapshot) -> None:
+    """Restore state captured by :func:`snapshot_helper`. An empty snapshot
+    (nothing was registered) removes the kind entirely, which re-arms lazy
+    default discovery rather than leaving a stale override behind."""
+    entry, was_default, was_disabled = snapshot
+    if entry is None:
+        _HELPERS.pop(kind, None)
+        _DEFAULT_REGISTERED.discard(kind)
+    else:
+        _HELPERS[kind] = entry
+        if was_default:
+            _DEFAULT_REGISTERED.add(kind)
+        else:
+            _DEFAULT_REGISTERED.discard(kind)
+    if was_disabled:
+        _DISABLED.add(kind)
+    else:
+        _DISABLED.discard(kind)

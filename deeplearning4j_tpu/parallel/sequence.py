@@ -22,6 +22,7 @@ the single-device reference implementation."""
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Optional
 
 import jax
@@ -194,7 +195,8 @@ class SequenceParallelTrainer:
 
 
 def enable_ring_attention(mesh: Mesh, axis: str = "sp",
-                          platforms=("tpu", "axon", "cpu")):
+                          platforms=("tpu", "axon", "cpu"),
+                          _scoped: bool = False):
     """Route every SelfAttentionLayer through ring attention over ``mesh``
     via the helper seam (nn/helpers kind="attention" — the same registry the
     cuDNN-style kernels use): with activations sequence-sharded on T, the
@@ -210,17 +212,27 @@ def enable_ring_attention(mesh: Mesh, axis: str = "sp",
                              "ring helper")
         return ring_self_attention(q, k, v, mesh, axis, causal=conf.causal)
 
-    register_helper("attention", ring_helper, platforms)
+    register_helper("attention", ring_helper, platforms, _scoped=_scoped)
     # a prior disable_ring_attention() leaves the kind in the disabled set;
     # re-enabling must clear it or every later trainer silently falls back
     # to the all-gather path
     from ..nn.helpers import enable_helper
     enable_helper("attention")
+    return ring_helper
 
 
 def disable_ring_attention():
     from ..nn.helpers import disable_helper
     disable_helper("attention")
+
+
+# ring helpers of trainers that have been close()d, mapped to the snapshot
+# each trainer displaced: restoring a closed ring from a snapshot would
+# resurrect a ring bound to a dead mesh, so restores walk this chain to the
+# most recent still-live registration instead (weak keys: entries vanish
+# once nothing else can resurrect the helper)
+_CLOSED_RING_SNAPSHOTS: "weakref.WeakKeyDictionary" = \
+    weakref.WeakKeyDictionary()
 
 
 class GraphSequenceParallelTrainer:
@@ -237,12 +249,59 @@ class GraphSequenceParallelTrainer:
 
     def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "sp"):
         from .mesh import make_mesh
+        from ..nn.helpers import snapshot_helper
         self.net = net
         self.mesh = mesh if mesh is not None else \
             make_mesh(axis_names=("sp",))
         self.axis = axis
-        enable_ring_attention(self.mesh, axis)
+        # The ring helper claims the process-global "attention" slot; without
+        # restoration, every later SelfAttentionLayer in the process (other
+        # nets, net.output() sampling) would silently route through ring
+        # attention bound to THIS trainer's mesh. Snapshot what was there and
+        # put it back in close() / on context exit.
+        self._prev_attention = snapshot_helper("attention")
+        self._ring_helper = enable_ring_attention(self.mesh, axis,
+                                                  _scoped=True)
+        self._closed = False
         self._jit_step = None
+
+    def close(self):
+        """Restore whatever attention helper was registered before this
+        trainer claimed the slot (the lazy flash default, usually). Safe to
+        call more than once. Restores only while THIS trainer's helper still
+        holds the slot — under non-LIFO closes (or a helper registered after
+        this trainer) restoring would reinstall a stale ring bound to this
+        trainer's mesh over whoever registered since, so close() warns and
+        leaves the current registration alone instead."""
+        if self._closed:
+            return
+        self._closed = True
+        _CLOSED_RING_SNAPSHOTS[self._ring_helper] = self._prev_attention
+        from ..nn import helpers
+        current = helpers._HELPERS.get("attention")
+        if current is not None and current[0] is not self._ring_helper:
+            import warnings
+            warnings.warn(
+                "GraphSequenceParallelTrainer.close(): the 'attention' "
+                "helper slot was re-registered after this trainer claimed "
+                "it; leaving the current registration in place (close "
+                "trainers LIFO to restore cleanly)", stacklevel=2)
+            return
+        snap = self._prev_attention
+        while snap[0] is not None and snap[0][0] in _CLOSED_RING_SNAPSHOTS:
+            # the displaced helper belongs to an already-closed trainer
+            # (non-LIFO close order): restoring it would resurrect a ring
+            # bound to a dead mesh — walk to what THAT trainer displaced,
+            # until a still-live registration (or the empty slot) surfaces
+            snap = _CLOSED_RING_SNAPSHOTS[snap[0][0]]
+        helpers.restore_helper("attention", snap)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def _build(self):
         net = self.net
@@ -265,6 +324,11 @@ class GraphSequenceParallelTrainer:
             donate_argnums=(0, 1, 2))
 
     def fit_batch(self, ds):
+        if self._closed:
+            raise RuntimeError(
+                "GraphSequenceParallelTrainer is closed: its ring-attention "
+                "registration has been restored away, so training would "
+                "silently lose sequence parallelism; create a new trainer")
         net = self.net
         net._ensure_init()
         n_sp = self.mesh.shape[self.axis]

@@ -67,9 +67,15 @@ class FlowIterationListener(IterationListener):
         # the per-layer timing probe is EAGER (one dispatch + blocking read
         # per layer — ~100 ms each through a tunneled device): by default it
         # runs on the first record and then every 10th reported iteration;
-        # records in between reuse the last measured timings
-        self.timing_frequency = max(1, int(timing_frequency)) \
-            if timing_frequency is not None else self.frequency * 10
+        # records in between reuse the last measured timings. Pass
+        # timing_frequency=0 to disable the probe entirely (the flow tab
+        # then shows structure + param counts without timings).
+        if timing_frequency is None:
+            self.timing_frequency = self.frequency * 10
+        elif int(timing_frequency) <= 0:
+            self.timing_frequency = 0
+        else:
+            self.timing_frequency = int(timing_frequency)
         self._last_timings = None
 
     @staticmethod
@@ -95,8 +101,9 @@ class FlowIterationListener(IterationListener):
             self._static_sent = True
         sizes = [sum(int(np.prod(v.shape)) for v in p.values())
                  for p in param_dicts]
-        if self._last_timings is None or \
-                iteration % self.timing_frequency == 0:
+        if self.timing_frequency and (
+                self._last_timings is None
+                or iteration % self.timing_frequency == 0):
             timed = self._time_layers(model)
             if timed is not None:
                 self._last_timings = timed

@@ -290,7 +290,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _latest_conv_record(self, session: str = ""):
         """Most recent 'convolutional' record — in ``session`` when given
-        (the conv listener uses its own session id), else across sessions."""
+        (the conv listener uses its own session id), else across sessions.
+        An explicitly requested session with no conv records returns None
+        rather than silently showing another run's activations under the
+        selected session id."""
         storage = type(self).storage
         if storage is None:
             return None
@@ -300,8 +303,6 @@ class _Handler(BaseHTTPRequestHandler):
             for u in reversed(storage.get_updates(sess)):
                 if u.get("type") == "convolutional":
                     return u
-        if session:               # fall back to any session's conv records
-            return self._latest_conv_record("")
         return None
 
     def do_GET(self):

@@ -33,6 +33,7 @@ def main():
     print(f"vocab {V}, params {net.num_params():,}")
 
     rng = np.random.default_rng(0)
+    trainer = None
     if "--sp" in sys.argv:
         from deeplearning4j_tpu.parallel.mesh import make_mesh
         from deeplearning4j_tpu.parallel.sequence import \
@@ -52,11 +53,10 @@ def main():
         if step % 50 == 0:
             print(f"step {step:3d} loss {float(net.score_value):.3f}")
 
-    if "--sp" in sys.argv:
-        # sampling feeds ragged contexts; route attention off the ring
-        from deeplearning4j_tpu.parallel.sequence import \
-            disable_ring_attention
-        disable_ring_attention()
+    if trainer is not None:
+        # sampling feeds ragged contexts; close() hands the attention slot
+        # back to whatever was registered before (the flash default)
+        trainer.close()
 
     prompt = [stoi[c] for c in "the quick "]
     out = generate(net, prompt, 40, temperature=0)

@@ -261,6 +261,21 @@ class TestCorpusScanPath:
         w2v, ta, tb = self._fit_scan(rng_np, negative=0)
         assert w2v.similarity(ta[0], ta[1]) > w2v.similarity(ta[0], tb[0])
 
+    def test_per_pair_negatives_option(self, rng_np):
+        """shared_negatives=False draws per-pair negatives in the scan
+        program (word2vec.c's behavior) and is exposed on the Builder; the
+        scan threshold is configurable too (ADVICE r3)."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        seqs, topic_a, topic_b = _topic_corpus(rng_np, n_sentences=200)
+        w2v = (Word2Vec.Builder().layer_size(16).window_size(3)
+               .negative_sample(5).epochs(10).seed(2).batch_size(256)
+               .shared_negatives(False).scan_min_tokens(0).build())
+        assert w2v.shared_negatives is False
+        assert w2v.SCAN_MIN_TOKENS == 0      # instance override, scan forced
+        w2v.fit(seqs)
+        assert w2v.similarity(topic_a[0], topic_a[1]) > \
+            w2v.similarity(topic_a[0], topic_b[0])
+
     def test_scan_respects_sentence_boundaries(self):
         """A pair crossing a -1 separator must contribute nothing: train on
         two 'sentences' of mutually-exclusive vocab; cross-words must not

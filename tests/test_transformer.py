@@ -189,6 +189,75 @@ class TestSPRegressions:
         finally:
             disable_ring_attention()
 
+    def test_trainer_close_restores_previous_helper(self, rng_np):
+        """The SP trainer claims the process-global 'attention' slot; close()
+        (or context exit) must put back EXACTLY what was there before —
+        other nets in the process must not silently route through a ring
+        bound to the trainer's mesh."""
+        from deeplearning4j_tpu.nn import helpers
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer)
+
+        def marker_helper(conf, q, k, v, mask):
+            return None
+
+        snap = helpers.snapshot_helper("attention")
+        try:
+            helpers.restore_helper("attention", (None, False, False))
+            helpers.register_helper("attention", marker_helper, ("cpu",))
+            helpers.enable_helper("attention")   # a prior test may disable
+            mesh = make_mesh(axis_names=("sp",))
+            with GraphSequenceParallelTrainer(_tiny_lm(), mesh):
+                got = helpers.get_helper("attention")
+                assert got is not None and got is not marker_helper
+            assert helpers.get_helper("attention") is marker_helper
+            # nothing registered before: close() must fully clear the slot
+            helpers.restore_helper("attention", (None, False, False))
+            t2 = GraphSequenceParallelTrainer(_tiny_lm(), mesh)
+            assert helpers.get_helper("attention") is not None
+            t2.close()
+            t2.close()                      # idempotent
+            assert helpers._HELPERS.get("attention") is None
+        finally:
+            helpers.restore_helper("attention", snap)
+
+    def test_non_lifo_close_does_not_resurrect_stale_ring(self, rng_np):
+        """t1 closed while t2 holds the slot must not clobber t2; t2's later
+        close must not reinstall t1's dead ring either — the restore walks
+        through closed trainers' snapshots to the still-live base helper
+        (the user's custom registration here). A closed trainer also refuses
+        further fit_batch calls."""
+        import warnings as warnings_mod
+        from deeplearning4j_tpu.nn import helpers
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer)
+
+        def custom_fn(conf, q, k, v, mask):
+            return None
+
+        snap = helpers.snapshot_helper("attention")
+        try:
+            helpers.restore_helper("attention", (None, False, False))
+            helpers.register_helper("attention", custom_fn, ("cpu",))
+            helpers.enable_helper("attention")
+            mesh = make_mesh(axis_names=("sp",))
+            with warnings_mod.catch_warnings():
+                warnings_mod.simplefilter("ignore")   # slot-replace warnings
+                t1 = GraphSequenceParallelTrainer(_tiny_lm(), mesh)
+                t2 = GraphSequenceParallelTrainer(_tiny_lm(), mesh)
+            with pytest.warns(UserWarning, match="LIFO"):
+                t1.close()
+            assert helpers._HELPERS["attention"][0] is t2._ring_helper
+            t2.close()
+            # t1's dead ring was skipped; the user's helper survives
+            assert helpers.get_helper("attention") is custom_fn
+            with pytest.raises(RuntimeError, match="closed"):
+                t2.fit_batch(_cyclic_batch(rng_np, n=2, t=16))
+        finally:
+            helpers.restore_helper("attention", snap)
+
     def test_sp_label_mask_matches_single_device(self, rng_np):
         """Per-token label masks shard over T and must weight the loss
         exactly like the single-device step."""

@@ -82,6 +82,14 @@ class TestUITabs:
                                       timeout=10).read().decode()
         assert "activations" in html.lower()
 
+    def test_activations_no_cross_session_fallback(self, served):
+        """An explicitly requested session with no conv records must return
+        an empty record, not another run's activations (ADVICE r3)."""
+        a = _get(served, "/train/activations?session=no-such-session")
+        assert a == {}
+        # no session param: latest conv record across sessions still serves
+        assert _get(served, "/train/activations")["layers"]
+
 
 class TestLrnHelper:
     def test_helper_matches_pure_path_forward_and_grad(self, rng_np):
@@ -291,6 +299,34 @@ class TestFlowTabAndSessions:
         html = urllib.request.urlopen(two_sessions + "/train/flow.html",
                                       timeout=10).read().decode()
         assert "Flow" in html and "sesssel" in html
+
+    def test_timing_frequency_zero_disables_probe(self, rng_np):
+        """timing_frequency=0 must skip the eager per-layer timing probe
+        entirely (each probe is a blocking dispatch per layer — ~100 ms
+        through a tunneled device; ADVICE r3)."""
+        from deeplearning4j_tpu.ui.legacy_listeners import \
+            FlowIterationListener
+        storage = InMemoryStatsStorage()
+        lst = FlowIterationListener(storage, session_id="notimer",
+                                    timing_frequency=0)
+        calls = []
+        lst._time_layers = lambda model: calls.append(1)
+        conf = (NeuralNetConfiguration.Builder().seed(1).learning_rate(0.05)
+                .updater("sgd").weight_init("xavier").activation("tanh")
+                .list()
+                .layer(DenseLayer(n_out=6))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        X = rng_np.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng_np.integers(0, 2, 8)]
+        net.set_listeners(lst)
+        net.fit([DataSet(X, y)] * 3)
+        assert not calls
+        recs = [u for u in storage.get_updates("notimer")
+                if u.get("type") == "flow"]
+        assert recs and all(r["layer_timings_ms"] is None for r in recs)
 
     def test_both_sessions_reachable(self, two_sessions):
         sessions = _get(two_sessions, "/train/sessions")
