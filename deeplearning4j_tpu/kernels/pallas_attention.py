@@ -16,9 +16,13 @@ the FlashAttention-2 factorization: forward saves the per-row logsumexp;
 dq accumulates over k blocks, dk/dv over q blocks, with the row term
 delta = rowsum(dO·O) computed outside.
 
-Key masks are not supported here — the registered helper declines and the
-layer falls back (masked long-context goes through the jnp blockwise
-path)."""
+Key masks ([B, T], 1 real / 0 masked) are supported in-kernel (r4): each
+grid step loads the [1, KB] mask tile for its k block and REPLACES masked
+keys' logits by −1e30 in ``_scores`` — shared by forward and both backward
+kernels — so ragged long-context batches keep the kernel's speed. A fully
+masked row degrades to the same uniform average as the materialized and
+jnp blockwise paths (arbitrary-but-finite; such rows are excluded by loss
+masks)."""
 
 from __future__ import annotations
 
@@ -36,12 +40,19 @@ NEG = -1e30
 ROWW = 8
 
 
-def _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale):
+def _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale, mask_ref=None):
     """Scaled q·kᵀ block with the causal −1e30 replacement mask — shared by
     the forward and both backward kernels so the masking can never
-    diverge between them."""
+    diverge between them. ``mask_ref`` (a [1, KB] block of the [B, T] key
+    mask) REPLACES masked keys' logits by −1e30, so a fully-masked row
+    degrades to the same uniform average as the materialized and jnp
+    blockwise paths."""
     s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    if mask_ref is not None:
+        # mask block is [1, 1, KB] (of the [B, 1, T] carrier — the middle
+        # singleton keeps the TPU block-shape rule happy for any B)
+        s = jnp.where(mask_ref[0, 0][None, :] > 0, s, NEG)
     if causal:
         qpos = qi * qb + jax.lax.broadcasted_iota(jnp.int32, (qb, kb), 0)
         kpos = ki * kb + jax.lax.broadcasted_iota(jnp.int32, (qb, kb), 1)
@@ -49,8 +60,13 @@ def _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale):
     return s
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                causal, scale, kb, qb):
+def _fwd_kernel(*refs, causal, scale, kb, qb, masked=False):
+    if masked:
+        (q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+         m_s, l_s, acc_s) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        mask_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(1)
     nk = pl.num_programs(2)
@@ -69,7 +85,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     def _attend():
         # dots run at the INPUT precision (bf16 hits the full-rate MXU)
         # with f32 accumulation; only the softmax math is f32
-        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale)
+        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale, mask_ref)
 
         m_prev = m_s[:, :1]                        # [QB, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -91,8 +107,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                            jnp.log(l_fin)).astype(lse_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_s, *, causal, scale, kb, qb):
+def _dq_kernel(*refs, causal, scale, kb, qb, masked=False):
+    if masked:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_s) = refs
+        mask_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(1)
     nk = pl.num_programs(2)
@@ -110,7 +132,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]
         lse = lse_ref[0][:, :1]                    # [QB, 1]
         delta = delta_ref[0][:, :1]                # [QB, 1]
-        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale)
+        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale, mask_ref)
         p = jnp.exp(s - lse)                       # [QB, KB]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -124,8 +146,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, ...] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_s, dv_s, *, causal, scale, kb, qb):
+def _dkv_kernel(*refs, causal, scale, kb, qb, masked=False):
+    if masked:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+        mask_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(1)
     nq = pl.num_programs(2)
@@ -143,7 +171,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
-        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale)
+        s = _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale, mask_ref)
         p = jnp.exp(s - lse)                       # [QB, KB]
         dv_s[...] = dv_s[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -180,22 +208,31 @@ def _interpret_default():
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q3, k3, v3, causal, qb, kb, interpret):
-    o, _ = _flash_fwd_impl(q3, k3, v3, causal, qb, kb, interpret)
+    o, _ = _flash_fwd_impl(q3, k3, v3, None, 1, causal, qb, kb, interpret)
     return o
 
 
-def _flash_fwd_impl(q3, k3, v3, causal, qb, kb, interpret):
+def _flash_fwd_impl(q3, k3, v3, mask2, h, causal, qb, kb, interpret):
+    """``mask2``: optional [B, T] key mask (1 real / 0 masked); ``h`` is the
+    head count, mapping folded index bh → batch row bh // h for the mask's
+    block index."""
     bh, t, d = q3.shape
     scale = float(1.0 / np.sqrt(d))
     grid = (bh, t // qb, t // kb)
+    masked = mask2 is not None
     kern = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                             kb=kb, qb=qb)
+                             kb=kb, qb=qb, masked=masked)
+    in_specs = [_specs(qb, d, "q"), _specs(kb, d, "k"), _specs(kb, d, "k")]
+    operands = [q3, k3, v3]
+    if masked:
+        in_specs.append(pl.BlockSpec((1, 1, kb),
+                                     lambda bhi, qi, ki: (bhi // h, 0, ki)))
+        operands.append(mask2[:, None, :])
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
         interpret=interpret,
-        in_specs=[_specs(qb, d, "q"), _specs(kb, d, "k"),
-                  _specs(kb, d, "k")],
+        in_specs=in_specs,
         out_specs=[_specs(qb, d, "q"),
                    pl.BlockSpec((1, qb, ROWW), lambda bh, qi, ki:
                                 (bh, qi, 0))],
@@ -207,28 +244,35 @@ def _flash_fwd_impl(q3, k3, v3, causal, qb, kb, interpret):
             pltpu.VMEM((qb, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q3, k3, v3)
+    )(*operands)
     return o, lse
 
 
 def _flash_fwd(q3, k3, v3, causal, qb, kb, interpret):
-    o, lse = _flash_fwd_impl(q3, k3, v3, causal, qb, kb, interpret)
+    o, lse = _flash_fwd_impl(q3, k3, v3, None, 1, causal, qb, kb, interpret)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash_bwd(causal, qb, kb, interpret, res, do):
-    q3, k3, v3, o, lse = res
+def _flash_bwd_impl(q3, k3, v3, mask2, h, o, lse, do, causal, qb, kb,
+                    interpret):
     bh, t, d = q3.shape
     scale = float(1.0 / np.sqrt(d))
+    masked = mask2 is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                  # [BH, T]
     delta3 = jnp.broadcast_to(delta[..., None], (bh, t, ROWW))
     row = pl.BlockSpec((1, qb, ROWW), lambda bhi, qi, ki: (bhi, qi, 0))
-    common = [_specs(qb, d, "q"), _specs(kb, d, "k"), _specs(kb, d, "k"),
-              _specs(qb, d, "q"), row, row]
+    common = [_specs(qb, d, "q"), _specs(kb, d, "k"), _specs(kb, d, "k")]
+    dq_operands = [q3, k3, v3]
+    if masked:
+        common.append(pl.BlockSpec((1, 1, kb),
+                                   lambda bhi, qi, ki: (bhi // h, 0, ki)))
+        dq_operands.append(mask2[:, None, :])
+    common += [_specs(qb, d, "q"), row, row]
+    dq_operands += [do, lse, delta3]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale,
-                          kb=kb, qb=qb),
+                          kb=kb, qb=qb, masked=masked),
         grid=(bh, t // qb, t // kb),
         interpret=interpret,
         in_specs=common,
@@ -237,7 +281,7 @@ def _flash_bwd(causal, qb, kb, interpret, res, do):
         scratch_shapes=[pltpu.VMEM((qb, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q3, k3, v3, do, lse, delta3)
+    )(*dq_operands)
 
     # dk/dv: k blocks outer ("parallel"), q blocks inner accumulate
     def kspec(block, which):
@@ -247,13 +291,20 @@ def _flash_bwd(causal, qb, kb, interpret, res, do):
         return pl.BlockSpec((1, block, d),
                             lambda bhi, ki, qi: (bhi, qi, 0))
     rowq = pl.BlockSpec((1, qb, ROWW), lambda bhi, ki, qi: (bhi, qi, 0))
+    kv_specs = [kspec(qb, "q"), kspec(kb, "k"), kspec(kb, "k")]
+    kv_operands = [q3, k3, v3]
+    if masked:
+        kv_specs.append(pl.BlockSpec((1, 1, kb),
+                                     lambda bhi, ki, qi: (bhi // h, 0, ki)))
+        kv_operands.append(mask2[:, None, :])
+    kv_specs += [kspec(qb, "q"), rowq, rowq]
+    kv_operands += [do, lse, delta3]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale,
-                          kb=kb, qb=qb),
+                          kb=kb, qb=qb, masked=masked),
         grid=(bh, t // kb, t // qb),
         interpret=interpret,
-        in_specs=[kspec(qb, "q"), kspec(kb, "k"), kspec(kb, "k"),
-                  kspec(qb, "q"), rowq, rowq],
+        in_specs=kv_specs,
         out_specs=[kspec(kb, "k"), kspec(kb, "k")],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
                    jax.ShapeDtypeStruct((bh, t, d), q3.dtype)],
@@ -261,23 +312,58 @@ def _flash_bwd(causal, qb, kb, interpret, res, do):
                         pltpu.VMEM((kb, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q3, k3, v3, do, lse, delta3)
+    )(*kv_operands)
     return dq, dk, dv
+
+
+def _flash_bwd(causal, qb, kb, interpret, res, do):
+    q3, k3, v3, o, lse = res
+    return _flash_bwd_impl(q3, k3, v3, None, 1, o, lse, do, causal, qb, kb,
+                           interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ---- masked variant: the key mask is a regular (non-differentiated) tensor
+# input — custom_vjp can't mark array args nondiff, so the bwd returns a
+# zero cotangent for it
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_masked(q3, k3, v3, mask2, h, causal, qb, kb, interpret):
+    o, _ = _flash_fwd_impl(q3, k3, v3, mask2, h, causal, qb, kb, interpret)
+    return o
+
+
+def _flash_masked_fwd(q3, k3, v3, mask2, h, causal, qb, kb, interpret):
+    o, lse = _flash_fwd_impl(q3, k3, v3, mask2, h, causal, qb, kb, interpret)
+    return o, (q3, k3, v3, mask2, o, lse)
+
+
+def _flash_masked_bwd(h, causal, qb, kb, interpret, res, do):
+    q3, k3, v3, mask2, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q3, k3, v3, mask2, h, o, lse, do, causal,
+                                 qb, kb, interpret)
+    return dq, dk, dv, jnp.zeros_like(mask2)
+
+
+_flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
+
+
 def pallas_flash_attention(q, k, v, causal: bool = False,
                            q_block: int = 512, k_block: int = 512,
-                           interpret=None):
+                           interpret=None, key_mask=None):
     """[B, T, H, D] attention via the Pallas kernels.
 
-    Non-divisible T: under causal masking, q/k/v are right-padded to the
-    block multiple and the result sliced back (padded keys sit strictly in
-    the future of every real query, so real rows are untouched);
-    non-causal non-divisible inputs route to the jnp blockwise path, whose
-    key-mask machinery handles the padding.
+    ``key_mask`` [B, T] (1 real / 0 masked): masked keys' logits are
+    replaced by −1e30 INSIDE the kernels (a [1, KB] mask tile per block),
+    so ragged long-context batches keep the kernel speed instead of
+    dropping to the jnp blockwise path.
+
+    Non-divisible T: with a mask (or non-causal, where an all-ones mask is
+    synthesized), q/k/v right-pad to the block multiple with the padded
+    keys masked out and the result sliced back; unmasked causal inputs pad
+    without a mask (padded keys sit strictly in the future of every real
+    query, so real rows are untouched).
 
     ``interpret``: None derives Pallas interpret mode from the DEFAULT
     backend; pass True/False explicitly when tracing for a non-default
@@ -288,42 +374,46 @@ def pallas_flash_attention(q, k, v, causal: bool = False,
     qb = min(q_block, t)
     kb = min(k_block, t)
     pad = max((-t) % qb, (-t) % kb)
-    if pad and not causal:
-        from .flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=False,
-                               block_size=max(qb, kb))
     if pad:
+        if key_mask is None and not causal:
+            # padded keys are visible to real queries non-causally; mask
+            # them out explicitly
+            key_mask = jnp.ones((b, t), jnp.float32)
         padded = [jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
                   for x in (q, k, v)]
+        km = None if key_mask is None else \
+            jnp.pad(key_mask.astype(jnp.float32), ((0, 0), (0, pad)))
         out = pallas_flash_attention(padded[0], padded[1], padded[2],
                                      causal=causal, q_block=q_block,
-                                     k_block=k_block, interpret=interpret)
+                                     k_block=k_block, interpret=interpret,
+                                     key_mask=km)
         return out[:, :t]
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    out3 = _flash(fold(q), fold(k), fold(v), causal, qb, kb, bool(interpret))
+    if key_mask is not None:
+        out3 = _flash_masked(fold(q), fold(k), fold(v),
+                             key_mask.astype(jnp.float32), h, causal,
+                             qb, kb, bool(interpret))
+    else:
+        out3 = _flash(fold(q), fold(k), fold(v), causal, qb, kb,
+                      bool(interpret))
     return out3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
 def make_pallas_flash_helper(min_seq_len: int = 1024,
                              q_block: int = 512, k_block: int = 512,
                              interpret=None):
-    """Helper chain: Pallas kernels for long unmasked sequences; the jnp
-    blockwise path for long MASKED sequences (declining outright would
-    drop to the layer's materialized O(T²) softmax — which cannot even
-    compile at the very lengths this kernel exists for); decline only
-    below min_seq_len, where materialized is fastest."""
+    """Helper: Pallas kernels for every long sequence — key masks ride
+    into the kernels as [1, KB] tiles (r4; the r3 helper dropped masked
+    long-context to the jnp blockwise path and lost the 2-2.8x win on
+    ragged batches). Decline only below min_seq_len, where the
+    materialized path is fastest."""
     def helper(conf, q, k, v, mask):
         t = q.shape[1]
         if t < min_seq_len:
             return None                      # short: materialized path wins
-        if mask is not None:
-            from .flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=conf.causal,
-                                   block_size=max(q_block, k_block),
-                                   key_mask=mask)
         return pallas_flash_attention(q, k, v, causal=conf.causal,
                                       q_block=q_block, k_block=k_block,
-                                      interpret=interpret)
+                                      interpret=interpret, key_mask=mask)
     return helper
 
 

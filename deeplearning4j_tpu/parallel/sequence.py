@@ -329,6 +329,15 @@ class GraphSequenceParallelTrainer:
                 "GraphSequenceParallelTrainer is closed: its ring-attention "
                 "registration has been restored away, so training would "
                 "silently lose sequence parallelism; create a new trainer")
+        from ..nn import helpers
+        current = helpers._HELPERS.get("attention")
+        if current is None or current[0] is not self._ring_helper:
+            raise RuntimeError(
+                "this trainer's ring-attention helper no longer holds the "
+                "'attention' slot (another trainer or helper registration "
+                "displaced it); training would route attention through the "
+                "wrong mesh — close the other registration first or use "
+                "one trainer at a time")
         net = self.net
         net._ensure_init()
         n_sp = self.mesh.shape[self.axis]

@@ -210,13 +210,22 @@ h3{margin:4px 0;font-size:13px}</style></head><body>
 // records arrive over the unauthenticated /remote/receive push: escape
 // every interpolated field (same esc() policy as the model tab)
 async function render(s){
-  const d = await (await fetch('/train/activations?session=' + s)).json();
-  if (!d.layers) return;
+  let d = await (await fetch('/train/activations?session=' + s)).json();
+  let ps = s;
+  if (!d.layers){
+    // the conv listener records under its own session id (default 'conv');
+    // when the SELECTED session has no conv records, show the latest conv
+    // records across sessions rather than a permanently blank tab (the
+    // server no longer silently substitutes — the page asks explicitly)
+    d = await (await fetch('/train/activations')).json();
+    ps = '';
+    if (!d.layers) return;
+  }
   document.getElementById('grids').innerHTML = d.layers.map(l =>
     `<div class=card><h3>layer ${esc(l.layer)} — shape ` +
     `[${esc(l.shape)}] mean ${Number(l.mean).toFixed(3)} ` +
     `std ${Number(l.std).toFixed(3)}</h3>` +
-    `<img src="/train/activations.png?session=${esc(s)}&layer=` +
+    `<img src="/train/activations.png?session=${esc(ps)}&layer=` +
     `${encodeURIComponent(l.layer)}&it=${encodeURIComponent(d.iteration)}"` +
     ` width="${Number(l.grid_shape && l.grid_shape[1]) * 3 || 64}">` +
     `</div>`).join('');
