@@ -254,13 +254,17 @@ def _flash_fwd(q3, k3, v3, causal, qb, kb, interpret):
 
 
 def _flash_bwd_impl(q3, k3, v3, mask2, h, o, lse, do, causal, qb, kb,
-                    interpret):
+                    interpret, delta3=None):
+    """``delta3``: optional precomputed [BH, T, ROWW] row term
+    rowsum(dO·O) — loop-invariant callers (the ring backward, which calls
+    this once per ring step) hoist it instead of recomputing n times."""
     bh, t, d = q3.shape
     scale = float(1.0 / np.sqrt(d))
     masked = mask2 is not None
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                  # [BH, T]
-    delta3 = jnp.broadcast_to(delta[..., None], (bh, t, ROWW))
+    if delta3 is None:
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)                              # [BH, T]
+        delta3 = jnp.broadcast_to(delta[..., None], (bh, t, ROWW))
     row = pl.BlockSpec((1, qb, ROWW), lambda bhi, qi, ki: (bhi, qi, 0))
     common = [_specs(qb, d, "q"), _specs(kb, d, "k"), _specs(kb, d, "k")]
     dq_operands = [q3, k3, v3]

@@ -189,6 +189,32 @@ class TestSPRegressions:
         finally:
             disable_ring_attention()
 
+    def test_sp_long_t_step_matches_single_device(self, rng_np):
+        """T=2048 (shard length 256 — the Pallas pair-kernel ring path):
+        one SP train step of the full LM equals one single-device step.
+        This is the r4 composition test — SP and the Pallas kernel
+        multiplying, not just coexisting (VERDICT r3 #3)."""
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.sequence import (
+            GraphSequenceParallelTrainer)
+        t = 2048
+        kw = dict(d_model=16, num_heads=2, num_layers=1, max_length=t)
+        ds = _cyclic_batch(rng_np, n=1, t=t)
+        solo = _tiny_lm(**kw)
+        solo.fit_batch(ds)
+        sp_net = _tiny_lm(**kw)
+        with GraphSequenceParallelTrainer(
+                sp_net, mesh=make_mesh(axis_names=("sp",))) as trainer:
+            trainer.fit_batch(ds)
+        assert abs(float(sp_net.score_value) -
+                   float(solo.score_value)) < 1e-3
+        for name in solo.params:
+            for k in solo.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(sp_net.params[name][k]),
+                    np.asarray(solo.params[name][k]),
+                    rtol=5e-3, atol=2e-4, err_msg=f"{name}/{k}")
+
     def test_trainer_close_restores_previous_helper(self, rng_np):
         """The SP trainer claims the process-global 'attention' slot; close()
         (or context exit) must put back EXACTLY what was there before —
