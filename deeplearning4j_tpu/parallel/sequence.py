@@ -232,24 +232,34 @@ def _ring_block(t_local: int):
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                        causal: bool = False, impl: Optional[str] = None):
+                        causal: bool = False, impl: Optional[str] = None,
+                        batch_axis: Optional[str] = None):
     """Ring attention: q/k/v [B, T, H, D] sharded over ``axis`` on dim 1.
     Returns [B, T, H, D] with the same sharding.
 
     ``impl``: None picks the Pallas pair-kernel ring when the shard length
     tiles a kernel block (the fast path; see :func:`_ring_flash`), else the
     jnp streaming-softmax ring; "jnp"/"pallas" force a path (the parity
-    test runs both)."""
+    test runs both).
+
+    ``batch_axis``: on a composed (data, sp) mesh, the mesh axis the BATCH
+    dim is sharded over — devices along it run independent rings
+    (``ppermute`` over ``axis`` only rotates within one batch shard)."""
+    from ..kernels.pallas_attention import _interpret_default
     n_dev = mesh.shape[axis]
     t_local = q.shape[1] // n_dev
     blk = _ring_block(t_local)
-    use_kernel = (impl == "pallas") or (impl is None and blk is not None)
+    # auto mode requires a real kernel backend: in Pallas INTERPRET mode
+    # (CPU) the kernels are orders of magnitude slower than the XLA jnp
+    # ring, so interpret backends keep the jnp path unless impl="pallas"
+    # forces the kernels (parity tests and the driver dryrun do)
+    use_kernel = (impl == "pallas") or (
+        impl is None and blk is not None and not _interpret_default())
     if use_kernel and blk is None:
         raise ValueError(f"no kernel block tiles shard length {t_local}")
+    spec = P(batch_axis, axis, None, None)
     if use_kernel:
-        from ..kernels.pallas_attention import _interpret_default
         interpret = _interpret_default()
-        b, t, h, d = q.shape
 
         def ring_kernel(ql, kl, vl):
             bl, tl, hl, dl = ql.shape
@@ -258,7 +268,6 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                              causal, blk, blk, interpret)
             return o3.reshape(bl, hl, tl, dl).transpose(0, 2, 1, 3)
 
-        spec = P(None, axis, None, None)
         return jax.shard_map(ring_kernel, mesh=mesh,
                              in_specs=(spec, spec, spec), out_specs=spec,
                              check_vma=False)(q, k, v)
@@ -279,7 +288,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
             m, l, o = _block_attend(ql, k_cur, v_cur, m, l, o,
                                     q_offset, k_offset, causal)
             # rotate: receive the next chunk from the ring neighbour
-            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            perm = _ring_perm(n_dev)
             k_next = lax.ppermute(k_cur, axis, perm)
             v_next = lax.ppermute(v_cur, axis, perm)
             return m, l, o, k_next, v_next
@@ -290,7 +299,6 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         denom = jnp.transpose(jnp.maximum(l, 1e-20), (0, 2, 1))[..., None]
         return o / denom
 
-    spec = P(None, axis, None, None)
     return jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
@@ -378,6 +386,8 @@ class SequenceParallelTrainer:
 
 def enable_ring_attention(mesh: Mesh, axis: str = "sp",
                           platforms=("tpu", "axon", "cpu"),
+                          batch_axis: Optional[str] = None,
+                          impl: Optional[str] = None,
                           _scoped: bool = False):
     """Route every SelfAttentionLayer through ring attention over ``mesh``
     via the helper seam (nn/helpers kind="attention" — the same registry the
@@ -392,7 +402,8 @@ def enable_ring_attention(mesh: Mesh, axis: str = "sp",
             raise ValueError("ring attention does not support key masks; "
                              "train unmasked (LM) sequences or disable the "
                              "ring helper")
-        return ring_self_attention(q, k, v, mesh, axis, causal=conf.causal)
+        return ring_self_attention(q, k, v, mesh, axis, causal=conf.causal,
+                                   batch_axis=batch_axis, impl=impl)
 
     register_helper("attention", ring_helper, platforms, _scoped=_scoped)
     # a prior disable_ring_attention() leaves the kind in the disabled set;
@@ -429,21 +440,36 @@ class GraphSequenceParallelTrainer:
     The CPU-mesh test asserts one SP step == one single-device step
     (ring attention is exact, not an approximation)."""
 
-    def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "sp"):
+    def __init__(self, net, mesh: Optional[Mesh] = None, axis: str = "sp",
+                 data_axis: Optional[str] = None,
+                 ring_impl: Optional[str] = None):
+        """``data_axis``: on a composed 2-D mesh (e.g. make_mesh(
+        axis_names=("data", "sp"), shape=(2, 4))), the axis the BATCH dim
+        shards over — DP×SP: independent rings per batch shard, gradients
+        all-reduced over ``data`` by GSPMD. ``ring_impl``: forwarded to
+        :func:`ring_self_attention` ("pallas" forces the kernel ring even
+        on interpret backends — the parity tests and driver dryrun do)."""
         from .mesh import make_mesh
         from ..nn.helpers import snapshot_helper
         self.net = net
         self.mesh = mesh if mesh is not None else \
             make_mesh(axis_names=("sp",))
         self.axis = axis
+        if data_axis is not None and data_axis == axis:
+            raise ValueError(
+                f"data_axis {data_axis!r} must differ from the sequence "
+                f"axis {axis!r} (use a 2-D mesh like axis_names="
+                f"('data', 'sp'))")
+        self.data_axis = data_axis if data_axis in self.mesh.shape else None
         # The ring helper claims the process-global "attention" slot; without
         # restoration, every later SelfAttentionLayer in the process (other
         # nets, net.output() sampling) would silently route through ring
         # attention bound to THIS trainer's mesh. Snapshot what was there and
         # put it back in close() / on context exit.
         self._prev_attention = snapshot_helper("attention")
-        self._ring_helper = enable_ring_attention(self.mesh, axis,
-                                                  _scoped=True)
+        self._ring_helper = enable_ring_attention(
+            self.mesh, axis, batch_axis=self.data_axis, impl=ring_impl,
+            _scoped=True)
         self._closed = False
         self._jit_step = None
 
@@ -491,8 +517,9 @@ class GraphSequenceParallelTrainer:
         step = net._make_train_step()
         from jax.sharding import NamedSharding
         rep = NamedSharding(mesh, P())
-        seq2 = NamedSharding(mesh, P(None, axis))
-        seq3 = NamedSharding(mesh, P(None, axis, None))
+        da = self.data_axis
+        seq2 = NamedSharding(mesh, P(da, axis))
+        seq3 = NamedSharding(mesh, P(da, axis, None))
 
         def wrapped(params, upd, state, inputs, labels, imasks, lmasks,
                     iteration):
@@ -527,6 +554,12 @@ class GraphSequenceParallelTrainer:
         if t % n_sp:
             raise ValueError(f"sequence length {t} not divisible by sp "
                              f"axis size {n_sp}")
+        if self.data_axis:
+            n_dp = self.mesh.shape[self.data_axis]
+            n = np.asarray(ds.features).shape[0]
+            if n % n_dp:
+                raise ValueError(f"batch size {n} not divisible by data "
+                                 f"axis size {n_dp}")
         if self._jit_step is None:
             self._build()
         net.last_input_batch = ds    # probe data for flow/debug listeners
