@@ -182,11 +182,14 @@ def _transformer_lm() -> float:
     import jax
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.models import lm_batch, transformer_lm_conf
+    from deeplearning4j_tpu.models import lm_batch_sparse, transformer_lm_conf
     from deeplearning4j_tpu.nn.graph import ComputationGraph
 
     # batch 32 is the measured sweet spot (r3 sweep: 8→118k, 16→128k,
-    # 32→131k tokens/s)
+    # 32→131k tokens/s; r4 sparse-CE sweep: 32→139k, 64→139k).
+    # Labels ride as [B, T] int32 through the fused sparse-CE path
+    # (kernels/fused_ce.py): +6% device step vs one-hot, and the label
+    # batch is 4 bytes/token instead of 64k (BASELINE.md r4).
     V, B, T = 32_000, int(os.environ.get("BENCH_LM_BATCH", "32")), 512
     conf = transformer_lm_conf(vocab_size=V, d_model=768, num_heads=12,
                                num_layers=12, max_length=T,
@@ -194,10 +197,10 @@ def _transformer_lm() -> float:
     net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
     rng = np.random.default_rng(0)
     toks = rng.integers(0, V, (B, T + 1))
-    x, y = lm_batch(toks, V)
+    x, y = lm_batch_sparse(toks)
     from deeplearning4j_tpu.ops.dataset import DataSet
     ds = DataSet(jax.device_put(jnp.asarray(x)),
-                 jax.device_put(jnp.asarray(y, jnp.bfloat16)))
+                 jax.device_put(jnp.asarray(y)))
     for _ in range(WARMUP):
         net.fit_batch(ds)
     float(net.score_value)

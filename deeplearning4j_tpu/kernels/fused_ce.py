@@ -1,0 +1,206 @@
+"""Fused sparse-label softmax cross-entropy over the output projection.
+
+The flagship LM's output layer (RnnOutputLayer, loss=mcxent, softmax,
+vocab 32k) dominated the r3 step accounting: the one-hot label tensor is
+[B, T, V] (1+ GB at B=32/T=512/V=32k — bigger than the model), and the
+materialized path reads it twice on-device (loss + dlogits) besides paying
+host->device staging for it every batch (reference analog: the
+LossMCXENT/INDArray one-hot convention of BaseOutputLayer.java:103 carried
+into RnnOutputLayer — fine at 10-class MNIST scale, pathological at 32k).
+
+This module computes  sum_i w_i * (logsumexp(x_i W + b) - (x_i W + b)[t_i])
+directly from integer class ids under a custom VJP:
+
+- forward: logits never leave the fusion except as per-row (lse, target)
+  scalars in f32 (the materialized path reduces the loss in bf16 — at
+  T=512 the bf16 sum of 16k one-hot products is the LESS accurate one);
+  row-chunked via lax.map above ``CHUNK_ROWS`` so [R, V] never fully
+  materializes for long-context shapes.
+- backward: dlogits = (softmax - onehot) * w * g built in one fusion from
+  either stored logits (fast, moderate shapes) or a chunked recompute
+  (long-context: trades one extra [R,D]x[D,V] matmul for never holding
+  [R, V] in HBM), then consumed immediately by the dx / dW matmuls.
+
+Measured device win at the flagship shape (B=32, T=512, V=32k) is ~4-5 ms
+of label/loss traffic out of a 118.6 ms step (BASELINE.md r4 accounting);
+the structural win is the input pipeline: fit(iterator) ships [B, T] int32
+instead of [B, T, V] one-hot — 4 bytes/token instead of 2·V.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Above this many logit elements ([rows x vocab]), the forward chunks the
+# row axis and the backward recomputes logits chunk-wise instead of storing
+# them. 2^29 elements = 1 GiB of bf16 — roughly the flagship T=512 batch.
+MATERIALIZE_LIMIT = 1 << 29
+CHUNK_ROWS = 4096
+
+
+def _acc(dtype):
+    """Accumulation dtype: at least f32 (bf16 sums drift), f64 stays f64 so
+    finite-difference oracles see full precision."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+def _lse_tgt_from(logits, ids):
+    """Per-row (logsumexp, target logit) from logits KEPT at compute dtype:
+    casting the [C, V] array up front would materialize a full f32 copy just
+    to feed the (unfusable) gather — measured +17 ms/step at the flagship
+    shape. Only the elementwise exp runs in the accumulation dtype, fused
+    into the reduce."""
+    acc = _acc(logits.dtype)
+    m = jnp.max(logits, axis=-1)
+    z = jnp.sum(jnp.exp((logits - m[:, None]).astype(acc)), axis=-1)
+    lse = m.astype(acc) + jnp.log(z)
+    tgt = jnp.take_along_axis(logits, ids[:, None], axis=-1)[:, 0]
+    return lse, tgt.astype(acc)
+
+
+def _lse_tgt(x2, W, b, ids):
+    return _lse_tgt_from(x2 @ W + b, ids)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def sparse_softmax_ce_sum(x2, W, b, ids, w, _chunked=False):
+    """sum_i w_i * CE_i for rows x2 [R, D], projection W [D, V] + b [V],
+    integer ids [R], weights w [R] (f32; 0 masks a row out). Returns the
+    f32 scalar sum (the caller divides by its averaging denominator)."""
+    lse, tgt = _fwd_parts(x2, W, b, ids, _chunked)
+    return jnp.sum((lse - tgt) * w)
+
+
+def _fwd_parts(x2, W, b, ids, chunked):
+    if not chunked:
+        return _lse_tgt(x2, W, b, ids)
+    R = x2.shape[0]
+    n = max(1, -(-R // CHUNK_ROWS))
+    pad = n * CHUNK_ROWS - R
+    xp = jnp.pad(x2, ((0, pad), (0, 0)))
+    ip = jnp.pad(ids, (0, pad))
+    xc = xp.reshape(n, CHUNK_ROWS, x2.shape[1])
+    ic = ip.reshape(n, CHUNK_ROWS)
+    lse, tgt = jax.lax.map(lambda ab: _lse_tgt(ab[0], W, b, ab[1]), (xc, ic))
+    return lse.reshape(-1)[:R], tgt.reshape(-1)[:R]
+
+
+def _ce_fwd(x2, W, b, ids, w, _chunked):
+    if _chunked:
+        lse, tgt = _fwd_parts(x2, W, b, ids, _chunked)
+        res = (x2, W, b, ids, w, lse, None)
+    else:
+        # store the compute-dtype logits: one [R, V] write+read beats
+        # recomputing the projection matmul at moderate shapes
+        logits = x2 @ W + b
+        lse, tgt = _lse_tgt_from(logits, ids)
+        res = (x2, W, b, ids, w, lse, logits)
+    total = jnp.sum((lse - tgt) * w)
+    return total, res
+
+
+def _dlogits(logits, lse, ids, scale):
+    """(softmax - onehot) * scale at the projection's compute dtype. The
+    one-hot subtraction is a broadcasted-iota comparison, NOT a scatter: a
+    scatter is unfusable and forces the f32 [R, V] softmax to materialize
+    (measured as the bulk of a +17 ms/step regression); the comparison
+    keeps the whole dlogits a single elementwise fusion feeding the dx/dW
+    matmuls."""
+    acc = _acc(logits.dtype)
+    p = jnp.exp(logits.astype(acc) - lse[:, None])
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    onehot = (cols == ids[:, None]).astype(acc)
+    return ((p - onehot) * scale[:, None]).astype(logits.dtype)
+
+
+def _ce_bwd(_chunked, res, g):
+    x2, W, b, ids, w, lse, logits = res
+    scale = (w * g).astype(_acc(x2.dtype))               # [R]
+    if logits is not None:
+        dl = _dlogits(logits, lse, ids, scale)
+        dx = dl @ W.T
+        dW = x2.T @ dl
+        db = jnp.sum(dl.astype(_acc(dl.dtype)), axis=0).astype(b.dtype)
+        return dx, dW, db, None, None
+
+    R, D = x2.shape
+    n = max(1, -(-R // CHUNK_ROWS))
+    pad = n * CHUNK_ROWS - R
+    xc = jnp.pad(x2, ((0, pad), (0, 0))).reshape(n, CHUNK_ROWS, D)
+    ic = jnp.pad(ids, (0, pad)).reshape(n, CHUNK_ROWS)
+    lc = jnp.pad(lse, (0, pad)).reshape(n, CHUNK_ROWS)
+    # padded rows carry scale 0 -> contribute nothing to dW/db/dx
+    sc = jnp.pad(scale, (0, pad)).reshape(n, CHUNK_ROWS)
+
+    acc = _acc(x2.dtype)
+
+    def chunk(carry, parts):
+        dW_acc, db_acc = carry
+        xci, ici, lci, sci = parts
+        dl = _dlogits(xci @ W + b, lci, ici, sci)
+        dxi = dl @ W.T
+        dW_acc = dW_acc + (xci.T @ dl).astype(acc)
+        db_acc = db_acc + jnp.sum(dl.astype(acc), axis=0)
+        return (dW_acc, db_acc), dxi
+
+    (dW, db), dxc = jax.lax.scan(
+        chunk, (jnp.zeros(W.shape, acc), jnp.zeros(b.shape, acc)),
+        (xc, ic, lc, sc))
+    dx = dxc.reshape(-1, D)[:R]
+    return dx, dW.astype(W.dtype), db.astype(b.dtype), None, None
+
+
+sparse_softmax_ce_sum.defvjp(_ce_fwd, _ce_bwd)
+
+
+def fused_sparse_ce_score(layer_params, x, ids, mask: Optional[jnp.ndarray],
+                          average: bool = True):
+    """compute_score twin for the fused path: x is the output layer's INPUT
+    ([N, D] or [N, T, D]), ids the integer labels ([N] or [N, T]). Replicates
+    losses.compute_loss averaging: per-present-cell for sequences (the
+    padding-invariance contract of test_variable_length), per-example (or
+    per-present-example with a vector mask) for 2D."""
+    W, b = layer_params["W"], layer_params["b"]
+    seq = x.ndim == 3
+    if seq:
+        N, T, D = x.shape
+        x2 = x.reshape(N * T, D)
+        ids2 = ids.reshape(N * T).astype(jnp.int32)
+    else:
+        x2 = x
+        ids2 = ids.reshape(x.shape[0]).astype(jnp.int32)
+    acc = _acc(x2.dtype)
+    per_example_seq_mask = False
+    if mask is not None:
+        m = mask.astype(acc)
+        if seq and m.size == x.shape[0]:
+            # per-example mask on a sequence output: broadcast across T,
+            # exactly like losses._apply_mask's trailing-dim broadcast
+            m = jnp.broadcast_to(m.reshape(x.shape[0], 1),
+                                 (x.shape[0], x.shape[1]))
+            per_example_seq_mask = True
+        w = m.reshape(-1)
+        if w.shape[0] != x2.shape[0]:
+            raise ValueError(
+                f"mask {mask.shape} does not cover rows {x2.shape[0]}")
+    else:
+        w = jnp.ones((x2.shape[0],), acc)
+    chunked = x2.shape[0] * W.shape[1] > MATERIALIZE_LIMIT
+    total = sparse_softmax_ce_sum(x2, W, b, ids2, w, chunked)
+    if not average:
+        return total
+    if seq:
+        # compute_loss 3D rule: a [N, T]-shaped mask counts present cells;
+        # a per-example [N]/[N,1] mask (ndim < 2 over [N, T]) keeps the
+        # N*T denominator (losses.compute_loss:208 parity)
+        count = jnp.maximum(jnp.sum(w), 1.0) \
+            if mask is not None and not per_example_seq_mask \
+            else jnp.asarray(float(x.shape[0] * x.shape[1]), acc)
+    else:
+        count = jnp.maximum(jnp.sum(w), 1.0) if mask is not None \
+            else jnp.asarray(float(x.shape[0]), acc)
+    return total / count

@@ -5,10 +5,10 @@ from .char_rnn import char_rnn_conf, CharacterIterator
 from .resnet import resnet_conf, resnet50_conf, resnet_tiny_conf
 from .vgg16 import (vgg16_conf, VGG16ImagePreProcessor, ImageNetLabels,
                     TrainedModels)
-from .transformer import transformer_lm_conf, lm_batch, generate
+from .transformer import (transformer_lm_conf, lm_batch, lm_batch_sparse, generate)
 
 __all__ = ["lenet_conf", "char_rnn_conf", "CharacterIterator",
-           "transformer_lm_conf", "lm_batch", "generate",
+           "transformer_lm_conf", "lm_batch", "lm_batch_sparse", "generate",
            "resnet_conf", "resnet50_conf", "resnet_tiny_conf",
            "vgg16_conf", "VGG16ImagePreProcessor", "ImageNetLabels",
            "TrainedModels"]

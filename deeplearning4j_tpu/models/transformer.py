@@ -77,6 +77,15 @@ def transformer_lm_conf(vocab_size: int, d_model: int = 128,
     return g.build()
 
 
+def lm_batch_sparse(tokens: np.ndarray):
+    """(features, integer labels) for next-token training from token ids
+    [N, T+1] — the fused-CE path (kernels/fused_ce.py): labels stay [N, T]
+    int32 (4 bytes/token) instead of the [N, T, V] one-hot (2·V bytes/token
+    at bf16), and the graph train step fuses projection + softmax-CE."""
+    return (np.asarray(tokens[:, :-1], np.int32),
+            np.asarray(tokens[:, 1:], np.int32))
+
+
 def lm_batch(tokens: np.ndarray, vocab_size: int):
     """(features, one-hot labels) for next-token training from token ids
     [N, T+1]: inputs are tokens[:, :-1], labels tokens[:, 1:]. The one-hot

@@ -127,13 +127,17 @@ class ComputationGraph:
     def _forward(self, params, state, inputs: Dict[str, jnp.ndarray], *,
                  train, rng, input_masks: Optional[Dict] = None,
                  output_preout: bool = False,
-                 initial_rnn: Optional[Dict] = None):
+                 initial_rnn: Optional[Dict] = None,
+                 skip_preoutput=()):
         """Walk topo order. Returns (activations dict, new_state dict, reg).
         With ``output_preout``, output layer vertices contribute their
         PRE-activation (for fused losses) in a separate dict.
         ``initial_rnn``: per-vertex rnn carries (graph TBPTT / rnnTimeStep —
         reference ComputationGraph.java:2010, :1194-analog); a non-empty
-        entry replaces that vertex's state, like the MLN path."""
+        entry replaces that vertex's state, like the MLN path.
+        ``skip_preoutput``: terminal output vertices whose projection is
+        computed INSIDE the loss (kernels/fused_ce.py) — only their input is
+        recorded; the [.., n_out] pre-activation is never built."""
         acts: Dict[str, jnp.ndarray] = dict(inputs)
         masks: Dict[str, Optional[jnp.ndarray]] = dict(input_masks or {})
         new_state: Dict[str, Dict] = {}
@@ -171,12 +175,14 @@ class ComputationGraph:
                     m = v.preprocessor.feed_forward_mask(m)
                 if v.layer.drop_out and train:
                     x = v.layer.maybe_dropout(x, train=train, rng=vrng)
-                pre = v.layer.preoutput(params[name], x)
-                preouts[name] = pre
                 last_inputs[name] = x
                 masks[name] = m
-                acts[name] = v.layer.activation_fn()(pre)
                 new_state[name] = vstate
+                if name in skip_preoutput:
+                    continue            # projection fused into the loss
+                pre = v.layer.preoutput(params[name], x)
+                preouts[name] = pre
+                acts[name] = v.layer.activation_fn()(pre)
             else:
                 y, nstate = v.forward(params[name], vstate, xs,
                                       train=train, rng=vrng, masks=ms)
@@ -248,14 +254,48 @@ class ComputationGraph:
         return jax.tree_util.tree_map(
             lambda a: a.astype(cd) if a.dtype == jnp.float32 else a, params)
 
+    def _fused_ce_outputs(self, labels: Dict):
+        """Terminal softmax+mcxent output layers whose labels arrived as
+        integer class ids: their [.., n_out] projection + loss run as ONE
+        fused sparse cross-entropy (kernels/fused_ce.py) — at a 32k LM
+        vocab the one-hot labels alone are 2·V bytes/token and the
+        materialized loss reads them twice. Only outputs no other vertex
+        consumes are eligible (their activation is never built)."""
+        eligible = set()
+        for out_name in self.conf.network_outputs:
+            v = self.conf.vertices[out_name]
+            if not isinstance(v, LayerVertex):
+                continue
+            layer = v.layer
+            if str(getattr(layer, "loss", "")).lower() not in (
+                    "mcxent", "negativeloglikelihood",
+                    "categorical_crossentropy"):
+                continue
+            if str(getattr(layer, "activation", "")).lower() != "softmax":
+                continue
+            from ..conf.layers import OutputLayer
+            if not isinstance(layer, OutputLayer):
+                continue                 # needs a W/b projection to fuse
+            y = labels.get(out_name)
+            if y is None or not jnp.issubdtype(jnp.asarray(y).dtype,
+                                               jnp.integer):
+                continue
+            if any(out_name in ins
+                   for n, ins in self.conf.vertex_inputs.items()):
+                continue                         # someone consumes this act
+            eligible.add(out_name)
+        return eligible
+
     def _loss(self, params, state, inputs, labels: Dict, rng,
               label_masks: Optional[Dict] = None, input_masks=None,
               initial_rnn=None):
+        from ...kernels.fused_ce import fused_sparse_ce_score
         params = self._cast_params(params)
+        fused_outs = self._fused_ce_outputs(labels)
         acts, new_state, reg, preouts, masks, last_in = self._forward(
             params, state, inputs, train=True, rng=rng,
             input_masks=input_masks, output_preout=True,
-            initial_rnn=initial_rnn)
+            initial_rnn=initial_rnn, skip_preoutput=fused_outs)
         score = reg
         for out_name in self.conf.network_outputs:
             v = self.conf.vertices[out_name]
@@ -263,8 +303,26 @@ class ComputationGraph:
                     not hasattr(v.layer, "compute_score"):
                 continue
             y = labels[out_name]
-            pre = preouts[out_name]
             lmask = (label_masks or {}).get(out_name)
+            if out_name in fused_outs:
+                x = last_in[out_name]
+                if lmask is None and x.ndim == 3:
+                    lmask = masks.get(out_name)
+                score = score + fused_sparse_ce_score(params[out_name], x, y,
+                                                      lmask)
+                continue
+            if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer) and \
+                    str(getattr(v.layer, "loss", "")).lower() in (
+                        "mcxent", "negativeloglikelihood",
+                        "categorical_crossentropy"):
+                raise ValueError(
+                    f"output '{out_name}' got integer class-id labels but "
+                    "is not fused-CE eligible (sparse labels need a "
+                    "TERMINAL OutputLayer with softmax activation whose "
+                    "activation no other vertex consumes). Pass one-hot "
+                    "labels here, or restructure the graph so the softmax "
+                    "head is terminal.")
+            pre = preouts[out_name]
             if lmask is None and pre.ndim == 3:
                 lmask = masks.get(out_name)
             score = score + v.layer.compute_score(params[out_name], y, pre,
@@ -404,10 +462,21 @@ class ComputationGraph:
         carry: Dict[str, Dict] = {}
         for start in range(0, t_total, window):
             end = min(start + window, t_total)
+            # 2D integer labels (sparse class ids, [N, T]) are
+            # time-distributed too — slice them like masks, not like
+            # [N, T, C] one-hot (min_ndim=3 would pass them whole and the
+            # fused CE would see T_total ids against a window of inputs)
+            sliced_labels = {
+                k: (v if v is None else
+                    (v[:, start:end]
+                     if v.ndim >= 3 or (v.ndim == 2 and
+                                        jnp.issubdtype(v.dtype, jnp.integer))
+                     else v))
+                for k, v in labels.items()}
             self.params, self.updater_state, new_states, score = step(
                 self.params, self.updater_state, self.state,
                 self._slice_time(inputs, start, end),
-                self._slice_time(labels, start, end),
+                sliced_labels,
                 self._slice_time(imasks, start, end, min_ndim=2),
                 self._slice_time(lmasks, start, end, min_ndim=2),
                 self.iteration, carry)

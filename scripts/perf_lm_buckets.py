@@ -1,0 +1,53 @@
+"""Bucketed device-time accounting for the transformer-LM step from the last
+captured xplane trace (run scripts/perf_lm_profile.py first).
+
+Buckets every synchronous "XLA Ops" event by what it touches — the vocab-side
+CE/logits complex (any op reading/writing a [.., 32000] operand), attention
+custom-calls, matmul fusions, adam/updater ops, layernorm/elementwise — and
+prints us/step per bucket so BASELINE.md can carry the table."""
+import collections
+import glob
+import re
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+STEPS = 5
+f = sorted(glob.glob('/tmp/jaxprof/**/*.xplane.pb', recursive=True))[-1]
+xs = xplane_pb2.XSpace()
+xs.ParseFromString(open(f, 'rb').read())
+
+for plane in xs.planes:
+    if 'TPU' not in plane.name:
+        continue
+    evmeta = plane.event_metadata
+    buckets = collections.Counter()
+    names = collections.defaultdict(collections.Counter)
+    total = 0.0
+    for line in plane.lines:
+        if line.name != 'XLA Ops':
+            continue
+        for ev in line.events:
+            name = evmeta[ev.metadata_id].name
+            us = ev.duration_ps / 1e6
+            total += us
+            if '32000' in name:
+                b = 'vocab/CE complex'
+            elif 'custom-call' in name:
+                b = 'custom-call (attention kernel / host)'
+            elif re.search(r'%(convolution|dot|fusion.*dot)', name) or \
+                    name.startswith('%dot'):
+                b = 'matmul'
+            elif 'copy' in name:
+                b = 'copies'
+            elif 'divide_subtract' in name or 'subtract_multiply' in name:
+                b = 'updater'
+            else:
+                b = 'other fusions/elementwise'
+            buckets[b] += us
+            names[b][re.sub(r'[.\d]+$', '', name.split(' = ')[0])] += us
+    print(f'total sync device time: {total/STEPS/1000:.1f} ms/step')
+    for b, us in buckets.most_common():
+        print(f'  {b:42s} {us/STEPS/1000:8.2f} ms/step')
+        for n, nus in names[b].most_common(6):
+            print(f'      {n:50s} {nus/STEPS/1000:8.2f}')

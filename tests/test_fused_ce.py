@@ -1,0 +1,281 @@
+"""Fused sparse-label softmax cross-entropy (kernels/fused_ce.py): the
+integer-label fast path of the graph train step must score and train exactly
+like the one-hot materialized path (the CuDNN-helper-vs-builtin equivalence
+pattern, SURVEY.md §4), with gradients pinned by finite differences."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.kernels import fused_ce
+from deeplearning4j_tpu.kernels.fused_ce import (fused_sparse_ce_score,
+                                                 sparse_softmax_ce_sum)
+from deeplearning4j_tpu.models import (lm_batch, lm_batch_sparse,
+                                       transformer_lm_conf)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.ops.losses import compute_loss
+
+
+def _one_hot(ids, V):
+    y = np.zeros(ids.shape + (V,), np.float32)
+    np.put_along_axis(y, ids[..., None], 1.0, axis=-1)
+    return y
+
+
+class TestFusedOpEquivalence:
+    def _setup(self, N=3, T=5, D=8, V=13, seed=0):
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.normal(size=(N, T, D)), jnp.float32)
+        W = jnp.asarray(rng.normal(size=(D, V)) * 0.3, jnp.float32)
+        b = jnp.asarray(rng.normal(size=(V,)) * 0.1, jnp.float32)
+        ids = rng.integers(0, V, (N, T))
+        return x, W, b, ids
+
+    def test_score_matches_materialized(self):
+        x, W, b, ids = self._setup()
+        y1 = jnp.asarray(_one_hot(ids, W.shape[1]))
+        ref = compute_loss("mcxent", y1, x @ W + b, "softmax", None, True)
+        got = fused_sparse_ce_score({"W": W, "b": b}, x,
+                                    jnp.asarray(ids, jnp.int32), None, True)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+
+    def test_masked_score_matches(self):
+        x, W, b, ids = self._setup()
+        mask = np.ones(ids.shape, np.float32)
+        mask[1, 3:] = 0.0
+        mask[2, 1:] = 0.0
+        y1 = jnp.asarray(_one_hot(ids, W.shape[1]))
+        ref = compute_loss("mcxent", y1, x @ W + b, "softmax",
+                           jnp.asarray(mask), True)
+        got = fused_sparse_ce_score({"W": W, "b": b}, x,
+                                    jnp.asarray(ids, jnp.int32),
+                                    jnp.asarray(mask), True)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+
+    def test_grads_match_autodiff_of_materialized(self):
+        x, W, b, ids = self._setup()
+        y1 = jnp.asarray(_one_hot(ids, W.shape[1]))
+        ids_j = jnp.asarray(ids, jnp.int32)
+
+        def f_ref(x, W, b):
+            return compute_loss("mcxent", y1, x @ W + b, "softmax", None,
+                                True)
+
+        def f_fused(x, W, b):
+            return fused_sparse_ce_score({"W": W, "b": b}, x, ids_j, None,
+                                         True)
+
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(x, W, b)
+        g_f = jax.grad(f_fused, argnums=(0, 1, 2))(x, W, b)
+        for a, bb in zip(g_ref, g_f):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=2e-4, atol=1e-6)
+
+    def test_grads_finite_difference(self):
+        """Central-difference oracle on the summed fused loss (f64 under the
+        test conftest) — the GradientCheckUtil pattern."""
+        rng = np.random.default_rng(3)
+        R, D, V = 6, 5, 9
+        x = jnp.asarray(rng.normal(size=(R, D)))
+        W = jnp.asarray(rng.normal(size=(D, V)) * 0.4)
+        b = jnp.asarray(rng.normal(size=(V,)) * 0.1)
+        ids = jnp.asarray(rng.integers(0, V, (R,)), jnp.int32)
+        w = jnp.asarray(rng.uniform(0.3, 1.0, (R,)))
+
+        def f(W):
+            return sparse_softmax_ce_sum(x, W, b, ids, w, False)
+
+        g = np.asarray(jax.grad(f)(W))
+        eps = 1e-5
+        Wn = np.asarray(W)
+        for i, j in [(0, 0), (2, 5), (4, 8), (1, 3)]:
+            Wp, Wm = Wn.copy(), Wn.copy()
+            Wp[i, j] += eps
+            Wm[i, j] -= eps
+            num = (float(f(jnp.asarray(Wp))) - float(f(jnp.asarray(Wm)))) \
+                / (2 * eps)
+            rel = abs(num - g[i, j]) / max(abs(num) + abs(g[i, j]), 1e-8)
+            assert rel < 1e-5, (i, j, num, g[i, j])
+
+    def test_chunked_matches_unchunked(self, monkeypatch):
+        monkeypatch.setattr(fused_ce, "CHUNK_ROWS", 4)
+        x, W, b, ids = self._setup(N=3, T=5)
+        ids_j = jnp.asarray(ids, jnp.int32)
+
+        def f(x, W, b, chunked):
+            x2 = x.reshape(-1, x.shape[-1])
+            w = jnp.ones((x2.shape[0],), jnp.float32)
+            return sparse_softmax_ce_sum(x2, W, b, ids_j.reshape(-1), w,
+                                         chunked)
+
+        v0, g0 = jax.value_and_grad(f, argnums=(0, 1, 2))(x, W, b, False)
+        v1, g1 = jax.value_and_grad(f, argnums=(0, 1, 2))(x, W, b, True)
+        np.testing.assert_allclose(float(v0), float(v1), rtol=1e-6)
+        for a, bb in zip(g0, g1):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=1e-5, atol=1e-7)
+
+
+class TestGraphIntegration:
+    def _nets_and_data(self, V=23, B=3, T=6):
+        conf = transformer_lm_conf(vocab_size=V, d_model=8, num_heads=2,
+                                   num_layers=1, max_length=T)
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, V, (B, T + 1))
+        x, y1 = lm_batch(toks, V)
+        xs, y2 = lm_batch_sparse(toks)
+        return conf, (x, y1), (xs, y2)
+
+    def test_sparse_labels_trigger_fused_path(self):
+        conf, _, (xs, y2) = self._nets_and_data()
+        net = ComputationGraph(conf).init()
+        fused = net._fused_ce_outputs({"out": jnp.asarray(y2)})
+        assert fused == {"out"}
+        # one-hot float labels never take the fused path
+        assert net._fused_ce_outputs(
+            {"out": jnp.zeros((3, 6, 23), jnp.float32)}) == set()
+
+    def test_score_and_training_parity(self):
+        conf, (x, y1), (xs, y2) = self._nets_and_data()
+        net1 = ComputationGraph(conf).init()
+        net2 = ComputationGraph(conf).init()
+        ds1, ds2 = DataSet(x, y1), DataSet(xs, y2)
+        for _ in range(3):
+            net1.fit_batch(ds1)
+            net2.fit_batch(ds2)
+        s1, s2 = float(net1.score_value), float(net2.score_value)
+        # identical math, different op/summation order: scores track each
+        # other through training (adam amplifies f32 reorder noise in the
+        # params themselves, so score — not bitwise params — is the contract)
+        assert abs(s1 - s2) < 5e-3 * max(1.0, abs(s1)), (s1, s2)
+
+    def test_masked_training_parity(self):
+        conf, (x, y1), (xs, y2) = self._nets_and_data()
+        mask = np.ones(y2.shape, np.float32)
+        mask[1, 3:] = 0.0
+        net1 = ComputationGraph(conf).init()
+        net2 = ComputationGraph(conf).init()
+        ds1 = DataSet(x, y1, labels_mask=mask)
+        ds2 = DataSet(xs, y2, labels_mask=mask)
+        for _ in range(2):
+            net1.fit_batch(ds1)
+            net2.fit_batch(ds2)
+        s1, s2 = float(net1.score_value), float(net2.score_value)
+        assert abs(s1 - s2) < 5e-3 * max(1.0, abs(s1)), (s1, s2)
+
+    def test_fused_path_trains_to_memorize(self):
+        """End-to-end sanity: the fused path actually learns (loss drops
+        substantially on a tiny memorization task)."""
+        V, B, T = 17, 4, 6
+        conf = transformer_lm_conf(vocab_size=V, d_model=16, num_heads=2,
+                                   num_layers=1, max_length=T,
+                                   learning_rate=3e-3)
+        net = ComputationGraph(conf).init()
+        toks = np.tile(np.arange(T + 1)[None, :], (B, 1)) % V
+        xs, y2 = lm_batch_sparse(toks)
+        ds = DataSet(xs, y2)
+        net.fit_batch(ds)
+        first = float(net.score_value)
+        for _ in range(60):
+            net.fit_batch(ds)
+        last = float(net.score_value)
+        assert last < 0.5 * first, (first, last)
+
+    def test_non_terminal_output_keeps_materialized_path(self):
+        """An output whose activation feeds another vertex must not fuse."""
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex
+        g = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+             .updater("sgd").graph_builder().add_inputs("in"))
+        g.add_layer("mid", DenseLayer(n_in=4, n_out=4), "in")
+        g.add_layer("o1", OutputLayer(n_in=4, n_out=4,
+                                      loss="mcxent", activation="softmax"),
+                    "mid")
+        g.add_vertex("sum", ElementWiseVertex(op="add"), "mid", "o1")
+        g.add_layer("o2", OutputLayer(n_in=4, n_out=3, loss="mse",
+                                      activation="identity"), "sum")
+        g.set_outputs("o1", "o2")
+        net = ComputationGraph(g.build()).init()
+        labels = {"o1": jnp.asarray(np.array([1, 2], np.int32)),
+                  "o2": jnp.zeros((2, 3), jnp.float32)}
+        assert net._fused_ce_outputs(labels) == set()
+
+    def test_tbptt_slices_sparse_labels(self):
+        """TBPTT must window [N, T] integer labels alongside the inputs
+        (review finding: min_ndim=3 slicing passed them whole)."""
+        V, B, T = 11, 2, 6
+        conf = transformer_lm_conf(vocab_size=V, d_model=8, num_heads=2,
+                                   num_layers=1, max_length=T)
+        conf.backprop_type = "truncated_bptt"
+        conf.tbptt_fwd_length = 3
+        conf.tbptt_back_length = 3
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        xs, y2 = lm_batch_sparse(rng.integers(0, V, (B, T + 1)))
+        net.fit_batch(DataSet(xs, y2))          # crashed before the fix
+        assert np.isfinite(float(net.score_value))
+
+    def test_per_example_mask_broadcasts(self):
+        """[N] per-example label mask on a sequence output: weighted like
+        the materialized path (broadcast over T, N*T denominator)."""
+        conf, (x, y1), (xs, y2) = self._nets_and_data()
+        pmask = np.array([1.0, 0.0, 1.0], np.float32)
+        net1 = ComputationGraph(conf).init()
+        net2 = ComputationGraph(conf).init()
+        net1.fit_batch(DataSet(x, y1, labels_mask=pmask))
+        net2.fit_batch(DataSet(xs, y2, labels_mask=pmask))
+        np.testing.assert_allclose(float(net1.score_value),
+                                   float(net2.score_value), rtol=1e-5)
+
+    def test_ineligible_sparse_labels_raise_informatively(self):
+        """Integer mcxent labels on a non-terminal softmax head: explicit
+        error, not an obscure broadcast failure inside the jitted step."""
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex
+        g = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+             .updater("sgd").graph_builder().add_inputs("in"))
+        g.add_layer("mid", DenseLayer(n_in=4, n_out=4), "in")
+        g.add_layer("o1", OutputLayer(n_in=4, n_out=4, loss="mcxent",
+                                      activation="softmax"), "mid")
+        g.add_vertex("sum", ElementWiseVertex(op="add"), "mid", "o1")
+        g.add_layer("o2", OutputLayer(n_in=4, n_out=3, loss="mse",
+                                      activation="identity"), "sum")
+        g.set_outputs("o1", "o2")
+        net = ComputationGraph(g.build()).init()
+        from deeplearning4j_tpu.ops.dataset import MultiDataSet
+        X = np.zeros((2, 4), np.float32)
+        with pytest.raises(Exception, match="fused-CE eligible"):
+            net.fit_batch(MultiDataSet(
+                [X], [np.array([1, 2], np.int32),
+                      np.zeros((2, 3), np.float32)]))
+
+    def test_2d_sparse_labels_classifier(self):
+        """[N] integer labels on a plain softmax classifier also fuse, and
+        match the one-hot score."""
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        V = 5
+        g = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+             .updater("sgd").graph_builder().add_inputs("in"))
+        g.add_layer("h", DenseLayer(n_in=6, n_out=8), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=V, loss="mcxent",
+                                       activation="softmax"), "h")
+        g.set_outputs("out")
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(7, 6)).astype(np.float32)
+        ids = rng.integers(0, V, (7,))
+        net1 = ComputationGraph(g.build()).init()
+        net2 = ComputationGraph(g.build()).init()
+        assert net2._fused_ce_outputs(
+            {"out": jnp.asarray(ids, jnp.int32)}) == {"out"}
+        net1.fit_batch(DataSet(X, _one_hot(ids, V)))
+        net2.fit_batch(DataSet(X, ids.astype(np.int32)))
+        np.testing.assert_allclose(float(net1.score_value),
+                                   float(net2.score_value), rtol=1e-5)
