@@ -69,6 +69,27 @@ class Evaluation:
             return
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        if np.issubdtype(labels.dtype, np.integer) and \
+                labels.ndim == predictions.ndim - 1:
+            # sparse class-id labels ([N] or [N, T]) — the fused-CE label
+            # format (kernels/fused_ce.py); ids are the actuals directly
+            c = predictions.shape[-1]
+            actual = labels.reshape(-1)
+            predictions = predictions.reshape(-1, c)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                actual = actual[keep]
+                predictions = predictions[keep]
+            self._ensure(c)
+            pred = np.argmax(predictions, axis=-1)
+            np.add.at(self.confusion, (actual, pred), 1)
+            self.total += len(actual)
+            if self.top_n > 1:
+                topk = np.argsort(-predictions, axis=-1)[:, :self.top_n]
+                self.top_n_correct += int(np.sum(topk == actual[:, None]))
+            else:
+                self.top_n_correct += int(np.sum(actual == pred))
+            return
         if labels.ndim == 3:
             n, t, c = labels.shape
             labels = labels.reshape(n * t, c)

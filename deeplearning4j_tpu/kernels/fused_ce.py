@@ -177,9 +177,12 @@ def fused_sparse_ce_score(layer_params, x, ids, mask: Optional[jnp.ndarray],
     per_example_seq_mask = False
     if mask is not None:
         m = mask.astype(acc)
-        if seq and m.size == x.shape[0]:
-            # per-example mask on a sequence output: broadcast across T,
-            # exactly like losses._apply_mask's trailing-dim broadcast
+        # compute_loss's 3D rule verbatim: a mask is per-CELL iff
+        # ndim >= 2 and shape[:2] == (N, T) — so [N, 1] at T==1 counts
+        # present cells, while [N] / [N, 1] at T > 1 is per-example
+        # (broadcast across T, N*T denominator)
+        if seq and not (m.ndim >= 2 and
+                        m.shape[:2] == (x.shape[0], x.shape[1])):
             m = jnp.broadcast_to(m.reshape(x.shape[0], 1),
                                  (x.shape[0], x.shape[1]))
             per_example_seq_mask = True

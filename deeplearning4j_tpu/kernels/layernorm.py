@@ -1,0 +1,74 @@
+"""Fused last-axis LayerNorm with an analytic custom VJP.
+
+The flagship LM's 25 LayerNorms contribute ~7.4 ms/step of backward
+fusions at T=512/B=32 (BASELINE.md r4 accounting) — ~6x the bandwidth
+floor, because autodiff's backward saves and re-reads f32 intermediates of
+the [N, T, C] activation. This VJP stores only (x, mean, rstd) — the two
+statistics are [N, T] scalars-per-token — and rebuilds x_hat inside the
+backward fusion, so the whole dx/dgamma/dbeta computation is two passes
+over compute-dtype data (one for the row reductions XLA fuses together,
+one for dx).
+
+Same statistics discipline as the layer it accelerates
+(nn/conf/layers/attention.py LayerNormalization): accumulate at >= f32,
+f64 kept for the finite-difference oracle. Reference seam analog:
+BatchNormalizationHelper (CudnnBatchNormalizationHelper.java:29) — an
+accelerated implementation behind the layer's exact math, equivalence- and
+gradient-tested against the built-in path (kernels/batchnorm.py is the
+template; tests/test_transformer.py::test_layernorm_gradients the oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _sd(dtype):
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layernorm(x, gamma, beta, eps: float = 1e-5):
+    """y = (x - mean) / sqrt(var + eps) * gamma + beta over the LAST axis.
+    x: [..., C]; gamma/beta: [C]. Output at x.dtype."""
+    y, _, _ = _ln_forward(x, gamma, beta, eps)
+    return y
+
+
+def _ln_forward(x, gamma, beta, eps):
+    sd = _sd(x.dtype)
+    xf = x.astype(sd)
+    mean = jnp.mean(xf, axis=-1)
+    var = jnp.mean(jnp.square(xf - mean[..., None]), axis=-1)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (xf - mean[..., None]) * rstd[..., None]
+    y = y * gamma.astype(sd) + beta.astype(sd)
+    return y.astype(x.dtype), mean, rstd
+
+
+def _ln_fwd(x, gamma, beta, eps):
+    y, mean, rstd = _ln_forward(x, gamma, beta, eps)
+    return y, (x, gamma, mean, rstd)
+
+
+def _ln_bwd(eps, res, dy):
+    x, gamma, mean, rstd = res
+    sd = _sd(x.dtype)
+    dyf = dy.astype(sd)
+    xhat = (x.astype(sd) - mean[..., None]) * rstd[..., None]
+    # param grads: reductions over every non-channel axis
+    axes = tuple(range(x.ndim - 1))
+    dgamma = jnp.sum(dyf * xhat, axis=axes).astype(gamma.dtype)
+    dbeta = jnp.sum(dyf, axis=axes).astype(gamma.dtype)
+    # dx = rstd * (t - mean(t) - xhat * mean(t * xhat)),  t = dy * gamma
+    t = dyf * gamma.astype(sd)
+    mt = jnp.mean(t, axis=-1)
+    mtx = jnp.mean(t * xhat, axis=-1)
+    dx = rstd[..., None] * (t - mt[..., None] - xhat * mtx[..., None])
+    return dx.astype(x.dtype), dgamma, dbeta
+
+
+layernorm.defvjp(_ln_fwd, _ln_bwd)

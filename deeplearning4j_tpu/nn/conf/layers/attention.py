@@ -104,14 +104,14 @@ class LayerNormalization(BaseRecurrentLayerConf):
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         # statistics at >= f32 (bf16 upcast; f64 stays f64 for the
-        # finite-difference gradient oracle)
-        sd = jnp.promote_types(x.dtype, jnp.float32)
-        xf = x.astype(sd)
-        mean = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
-        y = (xf - mean) / jnp.sqrt(var + self.eps)
-        y = y * params["gamma"].astype(sd) + params["beta"].astype(sd)
-        return y.astype(x.dtype), state
+        # finite-difference gradient oracle). The analytic custom VJP
+        # (kernels/layernorm.py) stores only per-token (mean, rstd) and
+        # rebuilds x_hat in backward — autodiff of the naive form re-reads
+        # f32 [N, T, C] intermediates and ran ~6x the bandwidth floor
+        # (BASELINE.md r4).
+        from ....kernels.layernorm import layernorm
+        return layernorm(x, params["gamma"], params["beta"],
+                         float(self.eps)), state
 
 
 @register_config

@@ -280,6 +280,13 @@ class ComputationGraph:
             if y is None or not jnp.issubdtype(jnp.asarray(y).dtype,
                                                jnp.integer):
                 continue
+            # shape gate: sparse ids are [N, T] for rnn heads, [N] for ff
+            # heads. Integer-dtype ONE-HOT labels ([N, V] / [N, T, V]) keep
+            # the materialized path (compute_loss promotes them) — dtype
+            # alone must not reroute previously-working inputs.
+            expected = 2 if layer.input_kind() == "rnn" else 1
+            if jnp.ndim(y) != expected:
+                continue
             if any(out_name in ins
                    for n, ins in self.conf.vertex_inputs.items()):
                 continue                         # someone consumes this act
@@ -312,7 +319,9 @@ class ComputationGraph:
                                                       lmask)
                 continue
             if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer) and \
-                    str(getattr(v.layer, "loss", "")).lower() in (
+                    jnp.ndim(y) == (2 if hasattr(v.layer, "input_kind") and
+                                    v.layer.input_kind() == "rnn" else 1) \
+                    and str(getattr(v.layer, "loss", "")).lower() in (
                         "mcxent", "negativeloglikelihood",
                         "categorical_crossentropy"):
                 raise ValueError(

@@ -255,6 +255,59 @@ class TestGraphIntegration:
                 [X], [np.array([1, 2], np.int32),
                       np.zeros((2, 3), np.float32)]))
 
+    def test_evaluation_accepts_sparse_labels(self):
+        """evaluate() on a net trained with integer labels: Evaluation.eval
+        must treat [N, T] ids as actuals, not argmax over them (review
+        finding)."""
+        from deeplearning4j_tpu.eval import Evaluation
+        conf, _, (xs, y2) = self._nets_and_data()
+        net = ComputationGraph(conf).init()
+        ds = DataSet(xs, y2)
+        net.fit_batch(ds)
+        ev = Evaluation()
+        probs = net.output(xs)[0]
+        ev.eval(y2, probs)
+        V = probs.shape[-1]
+        assert ev.total == y2.size
+        assert 0.0 <= ev.accuracy() <= 1.0
+        assert ev.num_classes == V
+        # 2D classifier form with a mask
+        ev2 = Evaluation()
+        p2 = np.asarray(np.random.default_rng(0).dirichlet(np.ones(4), 5),
+                        np.float32)
+        ids = np.array([0, 1, 2, 3, 1], np.int32)
+        ev2.eval(ids, p2, mask=np.array([1, 1, 1, 0, 1], np.float32))
+        assert ev2.total == 4
+
+    def test_integer_one_hot_keeps_materialized_path(self):
+        """Integer-dtype ONE-HOT labels trained fine before the fused path
+        existed; dtype alone must not reroute them (review finding)."""
+        conf, (x, y1), _ = self._nets_and_data()
+        net = ComputationGraph(conf).init()
+        y_int = jnp.asarray(y1, jnp.int32)        # [N, T, V] one-hot ints
+        assert net._fused_ce_outputs({"out": y_int}) == set()
+        net.fit_batch(DataSet(x, np.asarray(y1, np.int32)))
+        assert np.isfinite(float(net.score_value))
+
+    def test_n1_mask_at_t1_counts_cells(self):
+        """[N, 1] mask on a T==1 sequence output is a per-CELL mask in
+        compute_loss (shape[:2] == (N, T)); the fused path must use the
+        same denominator (review finding)."""
+        V, B = 7, 3
+        conf = transformer_lm_conf(vocab_size=V, d_model=8, num_heads=2,
+                                   num_layers=1, max_length=1)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, V, (B, 2))
+        x, y1 = lm_batch(toks, V)
+        xs, y2 = lm_batch_sparse(toks)
+        mask = np.array([[1.0], [0.0], [1.0]], np.float32)
+        net1 = ComputationGraph(conf).init()
+        net2 = ComputationGraph(conf).init()
+        net1.fit_batch(DataSet(x, y1, labels_mask=mask))
+        net2.fit_batch(DataSet(xs, y2, labels_mask=mask))
+        np.testing.assert_allclose(float(net1.score_value),
+                                   float(net2.score_value), rtol=1e-5)
+
     def test_2d_sparse_labels_classifier(self):
         """[N] integer labels on a plain softmax classifier also fuse, and
         match the one-hot score."""
