@@ -77,7 +77,12 @@ class Evaluation:
             actual = labels.reshape(-1)
             predictions = predictions.reshape(-1, c)
             if mask is not None:
-                keep = np.asarray(mask).reshape(-1) > 0
+                m = np.asarray(mask)
+                if labels.ndim == 2 and m.size == labels.shape[0]:
+                    # per-example mask over [N, T] ids: broadcast across T,
+                    # same rule as the fused-CE training path
+                    m = np.broadcast_to(m.reshape(-1, 1), labels.shape)
+                keep = m.reshape(-1) > 0
                 actual = actual[keep]
                 predictions = predictions[keep]
             self._ensure(c)

@@ -25,6 +25,20 @@ from .skipgram import (skipgram_hs_step, skipgram_ns_step,
                        vectorized_skipgram_pairs, vectorized_cbow_windows)
 from .vocab import VocabCache, VocabConstructor
 
+import functools
+
+
+@jax.jit
+def _stage_corpus(corpus_wire):
+    """Device-side corpus staging for the scan path: upcast the (int16/
+    int32) pre-padded wire corpus and compute the separator prefix-sum —
+    one dispatch. The caller pads ON HOST to the quantized ``pad_len``
+    (a cheap memcpy; wire cost of the -1 tail is ~2 bytes/slot), so this
+    program has ONE shape per (n_steps-bucket, p) — a raw-length-shaped
+    argument would recompile per chunk (~0.65 s each over the tunnel)."""
+    corpus_d = corpus_wire.astype(jnp.int32)
+    return corpus_d, jnp.cumsum((corpus_d < 0).astype(jnp.int32))
+
 
 class InMemoryLookupTable:
     """syn0/syn1/syn1neg arrays (reference
@@ -34,11 +48,18 @@ class InMemoryLookupTable:
                  use_hs: bool = True, negative: int = 0):
         self.vocab = vocab
         self.vector_length = vector_length
-        rng = np.random.default_rng(seed)
         V = len(vocab)
-        self.syn0 = jnp.asarray(
-            (rng.random((V, vector_length)) - 0.5) / vector_length,
-            jnp.float32)
+        rng = np.random.default_rng(seed)
+        # word2vec init distribution (uniform(-0.5, 0.5)/dim). Generated
+        # host-side in f32 and staged with an ASYNC device_put: the old
+        # f64 jnp.asarray form paid a synchronous 2x-sized transfer plus an
+        # on-device convert (~2 s of single-pass fixed cost through a
+        # tunneled TPU); device-side jax.random was measured far worse
+        # (~12 s remote-compile pathology on the axon tunnel, BASELINE.md
+        # r4) — host f32 + overlap wins.
+        self.syn0 = jax.device_put(
+            ((rng.random((V, vector_length), np.float32) - 0.5)
+             / vector_length))
         self.syn1 = jnp.zeros((max(V - 1, 1), vector_length), jnp.float32) \
             if use_hs else None
         self.syn1neg = jnp.zeros((V, vector_length), jnp.float32) \
@@ -110,23 +131,38 @@ class SequenceVectors:
     def _index_chunks(self, sequences: Sequence[List[str]]):
         """Yield the corpus as int32 index streams with ``-1`` sentence
         separators (windows never cross a separator), in whole-sentence
-        chunks of ~CHUNK_TOKENS so arbitrarily large corpora stream."""
-        parts: List[np.ndarray] = []
-        size = 0
-        sep = np.array([-1], np.int32)
-        index_of = self.vocab.index_of
+        chunks of ~CHUNK_TOKENS so arbitrarily large corpora stream.
+
+        One flat dict.get pass over a chained iterator with an interleaved
+        separator sentinel — the per-sentence np.fromiter + double-lookup
+        form cost ~1 s per 2M tokens of pure Python (BASELINE.md r4).
+        Out-of-vocab words are DROPPED (-2 sentinel filtered out), never
+        turned into separators: a trimmed word must not break window
+        adjacency, matching the reference's vocab-filtered iteration."""
+        lookup = {w: vw.index for w, vw in self.vocab.words.items()}
+        # "\x00" is the interleaved separator sentinel (a pathological real
+        # vocab word "\x00" would be treated as a separator)
+        lookup["\x00"] = -1
+        batch: List[List[str]] = []
+        size = raw = 0
         for seq in sequences:
-            idxs = np.fromiter((index_of(w) for w in seq if w in self.vocab),
-                               np.int32)
-            if len(idxs):
-                parts.append(idxs)
-                parts.append(sep)
-                size += len(idxs)
+            batch.append(seq)
+            size += len(seq)        # chunk threshold: tokens, like always —
+            raw += len(seq) + 1     # a +1/sentence drift would move the
+            # boundary and change the scan program's (cached) corpus shape
             if size >= self.CHUNK_TOKENS:
-                yield np.concatenate(parts)
-                parts, size = [], 0
-        if parts:
-            yield np.concatenate(parts)
+                yield self._index_batch(batch, lookup, raw)
+                batch, size, raw = [], 0, 0
+        if batch:
+            yield self._index_batch(batch, lookup, raw)
+
+    @staticmethod
+    def _index_batch(batch, lookup, count) -> np.ndarray:
+        from itertools import chain
+        get = lookup.get
+        it = chain.from_iterable(chain(s, ("\x00",)) for s in batch)
+        arr = np.fromiter((get(w, -2) for w in it), np.int32, count=count)
+        return arr[arr != -2]                     # drop out-of-vocab words
 
     def fit(self, sequences: Sequence[List[str]]):
         """Train over the corpus (reference SequenceVectors.fit).
@@ -205,8 +241,11 @@ class SequenceVectors:
     # scan steps per program dispatch: the (n_steps, p) pair is static, so
     # EVERY corpus length reuses one compilation — the callers loop
     # ``start_step`` in SEG-sized segments (compile ~10 s dominated the
-    # end-to-end time; marginal cost is ~2.5 ms/step)
+    # end-to-end time; marginal cost is ~2.5 ms/step). Large corpora run
+    # SUPER_SEGMENT-step programs first (fewer ~0.2 s tunnel dispatches),
+    # with SEGMENT-step programs for the tail.
     SCAN_SEGMENT = 64
+    SCAN_SUPER_SEGMENT = 512
 
     def _run_skipgram_scan(self, corpus, seen, ntokens, total, nskey):
         """Whole-chunk skip-gram as jitted lax.scan programs: the corpus
@@ -219,7 +258,7 @@ class SequenceVectors:
         so the sqrt-count-normalized update count per epoch is unchanged —
         one giant step would silently under-train small corpora."""
         from ..ops.platform import configure_compilation_cache
-        configure_compilation_cache()
+        configure_compilation_cache(min_compile_secs=0.0)
         lt = self.lookup
         window = self.window
         p = max(32, self.batch_size // (2 * window))
@@ -227,38 +266,57 @@ class SequenceVectors:
         n = len(corpus)
         n_steps = max((n + p - 1) // p, 1)
         n_total = (n_steps + seg - 1) // seg * seg
-        padded = np.full(n_total * p + 2 * window, -1, np.int32)
+        # Stage the corpus at int16 when the vocab allows (ids and the -1
+        # separator fit; halves the bytes) and build the separator
+        # prefix-sum ON DEVICE in ONE jitted call: the padded int32 corpus
+        # plus host-side cumsum shipped ~18 MB through the ~4-8 MB/s
+        # tunnel (~4.5 s of the 2M-token single pass), and separate eager
+        # staging ops cost ~1 s of dispatch/compile-lookup EACH through
+        # the tunnel (both measured, BASELINE.md r4).
+        wire = np.int16 if len(self.vocab) < 2 ** 15 else np.int32
+        pad_len = n_total * p + 2 * window
+        padded = np.full((pad_len,), -1, wire)
         padded[window:window + n] = corpus
-        sep_cum = np.cumsum(padded < 0).astype(np.int32)
-        corpus_d = jnp.asarray(padded)
-        sep_d = jnp.asarray(sep_cum)
+        corpus_d, sep_d = _stage_corpus(jax.device_put(padded))
         frac0 = seen / max(total, 1)
         frac_per_step = (ntokens / max(total, 1)) / n_steps
-        lr0 = jnp.float32(self.learning_rate)
-        lr_min = jnp.float32(self.min_learning_rate)
+        # host numpy scalars: a jnp.float32(x) wrapper is an EAGER device
+        # op (~0.1-1 s of tunnel dispatch each); np scalars ride along
+        # with the jitted call for free
+        lr0 = np.float32(self.learning_rate)
+        lr_min = np.float32(self.min_learning_rate)
         loss_sum = jnp.float32(0.0)
         cnt = jnp.float32(0.0)
         if self.negative > 0 and \
                 getattr(self, "_neg_table_dev", None) is None:
             self._neg_table_dev = jnp.asarray(self._neg_table)
-        for start in range(0, n_total, seg):
-            key = jax.random.fold_in(nskey, start)
+        # Adaptive segmenting: big corpora ride SCAN_SUPER_SEGMENT-step
+        # programs (one compile each, persistently cached) so the number
+        # of tunnel dispatches stays small (~0.2 s each, measured r4);
+        # the remainder runs in SCAN_SEGMENT-step programs. Per-step
+        # update math is identical — a segment boundary only changes
+        # where the host folds the RNG key.
+        sup = self.SCAN_SUPER_SEGMENT
+        start = 0
+        while start < n_total:
+            use = sup if n_total - start >= sup else seg
             if self.negative > 0:
                 lt.syn0, lt.syn1neg, ls, c = skipgram_ns_corpus_scan(
                     lt.syn0, lt.syn1neg, corpus_d, sep_d,
-                    self._neg_table_dev, key, jnp.int32(start), lr0, lr_min,
-                    jnp.float32(frac0), jnp.float32(frac_per_step),
-                    k=self.negative, window=window, n_steps=seg, p=p,
+                    self._neg_table_dev, nskey, np.int32(start), lr0,
+                    lr_min, np.float32(frac0), np.float32(frac_per_step),
+                    k=self.negative, window=window, n_steps=use, p=p,
                     shared_negatives=self.shared_negatives)
             else:
                 lt.syn0, lt.syn1, ls, c = skipgram_hs_corpus_scan(
                     lt.syn0, lt.syn1, corpus_d, sep_d, self._codes,
-                    self._points, self._lengths, key, jnp.int32(start),
-                    lr0, lr_min, jnp.float32(frac0),
-                    jnp.float32(frac_per_step), window=window,
-                    n_steps=seg, p=p)
+                    self._points, self._lengths, nskey, np.int32(start),
+                    lr0, lr_min, np.float32(frac0),
+                    np.float32(frac_per_step), window=window,
+                    n_steps=use, p=p)
             loss_sum = loss_sum + ls
             cnt = cnt + c
+            start += use
         return loss_sum / jnp.maximum(cnt, 1.0)   # device scalar; lazy sync
 
     def _run_skipgram(self, centers, targets, seen, ntokens, total, nskey):

@@ -262,7 +262,11 @@ def skipgram_ns_corpus_scan(syn0, syn1neg, corpus, sep_cum, neg_table, key,
 
     lr decays linearly in scan progress: lr(i) = max(lr0*(1−frac0−
     i*frac_per_step), lr_min) — word2vec's schedule by tokens seen.
+    ``key`` is the per-chunk BASE key; the per-segment fold_in(key,
+    start_step) happens INSIDE the program — an eager fold_in per segment
+    cost ~1 s of tunnel dispatch each (BASELINE.md r4).
     Returns (syn0, syn1neg, loss_sum, pair_count)."""
+    key = jax.random.fold_in(key, start_step)
     dtype = syn0.dtype
     offs = jnp.asarray([d * sgn for d in range(1, window + 1)
                         for sgn in (-1, 1)], jnp.int32)       # [2W]
@@ -313,7 +317,9 @@ def skipgram_hs_corpus_scan(syn0, syn1, corpus, sep_cum, codes_tab,
                             window: int, n_steps: int, p: int):
     """Hierarchical-softmax sibling of :func:`skipgram_ns_corpus_scan`:
     Huffman code/point tables stay device-resident ([V, L]) and are gathered
-    per target inside the scan."""
+    per target inside the scan (per-segment key fold inside the program,
+    like the NS scan)."""
+    key = jax.random.fold_in(key, start_step)
     dtype = syn0.dtype
     L = codes_tab.shape[1]
     offs = jnp.asarray([d * sgn for d in range(1, window + 1)

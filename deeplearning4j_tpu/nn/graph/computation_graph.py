@@ -281,11 +281,15 @@ class ComputationGraph:
                                                jnp.integer):
                 continue
             # shape gate: sparse ids are [N, T] for rnn heads, [N] for ff
-            # heads. Integer-dtype ONE-HOT labels ([N, V] / [N, T, V]) keep
-            # the materialized path (compute_loss promotes them) — dtype
-            # alone must not reroute previously-working inputs.
+            # heads — with an optional trailing singleton ([N, 1] /
+            # [N, T, 1], the classic DL4J column-vector label format).
+            # Integer-dtype ONE-HOT labels ([N, V] / [N, T, V]) keep the
+            # materialized path (compute_loss promotes them) — dtype alone
+            # must not reroute previously-working inputs.
             expected = 2 if layer.input_kind() == "rnn" else 1
-            if jnp.ndim(y) != expected:
+            nd = jnp.ndim(y)
+            if nd != expected and not (nd == expected + 1 and
+                                       jnp.shape(y)[-1] == 1):
                 continue
             if any(out_name in ins
                    for n, ins in self.conf.vertex_inputs.items()):
@@ -318,9 +322,12 @@ class ComputationGraph:
                 score = score + fused_sparse_ce_score(params[out_name], x, y,
                                                       lmask)
                 continue
+            _exp = 2 if hasattr(v.layer, "input_kind") and \
+                v.layer.input_kind() == "rnn" else 1
+            _nd = jnp.ndim(y)
             if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer) and \
-                    jnp.ndim(y) == (2 if hasattr(v.layer, "input_kind") and
-                                    v.layer.input_kind() == "rnn" else 1) \
+                    (_nd == _exp or (_nd == _exp + 1 and
+                                     jnp.shape(y)[-1] == 1)) \
                     and str(getattr(v.layer, "loss", "")).lower() in (
                         "mcxent", "negativeloglikelihood",
                         "categorical_crossentropy"):

@@ -28,17 +28,34 @@ def train_donate_argnums(default=(0, 1, 2)):
 
 
 _CACHE_CONFIGURED = False
+_CACHE_MIN_SECS = 1.0
 
 
-def configure_compilation_cache(path: str = None) -> bool:
+def configure_compilation_cache(path: str = None,
+                                min_compile_secs: float = 1.0) -> bool:
     """Enable JAX's persistent (on-disk) compilation cache once per process.
 
     Through the tunneled device, compiling a corpus-scan program costs ~10 s
     while running it costs ~0.2 s — for short jobs the cache IS the
     throughput. Safe to call repeatedly; opt out with
-    ``DL4J_TPU_COMPILE_CACHE=0``. Returns True when the cache is active."""
-    global _CACHE_CONFIGURED
+    ``DL4J_TPU_COMPILE_CACHE=0``. Returns True when the cache is active.
+
+    ``min_compile_secs``: programs compiling faster than this are NOT
+    persisted (jax default 1.0). Callers whose fixed costs are dominated by
+    sub-second helper-program compiles (the word2vec scan path: 7 x 0.65 s
+    per process, BASELINE.md r4) pass 0.0 — scoped per caller rather than
+    globally, so ordinary users don't accumulate unbounded tiny cache
+    files. Repeated calls may only LOWER the active floor."""
+    global _CACHE_CONFIGURED, _CACHE_MIN_SECS
     if _CACHE_CONFIGURED:
+        if min_compile_secs < _CACHE_MIN_SECS:
+            try:
+                import jax
+                jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                                  float(min_compile_secs))
+                _CACHE_MIN_SECS = float(min_compile_secs)
+            except Exception:              # pragma: no cover - best effort
+                pass
         return True
     if os.environ.get("DL4J_TPU_COMPILE_CACHE", "").lower() in \
             ("0", "false", "no"):
@@ -51,7 +68,9 @@ def configure_compilation_cache(path: str = None) -> bool:
                          "dl4j_tpu_xla"))
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        _CACHE_MIN_SECS = float(min_compile_secs)
         _CACHE_CONFIGURED = True
         return True
     except Exception:                      # pragma: no cover - best effort

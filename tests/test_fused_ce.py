@@ -308,6 +308,31 @@ class TestGraphIntegration:
         np.testing.assert_allclose(float(net1.score_value),
                                    float(net2.score_value), rtol=1e-5)
 
+    def test_column_vector_ids_fuse(self):
+        """[N, 1] integer ids (classic DL4J column-vector labels) must take
+        the fused path, not broadcast through mcxent (review finding)."""
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        V = 5
+        g = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+             .updater("sgd").graph_builder().add_inputs("in"))
+        g.add_layer("h", DenseLayer(n_in=6, n_out=8), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=V, loss="mcxent",
+                                       activation="softmax"), "h")
+        g.set_outputs("out")
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(7, 6)).astype(np.float32)
+        ids = rng.integers(0, V, (7,))
+        net1 = ComputationGraph(g.build()).init()
+        net2 = ComputationGraph(g.build()).init()
+        assert net2._fused_ce_outputs(
+            {"out": jnp.asarray(ids.reshape(-1, 1), jnp.int32)}) == {"out"}
+        net1.fit_batch(DataSet(X, _one_hot(ids, V)))
+        net2.fit_batch(DataSet(X, ids.reshape(-1, 1).astype(np.int32)))
+        np.testing.assert_allclose(float(net1.score_value),
+                                   float(net2.score_value), rtol=1e-5)
+
     def test_2d_sparse_labels_classifier(self):
         """[N] integer labels on a plain softmax classifier also fuse, and
         match the one-hot score."""
