@@ -16,6 +16,7 @@ from .vectorizers import (BagOfWordsVectorizer, TfidfVectorizer,
 from .word2vec_iterator import Word2VecDataSetIterator, WindowDataSetIterator
 from .cjk import JapaneseTokenizerFactory, KoreanTokenizerFactory
 from .lattice import LatticeJapaneseTokenizerFactory
+from .klattice import LatticeKoreanTokenizerFactory
 from .annotators import (Annotation, AnnotatedDocument, SentenceAnnotator,
                          TokenizerAnnotator, PosTagger, StemmerAnnotator,
                          AnnotatorPipeline)
@@ -31,6 +32,7 @@ __all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
            "StaticWord2Vec", "Word2VecDataSetIterator",
            "WindowDataSetIterator", "JapaneseTokenizerFactory",
            "LatticeJapaneseTokenizerFactory",
+           "LatticeKoreanTokenizerFactory",
            "KoreanTokenizerFactory", "Annotation", "AnnotatedDocument",
            "SentenceAnnotator", "TokenizerAnnotator", "PosTagger",
            "StemmerAnnotator", "AnnotatorPipeline", "DistributedWord2Vec"]

@@ -15,8 +15,11 @@ iterators) consumes:
 - Korean: whitespace eojeol segmentation with optional trailing-particle
   (josa) stripping.
 
-A user with a real analyzer can plug it in via the TokenizerFactory
-interface unchanged.
+These are the dictionary-FREE fallbacks; the dictionary/lattice analyzers
+live in nlp/lattice.py (Japanese) and nlp/klattice.py (Korean, over the
+paradigm-generated morpheme dictionary of nlp/kconj.py). A user with an
+external analyzer can plug it in via the TokenizerFactory interface
+unchanged.
 """
 
 from __future__ import annotations
@@ -35,6 +38,9 @@ def _char_class(ch: str) -> str:
         return "katakana"
     if 0x4E00 <= code <= 0x9FFF or 0x3400 <= code <= 0x4DBF:
         return "kanji"
+    if 0xAC00 <= code <= 0xD7A3 or 0x1100 <= code <= 0x11FF or \
+            0x3130 <= code <= 0x318F:
+        return "hangul"
     if ch.isdigit():
         return "digit"
     if ch.isalpha():
@@ -82,6 +88,10 @@ class JapaneseTokenizerFactory(TokenizerFactory):
 _KO_JOSA = ("은", "는", "이", "가", "을", "를", "의", "에", "와", "과",
             "도", "로", "으로", "에서", "부터", "까지", "마저", "조차")
 
+# shared by the heuristic factory here and the lattice factory
+# (nlp/klattice.py) so the two Korean tokenizers strip identically
+KO_STRIP_PUNCT = ".,!?·…\"'()[]~"
+
 
 class KoreanTokenizerFactory(TokenizerFactory):
     """Eojeol (whitespace) segmentation with optional josa stripping."""
@@ -94,7 +104,7 @@ class KoreanTokenizerFactory(TokenizerFactory):
     def create(self, text: str) -> Tokenizer:
         tokens = []
         for eojeol in unicodedata.normalize("NFKC", text).split():
-            word = eojeol.strip(".,!?·…\"'()[]")
+            word = eojeol.strip(KO_STRIP_PUNCT)
             if not word:
                 continue
             if self.strip_josa and len(word) > 1:

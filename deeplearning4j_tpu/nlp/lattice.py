@@ -88,11 +88,20 @@ def _conn(left: str, right: str) -> int:
 
 
 class ViterbiLattice:
-    """Minimal-cost segmentation of one sentence over a morpheme trie."""
+    """Minimal-cost segmentation of one sentence over a morpheme trie.
 
-    def __init__(self, trie: _Trie, max_unk_len: int = 8):
+    ``conn``: POS-pair connection-cost function (defaults to the Japanese
+    matrix; the Korean lattice passes its own). ``unknown_all_lengths``:
+    emit every prefix of the unknown run, not just {1, full} — needed for
+    agglutinative scripts where a trailing particle shares the unknown
+    run's character class (스마트폰을 → unknown(스마트폰) + josa(을))."""
+
+    def __init__(self, trie: _Trie, max_unk_len: int = 8, conn=None,
+                 unknown_all_lengths: bool = False):
         self.trie = trie
         self.max_unk_len = max_unk_len
+        self.conn = conn or _conn
+        self.unknown_all_lengths = unknown_all_lengths
 
     def _unknown_edges(self, text: str, i: int):
         """Unknown-word candidates: prefixes of the same-char-class run
@@ -102,9 +111,12 @@ class ViterbiLattice:
         while end < len(text) and end - i < self.max_unk_len and \
                 _char_class(text[end]) == cls:
             end += 1
-        # emit the full run and single char (the two useful granularities)
-        lens = {1, end - i}
-        for ln in sorted(lens):
+        if self.unknown_all_lengths:
+            lens = range(1, end - i + 1)
+        else:
+            # the full run and single char (the two useful granularities)
+            lens = sorted({1, end - i})
+        for ln in lens:
             yield (text[i:i + ln], "unknown",
                    _UNK_BASE_COST + _UNK_LEN_COST * (ln - 1))
 
@@ -127,7 +139,7 @@ class ViterbiLattice:
             for surface, pos, wcost in cands:
                 j = i + len(surface)
                 for lpos, (lcost, _bp) in states[i].items():
-                    c = lcost + wcost + _conn(lpos, pos)
+                    c = lcost + wcost + self.conn(lpos, pos)
                     cur = states[j].get(pos)
                     if cur is None or c < cur[0]:
                         states[j][pos] = (c, (i, lpos, surface))
