@@ -17,6 +17,10 @@ from .word2vec_iterator import Word2VecDataSetIterator, WindowDataSetIterator
 from .cjk import JapaneseTokenizerFactory, KoreanTokenizerFactory
 from .lattice import LatticeJapaneseTokenizerFactory
 from .klattice import LatticeKoreanTokenizerFactory
+from .treeparser import (Tree, TreeParser, TreeVectorizer,
+                         BinarizeTreeTransformer, CollapseUnaries,
+                         HeadWordFinder)
+from .sentiment import SentimentScorer
 from .annotators import (Annotation, AnnotatedDocument, SentenceAnnotator,
                          TokenizerAnnotator, PosTagger, StemmerAnnotator,
                          AnnotatorPipeline)
@@ -33,6 +37,9 @@ __all__ = ["VocabCache", "VocabConstructor", "VocabWord", "build_huffman",
            "WindowDataSetIterator", "JapaneseTokenizerFactory",
            "LatticeJapaneseTokenizerFactory",
            "LatticeKoreanTokenizerFactory",
+           "Tree", "TreeParser", "TreeVectorizer",
+           "BinarizeTreeTransformer", "CollapseUnaries", "HeadWordFinder",
+           "SentimentScorer",
            "KoreanTokenizerFactory", "Annotation", "AnnotatedDocument",
            "SentenceAnnotator", "TokenizerAnnotator", "PosTagger",
            "StemmerAnnotator", "AnnotatorPipeline", "DistributedWord2Vec"]

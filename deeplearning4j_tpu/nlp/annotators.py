@@ -15,6 +15,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 
+# shared English punctuation strip set (sentence punctuation riding on
+# whitespace tokens), used by treeparser._vector and sentiment scoring
+EN_STRIP_PUNCT = ".,!?;:\"'()[]"
+
+
 @dataclass
 class Annotation:
     """A typed span over the document text (UIMA Annotation analog)."""
@@ -91,12 +96,24 @@ class PosTagger(Annotator):
 
     _DET = {"the", "a", "an", "this", "that", "these", "those"}
     _PRON = {"i", "you", "he", "she", "it", "we", "they"}
+    _BE_VERB = {"is", "are", "was", "were", "be", "been", "being", "am",
+                "has", "have", "had", "do", "does", "did", "go", "goes",
+                "went", "gone", "get", "gets", "got", "make", "makes",
+                "made", "say", "says", "said", "see", "sees", "saw",
+                "take", "takes", "took", "run", "runs", "ran", "sat",
+                "sit", "sits", "came", "come", "comes"}
+    _MODAL = {"can", "could", "will", "would", "shall", "should", "may",
+              "might", "must"}
     _PREP = {"in", "on", "at", "by", "for", "with", "over", "under", "past",
              "to", "of", "from"}
     _CONJ = {"and", "or", "but", "nor", "so", "yet"}
 
     def _tag(self, word: str) -> str:
         w = word.lower()
+        if w in self._BE_VERB:
+            return "VB"
+        if w in self._MODAL:
+            return "MD"
         if w in self._DET:
             return "DT"
         if w in self._PRON:

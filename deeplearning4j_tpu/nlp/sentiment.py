@@ -1,0 +1,168 @@
+"""Sentiment lexicon scorer (reference deeplearning4j-nlp-uima
+corpora/sentiwordnet/SWN3.java:1): SentiWordNet-style per-word polarity
+scores aggregated per sentence with naive negation flipping, classified
+into the seven SWN3 bands.
+
+The reference ships /sentiment/sentiwordnet.txt (the SentiWordNet 3.0
+dump) and rank-weights each word's sense scores (pos - neg, weighted
+1/(sense rank)); vendoring that data is out of scope, so the lexicon
+here is a compact hand-scored inventory of everyday polarity words in
+[-1, 1] with the same aggregation semantics. Any SentiWordNet-format
+file can be loaded instead via :meth:`SentimentScorer.load_swn` — the
+format parser (pos/neg columns, #rank sense terms, 1/rank weighting)
+matches SWN3's reader.
+
+DELIBERATE DIVERGENCE: SWN3.classForScore walks overlapping else-if
+ranges that leave (0.5, 0.75) classified as "weak_positive" and
+(0, 0.25) as "neutral"; the bands here are the monotone ladder the
+method evidently intended. Cited so parity checks know where to look."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional
+
+from .annotators import EN_STRIP_PUNCT, AnnotatorPipeline
+
+NEGATION_WORDS = frozenset({
+    "not", "no", "never", "isn't", "aren't", "wasn't", "weren't",
+    "haven't", "hasn't", "doesn't", "didn't", "don't", "won't", "can't",
+    "couldn't", "wouldn't", "shouldn't", "cannot",
+})
+
+# compact hand-scored polarity lexicon (word -> score in [-1, 1])
+_POSITIVE = {
+    0.9: ["excellent", "outstanding", "superb", "magnificent", "perfect",
+          "wonderful", "amazing", "fantastic", "brilliant", "exceptional"],
+    0.7: ["great", "love", "loved", "beautiful", "delightful", "awesome",
+          "impressive", "terrific", "marvelous", "joy", "joyful",
+          "thrilled", "excited", "exciting", "best"],
+    0.5: ["good", "happy", "nice", "pleasant", "enjoy", "enjoyed",
+          "enjoyable", "like", "liked", "likes", "glad", "pleased",
+          "satisfying", "satisfied", "fun", "friendly", "helpful",
+          "charming", "comfortable", "recommend", "recommended",
+          "fresh", "tasty", "delicious", "clean", "bright", "warm",
+          "smooth", "win", "winner", "success", "successful", "improve",
+          "improved", "better"],
+    0.3: ["fine", "okay", "decent", "fair", "solid", "useful", "easy",
+          "interesting", "calm", "safe", "cheap", "fast", "reliable",
+          "worth", "favorite", "pretty", "cool", "smart", "clever"],
+}
+_NEGATIVE = {
+    0.9: ["horrible", "terrible", "awful", "dreadful", "disgusting",
+          "atrocious", "abysmal", "appalling", "worst", "hate", "hated"],
+    0.7: ["bad", "poor", "disappointing", "disappointed", "ugly",
+          "painful", "miserable", "nasty", "angry", "furious", "rude",
+          "broken", "fail", "failed", "failure", "useless", "dirty",
+          "scary", "frightening", "sad", "cruel", "evil"],
+    0.5: ["slow", "boring", "bored", "annoying", "annoyed", "unpleasant",
+          "uncomfortable", "expensive", "wrong", "problem", "problems",
+          "difficult", "hard", "worse", "weak", "tired", "sick", "hurt",
+          "noisy", "cold", "stale", "mess", "messy", "lose", "loser",
+          "lost", "regret", "complaint", "complain"],
+    0.3: ["mediocre", "plain", "odd", "strange", "unclear", "confusing",
+          "risky", "cheap-looking", "late", "small", "crowded"],
+}
+
+
+def default_lexicon() -> Dict[str, float]:
+    lex: Dict[str, float] = {}
+    for score, words in _POSITIVE.items():
+        for w in words:
+            lex[w] = score
+    for score, words in _NEGATIVE.items():
+        for w in words:
+            lex[w] = -score
+    return lex
+
+
+class SentimentScorer:
+    """SWN3-role scorer: per-sentence sum of token polarities with
+    negation flip, summed over the document; seven-band classification."""
+
+    def __init__(self, lexicon: Optional[Dict[str, float]] = None,
+                 pipeline: Optional[AnnotatorPipeline] = None):
+        self.lexicon = dict(lexicon) if lexicon is not None \
+            else default_lexicon()
+        self.pipeline = pipeline or AnnotatorPipeline()
+
+    # ------------------------------------------------------ SWN loading
+    @classmethod
+    def load_swn(cls, lines: Iterable[str],
+                 pipeline: Optional[AnnotatorPipeline] = None
+                 ) -> "SentimentScorer":
+        """Parse SentiWordNet-3.0-format lines (POS \\t id \\t PosScore
+        \\t NegScore \\t word#rank [word#rank ...]) with SWN3.java's
+        1/rank sense weighting; keys are plain lowercase words (the
+        POS-qualified key of the reference collapses to max-priority)."""
+        senses: Dict[str, List] = defaultdict(list)
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("\t")
+            if len(parts) < 5 or not parts[2] or not parts[3]:
+                continue
+            try:
+                score = float(parts[2]) - float(parts[3])
+            except ValueError:
+                continue            # malformed row: skip, don't abort
+            for term in parts[4].split():
+                if "#" not in term:
+                    continue
+                word, rank = term.rsplit("#", 1)
+                try:
+                    senses[word.lower()].append((int(rank), score))
+                except ValueError:
+                    continue
+        lex: Dict[str, float] = {}
+        for word, ranked in senses.items():
+            num = sum(s / r for r, s in ranked)
+            den = sum(1.0 / r for r, _ in ranked)
+            if den:
+                lex[word] = num / den
+        return cls(lex, pipeline)
+
+    # ---------------------------------------------------------- scoring
+    def score_tokens(self, tokens: List[str]) -> float:
+        """One sentence: polarity sum; flipped when a negation word is
+        present (SWN3.scoreTokens semantics)."""
+        total = 0.0
+        negated = False
+        for tok in tokens:
+            w = tok.lower().strip(EN_STRIP_PUNCT)
+            total += self.lexicon.get(w, 0.0)
+            if w in NEGATION_WORDS:
+                negated = True
+        return -total if negated else total
+
+    def score(self, text: str) -> float:
+        doc = self.pipeline.process(text)
+        sentences = doc.select("sentence")
+        if not sentences:
+            return self.score_tokens(text.split())
+        total = 0.0
+        all_tokens = doc.select("token")    # one scan, not per sentence
+        for sent in sentences:
+            toks = [t.text for t in all_tokens
+                    if t.begin >= sent.begin and t.end <= sent.end]
+            total += self.score_tokens(toks)
+        return total
+
+    def class_for_score(self, score: float) -> str:
+        if score >= 0.75:
+            return "strong_positive"
+        if score >= 0.25:
+            return "positive"
+        if score > 0:
+            return "weak_positive"
+        if score == 0:
+            return "neutral"
+        if score > -0.25:
+            return "weak_negative"
+        if score > -0.75:
+            return "negative"
+        return "strong_negative"
+
+    def classify(self, text: str) -> str:
+        return self.class_for_score(self.score(text))

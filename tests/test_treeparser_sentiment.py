@@ -1,0 +1,191 @@
+"""UIMA corpora depth (VERDICT r3 item #8): constituency tree parser +
+binarize/collapse/head-finder transforms + TreeVectorizer, and the
+SWN3-style sentiment scorer — reference treeparser/TreeParser.java:1,
+BinarizeTreeTransformer.java:1, CollapseUnaries.java:1,
+HeadWordFinder.java:1, TreeVectorizer.java:1, sentiwordnet/SWN3.java:1."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.sentiment import SentimentScorer, default_lexicon
+from deeplearning4j_tpu.nlp.treeparser import (BinarizeTreeTransformer,
+                                               CollapseUnaries,
+                                               HeadWordFinder, Tree,
+                                               TreeParser, TreeVectorizer)
+
+
+class TestTreeParser:
+    def test_parses_simple_sentence(self):
+        trees = TreeParser().get_trees("The quick dog chased a small cat.")
+        assert len(trees) == 1
+        t = trees[0]
+        assert t.label == "S"
+        assert t.tokens() == ["The", "quick", "dog", "chased", "a",
+                              "small", "cat."]
+        labels = [c.label for c in t.children]
+        assert "NP" in labels and "VP" in labels
+        # the VP absorbed its object NP
+        vp = next(c for c in t.children if c.label == "VP")
+        assert any(k.label == "NP" for k in vp.children)
+
+    def test_pp_absorbs_object(self):
+        trees = TreeParser().get_trees("The dog sat on the mat.")
+        t = trees[0]
+        pps = [n for n in t.all_nodes() if n.label == "PP"]
+        assert pps, t.to_bracket()
+        assert any(k.label == "NP" for k in pps[0].children)
+
+    def test_multiple_sentences(self):
+        trees = TreeParser().get_trees("I like it. You hate it.")
+        assert len(trees) == 2
+
+    def test_labels_stamped_on_every_node(self):
+        trees = TreeParser().get_trees_with_labels(
+            "The dog runs.", "positive", ["positive", "negative"])
+        for node in trees[0].all_nodes():
+            assert node.gold_label == "positive"
+
+    def test_unknown_label_rejected(self):
+        with pytest.raises(ValueError):
+            TreeParser().get_trees_with_labels("Hi there.", "bogus",
+                                               ["positive"])
+
+
+class TestTransforms:
+    def _nary(self):
+        kids = [Tree("NN", [Tree(w, value=w)], value=w)
+                for w in ("a", "b", "c", "d")]
+        return Tree("NP", kids, value="a b c d")
+
+    def test_binarize_caps_fanout(self):
+        t = BinarizeTreeTransformer().transform(self._nary())
+        for node in t.all_nodes():
+            assert len(node.children) <= 2
+        # leaves preserved in order
+        assert t.tokens() == ["a", "b", "c", "d"]
+        # intermediate nodes carry the @-factored label
+        assert any(n.label == "@NP" for n in t.all_nodes())
+
+    def test_collapse_unaries(self):
+        chain = Tree("S", [Tree("X", [Tree("NP", [
+            Tree("NN", [Tree("dog", value="dog")], value="dog"),
+            Tree("NN", [Tree("cat", value="cat")], value="cat")])])])
+        out = CollapseUnaries().transform(chain)
+        # S -> X -> NP collapsed; the NN pre-terminals survive
+        assert len(out.children) == 2
+        assert all(c.label == "NN" for c in out.children)
+        assert out.tokens() == ["dog", "cat"]
+
+    def test_head_finding(self):
+        trees = TreeParser().get_trees("The quick dog chased a cat.")
+        t = HeadWordFinder().annotate(trees[0])
+        # the sentence head is the VP's verb
+        assert t.head_word == "chased", t.to_bracket()
+        np_node = next(n for n in t.all_nodes() if n.label == "NP")
+        assert np_node.head_word in ("dog", "cat")
+
+
+class TestTreeVectorizer:
+    def test_vectors_at_leaves_and_binarized(self):
+        lookup = {"dog": np.ones(4), "cat": np.full(4, 2.0)}
+        tv = TreeVectorizer(lookup=lookup)
+        trees = tv.get_trees("The big brown dog chased the cat.")
+        t = trees[0]
+        for node in t.all_nodes():
+            assert len(node.children) <= 2          # binarized
+        leaves = t.yield_leaves()
+        by_word = {l.value.rstrip("."): l.vector for l in leaves}
+        np.testing.assert_allclose(by_word["dog"], np.ones(4))
+        # "cat." keeps its sentence period as a token; the vectorizer
+        # falls back to the stripped form for the embedding lookup
+        np.testing.assert_allclose(by_word["cat"], np.full(4, 2.0))
+        # unknown words get zero vectors of the model dim
+        assert by_word["big"].shape == (4,)
+        assert float(np.abs(by_word["big"]).sum()) == 0.0
+
+    def test_labels_ride_through_transforms(self):
+        tv = TreeVectorizer(lookup={})
+        trees = tv.get_trees_with_labels("I like it.", "pos",
+                                         ["pos", "neg"])
+        assert all(n.gold_label == "pos" for n in trees[0].all_nodes()
+                   if n.gold_label is not None)
+
+    def test_node_features(self):
+        tv = TreeVectorizer(lookup={"dog": np.arange(3.0)})
+        t = tv.get_trees("The dog runs.")[0]
+        feats = tv.node_features(t)
+        assert feats["leaf_vectors"].shape[1] == 3
+        assert feats["spans"].shape[0] == len(t.all_nodes())
+
+    def test_dim_learned_late_still_zero_fills_earlier_trees(self):
+        """Review finding: an all-OOV first sentence must still get zero
+        vectors once a later sentence reveals the model dim."""
+        tv = TreeVectorizer(lookup={"dog": np.ones(4)})
+        trees = tv.get_trees("Cats sleep. The dog runs.")
+        assert len(trees) == 2
+        for t in trees:
+            for leaf in t.yield_leaves():
+                assert leaf.vector is not None
+                assert leaf.vector.shape == (4,)
+
+    def test_word2vec_lookup_integration(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        seqs = [["the", "dog", "runs"], ["the", "cat", "sits"]] * 10
+        w2v = (Word2Vec.Builder().layer_size(8).window_size(2)
+               .negative_sample(2).epochs(1).seed(0).batch_size(32)
+               .min_word_frequency(1).build())
+        w2v.fit(seqs)
+        tv = TreeVectorizer(lookup=w2v)
+        t = tv.get_trees("the dog runs.")[0]
+        dog = next(l for l in t.yield_leaves() if l.value == "dog")
+        assert dog.vector is not None and dog.vector.shape == (8,)
+
+
+class TestSentiment:
+    def test_lexicon_scale_and_polarity(self):
+        lex = default_lexicon()
+        assert len(lex) > 150
+        assert lex["excellent"] > 0.8 and lex["terrible"] < -0.8
+
+    def test_classify_bands(self):
+        s = SentimentScorer()
+        assert s.classify("This movie is excellent and wonderful.") == \
+            "strong_positive"
+        assert s.classify("The food was terrible and the service awful."
+                          ) == "strong_negative"
+        assert s.classify("The chair is beside the table.") == "neutral"
+
+    def test_negation_flips_sentence(self):
+        s = SentimentScorer()
+        pos = s.score("The film was good.")
+        neg = s.score("The film was not good.")
+        assert pos > 0 and neg < 0
+        assert abs(pos) == pytest.approx(abs(neg))
+
+    def test_per_sentence_aggregation(self):
+        s = SentimentScorer()
+        both = s.score("The food was great. The service was awful.")
+        assert abs(both) < abs(s.score("The food was great.")) + \
+            abs(s.score("The service was awful."))
+
+    def test_swn_loader_skips_malformed_rows(self):
+        """Review finding: a non-numeric score column skips the row, it
+        does not abort the whole load."""
+        s = SentimentScorer.load_swn(["a\t1\tN/A\t0\tfoo#1",
+                                      "a\t2\t0.5\t0\tgood#1"])
+        assert "foo" not in s.lexicon
+        assert s.lexicon["good"] == pytest.approx(0.5)
+
+    def test_swn_format_loader(self):
+        lines = [
+            "# comment",
+            "a\t00001\t0.75\t0\tgood#1 goodish#2",
+            "a\t00002\t0\t0.625\tbad#1",
+            "a\t00003\t0.5\t0.25\tgood#2",
+        ]
+        s = SentimentScorer.load_swn(lines)
+        # good: rank1 score .75, rank2 .25 -> (0.75 + 0.125)/(1.5)
+        assert s.lexicon["good"] == pytest.approx((0.75 + 0.25 / 2) / 1.5)
+        assert s.lexicon["bad"] == pytest.approx(-0.625)
+        assert s.lexicon["goodish"] == pytest.approx(0.75)
+        assert s.classify("good") == "positive"
