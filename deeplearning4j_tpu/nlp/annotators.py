@@ -40,6 +40,25 @@ class AnnotatedDocument:
         return [a for a in self.annotations if a.type == type_]
 
 
+def group_tokens_by_sentence(doc: "AnnotatedDocument"):
+    """[(sentence, [tokens covered])] via one two-pointer sweep over the
+    span-sorted annotation lists — the per-sentence select() scan was
+    quadratic over large documents (shared by treeparser and sentiment)."""
+    sentences = sorted(doc.select("sentence"), key=lambda a: a.begin)
+    tokens = sorted(doc.select("token"), key=lambda a: a.begin)
+    out = []
+    i = 0
+    for sent in sentences:
+        while i < len(tokens) and tokens[i].begin < sent.begin:
+            i += 1
+        j = i
+        while j < len(tokens) and tokens[j].end <= sent.end:
+            j += 1
+        out.append((sent, tokens[i:j]))
+        i = j
+    return out
+
+
 class Annotator:
     def process(self, doc: AnnotatedDocument) -> None:
         raise NotImplementedError

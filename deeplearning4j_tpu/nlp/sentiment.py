@@ -137,16 +137,13 @@ class SentimentScorer:
         return -total if negated else total
 
     def score(self, text: str) -> float:
+        from .annotators import group_tokens_by_sentence
         doc = self.pipeline.process(text)
-        sentences = doc.select("sentence")
-        if not sentences:
+        if not doc.select("sentence"):
             return self.score_tokens(text.split())
         total = 0.0
-        all_tokens = doc.select("token")    # one scan, not per sentence
-        for sent in sentences:
-            toks = [t.text for t in all_tokens
-                    if t.begin >= sent.begin and t.end <= sent.end]
-            total += self.score_tokens(toks)
+        for _sent, toks in group_tokens_by_sentence(doc):
+            total += self.score_tokens([t.text for t in toks])
         return total
 
     def class_for_score(self, score: float) -> str:

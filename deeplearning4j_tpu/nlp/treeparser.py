@@ -97,13 +97,9 @@ class TreeParser:
         doc = self.pipeline.process(text)
         pos_by_span = {(a.begin, a.end): a.features.get("tag", "NN")
                        for a in doc.select("pos")}
-        # bucket tokens per sentence in ONE pass (a per-sentence
-        # doc.select scan is quadratic over large documents)
-        all_tokens = doc.select("token")
+        from .annotators import group_tokens_by_sentence
         trees = []
-        for sent in doc.select("sentence"):
-            toks = [t for t in all_tokens
-                    if t.begin >= sent.begin and t.end <= sent.end]
+        for sent, toks in group_tokens_by_sentence(doc):
             if not toks:
                 continue
             leaves = []
@@ -199,8 +195,6 @@ class TreeParser:
                 vp.end = c.end
             else:
                 args_done.append(c)
-        if len(args_done) == 1 and args_done[0].label == "S":
-            return args_done[0]
         return Tree("S", args_done,
                     value=" ".join(c.value for c in args_done),
                     begin=begin, end=end)
@@ -237,7 +231,8 @@ class CollapseUnaries:
     def transform(self, t: Optional[Tree]) -> Optional[Tree]:
         if t is None or t.is_leaf():
             return t
-        while len(t.children) == 1 and not t.is_pre_terminal():
+        while len(t.children) == 1 and not t.is_pre_terminal() and \
+            not t.children[0].is_pre_terminal():
             t.children = t.children[0].children
         t.children = [self.transform(c) for c in t.children]
         return t

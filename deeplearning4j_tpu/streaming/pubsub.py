@@ -120,12 +120,53 @@ class NDArraySubscriber:
         self.broker.unsubscribe(self.topic, self._q)
 
 
+# ------------------------------------------------------- broker drivers
+# The reference swaps transports by Camel route configuration
+# (kafka:... URIs); here a scheme-keyed driver registry plays that role:
+# "memory://" is the in-process broker, and an external broker (Kafka,
+# Redis, ...) drops in by registering a factory for its scheme — every
+# publisher/subscriber/route stays transport-agnostic.
+
+_BROKER_DRIVERS: Dict[str, Callable[..., MessageBroker]] = {}
+
+
+def register_broker_driver(scheme: str,
+                           factory: Callable[..., MessageBroker]) -> None:
+    """Register ``factory(url, capacity) -> broker`` for ``scheme://``
+    URLs. The broker contract is MessageBroker's surface:
+    publish/subscribe/unsubscribe over bytes payloads."""
+    _BROKER_DRIVERS[scheme.lower()] = factory
+
+
+def broker_schemes():
+    return sorted(_BROKER_DRIVERS)
+
+
+def create_broker(url: str = "memory://",
+                  capacity: int = 1024) -> MessageBroker:
+    """Instantiate the broker for a ``scheme://...`` URL."""
+    scheme = url.split("://", 1)[0].lower() if "://" in url else url.lower()
+    if scheme not in _BROKER_DRIVERS:
+        raise ValueError(
+            f"no broker driver for scheme '{scheme}' "
+            f"(registered: {broker_schemes()}); "
+            "register one with register_broker_driver()")
+    return _BROKER_DRIVERS[scheme](url, capacity)
+
+
+register_broker_driver("memory",
+                       lambda url, capacity: MessageBroker(capacity))
+
+
 class NDArrayStreamClient:
     """Paired publisher/subscriber on one broker (NDArrayKafkaClient
-    analog)."""
+    analog). Construct from an explicit broker instance or a driver URL
+    (default: the in-process memory broker)."""
 
-    def __init__(self, broker: Optional[MessageBroker] = None):
-        self.broker = broker or MessageBroker()
+    def __init__(self, broker: Optional[MessageBroker] = None,
+                 url: str = "memory://", capacity: int = 1024):
+        self.broker = broker if broker is not None \
+            else create_broker(url, capacity)
 
     def publisher(self, topic: str) -> NDArrayPublisher:
         return NDArrayPublisher(self.broker, topic)

@@ -1,11 +1,17 @@
 """Model-serving route (reference dl4j-streaming
 routes/DL4jServeRouteBuilder.java: Camel route that consumes NDArrays from a
-topic, runs the model, publishes outputs; SURVEY.md §2.4)."""
+topic, runs the model, publishes outputs; SURVEY.md §2.4).
+
+r4: the consumer micro-batches — messages queued while the previous
+dispatch ran are drained (same-shape runs stacked into ONE forward,
+results split back per message, order preserved), the
+BatchedInferenceObservable idea of parallel/inference.py applied at the
+route level."""
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import List, Optional
 
 import numpy as np
 
@@ -15,28 +21,85 @@ from .pubsub import MessageBroker, NDArrayPublisher, NDArraySubscriber
 class ModelServingRoute:
     """Consume feature arrays from ``input_topic``, publish ``net.output``
     results to ``output_topic`` — the serve-route the reference builds with
-    Camel. ``start()`` spins the consumer thread; ``stop()`` drains it."""
+    Camel. ``start()`` spins the consumer thread; ``stop()`` drains it.
+    ``max_batch``: cap on how many queued messages coalesce into one
+    forward pass."""
 
     def __init__(self, net, broker: MessageBroker,
                  input_topic: str = "dl4j-input",
-                 output_topic: str = "dl4j-output"):
+                 output_topic: str = "dl4j-output",
+                 max_batch: int = 32):
         self.net = net
         self.broker = broker
         self.sub = NDArraySubscriber(broker, input_topic)
         self.pub = NDArrayPublisher(broker, output_topic)
+        self.max_batch = max(1, int(max_batch))
         self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
         self.served = 0
+        self.batches = 0
+        self.errors = 0
 
-    def _serve_one(self, arr: np.ndarray) -> None:
-        out = np.asarray(self.net.output(arr.astype(np.float32)))
-        self.pub.publish(out)
-        self.served += 1
+    def _drain(self, first: np.ndarray) -> List[np.ndarray]:
+        arrs = [first]
+        while len(arrs) < self.max_batch:
+            nxt = self.sub.poll()            # non-blocking public surface
+            if nxt is None:
+                break
+            arrs.append(nxt)
+        return arrs
+
+    def _serve_batch(self, arrs: List[np.ndarray]) -> None:
+        # coalesce maximal same-shape BATCHED (ndim>=2) runs so order is
+        # preserved; vectors/scalars serve singly like the r3 route did
+        i = 0
+        while i < len(arrs):
+            j = i + 1
+            while j < len(arrs) and arrs[i].ndim >= 2 and \
+                    arrs[j].shape == arrs[i].shape:
+                j += 1
+            run = arrs[i:j]
+            try:
+                # count BEFORE publishing: a consumer that sees the
+                # output must also see the counters (observable-order
+                # contract the tests rely on)
+                if len(run) == 1 or run[0].ndim < 2:
+                    for a in run:
+                        out = np.asarray(
+                            self.net.output(a.astype(np.float32)))
+                        self.served += 1
+                        self.batches += 1
+                        self.pub.publish(out)
+                else:
+                    stacked = np.concatenate(
+                        [a.astype(np.float32) for a in run], axis=0)
+                    out = np.asarray(self.net.output(stacked))
+                    splits = np.cumsum([a.shape[0] for a in run])[:-1]
+                    pieces = np.split(out, splits, axis=0)
+                    self.served += len(pieces)
+                    self.batches += 1
+                    for piece in pieces:
+                        self.pub.publish(piece)
+            except Exception:
+                # a bad payload must not kill the route; skip the run
+                # (Camel's route error handling role)
+                self.errors += 1
+            i = j
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            first = self.sub.poll(timeout=0.1)
+            if first is None:
+                continue
+            self._serve_batch(self._drain(first))
 
     def start(self) -> "ModelServingRoute":
-        self._thread = self.sub.listen(self._serve_one)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
         return self
 
     def stop(self) -> None:
-        self.sub.close()
+        self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
+        self.sub.close()

@@ -67,6 +67,7 @@ _NAV = ('<div class=nav><a href="/train">overview</a> '
         '<a href="/train/system.html">system</a> '
         '<a href="/train/flow.html">flow</a> '
         '<a href="/train/activations.html">activations</a> '
+        '<a href="/train/histograms.html">histograms</a> '
         '&nbsp; session: <select id=sesssel></select></div>')
 
 _STYLE = """
@@ -162,6 +163,51 @@ function refresh(){ initSessions(render); }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>"""
 
+
+_HIST_PAGE = """<!DOCTYPE html>
+<html><head><title>Histograms</title>
+<style>""" + _STYLE + """
+.hsvg{height:140px}
+</style></head><body>
+<h1>Parameter histograms</h1>
+""" + _NAV + """
+<div id=hists><div class=card>no histogram records — train with a
+StatsListener(collect_histograms=True)</div></div>
+<script src="/train/sessions.js"></script>
+<script>
+function esc(x){const d=document.createElement('div');
+d.textContent=String(x);return d.innerHTML;}
+function bars(h){
+  const c = h.counts || [], b = h.bins || [];
+  if (!c.length) return '<i>empty</i>';
+  const W = 600, H = 120, max = Math.max(...c, 1), bw = W / c.length;
+  const rects = c.map((v, i) =>
+    `<rect x="${(i*bw).toFixed(1)}" y="${(H - v/max*H).toFixed(1)}"` +
+    ` width="${Math.max(bw-1,1).toFixed(1)}"` +
+    ` height="${(v/max*H).toFixed(1)}" fill="#2b8cbe"/>`).join('');
+  const lo = Number(b[0]).toPrecision(3),
+        hi = Number(b[b.length-1]).toPrecision(3);
+  return `<svg class=hsvg viewBox="0 0 ${W} ${H+16}"` +
+    ` preserveAspectRatio="none">${rects}` +
+    `<text x="2" y="${H+12}" font-size="10">${lo}</text>` +
+    `<text x="${W-60}" y="${H+12}" font-size="10">${hi}</text></svg>`;
+}
+async function render(s){
+  const d = await (await fetch('/train/histograms?session=' + s)).json();
+  const hs = d.param_histograms;
+  if (!hs) return;
+  document.getElementById('hists').innerHTML =
+    `<div class=card><b>iteration ${esc(d.iteration)}</b></div>` +
+    Object.keys(hs).sort().map(k =>
+      `<div class=card><b>${esc(k)}</b>` +
+      (d.param_mean_magnitudes && d.param_mean_magnitudes[k] != null
+        ? ` <span style="color:#777;font-size:12px">mean |w| = ` +
+          `${Number(d.param_mean_magnitudes[k]).toExponential(2)}</span>`
+        : '') + bars(hs[k]) + `</div>`).join('');
+}
+function refresh(){ initSessions(render); }
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
 
 _FLOW_PAGE = """<!DOCTYPE html>
 <html><head><title>Flow</title>
@@ -491,6 +537,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._html(_MODEL_PAGE)
         elif url.path == "/train/system.html":
             self._html(_SYSTEM_PAGE)
+        elif url.path == "/train/histograms.html":
+            self._html(_HIST_PAGE)
         elif url.path == "/train/flow.html":
             self._html(_FLOW_PAGE)
         elif url.path == "/train/activations.html":

@@ -82,6 +82,22 @@ class TestUITabs:
                                       timeout=10).read().decode()
         assert "activations" in html.lower()
 
+    def test_histograms_tab(self, served):
+        """The histogram tab renders the served /train/histograms data
+        (VERDICT r3 item #9: the data endpoint existed since r2 but no
+        page consumed it)."""
+        import urllib.request
+        html = urllib.request.urlopen(served + "/train/histograms.html",
+                                      timeout=10).read().decode()
+        assert "Parameter histograms" in html
+        assert "/train/histograms?session=" in html
+        assert "param_histograms" in html        # the JS consumes the data
+        d = _get(served, "/train/histograms?session=tabs")
+        assert d.get("param_histograms"), d.keys()
+        first = next(iter(d["param_histograms"].values()))
+        assert first["counts"] and len(first["bins"]) == \
+            len(first["counts"]) + 1
+
     def test_activations_no_cross_session_fallback(self, served):
         """An explicitly requested session with no conv records must return
         an empty record, not another run's activations (ADVICE r3)."""
