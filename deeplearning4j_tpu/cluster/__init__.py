@@ -21,6 +21,7 @@ from .ml_pipeline import (Pipeline, PipelineStage, NetworkClassifier,
                           NormalizerStage)
 
 __all__ = [
+    "DL4JClassifier",
     "DistributedDataSet", "TrainingMaster", "TrainingWorker",
     "WorkerConfiguration", "Repartition", "RepartitionStrategy",
     "RDDTrainingApproach", "TrainingHook",
@@ -29,3 +30,12 @@ __all__ = [
     "ClusterTrainingStats", "PhaseTimer", "Pipeline", "PipelineStage",
     "NetworkClassifier", "NormalizerStage",
 ]
+
+
+def __getattr__(name):
+    # lazy: sklearn (and scipy behind it) only load for actual
+    # DL4JClassifier users, not every cluster-package import
+    if name == "DL4JClassifier":
+        from .sklearn_compat import DL4JClassifier
+        return DL4JClassifier
+    raise AttributeError(name)

@@ -59,18 +59,14 @@ class ModelServingRoute:
                     arrs[j].shape == arrs[i].shape:
                 j += 1
             run = arrs[i:j]
-            try:
-                # count BEFORE publishing: a consumer that sees the
-                # output must also see the counters (observable-order
-                # contract the tests rely on)
-                if len(run) == 1 or run[0].ndim < 2:
-                    for a in run:
-                        out = np.asarray(
-                            self.net.output(a.astype(np.float32)))
-                        self.served += 1
-                        self.batches += 1
-                        self.pub.publish(out)
-                else:
+            # count BEFORE publishing: a consumer that sees the output
+            # must also see the counters (observable-order contract)
+            if len(run) == 1:
+                # runs only extend while ndim >= 2, so ndim<2 runs are
+                # provably singletons
+                self._serve_single(run[0])
+            else:
+                try:
                     stacked = np.concatenate(
                         [a.astype(np.float32) for a in run], axis=0)
                     out = np.asarray(self.net.output(stacked))
@@ -80,11 +76,25 @@ class ModelServingRoute:
                     self.batches += 1
                     for piece in pieces:
                         self.pub.publish(piece)
-            except Exception:
-                # a bad payload must not kill the route; skip the run
-                # (Camel's route error handling role)
-                self.errors += 1
+                except Exception:
+                    # the COALESCED forward failed (e.g. the stacked
+                    # batch is too big, or one payload is bad): retry
+                    # each message singly so the blast radius is the
+                    # actual bad input, not the whole run
+                    for a in run:
+                        self._serve_single(a)
             i = j
+
+    def _serve_single(self, a: np.ndarray) -> None:
+        try:
+            out = np.asarray(self.net.output(a.astype(np.float32)))
+            self.served += 1
+            self.batches += 1
+            self.pub.publish(out)
+        except Exception:
+            # a bad payload must not kill the route (Camel's route
+            # error-handling role); counted per message
+            self.errors += 1
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,92 @@
+"""sklearn ecosystem bridge (VERDICT r3 missing #5): DL4JClassifier must
+behave as a first-class scikit-learn estimator — Pipeline composition,
+clone/get_params, GridSearchCV, cross_val_score (the dl4j-spark-ml role
+of plugging nets into an existing pipeline ecosystem)."""
+
+import numpy as np
+import pytest
+
+sklearn = pytest.importorskip("sklearn")
+
+from sklearn.base import clone                              # noqa: E402
+from sklearn.model_selection import GridSearchCV, cross_val_score  # noqa
+from sklearn.pipeline import Pipeline                       # noqa: E402
+from sklearn.preprocessing import StandardScaler            # noqa: E402
+
+from deeplearning4j_tpu.cluster.sklearn_compat import DL4JClassifier  # noqa
+
+
+def _blobs(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    X0 = rng.normal(loc=(-2, 0), scale=0.6, size=(n // 2, 2))
+    X1 = rng.normal(loc=(2, 1), scale=0.6, size=(n - n // 2, 2))
+    X = np.concatenate([X0, X1]).astype(np.float32)
+    y = np.array(["a"] * (n // 2) + ["b"] * (n - n // 2))
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+class TestSklearnCompat:
+    def test_fit_predict_string_labels(self):
+        X, y = _blobs()
+        clf = DL4JClassifier(hidden=8, epochs=8, seed=1).fit(X, y)
+        assert set(clf.classes_) == {"a", "b"}
+        pred = clf.predict(X)
+        assert pred.dtype == y.dtype
+        assert (pred == y).mean() > 0.95
+        proba = clf.predict_proba(X)
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-4)
+
+    def test_pipeline_composition(self):
+        X, y = _blobs(seed=1)
+        pipe = Pipeline([("scale", StandardScaler()),
+                         ("net", DL4JClassifier(hidden=8, epochs=8))])
+        pipe.fit(X, y)
+        assert pipe.score(X, y) > 0.9
+
+    def test_clone_and_params(self):
+        clf = DL4JClassifier(hidden=12, epochs=3, learning_rate=0.05)
+        c = clone(clf)
+        assert c.get_params()["hidden"] == 12
+        assert c.get_params()["learning_rate"] == 0.05
+        c.set_params(hidden=4)
+        assert c.hidden == 4 and clf.hidden == 12
+
+    def test_cross_val_score(self):
+        X, y = _blobs(seed=2)
+        scores = cross_val_score(DL4JClassifier(hidden=8, epochs=6), X, y,
+                                 cv=3)
+        assert scores.mean() > 0.85, scores
+
+    def test_grid_search(self):
+        X, y = _blobs(seed=3)
+        gs = GridSearchCV(DL4JClassifier(epochs=4),
+                          {"hidden": [4, 8]}, cv=2)
+        gs.fit(X, y)
+        assert gs.best_params_["hidden"] in (4, 8)
+        assert gs.best_score_ > 0.8
+
+    def test_custom_conf_builder(self):
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+
+        def builder(n_in, n_classes, est):
+            return (NeuralNetConfiguration.Builder().seed(est.seed)
+                    .learning_rate(est.learning_rate).updater("adam")
+                    .weight_init("xavier").activation("tanh").list()
+                    .layer(DenseLayer(n_in=n_in, n_out=6))
+                    .layer(DenseLayer(n_in=6, n_out=6))
+                    .layer(OutputLayer(n_in=6, n_out=n_classes,
+                                       loss="mcxent", activation="softmax"))
+                    .build())
+
+        X, y = _blobs(seed=4)
+        clf = DL4JClassifier(conf_builder=builder, epochs=8).fit(X, y)
+        assert (clf.predict(X) == y).mean() > 0.9
+        assert len(clf.net_.layers) == 3
+
+    def test_unfitted_raises(self):
+        from sklearn.exceptions import NotFittedError
+        with pytest.raises(NotFittedError, match="not fitted"):
+            DL4JClassifier().predict(np.zeros((2, 2), np.float32))
