@@ -157,6 +157,48 @@ def _ce_bwd(_chunked, res, g):
 sparse_softmax_ce_sum.defvjp(_ce_fwd, _ce_bwd)
 
 
+_MCXENT_LOSSES = ("mcxent", "negativeloglikelihood",
+                  "categorical_crossentropy")
+
+
+def sparse_shaped(layer, y) -> bool:
+    """dtype+shape half of the gate: integer labels whose rank matches
+    what sparse ids would be for this head ([N, T] rnn / [N] ff, optional
+    trailing singleton). Used by the callers' diagnosable-error paths:
+    labels that LOOK sparse but hit an ineligible head must raise, not
+    broadcast garbage through mcxent."""
+    y = jnp.asarray(y)
+    if not jnp.issubdtype(y.dtype, jnp.integer):
+        return False
+    kind = layer.input_kind() if hasattr(layer, "input_kind") else "ff"
+    expected = 2 if kind == "rnn" else 1
+    nd = y.ndim
+    return nd == expected or (nd == expected + 1 and
+                              jnp.shape(y)[-1] == 1)
+
+
+def sparse_labels_eligible(layer, y, layer_params=None) -> bool:
+    """Shared eligibility gate for the fused sparse-CE path (used by both
+    ComputationGraph and MultiLayerNetwork): the head must be a plain
+    softmax+mcxent projection (W/b present, not a center-loss head — the
+    center update consumes one-hot labels), and the labels integer ids of
+    the right rank ([N, T] for rnn heads, [N] for ff, optional trailing
+    singleton). Integer ONE-HOT labels keep the materialized path."""
+    if hasattr(layer, "center_loss_and_update"):
+        return False
+    if str(getattr(layer, "loss", "")).lower() not in _MCXENT_LOSSES:
+        return False
+    if str(getattr(layer, "activation", "")).lower() != "softmax":
+        return False
+    if not hasattr(layer, "preoutput"):
+        return False
+    if layer_params is not None and not (
+            isinstance(layer_params, dict) and "W" in layer_params
+            and "b" in layer_params):
+        return False
+    return sparse_shaped(layer, y)
+
+
 def fused_sparse_ce_score(layer_params, x, ids, mask: Optional[jnp.ndarray],
                           average: bool = True):
     """compute_score twin for the fused path: x is the output layer's INPUT

@@ -322,15 +322,11 @@ class ComputationGraph:
                 score = score + fused_sparse_ce_score(params[out_name], x, y,
                                                       lmask)
                 continue
-            _exp = 2 if hasattr(v.layer, "input_kind") and \
-                v.layer.input_kind() == "rnn" else 1
-            _nd = jnp.ndim(y)
-            if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer) and \
-                    (_nd == _exp or (_nd == _exp + 1 and
-                                     jnp.shape(y)[-1] == 1)) \
-                    and str(getattr(v.layer, "loss", "")).lower() in (
-                        "mcxent", "negativeloglikelihood",
-                        "categorical_crossentropy"):
+            from ...kernels.fused_ce import (_MCXENT_LOSSES,
+                                             sparse_shaped)
+            if sparse_shaped(v.layer, y) and \
+                    str(getattr(v.layer, "loss", "")).lower() in \
+                    _MCXENT_LOSSES:
                 raise ValueError(
                     f"output '{out_name}' got integer class-id labels but "
                     "is not fused-CE eligible (sparse labels need a "

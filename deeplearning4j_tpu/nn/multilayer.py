@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.fused_ce import (fused_sparse_ce_score,
+                                sparse_labels_eligible)
 from ..ops import rng as rngmod
 from ..ops.dataset import DataSet
 from ..ops.updaters import make_updater, normalize_gradient, schedule_lr
@@ -128,11 +130,16 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------- forward passes
     def _forward(self, params, state, x, *, train, rng, fmask=None,
-                 to_layer=None, initial_rnn=None, last_preoutput=False):
+                 to_layer=None, initial_rnn=None, last_preoutput=False,
+                 skip_last_preoutput=False):
         """Run the stack. Returns (activation, new_state_list, reg_penalty).
         ``initial_rnn``: optional list of per-layer rnn carries (TBPTT).
         ``last_preoutput``: stop before the output layer's activation/loss so
-        the caller can apply the fused loss (stable log-softmax path)."""
+        the caller can apply the fused loss (stable log-softmax path).
+        ``skip_last_preoutput``: additionally skip the output projection
+        itself — it runs INSIDE the fused sparse-CE loss
+        (kernels/fused_ce.py), so the [.., n_out] pre-activation is never
+        built."""
         new_states = []
         reg = jnp.asarray(0.0, jnp.float32)
         act = x
@@ -154,9 +161,11 @@ class MultiLayerNetwork:
             if last_preoutput and is_last and hasattr(layer, "preoutput"):
                 if layer.drop_out and train:
                     act = layer.maybe_dropout(act, train=train, rng=lrng)
-                pre = layer.preoutput(params[i], act)
                 new_states.append(lstate)
                 reg = reg + layer.reg_penalty(params[i])
+                if skip_last_preoutput:
+                    return None, new_states, reg, act, mask
+                pre = layer.preoutput(params[i], act)
                 return pre, new_states, reg, act, mask
             act, nstate = layer.forward(params[i], lstate, act, train=train,
                                         rng=lrng, mask=mask)
@@ -226,12 +235,28 @@ class MultiLayerNetwork:
                  initial_rnn=None):
         params = self._cast_params(params)
         out_layer = self._output_layer()
+        fused = sparse_labels_eligible(out_layer, labels, params[-1])
         pre, new_states, reg, last_in, out_mask = self._forward(
             params, state, feats, train=True, rng=rng, fmask=fmask,
-            initial_rnn=initial_rnn, last_preoutput=True)
-        mask = lmask if lmask is not None else \
-            (out_mask if pre.ndim == 3 else None)
-        score = out_layer.compute_score(params[-1], labels, pre, mask)
+            initial_rnn=initial_rnn, last_preoutput=True,
+            skip_last_preoutput=fused)
+        if fused:
+            mask = lmask if lmask is not None else \
+                (out_mask if last_in.ndim == 3 else None)
+            score = fused_sparse_ce_score(params[-1], last_in, labels, mask)
+        else:
+            from ..kernels.fused_ce import _MCXENT_LOSSES, sparse_shaped
+            if sparse_shaped(out_layer, labels) and \
+                    str(getattr(out_layer, "loss", "")).lower() in \
+                    _MCXENT_LOSSES:
+                raise ValueError(
+                    "the output layer got integer class-id labels but is "
+                    "not fused-CE eligible (sparse labels need a plain "
+                    "softmax Output/RnnOutput head; center-loss heads "
+                    "need one-hot labels). Pass one-hot labels here.")
+            mask = lmask if lmask is not None else \
+                (out_mask if pre.ndim == 3 else None)
+            score = out_layer.compute_score(params[-1], labels, pre, mask)
         aux_state = new_states
         if isinstance(out_layer, CenterLossOutputLayer):
             closs, new_center_state = out_layer.center_loss_and_update(
@@ -374,7 +399,9 @@ class MultiLayerNetwork:
         for start in range(0, t_total, window):
             end = min(start + window, t_total)
             feats = jnp.asarray(ds.features[:, start:end], self.compute_dtype)
-            labels = jnp.asarray(ds.labels[:, start:end], self.compute_dtype)
+            # _as_device_dtype: integer (sparse-CE) labels keep their dtype
+            labels = _as_device_dtype(ds.labels[:, start:end],
+                                      self.compute_dtype)
             fmask = None if ds.features_mask is None else \
                 jnp.asarray(ds.features_mask[:, start:end], self.compute_dtype)
             lmask = None if ds.labels_mask is None else \

@@ -357,3 +357,99 @@ class TestGraphIntegration:
         net2.fit_batch(DataSet(X, ids.astype(np.int32)))
         np.testing.assert_allclose(float(net1.score_value),
                                    float(net2.score_value), rtol=1e-5)
+
+
+class TestMLNIntegration:
+    """MultiLayerNetwork rides the same fused sparse-CE path as the graph
+    (r4 follow-up): parity with one-hot training, TBPTT windows integer
+    labels, ineligible heads raise."""
+
+    def _mln(self, V=19, T=6):
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            TokenAndPositionEmbedding, RnnOutputLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+                .updater("adam").weight_init("xavier").list()
+                .layer(TokenAndPositionEmbedding(n_in=V, n_out=8,
+                                                 max_length=T))
+                .layer(RnnOutputLayer(n_in=8, n_out=V, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(V, T)).build())
+        return MultiLayerNetwork(conf)
+
+    def test_sequence_parity_with_one_hot(self):
+        V, B, T = 19, 3, 6
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, V, (B, T)).astype(np.int32)
+        ids = rng.integers(0, V, (B, T)).astype(np.int32)
+        net1 = self._mln().init()
+        net2 = self._mln().init()
+        net1._fit_batch(DataSet(x, _one_hot(ids, V)))
+        net2._fit_batch(DataSet(x, ids))
+        np.testing.assert_allclose(float(net1.score_value),
+                                   float(net2.score_value), rtol=1e-5)
+
+    def test_tbptt_windows_integer_labels(self):
+        """TBPTT (3D features + sparse int labels) must window the labels
+        WITHOUT casting ids through the compute dtype (a bf16 round-trip
+        corrupts ids >= 257) and keep the fused path per window."""
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM,
+                                                       RnnOutputLayer)
+        V, B, T, F = 300, 2, 6, 4
+        conf = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+                .updater("adam").weight_init("xavier").list()
+                .layer(GravesLSTM(n_in=F, n_out=8))
+                .layer(RnnOutputLayer(n_in=8, n_out=V, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(F, T)).build())
+        conf.backprop_type = "truncated_bptt"
+        conf.tbptt_fwd_length = 3
+        conf.tbptt_back_length = 3
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(B, T, F)).astype(np.float32)
+        # ids >= 257 would corrupt under a bf16 cast — the regression bait
+        ids = rng.integers(257, V, (B, T)).astype(np.int32)
+        net.fit([DataSet(x, ids)])
+        assert np.isfinite(float(net.score_value))
+
+    def test_2d_classifier_parity(self):
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        V = 5
+        conf = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+                .updater("sgd").list()
+                .layer(DenseLayer(n_in=6, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=V, loss="mcxent",
+                                   activation="softmax")).build())
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(7, 6)).astype(np.float32)
+        ids = rng.integers(0, V, (7,))
+        net1 = MultiLayerNetwork(conf).init()
+        net2 = MultiLayerNetwork(conf).init()
+        net1._fit_batch(DataSet(X, _one_hot(ids, V)))
+        net2._fit_batch(DataSet(X, ids.astype(np.int32)))
+        np.testing.assert_allclose(float(net1.score_value),
+                                   float(net2.score_value), rtol=1e-5)
+
+    def test_center_loss_head_raises_on_sparse(self):
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (CenterLossOutputLayer,
+                                                       DenseLayer)
+        conf = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.1)
+                .updater("sgd").list()
+                .layer(DenseLayer(n_in=4, n_out=6))
+                .layer(CenterLossOutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                             activation="softmax")).build())
+        net = MultiLayerNetwork(conf).init()
+        X = np.zeros((2, 4), np.float32)
+        with pytest.raises(Exception, match="one-hot"):
+            net._fit_batch(DataSet(X, np.array([0, 1], np.int32)))
