@@ -70,6 +70,14 @@ class Evaluation:
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if np.issubdtype(labels.dtype, np.integer) and \
+                labels.ndim == predictions.ndim and \
+                labels.shape[-1] == 1 and predictions.shape[-1] != 1:
+            # classic DL4J column-vector id format ([N, 1] / [N, T, 1]) —
+            # the same trailing-singleton shape the fused-CE training gate
+            # accepts (nn/multilayer.py sparse_shaped); squeeze to ids so
+            # fit-then-evaluate works with one label array
+            labels = labels[..., 0]
+        if np.issubdtype(labels.dtype, np.integer) and \
                 labels.ndim == predictions.ndim - 1:
             # sparse class-id labels ([N] or [N, T]) — the fused-CE label
             # format (kernels/fused_ce.py); ids are the actuals directly

@@ -25,7 +25,6 @@ from .skipgram import (skipgram_hs_step, skipgram_ns_step,
                        vectorized_skipgram_pairs, vectorized_cbow_windows)
 from .vocab import VocabCache, VocabConstructor
 
-import functools
 
 
 @jax.jit

@@ -478,11 +478,15 @@ class ComputationGraph:
             # time-distributed too — slice them like masks, not like
             # [N, T, C] one-hot (min_ndim=3 would pass them whole and the
             # fused CE would see T_total ids against a window of inputs)
+            # ... but only when dim 1 actually spans time: a [N, 1] integer
+            # column label on a feedforward head in a mixed graph must pass
+            # through whole, not be sliced along its singleton class axis
             sliced_labels = {
                 k: (v if v is None else
                     (v[:, start:end]
                      if v.ndim >= 3 or (v.ndim == 2 and
-                                        jnp.issubdtype(v.dtype, jnp.integer))
+                                        jnp.issubdtype(v.dtype, jnp.integer)
+                                        and v.shape[1] == t_total)
                      else v))
                 for k, v in labels.items()}
             self.params, self.updater_state, new_states, score = step(

@@ -11,6 +11,7 @@ route level."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -23,29 +24,45 @@ class ModelServingRoute:
     results to ``output_topic`` — the serve-route the reference builds with
     Camel. ``start()`` spins the consumer thread; ``stop()`` drains it.
     ``max_batch``: cap on how many queued messages coalesce into one
-    forward pass."""
+    forward pass. ``batch_window``: max seconds to wait, after the first
+    message of a batch, for more messages to coalesce (the windowed
+    semantics of parallel/inference.py's BatchedInferenceObservable) — the
+    latency SLA knob: 0.0 means flush immediately with whatever is already
+    queued (a trickle serves singly; a burst still coalesces), >0 trades
+    that much first-message latency for trickle coalescing."""
 
     def __init__(self, net, broker: MessageBroker,
                  input_topic: str = "dl4j-input",
                  output_topic: str = "dl4j-output",
-                 max_batch: int = 32):
+                 max_batch: int = 32,
+                 batch_window: float = 0.0):
         self.net = net
         self.broker = broker
         self.sub = NDArraySubscriber(broker, input_topic)
         self.pub = NDArrayPublisher(broker, output_topic)
         self.max_batch = max(1, int(max_batch))
+        self.batch_window = max(0.0, float(batch_window))
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.served = 0
-        self.batches = 0
+        self.batches = 0      # coalesced (>=2 message) dispatch attempts
+        self.singles = 0      # single-message dispatches (incl. fallbacks)
         self.errors = 0
 
     def _drain(self, first: np.ndarray) -> List[np.ndarray]:
         arrs = [first]
+        deadline = time.monotonic() + self.batch_window
         while len(arrs) < self.max_batch:
-            nxt = self.sub.poll()            # non-blocking public surface
-            if nxt is None:
-                break
+            # cap each wait so stop() is observed promptly even mid-window
+            wait = min(deadline - time.monotonic(), 0.05)
+            if wait > 0 and not self._stop.is_set():
+                nxt = self.sub.poll(timeout=wait)
+                if nxt is None:
+                    continue
+            else:
+                nxt = self.sub.poll()
+                if nxt is None:
+                    break
             arrs.append(nxt)
         return arrs
 
@@ -66,6 +83,7 @@ class ModelServingRoute:
                 # provably singletons
                 self._serve_single(run[0])
             else:
+                self.batches += 1    # one coalesced dispatch attempt
                 try:
                     stacked = np.concatenate(
                         [a.astype(np.float32) for a in run], axis=0)
@@ -73,7 +91,6 @@ class ModelServingRoute:
                     splits = np.cumsum([a.shape[0] for a in run])[:-1]
                     pieces = np.split(out, splits, axis=0)
                     self.served += len(pieces)
-                    self.batches += 1
                     for piece in pieces:
                         self.pub.publish(piece)
                 except Exception:
@@ -86,10 +103,10 @@ class ModelServingRoute:
             i = j
 
     def _serve_single(self, a: np.ndarray) -> None:
+        self.singles += 1
         try:
             out = np.asarray(self.net.output(a.astype(np.float32)))
             self.served += 1
-            self.batches += 1
             self.pub.publish(out)
         except Exception:
             # a bad payload must not kill the route (Camel's route

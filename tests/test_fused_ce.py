@@ -219,6 +219,64 @@ class TestGraphIntegration:
         net.fit_batch(DataSet(xs, y2))          # crashed before the fix
         assert np.isfinite(float(net.score_value))
 
+    def test_evaluation_accepts_column_vector_ids(self):
+        """[N, 1] / [N, T, 1] trailing-singleton integer ids (the format
+        the fused-CE training gate accepts) must evaluate via the sparse
+        branch, not crash in the dense one-hot path (advisor finding)."""
+        from deeplearning4j_tpu.eval import Evaluation
+        rng = np.random.default_rng(0)
+        p = np.asarray(rng.dirichlet(np.ones(4), 6), np.float32)
+        ids = rng.integers(0, 4, (6,))
+        ev_col = Evaluation()
+        ev_col.eval(ids.reshape(-1, 1).astype(np.int32), p)
+        ev_flat = Evaluation()
+        ev_flat.eval(ids.astype(np.int32), p)
+        assert ev_col.total == 6
+        assert ev_col.accuracy() == ev_flat.accuracy()
+        # [N, T, 1] sequence ids
+        p3 = np.asarray(rng.dirichlet(np.ones(5), (2, 3)), np.float32)
+        ids3 = rng.integers(0, 5, (2, 3, 1)).astype(np.int32)
+        ev3 = Evaluation()
+        ev3.eval(ids3, p3)
+        assert ev3.total == 6
+        # genuinely single-column predictions must NOT be squeezed
+        ev1 = Evaluation()
+        ev1.eval(np.array([[0], [1]], np.int32),
+                 np.array([[0.2], [0.8]], np.float32))
+        assert ev1.total == 2
+
+    def test_tbptt_keeps_feedforward_column_labels_whole(self):
+        """A [N, 1] integer column label on a feedforward head in a mixed
+        TBPTT graph must NOT be time-sliced along its singleton axis
+        (advisor finding: windows after the first saw empty labels)."""
+        from deeplearning4j_tpu.nn.conf.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM,
+                                                       OutputLayer,
+                                                       RnnOutputLayer)
+        from deeplearning4j_tpu.nn.graph.vertices import LastTimeStepVertex
+        V, B, T = 7, 2, 6
+        g = (NeuralNetConfiguration.Builder().seed(0).learning_rate(0.05)
+             .updater("sgd").graph_builder().add_inputs("in"))
+        g.add_layer("lstm", GravesLSTM(n_in=4, n_out=8), "in")
+        g.add_layer("seq", RnnOutputLayer(n_in=8, n_out=V, loss="mcxent",
+                                          activation="softmax"), "lstm")
+        g.add_vertex("last", LastTimeStepVertex("in"), "lstm")
+        g.add_layer("ff", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                      activation="softmax"), "last")
+        g.set_outputs("seq", "ff")
+        conf = g.build()
+        conf.backprop_type = "truncated_bptt"
+        conf.tbptt_fwd_length = 3
+        conf.tbptt_back_length = 3
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(B, T, 4)).astype(np.float32)
+        y_seq = rng.integers(0, V, (B, T)).astype(np.int32)
+        y_ff = rng.integers(0, 3, (B, 1)).astype(np.int32)
+        from deeplearning4j_tpu.ops.dataset import MultiDataSet
+        net.fit_batch(MultiDataSet([x], [y_seq, y_ff]))
+        assert np.isfinite(float(net.score_value))
+
     def test_per_example_mask_broadcasts(self):
         """[N] per-example label mask on a sequence output: weighted like
         the materialized path (broadcast over T, N*T denominator)."""
