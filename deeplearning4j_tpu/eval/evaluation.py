@@ -103,6 +103,21 @@ class Evaluation:
             else:
                 self.top_n_correct += int(np.sum(actual == pred))
             return
+        if predictions.shape[-1] == 1 and labels.shape[-1] == 1:
+            # single-column (sigmoid) predictions: binary decision at 0.5
+            # (the reference's single-output Evaluation semantics) — argmax
+            # over a singleton axis would silently call everything class 0
+            actual = (labels.reshape(-1) >= 0.5).astype(np.int64)
+            pred = (predictions.reshape(-1) >= 0.5).astype(np.int64)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                actual = actual[keep]
+                pred = pred[keep]
+            self._ensure(2)
+            np.add.at(self.confusion, (actual, pred), 1)
+            self.total += len(actual)
+            self.top_n_correct += int(np.sum(actual == pred))
+            return
         if labels.ndim == 3:
             n, t, c = labels.shape
             labels = labels.reshape(n * t, c)

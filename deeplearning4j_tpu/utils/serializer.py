@@ -147,10 +147,24 @@ class ModelSerializer:
 
 
 class ModelGuesser:
-    """Load any saved model guessing its type (reference util/ModelGuesser.java)."""
+    """Load any saved model guessing its type (reference
+    util/ModelGuesser.java:42-110, whose fallback chain tries the DL4J zip
+    formats and then the Keras HDF5 importers). Here: HDF5 files are
+    sniffed by magic (``\\x89HDF\\r\\n\\x1a\\n``) and routed through
+    keras.importer (Sequential → MultiLayerNetwork, functional →
+    ComputationGraph — the importer guesses that split itself); everything
+    else goes through the 4-slot zip reader with the model_type slot
+    deciding MLN vs CG."""
+
+    HDF5_MAGIC = b"\x89HDF\r\n\x1a\n"
 
     @staticmethod
     def load_model_guess_type(path):
+        with open(path, "rb") as f:
+            magic = f.read(8)
+        if magic == ModelGuesser.HDF5_MAGIC:
+            from ..keras.importer import KerasModelImport
+            return KerasModelImport.import_keras_model_and_weights(path)
         meta, *_ = ModelSerializer._read(path)
         if meta.get("model_type") == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(path)

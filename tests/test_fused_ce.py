@@ -239,11 +239,13 @@ class TestGraphIntegration:
         ev3 = Evaluation()
         ev3.eval(ids3, p3)
         assert ev3.total == 6
-        # genuinely single-column predictions must NOT be squeezed
+        # genuinely single-column predictions are NOT squeezed: they
+        # evaluate as binary with a 0.5 decision threshold
         ev1 = Evaluation()
-        ev1.eval(np.array([[0], [1]], np.int32),
-                 np.array([[0.2], [0.8]], np.float32))
-        assert ev1.total == 2
+        ev1.eval(np.array([[0], [1], [1]], np.int32),
+                 np.array([[0.2], [0.8], [0.3]], np.float32))
+        assert ev1.total == 3
+        assert ev1.accuracy() == pytest.approx(2 / 3)
 
     def test_tbptt_keeps_feedforward_column_labels_whole(self):
         """A [N, 1] integer column label on a feedforward head in a mixed

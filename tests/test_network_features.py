@@ -74,6 +74,44 @@ class TestSerialization:
         loaded = ModelGuesser.load_model_guess_type(path)
         assert isinstance(loaded, MultiLayerNetwork)
 
+    def test_model_guesser_keras_h5(self, tmp_path, rng_np):
+        """HDF5-magic sniffing routes Keras files through keras.importer
+        (reference ModelGuesser.java:42-110 Keras fallback chain)."""
+        import json
+        import h5py
+        W1 = rng_np.normal(size=(4, 8)).astype(np.float32)
+        b1 = np.zeros(8, np.float32)
+        W2 = rng_np.normal(size=(8, 3)).astype(np.float32)
+        b2 = np.zeros(3, np.float32)
+        cfg = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "units": 8, "activation": "relu",
+                        "use_bias": True, "batch_input_shape": [None, 4]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "units": 3,
+                        "activation": "softmax", "use_bias": True}}]}}
+        path = tmp_path / "keras_mlp.h5"
+        with h5py.File(path, "w") as f:
+            f.attrs["model_config"] = json.dumps(cfg)
+            mw = f.create_group("model_weights")
+            for lname, ws in (("dense_1", [("kernel:0", W1), ("bias:0", b1)]),
+                              ("dense_2", [("kernel:0", W2), ("bias:0", b2)])):
+                lg = mw.create_group(lname)
+                names = []
+                for wname, arr in ws:
+                    lg.create_dataset(wname, data=arr)
+                    names.append(f"{lname}/{wname}".encode())
+                lg.attrs["weight_names"] = names
+        loaded = ModelGuesser.load_model_guess_type(path)
+        assert isinstance(loaded, MultiLayerNetwork)
+        X = rng_np.normal(size=(5, 4)).astype(np.float32)
+        h = np.maximum(X @ W1 + b1, 0)
+        logits = h @ W2 + b2
+        expect = np.exp(logits - logits.max(-1, keepdims=True))
+        expect /= expect.sum(-1, keepdims=True)
+        np.testing.assert_allclose(loaded.output(X), expect,
+                                   rtol=1e-4, atol=1e-5)
+
     def test_graph_roundtrip(self, tmp_path, rng_np):
         g = (NeuralNetConfiguration.Builder().seed(3).learning_rate(0.1)
              .updater("sgd").weight_init("xavier").activation("relu")
