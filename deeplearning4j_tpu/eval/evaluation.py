@@ -77,6 +77,13 @@ class Evaluation:
             # accepts (nn/multilayer.py sparse_shaped); squeeze to ids so
             # fit-then-evaluate works with one label array
             labels = labels[..., 0]
+        if predictions.shape[-1] == 1 and \
+                np.issubdtype(labels.dtype, np.integer) and \
+                labels.ndim == predictions.ndim - 1:
+            # [N] (or [N, T]) integer ids against single-column sigmoid
+            # predictions: binary at 0.5, same as the column-label form
+            # below — the sparse-argmax path would build a 1x1 confusion
+            labels = labels[..., None]
         if np.issubdtype(labels.dtype, np.integer) and \
                 labels.ndim == predictions.ndim - 1:
             # sparse class-id labels ([N] or [N, T]) — the fused-CE label

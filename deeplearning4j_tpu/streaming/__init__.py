@@ -5,6 +5,8 @@ SURVEY.md §2.4)."""
 from .pubsub import (MessageBroker, NDArrayPublisher, NDArraySubscriber,
                      NDArrayStreamClient)
 from .serving import ModelServingRoute
+from .tcp_broker import TcpBrokerServer, TcpMessageBroker  # registers tcp://
 
 __all__ = ["MessageBroker", "NDArrayPublisher", "NDArraySubscriber",
-           "NDArrayStreamClient", "ModelServingRoute"]
+           "NDArrayStreamClient", "ModelServingRoute", "TcpBrokerServer",
+           "TcpMessageBroker"]
