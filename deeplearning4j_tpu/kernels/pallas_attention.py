@@ -405,16 +405,22 @@ def pallas_flash_attention(q, k, v, causal: bool = False,
 
 def make_pallas_flash_helper(min_seq_len: int = 1024,
                              q_block: int = 512, k_block: int = 512,
-                             interpret=None):
+                             interpret=None, short_t: bool = True):
     """Helper: Pallas kernels for every long sequence — key masks ride
     into the kernels as [1, KB] tiles (r4; the r3 helper dropped masked
     long-context to the jnp blockwise path and lost the 2-2.8x win on
-    ragged batches). Decline only below min_seq_len, where the
-    materialized path is fastest."""
+    ragged batches). Below min_seq_len, tile-aligned 256 ≤ T ≤ 512 takes
+    the whole-block short-T kernel pair (kernels/pallas_shortseq.py —
+    +10% measured on the T=512 flagship LM in-graph, BASELINE.md r5);
+    other short shapes keep the materialized path."""
     def helper(conf, q, k, v, mask):
         t = q.shape[1]
         if t < min_seq_len:
-            return None                      # short: materialized path wins
+            from .pallas_shortseq import MAX_T, short_attention
+            if short_t and 256 <= t <= MAX_T and t % 128 == 0:
+                return short_attention(q, k, v, causal=conf.causal,
+                                       key_mask=mask, interpret=interpret)
+            return None                      # tiny: materialized path wins
         return pallas_flash_attention(q, k, v, causal=conf.causal,
                                       q_block=q_block, k_block=k_block,
                                       interpret=interpret, key_mask=mask)

@@ -106,6 +106,12 @@ GODAN_VERBS = [
     "習う", "手伝う", "向かう", "違う", "もらう", "迷う",
     "咲く", "描く", "弾く", "引く", "ひく", "なる", "見つかる", "撮る", "守る", "治る",
     "下ろす", "なくす", "間に合う",
+    # r5 growth band: common everyday verbs (held-out eval showed the
+    # next frequency band missing)
+    "磨く", "誘う", "泊まる", "謝る", "沸かす", "転ぶ", "炊く", "研ぐ",
+    "眠る", "通う", "拾う", "吸う", "悩む", "倒す", "回す", "移る",
+    "祈る", "踊る", "預かる", "頼る", "乾く", "干す", "結ぶ", "積む",
+    "畳む", "塗る", "釣る", "掘る", "つまむ",
 ]
 ICHIDAN_VERBS = [
     "食べる", "見る", "起きる", "寝る", "出る", "入れる", "教える",
@@ -116,6 +122,10 @@ ICHIDAN_VERBS = [
     "育てる", "受ける", "助ける", "逃げる", "投げる", "曲げる", "上げる",
     "下げる", "挙げる", "疲れる", "遅れる", "晴れる", "壊れる", "折れる",
     "濡れる", "見つける",
+    # r5 growth band
+    "預ける", "並べる", "温める", "数える", "植える", "締める", "茹でる",
+    "混ぜる", "眺める", "止める", "出かける", "届ける", "着替える",
+    "片付ける", "慣れる", "冷える", "増える", "覚める", "燃える",
 ]
 SURU_NOUNS = [
     "勉強", "仕事", "研究", "旅行", "練習", "説明", "質問", "運動",
@@ -130,6 +140,9 @@ I_ADJECTIVES = [
     "面白い", "つまらない", "広い", "狭い", "重い", "軽い", "暗い",
     "明るい", "白い", "黒い", "赤い", "青い", "若い", "優しい", "汚い",
     "眠い", "痛い", "甘い", "辛い", "欲しい", "涼しい",
+    # r5 growth band
+    "珍しい", "恥ずかしい", "細かい", "苦い", "深い", "浅い", "厚い",
+    "薄い", "丸い", "硬い", "柔らかい", "危ない",
 ]
 
 

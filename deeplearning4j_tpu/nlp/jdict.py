@@ -65,6 +65,13 @@ NOUNS = [
     "日本語", "漢字", "会議", "毎朝", "毎年", "寺", "お寺", "近く",
     "昔", "上手", "元気", "好き", "みんな", "どちら", "この", "その",
     "あの", "どの",
+    # r5 growth band: household/everyday nouns + loanwords (held-out eval)
+    "歯", "毎晩", "冷蔵庫", "お弁当", "駐車場", "庭", "お湯", "切手",
+    "箸", "豆腐", "皿", "棚", "数", "半分", "信号", "階段", "枕",
+    "布団", "米", "青", "スープ", "シャワー", "エアコン", "コンビニ",
+    "スマホ", "メール", "パーティー", "コート", "ケーキ", "プール",
+    "テニス", "洗濯機", "歯医者", "屋根", "畑", "醤油", "鍋", "隣",
+    "角", "壁", "床", "天井", "窓口", "サッカー", "コーヒー",
 ]
 
 # -- common verbs (dictionary + frequent conjugated surfaces) ----------

@@ -1,0 +1,106 @@
+# -*- coding: utf-8 -*-
+"""Open-domain CJK segmentation coverage report (r5, VERDICT r4 item #5).
+
+The 1.000 F1 numbers on the ja/ko gold corpora are self-referential —
+fixture and dictionary were developed together (BASELINE.md r3/r4 says
+so). This script puts the honest numbers beside them:
+
+- dictionary size (entries) per language
+- token F1 on the development gold corpus (the old number)
+- token F1 on the HELD-OUT corpus (tests/ja_heldout_corpus.py /
+  ko_heldout_corpus.py — built from stems deliberately absent from the
+  seed lists), i.e. the open-domain degradation estimate
+- OOV rate of each corpus: fraction of gold tokens that are not an exact
+  dictionary surface (how much the lattice leans on the unknown-word
+  model)
+
+Usage: python scripts/eval_cjk_coverage.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+
+def spans(tokens):
+    out, i = [], 0
+    for t in tokens:
+        out.append((i, i + len(t)))
+        i += len(t)
+    return set(out)
+
+
+def token_f1(tokenize, corpus):
+    tp = fp = fn = 0
+    for text, toks in corpus:
+        pred = tokenize(text)
+        ps, gs = spans(pred), spans(toks)
+        tp += len(ps & gs)
+        fp += len(ps - gs)
+        fn += len(gs - ps)
+    p = tp / max(tp + fp, 1)
+    r = tp / max(tp + fn, 1)
+    return 2 * p * r / max(p + r, 1e-9)
+
+
+def oov_rate(surfaces, corpus):
+    """Fraction of gold tokens that are not an exact dictionary surface."""
+    total = miss = 0
+    for _, toks in corpus:
+        for t in toks:
+            total += 1
+            miss += t not in surfaces
+    return miss / max(total, 1)
+
+
+def main():
+    from ja_gold_corpus import GOLD as JA_GOLD
+    from ja_heldout_corpus import HELDOUT as JA_HELD
+    from ko_gold_corpus import GOLD as KO_GOLD
+    from ko_heldout_corpus import HELDOUT as KO_HELD
+    from deeplearning4j_tpu.nlp import LatticeJapaneseTokenizerFactory
+    from deeplearning4j_tpu.nlp.klattice import LatticeKoreanTokenizerFactory
+    from deeplearning4j_tpu.nlp.jdict import default_entries as ja_entries
+    from deeplearning4j_tpu.nlp.kconj import generated_entries as ko_entries
+
+    # Korean gold fixtures keep spaces in the sentence; tokens concatenate
+    # to the space-stripped text, so F1 spans index the stripped string
+    ja_f = LatticeJapaneseTokenizerFactory()
+    ko_f = LatticeKoreanTokenizerFactory()
+    ja_tok = lambda text: ja_f.create(text).get_tokens()
+    ko_tok = lambda text: ko_f.create(text).get_tokens()
+
+    ja_dict = list(ja_entries())
+    ko_dict = list(ko_entries())
+    ja_surf = {s for s, _, _ in ja_dict}
+    ko_surf = {s for s, _, _ in ko_dict}
+
+    def strip_spaces(corpus):
+        return [("".join(t.split()), toks) for t, toks in corpus]
+
+    rows = [
+        ("ja", "dev-gold", ja_tok, strip_spaces(JA_GOLD), ja_surf,
+         len(ja_dict)),
+        ("ja", "held-out", ja_tok, strip_spaces(JA_HELD), ja_surf,
+         len(ja_dict)),
+        ("ko", "dev-gold", ko_tok, strip_spaces(KO_GOLD), ko_surf,
+         len(ko_dict)),
+        ("ko", "held-out", ko_tok, strip_spaces(KO_HELD), ko_surf,
+         len(ko_dict)),
+    ]
+    print(f"{'lang':5s} {'corpus':9s} {'sents':>5s} {'dict':>6s} "
+          f"{'OOV%':>6s} {'F1':>6s}")
+    for lang, name, tok, corpus, surf, dsize in rows:
+        for text, toks in corpus:
+            assert "".join(toks) == text, f"bad fixture: {text}"
+        f1 = token_f1(tok, corpus)
+        oov = oov_rate(surf, corpus)
+        print(f"{lang:5s} {name:9s} {len(corpus):5d} {dsize:6d} "
+              f"{100 * oov:6.1f} {f1:6.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -230,3 +230,57 @@ class TestKoreanLattice:
             user_entries=[("김치찌개", "noun", 500)])
         assert f.create("김치찌개를 먹어요").get_tokens() == \
             ["김치찌개", "를", "먹어요"]
+
+
+class TestOpenDomainHeldout:
+    """Open-domain honesty (VERDICT r4 item #5): the held-out fixtures
+    were built from stems deliberately absent from the seed lists (see
+    tests/ja_heldout_corpus.py) — pre-growth they measured F1 0.739 (ja,
+    34% OOV) / 0.356 (ko, 45% OOV); the r5 growth band + the 요-cost fix
+    bring them to the pinned floors below (full table:
+    scripts/eval_cjk_coverage.py + BASELINE.md r5)."""
+
+    def _f1(self, tokenize, corpus):
+        tp = fp = fn = 0
+        for text, toks in corpus:
+            text = "".join(text.split())
+            assert "".join(toks) == text, f"bad fixture: {text}"
+            i, gs = 0, set()
+            for t in toks:
+                gs.add((i, i + len(t)))
+                i += len(t)
+            i, ps = 0, set()
+            for t in tokenize(text):
+                ps.add((i, i + len(t)))
+                i += len(t)
+            tp += len(ps & gs)
+            fp += len(ps - gs)
+            fn += len(gs - ps)
+        p, r = tp / (tp + fp), tp / (tp + fn)
+        return 2 * p * r / (p + r)
+
+    def test_japanese_heldout_floor(self):
+        from ja_heldout_corpus import HELDOUT
+        f = LatticeJapaneseTokenizerFactory()
+        f1 = self._f1(lambda t: f.create(t).get_tokens(), HELDOUT)
+        assert f1 >= 0.95, f1
+
+    def test_korean_heldout_floor(self):
+        from ko_heldout_corpus import HELDOUT
+        from deeplearning4j_tpu.nlp.klattice import \
+            LatticeKoreanTokenizerFactory
+        f = LatticeKoreanTokenizerFactory()
+        f1 = self._f1(lambda t: f.create(t).get_tokens(), HELDOUT)
+        assert f1 >= 0.90, f1
+
+    def test_polite_yo_stays_inside_unknown_verbs(self):
+        """The systematic pre-fix failure: unseen verbs ending 요 split as
+        unknown + josa(요). Verbs still absent from the dictionary pin
+        the fix."""
+        from deeplearning4j_tpu.nlp.klattice import \
+            LatticeKoreanTokenizerFactory
+        f = LatticeKoreanTokenizerFactory()
+        assert f.create("문을 두드려요").get_tokens() == \
+            ["문", "을", "두드려요"]
+        assert f.create("팔을 긁어요").get_tokens() == \
+            ["팔", "을", "긁어요"]
