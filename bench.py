@@ -62,7 +62,10 @@ CHARRNN_BASELINE = float(
     os.environ.get("BENCH_CHARRNN_BASELINE", "") or 1_022_705.0)
 TRANSFORMER_BASELINE = float(
     os.environ.get("BENCH_LM_BASELINE", "") or 131_353.9)
-LENET_BASELINE = float(os.environ.get("BENCH_LENET_BASELINE", "") or 656.0)
+# r5: the r2-era 656 img/s LeNet recording included first-epoch compile +
+# transfers; the r5 side-metric protocol warms one epoch first and
+# measures the steady fit path (6,489 img/s recorded r5)
+LENET_BASELINE = float(os.environ.get("BENCH_LENET_BASELINE", "") or 6488.67)
 WORD2VEC_BASELINE = float(
     os.environ.get("BENCH_W2V_BASELINE", "") or 194_000.0)
 
@@ -252,8 +255,10 @@ def _transformer_measure():
 
 def _lenet() -> float:
     """BASELINE config #1: LeNet-MNIST through the full fit(iterator) path
-    (synthetic MNIST; transfer-bound on the tunneled host — BASELINE.md
-    r2). Single run: an end-to-end fit has no separable warm region."""
+    (synthetic MNIST). One epoch warms compile + first transfers, then the
+    steady fit path is timed (single run — the timed region is itself a
+    multi-epoch aggregate); the r2-era 656 img/s recording included the
+    warm phase, hence the r5 baseline reset."""
     from deeplearning4j_tpu.datasets import MnistDataSetIterator
     from deeplearning4j_tpu.models import lenet_conf
     from deeplearning4j_tpu.nn import MultiLayerNetwork
@@ -324,9 +329,18 @@ def _side_metrics() -> dict:
     except Exception as e:  # noqa: BLE001
         side["lenet_mnist_fit_images_per_sec"] = {"error": str(e)[:200]}
     try:
-        med, spread, k = _median_runs(_word2vec)
-        record("word2vec_single_pass_tokens_per_sec", med, "tokens/sec",
-               WORD2VEC_BASELINE, spread, k)
+        # word2vec's in-process repeats are a DIFFERENT protocol: the
+        # first run is the cold single-pass (compile/tracing + cold host
+        # caches, the BASELINE.md protocol number); later runs reuse
+        # in-process compiled programs and warm host caches (measured
+        # 179k cold vs ~700k warm — a naive median straddles the two).
+        cold = _word2vec()
+        record("word2vec_single_pass_tokens_per_sec", cold, "tokens/sec",
+               WORD2VEC_BASELINE)
+        if RUNS > 1:
+            warm = [_word2vec() for _ in range(RUNS - 1)]
+            side["word2vec_single_pass_tokens_per_sec"][
+                "warm_tokens_per_sec"] = round(float(np.median(warm)), 2)
     except Exception as e:  # noqa: BLE001
         side["word2vec_single_pass_tokens_per_sec"] = {"error": str(e)[:200]}
     return side

@@ -298,8 +298,11 @@ def _short_bwd_impl(q3, k3, v3, mask2, h, o, lse, do, causal, g_heads,
         kern = functools.partial(_short_bwd_kernel, scale=scale, g_heads=g,
                                  causal=causal, masked=masked,
                                  q_split=q_split)
+        # dk/dv accumulators are only touched when q-splitting; don't
+        # reserve VMEM on the default whole-block path
+        nq_eff = q_split if causal else 1
         scratch = [pltpu.VMEM((t, d), jnp.float32),
-                   pltpu.VMEM((t, d), jnp.float32)]
+                   pltpu.VMEM((t, d), jnp.float32)] if nq_eff > 1 else []
     dq, dk, dv = pl.pallas_call(
         kern,
         grid=(bh // g,),
@@ -367,8 +370,9 @@ def short_attention(q, k, v, causal: bool = False, key_mask=None,
                     g_heads: int = 0, q_split: int = 0, interpret=None):
     """[B, T, H, D] attention via the whole-block short-T kernels
     (T ≤ MAX_T). ``g_heads``: heads per grid step (0 = auto via pick_g);
-    ``q_split``: causal q-block truncation factor (0 = auto: 4 when T is
-    divisible, else 1; ignored non-causally).
+    ``q_split``: causal q-block truncation factor (0 = auto = 1 — the
+    truncation measured flat in-graph and slower standalone, so it stays
+    opt-in; -1 selects the batched-dot kernels; ignored non-causally).
     Same −1e30 masking semantics as pallas_flash_attention."""
     b, t, h, d = q.shape
     if t > MAX_T:

@@ -64,6 +64,35 @@ _NEGATIVE = {
           "risky", "cheap-looking", "late", "small", "crowded"],
 }
 
+# r5 growth band (VERDICT r4 missing item #3): the held-out review
+# fixture (tests/sentiment_heldout.py) measured accuracy 0.050 with a
+# 1.4% lexicon hit rate — everyday REVIEW-domain polarity vocabulary was
+# missing wholesale. Frequency-ordered additions, same band structure.
+_POSITIVE[0.8] = ["flawless", "stunning", "superior", "gorgeous",
+                  "splendid", "captivating", "remarkable", "immersive"]
+_POSITIVE[0.5] = _POSITIVE[0.5] + [
+    "sturdy", "elegant", "spotless", "attentive", "graceful", "memorable",
+    "effortless", "durable", "refreshing", "vibrant", "knowledgeable",
+    "trustworthy", "intuitive", "polished", "admire", "dedication",
+    "generous", "courteous", "responsive", "crisp", "seamless",
+    "affordable", "spacious", "cozy", "tidy", "skilled", "talented",
+    "professional", "efficient", "vivid", "lovely", "pleasing", "rich"]
+_POSITIVE[0.3] = _POSITIVE[0.3] + ["prompt", "soft", "patient", "quick",
+                                   "neat", "polite", "handy", "roomy"]
+_NEGATIVE[0.8] = ["pathetic", "horrendous", "unacceptable", "shoddy",
+                  "scam", "fraud", "junk", "filthy", "rotten", "moldy"]
+_NEGATIVE[0.5] = _NEGATIVE[0.5] + [
+    "flimsy", "defective", "overpriced", "sluggish", "musty", "stained",
+    "bland", "soggy", "laggy", "tedious", "dishonest", "obnoxious",
+    "cramped", "greasy", "lukewarm", "clumsy", "faulty", "fragile",
+    "smelly", "rusty", "cracked", "leaking", "waste", "wasted",
+    "inferior", "unreliable", "unresponsive", "overrated", "grimy",
+    "torn", "ripped", "dented", "glitchy", "buggy", "crashes", "crash",
+    "malfunction", "insults", "dull"]
+_NEGATIVE[0.3] = _NEGATIVE[0.3] + ["delayed", "muddy", "damp", "outdated",
+                                   "errors", "drags", "dragged", "denied",
+                                   "scratched", "peeled", "snapped"]
+
 
 def default_lexicon() -> Dict[str, float]:
     lex: Dict[str, float] = {}

@@ -8,9 +8,20 @@ from .server import UIServer, RemoteStatsRouter
 from .legacy_listeners import (HistogramIterationListener,
                                FlowIterationListener,
                                ConvolutionalIterationListener)
+from .components import (ChartHistogram, ChartLine, ChartScatter,
+                         ChartStackedArea, ChartTimeline, ComponentDiv,
+                         ComponentTable, ComponentText, Style,
+                         component_from_json, render_page)
+from .report import (export_cluster_stats_html, export_stats_html,
+                     training_report)
 
 __all__ = ["StatsListener", "SparkStyntheticPhaseTimer", "profiler_trace",
            "StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
            "SqliteStatsStorage", "UIServer", "RemoteStatsRouter",
            "HistogramIterationListener", "FlowIterationListener",
-           "ConvolutionalIterationListener"]
+           "ConvolutionalIterationListener",
+           "ChartHistogram", "ChartLine", "ChartScatter",
+           "ChartStackedArea", "ChartTimeline", "ComponentDiv",
+           "ComponentTable", "ComponentText", "Style",
+           "component_from_json", "render_page", "export_stats_html",
+           "export_cluster_stats_html", "training_report"]

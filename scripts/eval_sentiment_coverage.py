@@ -1,0 +1,49 @@
+"""Open-domain sentiment-lexicon coverage report (r5, VERDICT r4 missing
+item #3, the SentiWordNet-scale half — the eval_cjk_coverage.py twin).
+
+tests/sentiment_heldout.py was written AFTER the lexicon, deliberately
+leaning on polarity words absent from it: pre-growth the scorer measured
+**accuracy 0.050 with a 1.4% lexicon hit rate** (nearly every sentence
+scored 0 → neutral). The r5 growth band (+109 review-domain polarity
+words) is the honest response; this script reports the current numbers.
+
+Usage: python scripts/eval_sentiment_coverage.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+
+def main():
+    from sentiment_heldout import HELDOUT
+    from deeplearning4j_tpu.nlp.annotators import EN_STRIP_PUNCT
+    from deeplearning4j_tpu.nlp.sentiment import (SentimentScorer,
+                                                  default_lexicon)
+    scorer = SentimentScorer()
+    lex = default_lexicon()
+    right = hits = toks = 0
+    confusion = {}
+    for text, label in HELDOUT:
+        sc = scorer.score(text)
+        pred = "positive" if sc > 0 else \
+            ("negative" if sc < 0 else "neutral")
+        right += pred == label
+        confusion[(label, pred)] = confusion.get((label, pred), 0) + 1
+        for w in text.lower().split():
+            toks += 1
+            hits += w.strip(EN_STRIP_PUNCT) in lex
+    print(f"lexicon size: {len(lex)}")
+    print(f"held-out sentences: {len(HELDOUT)}")
+    print(f"lexicon token hit rate: {hits / toks:.3f}")
+    print(f"binary accuracy (0 scores count as wrong): "
+          f"{right / len(HELDOUT):.3f}")
+    for (gold, pred), n in sorted(confusion.items()):
+        print(f"  gold={gold:9s} pred={pred:9s} {n}")
+
+
+if __name__ == "__main__":
+    main()

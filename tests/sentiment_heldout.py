@@ -1,0 +1,55 @@
+"""Held-out sentiment fixture (VERDICT r4 missing item #3, the
+SentiWordNet-coverage half): short review-style sentences labeled
+positive/negative, written AFTER the lexicon and deliberately leaning on
+polarity words that were absent from it at the time of writing
+(flawless, pathetic, defective, sturdy, flimsy, overpriced, …) mixed
+with everyday carriers. Accuracy here estimates open-domain lexicon
+coverage; scripts/eval_sentiment_coverage.py reports hit-rate beside it.
+
+Each entry: (text, label) with label in {"positive", "negative"}."""
+
+HELDOUT = [
+    # --- positive ---
+    ("The craftsmanship is flawless and the design is stunning.",
+     "positive"),
+    ("A sturdy case with a generous warranty.", "positive"),
+    ("The screen is crisp and the battery lasts forever.", "positive"),
+    ("Their support team was responsive and courteous.", "positive"),
+    ("An elegant solution to a messy problem.", "positive"),
+    ("The room was spotless and the staff attentive.", "positive"),
+    ("A superb meal with generous portions.", "positive"),
+    ("The update made everything faster and smoother.", "positive"),
+    ("This novel is captivating from the first page.", "positive"),
+    ("A graceful and memorable performance.", "positive"),
+    ("The instructions were clear and the setup effortless.", "positive"),
+    ("Remarkable value for the price.", "positive"),
+    ("The fabric feels soft and durable.", "positive"),
+    ("A refreshing drink on a hot day.", "positive"),
+    ("The garden looked vibrant after the rain.", "positive"),
+    ("Our guide was knowledgeable and patient.", "positive"),
+    ("The sound quality is rich and immersive.", "positive"),
+    ("A trustworthy seller with prompt shipping.", "positive"),
+    ("The interface is intuitive and polished.", "positive"),
+    ("I admire the dedication of this team.", "positive"),
+    # --- negative ---
+    ("The hinge is flimsy and snapped within a week.", "negative"),
+    ("A pathetic excuse for customer service.", "negative"),
+    ("The unit arrived defective and scratched.", "negative"),
+    ("Overpriced junk that stopped working immediately.", "negative"),
+    ("The plot is dull and the pacing sluggish.", "negative"),
+    ("Our room smelled musty and the sheets were stained.", "negative"),
+    ("The soup was bland and the bread soggy.", "negative"),
+    ("The app is laggy and crashes constantly.", "negative"),
+    ("A tedious lecture that dragged on for hours.", "negative"),
+    ("The seller was dishonest about the condition.", "negative"),
+    ("Shoddy construction and missing screws.", "negative"),
+    ("The coating peeled off after one wash.", "negative"),
+    ("An obnoxious noise comes from the fan.", "negative"),
+    ("The manual is confusing and riddled with errors.", "negative"),
+    ("A cramped seat and a delayed departure.", "negative"),
+    ("The warranty claim was denied on a technicality.", "negative"),
+    ("Greasy food served lukewarm.", "negative"),
+    ("The trail was muddy and poorly marked.", "negative"),
+    ("A clumsy remake that insults the original.", "negative"),
+    ("The battery drains overnight even when idle.", "negative"),
+]
