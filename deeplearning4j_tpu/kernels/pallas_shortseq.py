@@ -369,10 +369,21 @@ _short_masked.defvjp(_short_masked_fwd, _short_masked_bwd)
 def short_attention(q, k, v, causal: bool = False, key_mask=None,
                     g_heads: int = 0, q_split: int = 0, interpret=None):
     """[B, T, H, D] attention via the whole-block short-T kernels
-    (T ≤ MAX_T). ``g_heads``: heads per grid step (0 = auto via pick_g);
-    ``q_split``: causal q-block truncation factor (0 = auto = 1 — the
-    truncation measured flat in-graph and slower standalone, so it stays
-    opt-in; -1 selects the batched-dot kernels; ignored non-causally).
+    (T ≤ MAX_T). ``g_heads``: heads per grid step (0 = auto; must divide
+    B·H, and H too when key-masked); ``q_split``: causal q-block
+    truncation factor (0 = auto = 1 — the truncation measured flat
+    in-graph and slower standalone, so it stays opt-in; -1 selects the
+    folded batched-dot kernels; ignored non-causally).
+
+    Inputs fold to [B·H, T, D] around the kernels; the r5 profile showed
+    these transposes cost ~9.7 ms/step of XLA copies at the flagship
+    shape, and a 4-D-native variant ((1, T, G, D) blocks via index maps,
+    no fold) was built and REJECTED: Mosaic cannot lower per-head [T, D]
+    slices out of blocks whose minor dims are (H, D) — real-TPU compile
+    fails with "infer-vector-layout: unsupported shape cast" (interpret
+    mode passed, which is exactly why scripts/perf_kernel_checks.py
+    exists). The attention math needs (T, D)-minor tiles, so the relayout
+    must happen somewhere; XLA's explicit copies are that somewhere.
     Same −1e30 masking semantics as pallas_flash_attention."""
     b, t, h, d = q.shape
     if t > MAX_T:
@@ -380,7 +391,6 @@ def short_attention(q, k, v, causal: bool = False, key_mask=None,
     if interpret is None:
         from .pallas_attention import _interpret_default
         interpret = _interpret_default()
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     g = g_heads or pick_g(b * h, h, key_mask is not None)
     if (b * h) % g:
         raise ValueError(f"g_heads={g} must divide B*H={b * h}")
@@ -390,7 +400,7 @@ def short_attention(q, k, v, causal: bool = False, key_mask=None,
         raise ValueError(f"masked short attention needs g_heads | H "
                          f"({g} vs {h})")
     if q_split == -1:
-        qs = -1               # batched-dot kernels
+        qs = -1               # batched-dot kernels (folded path only)
     elif not causal:
         qs = 1
     elif q_split:
@@ -402,6 +412,7 @@ def short_attention(q, k, v, causal: bool = False, key_mask=None,
         # in-graph at T=512 (154.4k vs 154.1k tok/s, within spread) and
         # slower standalone; one whole-T block keeps the simplest schedule
         qs = 1
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     if key_mask is not None:
         out3 = _short_masked(fold(q), fold(k), fold(v),
                              key_mask.astype(jnp.float32), h, causal, g,

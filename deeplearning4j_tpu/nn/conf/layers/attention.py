@@ -27,6 +27,12 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
     head_size: int = 0            # inferred as n_out // num_heads
     causal: bool = False
     project_out: bool = True
+    #: compute q/k/v as ONE [n_in, 3·inner] matmul (params stay separate
+    #: Wq/Wk/Wv tensors; the concat rides inside the jitted step).
+    #: MEASURED SLOWER on the flagship LM (135.5k vs 139.9k tok/s — the
+    #: per-step concat of 3.5 MB of weights costs more than the wider
+    #: matmul saves), so it stays opt-in (BASELINE.md r5)
+    fused_qkv: bool = False
 
     def _head_size(self) -> int:
         return self.head_size or max(self.n_out // self.num_heads, 1)
@@ -54,9 +60,18 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         x = self.maybe_dropout(x, train=train, rng=rng)
         n, t, _ = x.shape
         hcount, hs = self.num_heads, self._head_size()
-        q = (x @ params["Wq"]).reshape(n, t, hcount, hs)
-        k = (x @ params["Wk"]).reshape(n, t, hcount, hs)
-        v = (x @ params["Wv"]).reshape(n, t, hcount, hs)
+        inner = hcount * hs
+        if getattr(self, "fused_qkv", False):
+            w = jnp.concatenate([params["Wq"], params["Wk"],
+                                 params["Wv"]], axis=1)
+            qkv = x @ w
+            q = qkv[..., :inner].reshape(n, t, hcount, hs)
+            k = qkv[..., inner:2 * inner].reshape(n, t, hcount, hs)
+            v = qkv[..., 2 * inner:].reshape(n, t, hcount, hs)
+        else:
+            q = (x @ params["Wq"]).reshape(n, t, hcount, hs)
+            k = (x @ params["Wk"]).reshape(n, t, hcount, hs)
+            v = (x @ params["Wv"]).reshape(n, t, hcount, hs)
         helper = get_helper("attention")
         out = helper(self, q, k, v, mask) if helper is not None else None
         if out is None:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import html as _html
 import json
+import re as _re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Type
 
@@ -35,15 +36,37 @@ def register_component(cls):
     return cls
 
 
+#: CSS color tokens (#hex / names) — style values render into SVG
+#: attributes, and component JSON may come from external front ends, so
+#: anything else is replaced (markup injection guard; text content is
+#: escaped separately)
+_SAFE_COLOR = _re.compile(r"^(#[0-9a-fA-F]{3,8}|[a-zA-Z]{1,30})$")
+
+
+def _safe_color(value: str, fallback: str) -> str:
+    return value if _SAFE_COLOR.match(str(value)) else fallback
+
+
 @dataclass
 class Style:
     """Subset of the reference's StyleChart/StyleDiv/StyleTable surface
-    that the renderers consume."""
+    that the renderers consume. Color values are validated against a CSS
+    color pattern at construction — style JSON is as untrusted as the
+    rest of the component tree."""
     width: int = 640
     height: int = 260
     background: str = "#ffffff"
     series_colors: Sequence[str] = field(default_factory=lambda: PALETTE)
     margin: int = 36
+
+    def __post_init__(self):
+        self.width = int(self.width)
+        self.height = int(self.height)
+        self.margin = int(self.margin)
+        self.background = _safe_color(self.background, "#ffffff")
+        self.series_colors = [_safe_color(c, PALETTE[i % len(PALETTE)])
+                              for i, c in enumerate(self.series_colors)] \
+            or PALETTE
 
     def to_dict(self):
         return {"width": self.width, "height": self.height,
@@ -80,6 +103,17 @@ class Component:
         raise NotImplementedError
 
     # -- svg helpers ----------------------------------------------------
+    def _legend(self, i: int, name: str, color: str) -> str:
+        return (f'<text x="{self.style.width - 120}" y="{16 + 13 * i}" '
+                f'font-size="11" fill="{color}">'
+                f'{_html.escape(name)}</text>')
+
+    def _title(self, title: str) -> str:
+        if not title:
+            return ""
+        return (f'<text x="{self.style.margin}" y="14" font-size="12" '
+                f'font-weight="bold">{_html.escape(title)}</text>')
+
     def _frame(self, body: str) -> str:
         s = self.style
         return (f'<svg xmlns="http://www.w3.org/2000/svg" '
@@ -163,13 +197,8 @@ class ChartLine(Component):
             color = colors[i % len(colors)]
             body += (f'<polyline points="{pts}" fill="none" '
                      f'stroke="{color}" stroke-width="1.5"/>')
-            body += (f'<text x="{self.style.width - 120}" '
-                     f'y="{16 + 13 * i}" font-size="11" fill="{color}">'
-                     f'{_html.escape(name)}</text>')
-        if self.title:
-            body += (f'<text x="{self.style.margin}" y="14" '
-                     f'font-size="12" font-weight="bold">'
-                     f'{_html.escape(self.title)}</text>')
+            body += self._legend(i, name, color)
+        body += self._title(self.title)
         return self._frame(body)
 
 
@@ -188,13 +217,8 @@ class ChartScatter(ChartLine):
             body += "".join(
                 f'<circle cx="{px(x):.1f}" cy="{py(y):.1f}" r="2.5" '
                 f'fill="{color}"/>' for x, y in zip(xs, ys))
-            body += (f'<text x="{self.style.width - 120}" '
-                     f'y="{16 + 13 * i}" font-size="11" fill="{color}">'
-                     f'{_html.escape(name)}</text>')
-        if self.title:
-            body += (f'<text x="{self.style.margin}" y="14" '
-                     f'font-size="12" font-weight="bold">'
-                     f'{_html.escape(self.title)}</text>')
+            body += self._legend(i, name, color)
+        body += self._title(self.title)
         return self._frame(body)
 
 
@@ -246,10 +270,7 @@ class ChartHistogram(Component):
                      f'width="{max(x1 - x0 - 1, 1):.1f}" '
                      f'height="{max(py(0) - y0, 0):.1f}" fill="{color}" '
                      f'fill-opacity="0.8"/>')
-        if self.title:
-            body += (f'<text x="{self.style.margin}" y="14" '
-                     f'font-size="12" font-weight="bold">'
-                     f'{_html.escape(self.title)}</text>')
+        body += self._title(self.title)
         return self._frame(body)
 
 
@@ -283,14 +304,9 @@ class ChartStackedArea(ChartLine):
             color = colors[i % len(colors)]
             body += (f'<polygon points="{up} {down}" fill="{color}" '
                      f'fill-opacity="0.55" stroke="{color}"/>')
-            body += (f'<text x="{self.style.width - 120}" '
-                     f'y="{16 + 13 * i}" font-size="11" fill="{color}">'
-                     f'{_html.escape(name)}</text>')
+            body += self._legend(i, name, color)
             prev = tops
-        if self.title:
-            body += (f'<text x="{self.style.margin}" y="14" '
-                     f'font-size="12" font-weight="bold">'
-                     f'{_html.escape(self.title)}</text>')
+        body += self._title(self.title)
         return self._frame(body)
 
 
@@ -349,9 +365,7 @@ class ChartTimeline(Component):
                          f'height="{lane_h - 3}" fill="{color}" '
                          f'fill-opacity="0.8">'
                          f'<title>{_html.escape(lbl)}</title></rect>')
-        if self.title:
-            body += (f'<text x="{s.margin}" y="14" font-size="12" '
-                     f'font-weight="bold">{_html.escape(self.title)}</text>')
+        body += self._title(self.title)
         return self._frame(body)
 
 

@@ -3,6 +3,11 @@ forward+backward, full train step (fwd+bwd+updater). Also prints XLA
 cost-analysis FLOPs -> measured MFU."""
 import time, json, sys
 import numpy as np
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax, jax.numpy as jnp
 
 from deeplearning4j_tpu.models import resnet50_conf

@@ -2,6 +2,11 @@
 time breakdown (uses tensorboard_plugin_profile's converters, no UI)."""
 import glob, json, os, sys
 import numpy as np
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax, jax.numpy as jnp
 
 from deeplearning4j_tpu.models import resnet50_conf

@@ -128,3 +128,18 @@ class TestReportExport:
         html = out.read_text()
         assert "Phase timeline" in html
         assert "<td>broadcast</td>" in html
+
+
+class TestStyleSanitization:
+    def test_style_injection_blocked(self):
+        """Style JSON is as untrusted as the rest of the component tree
+        (component_from_json is the external front-end contract): color
+        values render into SVG attributes and must not carry markup."""
+        evil = json.dumps({
+            "componentType": "ChartLine",
+            "style": {"background": '#fff"></svg><script>alert(1)</script>',
+                      "seriesColors": ['"><script>x</script>']}})
+        c = component_from_json(evil)
+        page = render_page(c)
+        assert "<script>" not in page
+        assert c.style.background == "#ffffff"       # fallback applied
