@@ -29,25 +29,31 @@ for plane in xs.planes:
             continue
         for ev in line.events:
             name = evmeta[ev.metadata_id].name
+            # classify on the op SYMBOL — substring tests over the full
+            # text mis-bucketed every op whose operand list mentioned a
+            # custom-call result (r5: 58.7 ms landed in 'custom-call')
+            sym = name.split(' = ')[0]
             us = ev.duration_ps / 1e6
             total += us
             if '32000' in name:
                 b = 'vocab/CE complex'
-            elif 'custom-call' in name:
+            elif 'custom-call' in sym or sym.startswith('%run'):
+                # Pallas kernels lower to custom-calls named %run.N
                 b = 'custom-call (attention kernel / host)'
-            elif re.search(r'%(convolution|dot|fusion.*dot)', name) or \
-                    name.startswith('%dot'):
-                b = 'matmul'
-            elif 'copy' in name:
+            elif 'copy' in sym:
                 b = 'copies'
-            elif 'divide_subtract' in name or 'subtract_multiply' in name:
+            elif re.search(r'(convolution|dot)', sym):
+                b = 'matmul fusions'
+            elif 'transpose' in sym:
+                b = 'transposes'
+            elif 'divide_subtract' in sym or 'subtract_multiply' in sym:
                 b = 'updater'
             else:
                 b = 'other fusions/elementwise'
             buckets[b] += us
-            names[b][re.sub(r'[.\d]+$', '', name.split(' = ')[0])] += us
+            names[b][re.sub(r'[.\d]+$', '', sym)] += us
     print(f'total sync device time: {total/STEPS/1000:.1f} ms/step')
     for b, us in buckets.most_common():
         print(f'  {b:42s} {us/STEPS/1000:8.2f} ms/step')
-        for n, nus in names[b].most_common(6):
+        for n, nus in names[b].most_common(10):
             print(f'      {n:50s} {nus/STEPS/1000:8.2f}')
