@@ -17,6 +17,15 @@ Modes (BENCH_MODE):
   transformer  r3 flagship: GPT-2-small-ish causal LM (12x768, 12 heads,
            T=512, vocab 32k, bf16) tokens/sec through the graph train
            step.
+  generate r6 serving path: KV-cache autoregressive decoding on the
+           flagship LM — prefill tok/s, steady-state decode tok/s,
+           per-token p50/p99 latency, the decode-vs-recompute (no-cache)
+           A/B at prompt T=512, and the continuous-batching A/B (mixed
+           length stream, slot refill on vs off). Knobs: BENCH_GEN_BATCH
+           (32), BENCH_GEN_PROMPT (512), BENCH_GEN_STEPS (64 decode
+           steps timed), BENCH_GEN_NOCACHE_STEPS (8), plus
+           BENCH_GEN_DMODEL/HEADS/LAYERS/VOCAB to shrink the model for
+           smoke runs.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
@@ -68,6 +77,8 @@ TRANSFORMER_BASELINE = float(
 LENET_BASELINE = float(os.environ.get("BENCH_LENET_BASELINE", "") or 6488.67)
 WORD2VEC_BASELINE = float(
     os.environ.get("BENCH_W2V_BASELINE", "") or 194_000.0)
+# first recording pending (r6 introduces the metric); 0 -> vs_baseline 1.0
+GEN_DECODE_BASELINE = float(os.environ.get("BENCH_GEN_BASELINE", "") or 0.0)
 
 # batch 128 is the measured single-chip sweet spot (r2 honest sweep:
 # 128→2747, 256→2577, 512→2488 img/s on the raw step path)
@@ -253,6 +264,161 @@ def _transformer_measure():
     return measure
 
 
+def _build_gen_decoder():
+    """Flagship LM + TransformerDecoder for the generate mode; max_length
+    covers prompt + generation so position embeddings exist for every
+    decoded slot."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import (TransformerDecoder,
+                                           transformer_lm_conf)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    v = int(os.environ.get("BENCH_GEN_VOCAB", "32000"))
+    d = int(os.environ.get("BENCH_GEN_DMODEL", "768"))
+    h = int(os.environ.get("BENCH_GEN_HEADS", "12"))
+    nl = int(os.environ.get("BENCH_GEN_LAYERS", "12"))
+    b = int(os.environ.get("BENCH_GEN_BATCH", "32"))
+    tp = int(os.environ.get("BENCH_GEN_PROMPT", "512"))
+    steps = int(os.environ.get("BENCH_GEN_STEPS", "64"))
+    conf = transformer_lm_conf(vocab_size=v, d_model=d, num_heads=h,
+                               num_layers=nl, max_length=tp + steps + 1)
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
+    return TransformerDecoder(net), v, b, tp, steps
+
+
+def _generate_result() -> dict:
+    """BENCH_MODE=generate: the KV-cache serving-path protocol. Headline:
+    steady-state decode tokens/sec (emitted tokens, context >= prompt
+    length), median of BENCH_RUNS after warmup. Side metrics: prefill
+    tok/s, per-token p50/p99 latency (with the per-step host sync real
+    serving does), the NO-CACHE recompute baseline (same fixed-bucket
+    program models.generate runs: full forward per emitted token), their
+    ratio, and the continuous-batching A/B (mixed-length stream, slot
+    refill on vs off) in emitted tok/s."""
+    from deeplearning4j_tpu.models import SlotGenerationEngine
+
+    dec, v, b, tp, steps = _build_gen_decoder()
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, v, (b, tp)).astype(np.int32)
+    lengths = np.full(b, tp, np.int32)
+
+    # ---- prefill ----
+    def prefill_once():
+        caches = dec.init_cache(b)
+        t0 = time.perf_counter()
+        nxt, _, caches = dec.prefill(caches, tokens, lengths)
+        np.asarray(nxt)                      # sync
+        return b * tp / (time.perf_counter() - t0), caches, nxt
+
+    _, caches, nxt = prefill_once()          # warmup (compile)
+    pre_med, pre_spread, pre_runs = _median_runs(
+        lambda: prefill_once()[0])
+
+    # ---- steady decode (throughput: sync once at the end) ----
+    ids0 = np.asarray(nxt)
+    pos0 = lengths.copy()
+
+    def decode_run():
+        ids, pos = ids0, pos0.copy()
+        cs = caches
+        t0 = time.perf_counter()
+        for s in range(steps):
+            nx, _, cs = dec.decode_step(cs, ids, pos)
+            ids = nx
+            pos = pos + 1
+        np.asarray(ids)                      # sync the chain
+        return b * steps / (time.perf_counter() - t0)
+
+    # NOTE: decode_step donates the cache on donating backends; rebuild a
+    # fresh prefill per timed run so each run owns a live cache
+    def decode_once():
+        nonlocal caches, nxt
+        _, caches, nxt = prefill_once()
+        return decode_run()
+
+    decode_once()                            # warmup decode compile
+    dec_med, dec_spread, dec_runs = _median_runs(decode_once)
+
+    # ---- per-token latency (per-step host sync, the serving pattern) ----
+    _, cs, nx = prefill_once()
+    ids, pos = np.asarray(nx), lengths.copy()
+    lat = []
+    for s in range(steps):
+        t0 = time.perf_counter()
+        nx, _, cs = dec.decode_step(cs, ids, pos)
+        ids = np.asarray(nx)                 # the [B] ids host read
+        lat.append(time.perf_counter() - t0)
+        pos = pos + 1
+    p50 = float(np.percentile(lat, 50) * 1e3)
+    p99 = float(np.percentile(lat, 99) * 1e3)
+
+    # ---- no-cache recompute baseline ----
+    nc_steps = int(os.environ.get("BENCH_GEN_NOCACHE_STEPS", "8"))
+    dec.recompute_logits(tokens, lengths)    # warmup
+
+    def nocache_once():
+        t0 = time.perf_counter()
+        for _ in range(nc_steps):
+            ids_nc, _ = dec.recompute_logits(tokens, lengths)
+        np.asarray(ids_nc)
+        return b * nc_steps / (time.perf_counter() - t0)
+
+    nc_med, nc_spread, nc_runs = _median_runs(nocache_once)
+
+    # ---- continuous batching A/B: mixed-length stream ----
+    slots = int(os.environ.get("BENCH_GEN_SLOTS", "8"))
+    n_req = int(os.environ.get("BENCH_GEN_REQUESTS", str(4 * slots)))
+    req_rng = np.random.default_rng(7)
+    plens = req_rng.integers(max(8, tp // 8), max(16, tp // 2), n_req)
+    gens = req_rng.integers(max(4, steps // 4), steps + 1, n_req)
+    prompts = [req_rng.integers(0, v, n).astype(np.int32) for n in plens]
+
+    def batching_run(refill: bool) -> float:
+        # decoder shared across engine instances: one set of compiled
+        # slot-prefill/decode programs serves every A/B run
+        eng = SlotGenerationEngine(dec.net, num_slots=slots,
+                                   refill=refill, decoder=dec)
+        for p, g in zip(prompts, gens):
+            eng.submit(p, int(g))
+        t0 = time.perf_counter()
+        eng.run_until_drained()
+        return eng.emitted_tokens / (time.perf_counter() - t0)
+
+    batching_run(True)                       # warmup slot-prefill compiles
+    ab_on = float(np.median([batching_run(True) for _ in range(RUNS)]))
+    ab_off = float(np.median([batching_run(False) for _ in range(RUNS)]))
+
+    return {
+        "metric": "lm_generate_decode_tokens_per_sec",
+        "value": round(dec_med, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(dec_med / GEN_DECODE_BASELINE, 4)
+        if GEN_DECODE_BASELINE > 0 else 1.0,
+        "spread_pct": dec_spread, "runs": dec_runs,
+        "side_metrics": {
+            "prefill_tokens_per_sec": {
+                "value": round(pre_med, 2), "spread_pct": pre_spread,
+                "runs": pre_runs},
+            "decode_token_latency_ms": {"p50": round(p50, 3),
+                                        "p99": round(p99, 3)},
+            "nocache_recompute_tokens_per_sec": {
+                "value": round(nc_med, 2), "spread_pct": nc_spread,
+                "runs": nc_runs},
+            "decode_vs_recompute_speedup": round(dec_med / nc_med, 2)
+            if nc_med > 0 else None,
+            "continuous_batching": {
+                "refill_on_tokens_per_sec": round(ab_on, 2),
+                "refill_off_tokens_per_sec": round(ab_off, 2),
+                "refill_speedup": round(ab_on / ab_off, 3)
+                if ab_off > 0 else None,
+                "slots": slots, "requests": n_req},
+            "config": {"batch": b, "prompt_t": tp, "decode_steps": steps,
+                       "vocab": v},
+        },
+    }
+
+
 def _lenet() -> float:
     """BASELINE config #1: LeNet-MNIST through the full fit(iterator) path
     (synthetic MNIST). One epoch warms compile + first transfers, then the
@@ -324,6 +490,14 @@ def _side_metrics() -> dict:
     except Exception as e:  # noqa: BLE001
         side["transformer_lm_train_tokens_per_sec"] = {"error": str(e)[:200]}
     try:
+        gen = _generate_result()
+        side["lm_generate"] = {k: gen[k] for k in
+                               ("metric", "value", "unit", "vs_baseline",
+                                "spread_pct", "runs")}
+        side["lm_generate"].update(gen["side_metrics"])
+    except Exception as e:  # noqa: BLE001
+        side["lm_generate"] = {"error": str(e)[:200]}
+    try:
         record("lenet_mnist_fit_images_per_sec", _lenet(), "images/sec",
                LENET_BASELINE)
     except Exception as e:  # noqa: BLE001
@@ -351,6 +525,9 @@ def _side_metrics() -> dict:
 
 
 def main() -> int:
+    if MODE == "generate":
+        print(json.dumps(_generate_result()))
+        return 0
     if MODE == "transformer":
         med, spread, k = _median_runs(_transformer_measure())
         print(json.dumps({

@@ -39,6 +39,10 @@ NEG = -1e30
 # block 2-D-tileable while costing 1/16 the footprint of a 128-lane row)
 ROWW = 8
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 
 def _scores(q_ref, k_ref, qi, ki, qb, kb, causal, scale, mask_ref=None):
     """Scaled q·kᵀ block with the causal −1e30 replacement mask — shared by
@@ -242,7 +246,7 @@ def _flash_fwd_impl(q3, k3, v3, mask2, h, causal, qb, kb, interpret):
             pltpu.VMEM((qb, 128), jnp.float32),
             pltpu.VMEM((qb, 128), jnp.float32),
             pltpu.VMEM((qb, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(*operands)
     return o, lse
@@ -283,7 +287,7 @@ def _flash_bwd_impl(q3, k3, v3, mask2, h, o, lse, do, causal, qb, kb,
         out_specs=_specs(qb, d, "q"),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((qb, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(*dq_operands)
 
@@ -314,7 +318,7 @@ def _flash_bwd_impl(q3, k3, v3, mask2, h, o, lse, do, causal, qb, kb,
                    jax.ShapeDtypeStruct((bh, t, d), q3.dtype)],
         scratch_shapes=[pltpu.VMEM((kb, d), jnp.float32),
                         pltpu.VMEM((kb, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(*kv_operands)
     return dq, dk, dv
@@ -411,15 +415,33 @@ def make_pallas_flash_helper(min_seq_len: int = 1024,
     long-context to the jnp blockwise path and lost the 2-2.8x win on
     ragged batches). Below min_seq_len, tile-aligned 256 ≤ T ≤ 512 takes
     the whole-block short-T kernel pair (kernels/pallas_shortseq.py —
-    +10% measured on the T=512 flagship LM in-graph, BASELINE.md r5);
+    +10% measured on the T=512 flagship LM in-graph, BASELINE.md r5),
+    gated on known-good shapes (D % 8 == 0, float dtypes) with kernel
+    construction failures declining to the materialized safety net;
     other short shapes keep the materialized path."""
     def helper(conf, q, k, v, mask):
         t = q.shape[1]
         if t < min_seq_len:
             from .pallas_shortseq import MAX_T, short_attention
-            if short_t and 256 <= t <= MAX_T and t % 128 == 0:
-                return short_attention(q, k, v, causal=conf.causal,
-                                       key_mask=mask, interpret=interpret)
+            # the short-T route is DEFAULT-on, so it only takes shapes the
+            # kernel is known good for: 128-lane-friendly head dims and
+            # float dtypes (Mosaic may fail to lower odd D / exotic dtypes
+            # — the failure mode the 4-D-native rejection documents);
+            # everything else declines to the materialized safety net.
+            # The try/except additionally declines on TRACE-TIME
+            # construction errors (shape validation, eager/interpret
+            # runs); a Mosaic failure at XLA compile time surfaces after
+            # this helper returned, so the shape/dtype gate above is the
+            # protection for the jitted path.
+            if short_t and 256 <= t <= MAX_T and t % 128 == 0 and \
+                    q.shape[-1] % 8 == 0 and \
+                    jnp.issubdtype(q.dtype, jnp.floating):
+                try:
+                    return short_attention(q, k, v, causal=conf.causal,
+                                           key_mask=mask,
+                                           interpret=interpret)
+                except Exception:
+                    return None          # kernel declined; built-in path
             return None                      # tiny: materialized path wins
         return pallas_flash_attention(q, k, v, causal=conf.causal,
                                       q_block=q_block, k_block=k_block,

@@ -46,6 +46,10 @@ from jax.experimental.pallas import tpu as pltpu
 NEG = -1e30
 ROWW = 8          # row-scalar carrier width, matches pallas_attention.ROWW
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 #: largest T the whole-block kernel accepts (one [T, T] f32 logits tile
 #: per head must fit VMEM alongside its neighbors)
 MAX_T = 512
@@ -257,7 +261,7 @@ def _short_fwd_impl(q3, k3, v3, mask2, h, causal, g_heads, interpret,
                    pl.BlockSpec((g, t, ROWW), lambda i: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
                    jax.ShapeDtypeStruct((bh, t, ROWW), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             # "parallel": grid steps are independent (the constant-index
             # amask fetch has no cross-step ordering need), freeing Mosaic
             # to pipeline DMA against compute across steps
@@ -311,7 +315,7 @@ def _short_bwd_impl(q3, k3, v3, mask2, h, o, lse, do, causal, g_heads,
         out_specs=[_gspec(g, t, d)] * 3,
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype)] * 3,
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",),
             vmem_limit_bytes=96 * 1024 * 1024),
     )(*operands)

@@ -6,9 +6,12 @@ from .resnet import resnet_conf, resnet50_conf, resnet_tiny_conf
 from .vgg16 import (vgg16_conf, VGG16ImagePreProcessor, ImageNetLabels,
                     TrainedModels)
 from .transformer import (transformer_lm_conf, lm_batch, lm_batch_sparse, generate)
+from .generation import (TransformerDecoder, SlotGenerationEngine,
+                         GenerationRequest)
 
 __all__ = ["lenet_conf", "char_rnn_conf", "CharacterIterator",
            "transformer_lm_conf", "lm_batch", "lm_batch_sparse", "generate",
+           "TransformerDecoder", "SlotGenerationEngine", "GenerationRequest",
            "resnet_conf", "resnet50_conf", "resnet_tiny_conf",
            "vgg16_conf", "VGG16ImagePreProcessor", "ImageNetLabels",
            "TrainedModels"]

@@ -102,12 +102,17 @@ def generate(net: ComputationGraph, prompt_ids, length: int,
              temperature: float = 1.0,
              rng: Optional[np.random.Generator] = None,
              bucket: Optional[int] = None) -> np.ndarray:
-    """Autoregressive sampling: the context is right-padded to a fixed
-    ``bucket`` length (default: the model's max_length) and the logit at
-    the true last position is read — causal attention never looks right,
-    so padding is invisible and every step reuses ONE compiled program
-    (a growing context would recompile per token: ~10 s each through a
-    tunneled TPU). Greedy when temperature == 0."""
+    """Autoregressive sampling WITHOUT a KV cache: every emitted token
+    recomputes the full O(T²) forward over the padded bucket. This is the
+    no-cache reference baseline (decode-vs-recompute A/B in
+    BENCH_MODE=generate); the serving path is models/generation.py's
+    TransformerDecoder, which prefills once and decodes O(T) per token.
+    The context is right-padded to a fixed ``bucket`` length (default:
+    the model's max_length) and the logit at the true last position is
+    read — causal attention never looks right, so padding is invisible
+    and every step reuses ONE compiled program (a growing context would
+    recompile per token: ~10 s each through a tunneled TPU). Greedy when
+    temperature == 0."""
     rng = rng or np.random.default_rng(0)
     ids = list(np.asarray(prompt_ids, np.int32).reshape(-1))
     if bucket is None:

@@ -12,6 +12,7 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..input_type import InputType
 from ..serde import register_config
@@ -56,8 +57,8 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
     def regularizable(self):
         return ("Wq", "Wk", "Wv", "Wo")
 
-    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        x = self.maybe_dropout(x, train=train, rng=rng)
+    def _project_qkv(self, params, x):
+        """x [N, T, n_in] → (q, k, v) each [N, T, H, Dh]."""
         n, t, _ = x.shape
         hcount, hs = self.num_heads, self._head_size()
         inner = hcount * hs
@@ -72,14 +73,22 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
             q = (x @ params["Wq"]).reshape(n, t, hcount, hs)
             k = (x @ params["Wk"]).reshape(n, t, hcount, hs)
             v = (x @ params["Wv"]).reshape(n, t, hcount, hs)
+        return q, k, v
+
+    def _attend(self, q, k, v, mask, dtype):
+        """Full [N, T, H, Dh] attention through the helper seam (flash /
+        short-T Pallas kernels) with the materialized-softmax path as the
+        always-available fallback. Returns [N, T, H, Dh]."""
+        hs = self._head_size()
+        t = q.shape[1]
         helper = get_helper("attention")
         out = helper(self, q, k, v, mask) if helper is not None else None
         if out is None:
             # no helper, or the helper declined (e.g. flash kernel below
             # its min_seq_len): built-in materialized-softmax path
-            scale = 1.0 / jnp.sqrt(jnp.asarray(hs, x.dtype))
+            scale = 1.0 / jnp.sqrt(jnp.asarray(hs, dtype))
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            neg = jnp.asarray(-1e30, x.dtype)
+            neg = jnp.asarray(-1e30, dtype)
             if self.causal:
                 cmask = jnp.tril(jnp.ones((t, t), bool))
                 logits = jnp.where(cmask[None, None], logits, neg)
@@ -88,10 +97,87 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
                 logits = jnp.where(key_keep, logits, neg)
             probs = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-        out = out.reshape(n, t, hcount * hs)
+        return out
+
+    def _project_out(self, params, out):
+        """[N, T, H, Dh] heads → activation([N, T, n_out])."""
+        n, t = out.shape[:2]
+        out = out.reshape(n, t, self.num_heads * self._head_size())
         if self.project_out:
             out = out @ params["Wo"] + params["bo"]
-        return self.activation_fn()(out), state
+        return self.activation_fn()(out)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        q, k, v = self._project_qkv(params, x)
+        out = self._attend(q, k, v, mask, x.dtype)
+        return self._project_out(params, out), state
+
+    # ---- KV-cache autoregressive decoding (models/generation.py) ----
+    def init_cache(self, batch: int, t_max: int, dtype=jnp.float32) -> Dict:
+        """Preallocated decode cache: {"k", "v"} each [B, H, T_max, Dh]."""
+        if not self.causal:
+            raise ValueError("KV-cache decoding needs causal=True "
+                             "(autoregressive attention)")
+        hs = self._head_size()
+        shape = (batch, self.num_heads, t_max, hs)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def prefill_forward(self, params, x, cache: Dict, mask=None):
+        """Teacher-forced pass over the prompt [B, T, n_in] that also fills
+        cache[:, :, :T] with this layer's k/v — attention itself rides the
+        SAME helper seam as forward() (flash / short-T Pallas kernels), so
+        prefill costs one ordinary forward. Positions beyond a row's true
+        length carry garbage k/v; decode_forward's length mask never
+        attends to them. Returns (out [B, T, n_out], new_cache)."""
+        q, k, v = self._project_qkv(params, x)
+        out = self._attend(q, k, v, mask, x.dtype)
+        new_cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], k.transpose(0, 2, 1, 3).astype(cache["k"].dtype),
+                (0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], v.transpose(0, 2, 1, 3).astype(cache["v"].dtype),
+                (0, 0, 0, 0))}
+        return self._project_out(params, out), new_cache
+
+    def decode_forward(self, params, x, cache: Dict, positions):
+        """One decode step: x [B, 1, n_in] is the token at ``positions``
+        ([B] int32, per-row — slots in a continuous batch sit at different
+        lengths). Writes k/v into the cache at each row's position
+        (vmapped ``lax.dynamic_update_slice`` — fixed-shape, ONE compile
+        serves every step) and attends q over cache[:, :, :pos+1] via a
+        length mask. Routed through the kind="decode_attention" helper
+        seam so a future Pallas decode kernel can slot in; the built-in
+        path is length-masked dot-product attention with f32 softmax.
+        Returns (out [B, 1, n_out], new_cache)."""
+        q, k, v = self._project_qkv(params, x)       # [B, 1, H, Dh]
+        pos = jnp.asarray(positions, jnp.int32).reshape(-1)
+        zero = jnp.zeros((), jnp.int32)   # match pos dtype under x64 mode
+        upd = lambda c, u, p: jax.lax.dynamic_update_slice(c, u,
+                                                           (zero, p, zero))
+        new_cache = {
+            "k": jax.vmap(upd)(cache["k"],
+                               k.transpose(0, 2, 1, 3).astype(
+                                   cache["k"].dtype), pos),
+            "v": jax.vmap(upd)(cache["v"],
+                               v.transpose(0, 2, 1, 3).astype(
+                                   cache["v"].dtype), pos)}
+        ck, cv = new_cache["k"], new_cache["v"]
+        helper = get_helper("decode_attention")
+        out = helper(self, q, ck, cv, pos) if helper is not None else None
+        if out is None:
+            hs = self._head_size()
+            scale = 1.0 / np.sqrt(hs)
+            logits = jnp.einsum("bhd,bhtd->bht", q[:, 0], ck,
+                                preferred_element_type=jnp.float32) * scale
+            kpos = jnp.arange(ck.shape[2], dtype=jnp.int32)
+            keep = kpos[None, :] <= pos[:, None]            # [B, T_max]
+            logits = jnp.where(keep[:, None, :], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)          # f32
+            out = jnp.einsum("bht,bhtd->bhd", probs.astype(cv.dtype), cv)
+            out = out[:, None]                               # [B, 1, H, Dh]
+        return self._project_out(params, out.astype(x.dtype)), new_cache
 
 
 @register_config
@@ -192,3 +278,11 @@ class TokenAndPositionEmbedding(BaseRecurrentLayerConf):
                              f"{self.max_length}")
         out = params["W"][ids] + params["P"][None, :t]
         return self.maybe_dropout(out, train=train, rng=rng), state
+
+    def embed_at(self, params, ids, positions):
+        """Single-position decode embedding: ids [B] + per-row positions
+        [B] → [B, 1, n_out]. The decode loop guards positions <
+        max_length; no dropout (inference only)."""
+        ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        pos = jnp.asarray(positions, jnp.int32).reshape(-1)
+        return (params["W"][ids] + params["P"][pos])[:, None, :]

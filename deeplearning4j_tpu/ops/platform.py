@@ -14,6 +14,19 @@ from __future__ import annotations
 import os
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: top-level ``jax.shard_map`` with
+    ``check_vma`` on current releases, ``jax.experimental.shard_map`` with
+    the equivalent ``check_rep`` flag on older ones."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def train_donate_argnums(default=(0, 1, 2)):
     """donate_argnums for jitted train steps, chosen per backend/env."""
     env = os.environ.get("DL4J_TPU_DONATE")

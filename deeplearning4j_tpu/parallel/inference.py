@@ -30,16 +30,22 @@ class InferenceMode:
 class ParallelInference:
     def __init__(self, net, mesh: Optional[Mesh] = None,
                  inference_mode: str = InferenceMode.BATCHED,
-                 max_batch_size: int = 64, queue_timeout: float = 0.005):
+                 max_batch_size: int = 64, queue_timeout: float = 0.005,
+                 generation_slots: int = 8,
+                 generation_t_max: Optional[int] = None):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
         self.max_batch_size = int(max_batch_size)
         self.queue_timeout = queue_timeout
+        self.generation_slots = int(generation_slots)
+        self.generation_t_max = generation_t_max
         self._jit_fwd = None
         self._lock = threading.Lock()
         self._requests: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
+        self._gen_engine = None
+        self._gen_lock = threading.Lock()
         self._shutdown = False
 
     class Builder:
@@ -147,5 +153,46 @@ class ParallelInference:
                     slot["error"] = e
                     done.set()
 
+    # --- batched autoregressive generation (models/generation.py) ---
+    def _ensure_gen_engine(self):
+        """Lazily start the shared slot-based continuous-batching engine:
+        concurrent generate() callers coalesce into ONE fixed-shape decode
+        loop (the BATCHED-mode coalescing idea applied to the
+        autoregressive workload); a caller finishing frees its cache slot
+        mid-loop for the next queued prompt."""
+        with self._gen_lock:
+            if self._shutdown:
+                raise RuntimeError("ParallelInference is shut down")
+            if self._gen_engine is None:
+                from ..models.generation import SlotGenerationEngine
+                self._gen_engine = SlotGenerationEngine(
+                    self.net, num_slots=self.generation_slots,
+                    t_max=self.generation_t_max).start()
+            return self._gen_engine
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, eos_id=None,
+                 timeout: Optional[float] = None):
+        """Generate a continuation for ONE prompt (1-D int array) through
+        the shared continuous-batching engine; blocks until complete and
+        returns the full [prompt + generated] id array. Thread-safe —
+        concurrent callers share the device batch."""
+        engine = self._ensure_gen_engine()
+        req = engine.submit(prompt_ids, max_new_tokens,
+                            temperature=temperature, eos_id=eos_id)
+        return req.result(timeout)
+
+    def generate_async(self, prompt_ids, max_new_tokens: int,
+                       temperature: float = 0.0, eos_id=None):
+        """Queue a prompt and return its GenerationRequest handle
+        (``.result()`` blocks; ``.done()`` polls)."""
+        return self._ensure_gen_engine().submit(
+            prompt_ids, max_new_tokens, temperature=temperature,
+            eos_id=eos_id)
+
     def shutdown(self):
         self._shutdown = True
+        with self._gen_lock:
+            if self._gen_engine is not None:
+                self._gen_engine.shutdown()
+                self._gen_engine = None

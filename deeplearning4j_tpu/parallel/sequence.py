@@ -268,9 +268,10 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                              causal, blk, blk, interpret)
             return o3.reshape(bl, hl, tl, dl).transpose(0, 2, 1, 3)
 
-        return jax.shard_map(ring_kernel, mesh=mesh,
-                             in_specs=(spec, spec, spec), out_specs=spec,
-                             check_vma=False)(q, k, v)
+        from ..ops.platform import shard_map_compat
+        return shard_map_compat(ring_kernel, mesh=mesh,
+                                in_specs=(spec, spec, spec), out_specs=spec,
+                                check_vma=False)(q, k, v)
 
     def ring(ql, kl, vl):
         b, t_local, h, d = ql.shape
@@ -299,8 +300,9 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         denom = jnp.transpose(jnp.maximum(l, 1e-20), (0, 2, 1))[..., None]
         return o / denom
 
-    return jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from ..ops.platform import shard_map_compat
+    return shard_map_compat(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(q, k, v)
 
 
 def sequence_sharded(mesh: Mesh, x, axis: str = "sp"):

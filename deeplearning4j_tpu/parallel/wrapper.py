@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ..ops.platform import shard_map_compat as shard_map
 
 from ..ops.dataset import DataSet
 from .mesh import make_mesh

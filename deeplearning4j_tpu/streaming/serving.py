@@ -130,3 +130,105 @@ class ModelServingRoute:
         if self._thread is not None:
             self._thread.join(timeout=2)
         self.sub.close()
+
+
+class GenerationServingRoute:
+    """Autoregressive-generation serve route: consume int token-id prompt
+    arrays from ``input_topic``, generate through a shared slot-based
+    continuous-batching engine (models/generation.py), publish the full
+    [prompt + generated] id arrays to ``output_topic`` in SUBMISSION
+    order — the ModelServingRoute coalescing idea extended to the decode
+    loop, where "coalescing" means prompts from the stream keep the
+    engine's cache slots full while earlier requests are still decoding.
+
+    ``engine`` may be a prebuilt SlotGenerationEngine (shared with other
+    routes/callers) or None to build one from ``net``."""
+
+    def __init__(self, net, broker: MessageBroker,
+                 input_topic: str = "dl4j-gen-input",
+                 output_topic: str = "dl4j-gen-output",
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 eos_id: Optional[int] = None, num_slots: int = 8,
+                 t_max: Optional[int] = None, engine=None,
+                 max_inflight: int = 64):
+        self._owns_engine = engine is None
+        if engine is None:
+            from ..models.generation import SlotGenerationEngine
+            engine = SlotGenerationEngine(net, num_slots=num_slots,
+                                          t_max=t_max)
+        self.engine = engine
+        self.broker = broker
+        self.sub = NDArraySubscriber(broker, input_topic)
+        self.pub = NDArrayPublisher(broker, output_topic)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self._stop = threading.Event()
+        self._consumer: Optional[threading.Thread] = None
+        self._publisher: Optional[threading.Thread] = None
+        self._inflight: "List" = []          # submission-ordered handles
+        self._inflight_lock = threading.Lock()
+        self.max_inflight = max(1, int(max_inflight))
+        self.served = 0
+        self.errors = 0
+
+    def _consume(self) -> None:
+        while not self._stop.is_set():
+            with self._inflight_lock:
+                full = len(self._inflight) >= self.max_inflight
+            if full:
+                # backpressure: stop draining the broker's BOUNDED
+                # (drop-oldest) queue so overload sheds there instead of
+                # growing the engine's pending deque without limit
+                time.sleep(0.02)
+                continue
+            arr = self.sub.poll(timeout=0.1)
+            if arr is None:
+                continue
+            try:
+                prompt = np.asarray(arr).astype(np.int64).reshape(-1)
+                req = self.engine.submit(prompt, self.max_new_tokens,
+                                         temperature=self.temperature,
+                                         eos_id=self.eos_id)
+                with self._inflight_lock:
+                    self._inflight.append(req)
+            except Exception:
+                self.errors += 1             # bad payload must not kill it
+
+    def _publish_in_order(self) -> None:
+        while not self._stop.is_set():
+            with self._inflight_lock:
+                req = self._inflight[0] if self._inflight else None
+            if req is None:
+                time.sleep(0.02)
+                continue
+            try:
+                out = req.result(timeout=0.2)
+            except TimeoutError:
+                continue
+            except Exception:
+                self.errors += 1
+                out = None
+            with self._inflight_lock:
+                self._inflight.pop(0)
+            if out is not None:
+                self.pub.publish(np.asarray(out, np.int32))
+                self.served += 1
+
+    def start(self) -> "GenerationServingRoute":
+        self.engine.start()
+        self._consumer = threading.Thread(target=self._consume, daemon=True)
+        self._publisher = threading.Thread(target=self._publish_in_order,
+                                           daemon=True)
+        self._consumer.start()
+        self._publisher.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in (self._consumer, self._publisher):
+            if t is not None:
+                t.join(timeout=2)
+        if self._owns_engine:                # an injected engine is shared;
+            self.engine.shutdown()           # its owner stops it
+        self.sub.close()

@@ -57,20 +57,67 @@ def _recv_frame(sock: socket.socket) -> Tuple[bytes, str, bytes]:
     return op, topic, body
 
 
+class _Outbound:
+    """Per-connection outbound queue drained by a dedicated writer thread.
+
+    Publishing enqueues (never blocks): a subscriber that stops reading
+    fills its TCP buffer, then its queue, and on overflow is DISCONNECTED
+    — one stalled consumer can no longer head-of-line block delivery to
+    every other subscriber or stop the server reading the publisher's
+    socket (the blocking-sendall failure mode)."""
+
+    def __init__(self, conn: socket.socket, max_queued: int = 256):
+        self.conn = conn
+        self.queue: "queue.Queue[Optional[bytes]]" = queue.Queue(max_queued)
+        self.dropped = False
+        self.thread = threading.Thread(target=self._drain, daemon=True)
+        self.thread.start()
+
+    def _drain(self) -> None:
+        while True:
+            frame = self.queue.get()
+            if frame is None:                # close sentinel
+                return
+            try:
+                self.conn.sendall(frame)
+            except OSError:
+                return                       # reader side cleans up
+
+    def send(self, frame: bytes) -> bool:
+        """Enqueue; False means the consumer overflowed (caller should
+        disconnect it)."""
+        try:
+            self.queue.put_nowait(frame)
+            return True
+        except queue.Full:
+            self.dropped = True
+            return False
+
+    def close(self) -> None:
+        try:
+            self.queue.put_nowait(None)
+        except queue.Full:
+            pass                             # writer dies with the socket
+
+
 class TcpBrokerServer:
     """Topic-fanout server: one accept thread + one reader thread per
-    connection. Forwarding happens on the publisher's reader thread with a
-    per-connection send lock — slow consumers back-pressure the TCP
-    buffers, not the server's memory."""
+    connection + one writer thread per connection. Forwarding enqueues
+    onto the subscriber's outbound queue (bounded, overflow =
+    disconnect) so a stalled subscriber can't block other subscribers or
+    the publisher's reader thread."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_queued_frames: int = 256):
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self._subs: Dict[str, Set[socket.socket]] = defaultdict(set)
-        self._locks: Dict[socket.socket, threading.Lock] = {}
+        self._outs: Dict[socket.socket, _Outbound] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self.max_queued_frames = int(max_queued_frames)
+        self.disconnects = 0                 # stalled-subscriber evictions
 
     @property
     def url(self) -> str:
@@ -89,11 +136,34 @@ class TcpBrokerServer:
             except OSError:
                 return
             with self._lock:
-                self._locks[conn] = threading.Lock()
+                self._outs[conn] = _Outbound(conn, self.max_queued_frames)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
+            # prune finished per-connection threads so a long-lived server
+            # doesn't leak one dead Thread object per connection ever made
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
+
+    def _evict(self, conn: socket.socket) -> None:
+        """Drop a dead/stalled connection from every topic and close it.
+        shutdown() before close(): closing the fd alone does not wake a
+        writer blocked in sendall on a full buffer (or the reader in
+        recv) — both threads and the queued frames would leak."""
+        with self._lock:
+            for subs in self._subs.values():
+                subs.discard(conn)
+            out = self._outs.pop(conn, None)
+        if out is not None:
+            out.close()
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _serve(self, conn: socket.socket) -> None:
         try:
@@ -106,31 +176,33 @@ class TcpBrokerServer:
                     with self._lock:
                         self._subs[topic].discard(conn)
                 elif op == b"P":
+                    t = topic.encode("utf-8")
+                    frame = b"M" + struct.pack(">I", len(t)) + t + \
+                        struct.pack(">Q", len(body)) + body
                     with self._lock:
-                        targets = [(c, self._locks[c])
+                        targets = [(c, self._outs.get(c))
                                    for c in self._subs[topic]]
-                    for c, lk in targets:
-                        try:
-                            _send_frame(c, lk, b"M", topic, body)
-                        except OSError:
-                            with self._lock:
-                                self._subs[topic].discard(c)
+                    for c, out in targets:
+                        if out is None or not out.send(frame):
+                            # overflowed (stalled) or already gone: evict
+                            self.disconnects += 1
+                            self._evict(c)
         except (ConnectionError, struct.error, OSError):
             pass
         finally:
-            with self._lock:
-                for subs in self._subs.values():
-                    subs.discard(conn)
-                self._locks.pop(conn, None)
-            conn.close()
+            self._evict(conn)
 
     def close(self) -> None:
         self._stop.set()
         self._listener.close()
         # close live connections so peers see EOF instead of a silent void
         with self._lock:
-            conns = list(self._locks)
+            conns = list(self._outs)
         for c in conns:
+            with self._lock:
+                out = self._outs.pop(c, None)
+            if out is not None:
+                out.close()
             try:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:

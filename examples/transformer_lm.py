@@ -60,7 +60,15 @@ def main():
 
     prompt = [stoi[c] for c in "the quick "]
     out = generate(net, prompt, 40, temperature=0)
-    print("sample:", "".join(chars[i] for i in out))
+    print("sample (no-cache):", "".join(chars[i] for i in out))
+
+    # the serving path: KV-cache decode — same greedy continuation, O(T)
+    # per emitted token instead of a full O(T^2) forward
+    from deeplearning4j_tpu.models import TransformerDecoder
+    dec = TransformerDecoder(net)
+    cached = dec.generate([prompt], 40, temperature=0.0)[0]
+    print("sample (kv-cache):", "".join(chars[i] for i in cached))
+    assert list(cached) == list(out), "cache/no-cache divergence"
 
 
 if __name__ == "__main__":

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Generation serving-path sweep: (batch, prompt-T, gen-T) grid over the
+KV-cache decode loop (models/generation.py), one JSON line per point —
+prefill tok/s, steady decode tok/s (emitted tokens), per-token p50/p99
+latency, and the no-cache recompute baseline with its speedup ratio —
+plus one continuous-batching A/B line (mixed-length stream, slot refill
+on vs off). BENCH_MODE=generate in bench.py is the single-point
+headline protocol; this is the full grid behind it.
+
+Model knobs (defaults: the flagship 12x768/12-head/32k-vocab LM):
+  GEN_VOCAB, GEN_DMODEL, GEN_HEADS, GEN_LAYERS
+Sweep knobs (comma-separated):
+  GEN_BATCHES   (default "8,32")
+  GEN_PROMPTS   (default "128,512")
+  GEN_TOKENS    (default "32,64")
+Protocol: GEN_RUNS median-of-N (default 3) after one warmup per compile.
+
+Run: [JAX_PLATFORMS=...] python scripts/perf_generate.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VOCAB = int(os.environ.get("GEN_VOCAB", "32000"))
+DMODEL = int(os.environ.get("GEN_DMODEL", "768"))
+HEADS = int(os.environ.get("GEN_HEADS", "12"))
+LAYERS = int(os.environ.get("GEN_LAYERS", "12"))
+BATCHES = [int(x) for x in os.environ.get("GEN_BATCHES", "8,32").split(",")]
+PROMPTS = [int(x) for x in os.environ.get("GEN_PROMPTS", "128,512").split(",")]
+TOKENS = [int(x) for x in os.environ.get("GEN_TOKENS", "32,64").split(",")]
+RUNS = int(os.environ.get("GEN_RUNS", "3"))
+NOCACHE_STEPS = int(os.environ.get("GEN_NOCACHE_STEPS", "8"))
+
+
+def _median(fn, runs=RUNS):
+    vals = [fn() for _ in range(runs)]
+    med = float(np.median(vals))
+    spread = 100.0 * (max(vals) - min(vals)) / med if med else 0.0
+    return med, round(spread, 2)
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                           TransformerDecoder,
+                                           transformer_lm_conf)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    t_max = max(PROMPTS) + max(TOKENS) + 1
+    conf = transformer_lm_conf(vocab_size=VOCAB, d_model=DMODEL,
+                               num_heads=HEADS, num_layers=LAYERS,
+                               max_length=t_max)
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
+    dec = TransformerDecoder(net)
+    rng = np.random.default_rng(0)
+
+    for b in BATCHES:
+        for tp in PROMPTS:
+            tokens = rng.integers(0, VOCAB, (b, tp)).astype(np.int32)
+            lengths = np.full(b, tp, np.int32)
+
+            def prefill_once():
+                caches = dec.init_cache(b)
+                t0 = time.perf_counter()
+                nxt, _, caches = dec.prefill(caches, tokens, lengths)
+                np.asarray(nxt)
+                return b * tp / (time.perf_counter() - t0), caches, nxt
+
+            prefill_once()                       # warm the compile
+            pre_med, pre_spread = _median(lambda: prefill_once()[0])
+
+            dec.recompute_logits(tokens, lengths)     # warm baseline
+
+            def nocache_once():
+                t0 = time.perf_counter()
+                for _ in range(NOCACHE_STEPS):
+                    ids, _ = dec.recompute_logits(tokens, lengths)
+                np.asarray(ids)
+                return b * NOCACHE_STEPS / (time.perf_counter() - t0)
+
+            nc_med, nc_spread = _median(nocache_once)
+
+            for gen_t in TOKENS:
+                def decode_once():
+                    _, caches, nxt = prefill_once()
+                    ids = np.asarray(nxt)
+                    pos = lengths.copy()
+                    lat = []
+                    t0 = time.perf_counter()
+                    for _ in range(gen_t):
+                        s0 = time.perf_counter()
+                        nx, _, caches = dec.decode_step(caches, ids, pos)
+                        ids = np.asarray(nx)     # serving-pattern sync
+                        lat.append(time.perf_counter() - s0)
+                        pos = pos + 1
+                    return b * gen_t / (time.perf_counter() - t0), lat
+
+                decode_once()                    # warm the decode compile
+                vals, lats = [], []
+                for _ in range(RUNS):
+                    v, lat = decode_once()
+                    vals.append(v)
+                    lats.extend(lat)
+                med = float(np.median(vals))
+                spread = 100.0 * (max(vals) - min(vals)) / med if med else 0
+                print(json.dumps({
+                    "point": {"batch": b, "prompt_t": tp, "gen_t": gen_t},
+                    "prefill_tok_s": round(pre_med, 1),
+                    "prefill_spread_pct": pre_spread,
+                    "decode_tok_s": round(med, 1),
+                    "decode_spread_pct": round(spread, 2),
+                    "decode_p50_ms": round(
+                        float(np.percentile(lats, 50)) * 1e3, 3),
+                    "decode_p99_ms": round(
+                        float(np.percentile(lats, 99)) * 1e3, 3),
+                    "nocache_tok_s": round(nc_med, 1),
+                    "nocache_spread_pct": nc_spread,
+                    "decode_vs_recompute": round(med / nc_med, 2)
+                    if nc_med else None,
+                }), flush=True)
+
+    # ---- continuous-batching A/B: mixed-length stream ----
+    slots = int(os.environ.get("GEN_SLOTS", "8"))
+    n_req = int(os.environ.get("GEN_REQUESTS", str(4 * slots)))
+    req_rng = np.random.default_rng(7)
+    tp, gen_t = max(PROMPTS), max(TOKENS)
+    plens = req_rng.integers(max(8, tp // 8), max(16, tp // 2), n_req)
+    gens = req_rng.integers(max(4, gen_t // 4), gen_t + 1, n_req)
+    prompts = [req_rng.integers(0, VOCAB, n).astype(np.int32)
+               for n in plens]
+
+    def batching_run(refill):
+        eng = SlotGenerationEngine(net, num_slots=slots, refill=refill,
+                                   decoder=dec)
+        for p, g in zip(prompts, gens):
+            eng.submit(p, int(g))
+        t0 = time.perf_counter()
+        eng.run_until_drained()
+        return (eng.emitted_tokens / (time.perf_counter() - t0),
+                eng.decode_steps)
+
+    batching_run(True)                           # warm slot-prefill buckets
+    on = [batching_run(True) for _ in range(RUNS)]
+    off = [batching_run(False) for _ in range(RUNS)]
+    on_med = float(np.median([x[0] for x in on]))
+    off_med = float(np.median([x[0] for x in off]))
+    print(json.dumps({
+        "continuous_batching": {
+            "slots": slots, "requests": n_req,
+            "refill_on_tok_s": round(on_med, 1),
+            "refill_off_tok_s": round(off_med, 1),
+            "refill_speedup": round(on_med / off_med, 3) if off_med else None,
+            "decode_steps_on": on[0][1], "decode_steps_off": off[0][1],
+        }}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -386,6 +386,84 @@ time.sleep(8)
             proc.kill()
             server.close()
 
+    def test_stalled_subscriber_does_not_block_others(self):
+        """Head-of-line-blocking regression (ADVICE r5): a subscriber
+        that never reads fills its TCP buffer, then its bounded outbound
+        queue, and is DISCONNECTED — delivery to every other subscriber
+        of the topic must continue (the old blocking-sendall fanout
+        wedged the publisher's reader thread on the stalled socket and
+        starved all topics)."""
+        import socket as socket_mod
+        import struct
+        from deeplearning4j_tpu.streaming.tcp_broker import (
+            TcpBrokerServer, TcpMessageBroker)
+        server = TcpBrokerServer(max_queued_frames=4).start()
+        stalled = None
+        healthy = publisher = None
+        try:
+            # raw socket that subscribes and then never reads, with a tiny
+            # receive buffer so its TCP window fills fast
+            stalled = socket_mod.socket()
+            stalled.setsockopt(socket_mod.SOL_SOCKET,
+                               socket_mod.SO_RCVBUF, 4096)
+            stalled.connect((server.host, server.port))
+            t = b"t"
+            stalled.sendall(b"S" + struct.pack(">I", len(t)) + t +
+                            struct.pack(">Q", 0))
+            healthy = TcpMessageBroker(server.host, server.port)
+            q = healthy.subscribe("t")
+            publisher = TcpMessageBroker(server.host, server.port)
+            time.sleep(0.2)                    # both subscriptions live
+            payload = b"x" * 262_144
+            n = 24
+            for _ in range(n):
+                publisher.publish("t", payload)
+            # the healthy subscriber receives EVERY message
+            got = 0
+            for _ in range(n):
+                msg = q.get(timeout=10)
+                assert msg == payload
+                got += 1
+            assert got == n
+            # ... and the stalled one was evicted rather than serviced
+            deadline = time.monotonic() + 5
+            while server.disconnects == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert server.disconnects >= 1
+        finally:
+            for c in (healthy, publisher):
+                if c is not None:
+                    c.close()
+            if stalled is not None:
+                stalled.close()
+            server.close()
+
+    def test_accept_prunes_finished_connection_threads(self):
+        """A long-lived server must not leak one dead Thread object per
+        connection ever accepted (ADVICE r5): churn connections and check
+        the retained list stays bounded."""
+        from deeplearning4j_tpu.streaming.pubsub import create_broker
+        server = self._server()
+        try:
+            for _ in range(12):
+                b = create_broker(server.url)
+                b.close()
+            # open one live connection so accept runs its prune pass
+            live = create_broker(server.url)
+            time.sleep(0.3)                    # reader threads wind down
+            b2 = create_broker(server.url)     # triggers the prune
+            time.sleep(0.1)
+            alive = [t for t in server._threads if t.is_alive()]
+            # accept thread + the two live connections (readers), plus
+            # any not-yet-reaped stragglers; the 12 churned connections'
+            # threads must be gone
+            assert len(server._threads) <= len(alive) + 3, \
+                (len(server._threads), len(alive))
+            live.close()
+            b2.close()
+        finally:
+            server.close()
+
     def test_serving_batch_window_coalesces_trickle(self):
         """batch_window > 0: messages arriving within the window coalesce
         even when the queue was empty at first poll (the latency-SLA knob

@@ -805,6 +805,28 @@ class TestShortSeqAttention:
         q128 = jnp.zeros((1, 128, 2, 8), jnp.float32)
         assert helper(Conf(), q128, q128, q128, None) is None  # tiny
 
+    def test_short_route_gated_on_known_good_shapes(self, rng_np):
+        """The DEFAULT-on short-T route declines unusual head dims and
+        non-float dtypes instead of raising at kernel construction — the
+        materialized path stays the safety net (ADVICE r5)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.kernels.pallas_attention import \
+            make_pallas_flash_helper
+
+        class Conf:
+            causal = True
+        helper = make_pallas_flash_helper(min_seq_len=1024,
+                                          interpret=True)
+        # odd head dim (D=12, not a multiple of 8): decline, don't raise
+        q12 = jnp.zeros((1, 256, 2, 12), jnp.float32)
+        assert helper(Conf(), q12, q12, q12, None) is None
+        # non-float q/k/v: decline
+        qi = jnp.zeros((1, 256, 2, 8), jnp.int32)
+        assert helper(Conf(), qi, qi, qi, None) is None
+        # known-good shape still rides the kernel
+        qok = jnp.asarray(rng_np.normal(size=(1, 256, 2, 16)), jnp.float32)
+        assert helper(Conf(), qok, qok, qok, None) is not None
+
     def test_invalid_configs_raise(self, rng_np):
         import jax.numpy as jnp
         import pytest

@@ -192,10 +192,14 @@ class TestSentiment:
 
 
 class TestSentimentHeldout:
-    """Open-domain lexicon honesty (VERDICT r4 missing item #3): the
-    held-out review fixture measured 0.050 accuracy / 1.4% hit rate
-    before the r5 growth band; the floor pinned here is the post-growth
-    state (full report: scripts/eval_sentiment_coverage.py)."""
+    """DEV/REGRESSION floor, NOT an open-domain estimate (ADVICE r5): the
+    review fixture measured 0.050 accuracy / 1.4% hit rate before the r5
+    growth band, but the band copied this fixture's polarity words into
+    the lexicon, so the 0.85 floor pinned here is a train-on-test
+    regression number (it pins the grown lexicon against regressions;
+    the pre-growth 0.050 in BASELINE.md remains the honest open-domain
+    estimate — a fresh fixture untouched during tuning would be needed
+    for a new one)."""
 
     def test_heldout_accuracy_floor(self):
         import sys
