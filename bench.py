@@ -25,7 +25,11 @@ Modes (BENCH_MODE):
            (32), BENCH_GEN_PROMPT (512), BENCH_GEN_STEPS (64 decode
            steps timed), BENCH_GEN_NOCACHE_STEPS (8), plus
            BENCH_GEN_DMODEL/HEADS/LAYERS/VOCAB to shrink the model for
-           smoke runs.
+           smoke runs. With --audit-compiles (or BENCH_AUDIT_COMPILES=1)
+           the whole protocol runs under analysis/compile_audit.py and a
+           "compile_audit" side metric reports per-function compile
+           counts, retrace storms, and steady-state decode compiles
+           (must be zero new after warmup).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
@@ -90,6 +94,11 @@ MODE = os.environ.get("BENCH_MODE", "staged")
 N_HOST_BATCHES = int(os.environ.get("BENCH_HOST_BATCHES", "8"))
 RUNS = int(os.environ.get("BENCH_RUNS", "3"))
 SIDE = os.environ.get("BENCH_SIDE", "1") not in ("0", "false")
+# --audit-compiles (or BENCH_AUDIT_COMPILES=1): run the generate protocol
+# under analysis/compile_audit.py and report per-function compile counts —
+# steady-state decode must show ZERO new compiles after warmup
+AUDIT_COMPILES = "--audit-compiles" in sys.argv[1:] or \
+    os.environ.get("BENCH_AUDIT_COMPILES", "0") not in ("0", "false", "")
 
 
 def _median_runs(measure, runs=None):
@@ -298,6 +307,14 @@ def _generate_result() -> dict:
     refill on vs off) in emitted tok/s."""
     from deeplearning4j_tpu.models import SlotGenerationEngine
 
+    if AUDIT_COMPILES:
+        from deeplearning4j_tpu.analysis import CompileAudit
+        with CompileAudit() as audit:
+            return _generate_protocol(SlotGenerationEngine, audit)
+    return _generate_protocol(SlotGenerationEngine, None)
+
+
+def _generate_protocol(SlotGenerationEngine, audit) -> dict:
     dec, v, b, tp, steps = _build_gen_decoder()
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, v, (b, tp)).astype(np.int32)
@@ -338,7 +355,11 @@ def _generate_result() -> dict:
         return decode_run()
 
     decode_once()                            # warmup decode compile
+    steady_snap = audit.snapshot() if audit is not None else None
     dec_med, dec_spread, dec_runs = _median_runs(decode_once)
+    # after the warmup everything is compiled: the timed runs must not
+    # trigger a single new lowering (one compile per shape signature)
+    steady_new = audit.delta(steady_snap) if audit is not None else None
 
     # ---- per-token latency (per-step host sync, the serving pattern) ----
     _, cs, nx = prefill_once()
@@ -389,7 +410,7 @@ def _generate_result() -> dict:
     ab_on = float(np.median([batching_run(True) for _ in range(RUNS)]))
     ab_off = float(np.median([batching_run(False) for _ in range(RUNS)]))
 
-    return {
+    result = {
         "metric": "lm_generate_decode_tokens_per_sec",
         "value": round(dec_med, 2),
         "unit": "tokens/sec",
@@ -417,6 +438,13 @@ def _generate_result() -> dict:
                        "vocab": v},
         },
     }
+    if audit is not None:
+        rep = audit.report()
+        # {} here IS the result: zero new compiles across the timed
+        # steady-state decode runs
+        rep["steady_decode_new_compiles"] = steady_new
+        result["side_metrics"]["compile_audit"] = rep
+    return result
 
 
 def _lenet() -> float:

@@ -1,0 +1,28 @@
+"""graftlint — trace-discipline static analysis + runtime compile auditing.
+
+The serving path (models/generation.py decode loop, continuous batching)
+and every jitted train step live or die on trace discipline: one stray
+host sync, per-shape retrace, or silent dtype/rank promotion erases the
+measured wins, and nothing catches it at review time. This subsystem
+machine-checks those invariants:
+
+- :mod:`.lint` — AST passes over the package flagging jit-hostility
+  (host syncs inside traced code, Python loops over array dims in hot
+  modules, tracer-dependent branches, numpy promotion hazards, jit
+  call-site consistency, unlocked shared writes in thread targets), with
+  a checked-in ``baseline.json`` so CI fails only on NEW violations
+  (``python scripts/lint.py --fail-on-new``).
+- :mod:`.compile_audit` — a context manager that counts XLA compilations
+  per jitted function (via the ``jax_log_compiles`` lowering hook),
+  detects retrace storms, and asserts expected-compile budgets in the
+  benches (``BENCH_MODE=generate --audit-compiles``).
+"""
+
+from .compile_audit import CompileAudit, CompileBudgetError
+from .lint import (Finding, LintRunner, RULES, load_baseline, lint_paths,
+                   new_findings, write_baseline)
+
+__all__ = [
+    "CompileAudit", "CompileBudgetError", "Finding", "LintRunner", "RULES",
+    "lint_paths", "load_baseline", "new_findings", "write_baseline",
+]

@@ -1,0 +1,240 @@
+"""Runtime compile auditor: count XLA compilations per jitted function.
+
+A fixed-shape decode loop must compile ONCE and then run; a retrace per
+step (shape-unstable inputs, a Python scalar riding where a device array
+should, a blown jit cache) silently turns the 16.7x KV-cache decode win
+into compile churn. jax already knows every lowering it performs — with
+``jax_log_compiles`` on, ``jax._src.interpreters.pxla`` logs one
+"Compiling <name> with global shapes and types [...]" record per cache
+miss, carrying the wrapped function's name and its full shape/dtype
+signature. :class:`CompileAudit` attaches a logging handler to that seam
+for the duration of a ``with`` block and aggregates:
+
+- ``counts[fn]`` — compiles per function name;
+- ``signatures[fn][sig]`` — compiles per (function, shape signature):
+  a signature compiled TWICE means the cache was blown (retrace storm),
+  not a new shape;
+- ``retraces()`` / ``duplicate_signature_compiles`` — storm detectors;
+- ``check(budget=..., total=...)`` — assert an expected-compile budget
+  (raises :class:`CompileBudgetError` with the offending functions).
+
+Works on any backend and costs one logging call per COMPILE (not per
+step), so wrapping a whole bench run is free. The monitoring-events API
+(``jax.monitoring``) records the same compiles without names and its
+listeners cannot be unregistered individually, so the logging seam is
+the instrumentation of choice; our own jit wrappers need no changes.
+
+Usage::
+
+    with CompileAudit() as audit:
+        run_bench()
+    audit.check(budget={"decode_step_impl": 1}, total=10)
+    print(audit.report())
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from collections import Counter, defaultdict
+from typing import Dict, Iterable, Optional
+
+_COMPILE_RE = re.compile(
+    r"Compiling ([^\s]+) with global shapes and types (\[.*?\])\.")
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+#: loggers that turn chatty at WARNING while jax_log_compiles is on; muted
+#: (propagate=False + NullHandler) for the audit scope so a bench run's
+#: stderr stays clean
+_MUTE_LOGGERS = ("jax._src.dispatch", "jax._src.compiler")
+
+
+class CompileBudgetError(AssertionError):
+    """An audited region compiled more than its budget allows."""
+
+
+class _CompileLogHandler(logging.Handler):
+    def __init__(self, audit: "CompileAudit"):
+        super().__init__(level=logging.DEBUG)
+        self._audit = audit
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILE_RE.match(record.getMessage())
+        except Exception:       # noqa: BLE001 — a logging handler must not throw
+            return
+        if m:
+            self._audit._record(m.group(1), m.group(2))
+
+
+class CompileAudit:
+    """Context manager counting per-function XLA compilations.
+
+    ``budget``: optional {function_name: max_compiles} checked on clean
+    exit (plus ``total_budget`` for the sum); violations raise
+    :class:`CompileBudgetError`. Pass ``ignore`` to exclude helper
+    programs (e.g. 'convert_element_type', '_threefry_split' — jax's own
+    tiny utility compiles) from totals and budget checks; the default
+    list covers the utility programs any real run compiles on the side,
+    keeping the audit about OUR entry points. ``ignore_internal=True``
+    additionally drops every name starting with '_' — do NOT use it on
+    this package, whose own seams are named ``_step``/``_out``/...)."""
+
+    #: jax-internal utility programs compiled on the side of any real run.
+    #: The jax.random samplers (_normal, _uniform, ...) matter beyond
+    #: noise: their SHAPE rides as a static argument that the compile log's
+    #: dynamic signature does not show, so per-shape init-time compiles
+    #: would read as duplicate-signature retraces (a false storm signal).
+    DEFAULT_IGNORE = ("convert_element_type", "broadcast_in_dim", "copy",
+                      "reshape", "concatenate", "squeeze", "transpose",
+                      "iota", "eq", "fn", "<lambda>", "_threefry_split",
+                      "_threefry_seed", "threefry_2x32", "_unstack",
+                      "_argmax", "_where", "_normal", "_normal_real",
+                      "_uniform", "_truncated_normal", "_categorical",
+                      "_bernoulli", "_gumbel", "_threefry_fold_in",
+                      "fold_in")
+
+    def __init__(self, budget: Optional[Dict[str, int]] = None,
+                 total_budget: Optional[int] = None,
+                 ignore: Optional[Iterable[str]] = None,
+                 ignore_internal: bool = False):
+        self.budget = dict(budget or {})
+        self.total_budget = total_budget
+        self.ignore = set(self.DEFAULT_IGNORE if ignore is None else ignore)
+        self.ignore_internal = ignore_internal
+        self.counts: Counter = Counter()
+        self.signatures: Dict[str, Counter] = defaultdict(Counter)
+        self._mutex = threading.Lock()
+        self._handler: Optional[_CompileLogHandler] = None
+        self._prev_log_compiles = None
+        self._prev_propagate = None
+        self._prev_level = None
+        self._muted = []      # (logger, null_handler, prev_propagate)
+
+    # ------------------------------------------------------------ capture
+    def _record(self, name: str, signature: str) -> None:
+        with self._mutex:
+            self.counts[name] += 1
+            self.signatures[name][signature] += 1
+
+    def _ignored(self, name: str) -> bool:
+        return name in self.ignore or \
+            (self.ignore_internal and name.startswith("_"))
+
+    def __enter__(self) -> "CompileAudit":
+        import jax
+        logger = logging.getLogger(_PXLA_LOGGER)
+        self._handler = _CompileLogHandler(self)
+        self._prev_propagate = logger.propagate
+        self._prev_level = logger.level
+        logger.addHandler(self._handler)
+        # keep the per-compile WARNING records out of the user's stderr
+        # (logging.lastResort prints them when no root handler exists)
+        logger.propagate = False
+        logger.setLevel(logging.DEBUG)
+        for lname in _MUTE_LOGGERS:
+            lg = logging.getLogger(lname)
+            nh = logging.NullHandler()
+            lg.addHandler(nh)      # NullHandler keeps lastResort quiet
+            self._muted.append((lg, nh, lg.propagate))
+            lg.propagate = False
+        self._prev_log_compiles = bool(getattr(jax.config,
+                                               "jax_log_compiles", False))
+        jax.config.update("jax_log_compiles", True)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        import jax
+        logger = logging.getLogger(_PXLA_LOGGER)
+        if self._handler is not None:
+            logger.removeHandler(self._handler)
+            self._handler = None
+        if self._prev_propagate is not None:
+            logger.propagate = self._prev_propagate
+        if self._prev_level is not None:
+            logger.setLevel(self._prev_level)
+        for lg, nh, prev in self._muted:
+            lg.removeHandler(nh)
+            lg.propagate = prev
+        self._muted = []
+        jax.config.update("jax_log_compiles",
+                          bool(self._prev_log_compiles))
+        if exc_type is None and (self.budget or
+                                 self.total_budget is not None):
+            self.check(self.budget, self.total_budget)
+
+    # ------------------------------------------------------------ results
+    @property
+    def total_compiles(self) -> int:
+        return sum(c for n, c in self.counts.items()
+                   if not self._ignored(n))
+
+    def compiles(self, name: str) -> int:
+        return self.counts.get(name, 0)
+
+    def retraces(self) -> Dict[str, dict]:
+        """Functions compiled more than once: how many compiles, how many
+        DISTINCT signatures, and how many compiles re-lowered an
+        already-seen signature (cache blown — the storm signal)."""
+        out = {}
+        for name, c in self.counts.items():
+            if c <= 1 or self._ignored(name):
+                continue
+            sigs = self.signatures[name]
+            out[name] = {
+                "compiles": c,
+                "distinct_signatures": len(sigs),
+                "duplicate_signature_compiles": sum(
+                    k - 1 for k in sigs.values() if k > 1),
+            }
+        return out
+
+    @property
+    def duplicate_signature_compiles(self) -> int:
+        """Total compiles that re-lowered an already-seen (function,
+        signature) — steady state demands this be ZERO."""
+        return sum(r["duplicate_signature_compiles"]
+                   for r in self.retraces().values())
+
+    def snapshot(self) -> Counter:
+        with self._mutex:
+            return Counter(self.counts)
+
+    def delta(self, since: Counter) -> Dict[str, int]:
+        """Per-function compiles since ``snapshot()`` (ignored names
+        excluded) — zero in any steady-state region."""
+        now = self.snapshot()
+        return {n: now[n] - since.get(n, 0) for n in now
+                if now[n] > since.get(n, 0) and not self._ignored(n)}
+
+    def report(self) -> dict:
+        return {
+            "total_compiles": self.total_compiles,
+            "per_function": {n: c for n, c in sorted(self.counts.items())
+                             if not self._ignored(n)},
+            "retraced": self.retraces(),
+            "duplicate_signature_compiles":
+                self.duplicate_signature_compiles,
+        }
+
+    def check(self, budget: Optional[Dict[str, int]] = None,
+              total: Optional[int] = None,
+              forbid_duplicate_signatures: bool = False) -> None:
+        """Raise CompileBudgetError on any budget violation."""
+        problems = []
+        for name, cap in (budget or {}).items():
+            got = self.counts.get(name, 0)
+            if got > cap:
+                problems.append(f"{name}: {got} compiles > budget {cap} "
+                                f"({len(self.signatures[name])} distinct "
+                                "signatures)")
+        if total is not None and self.total_compiles > total:
+            problems.append(f"total: {self.total_compiles} compiles > "
+                            f"budget {total}")
+        if forbid_duplicate_signatures and \
+                self.duplicate_signature_compiles:
+            problems.append(
+                "duplicate-signature compiles (cache blown): "
+                f"{self.retraces()}")
+        if problems:
+            raise CompileBudgetError("; ".join(problems))

@@ -1,0 +1,616 @@
+"""graftlint static passes: AST lint for jit/trace discipline.
+
+What counts as "inside traced code" (jit context) is decided statically,
+without interprocedural analysis, from four sources:
+
+1. decorators — ``@jax.jit``, ``@functools.partial(jax.jit, ...)``,
+   ``@jax.custom_vjp`` / ``@jax.custom_jvp`` and friends;
+2. wrapper call sites — a function (or lambda) passed by name to
+   ``jax.jit`` / ``jax.lax.scan`` / ``while_loop`` / ``fori_loop`` /
+   ``cond`` / ``jax.vmap`` / ``jax.grad`` / ``shard_map`` anywhere in
+   the same module;
+3. an explicit ``# graftlint: traced`` marker on (or directly above) a
+   ``def`` line — for methods that are only ever CALLED from jitted
+   walks (the decode seams in nn/conf/layers/attention.py,
+   models/generation.py's ``_walk_*``), which no local analysis can see;
+4. nesting — any function defined inside a jit-context function.
+
+Pallas kernel bodies (functions passed to ``pallas_call``) are NOT
+treated as jit context: their shape loops/branches are over static block
+shapes and idiomatic there.
+
+Suppression: ``# graftlint: disable=GL001[,GL002...]`` on the flagged
+line (or the line above) silences those rules for that line;
+``analysis/baseline.json`` suppresses pre-existing findings repo-wide so
+``scripts/lint.py --fail-on-new`` gates only regressions. Baseline keys
+are ``rule:path:function:snippet-hash`` — stable across unrelated line
+drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "GL001": "host sync inside jitted/traced code",
+    "GL002": "Python loop over array dims inside traced code (hot module)",
+    "GL003": "branch on a traced value inside jitted code",
+    "GL004": "numpy scalar math inside traced code (dtype promotion hazard)",
+    "GL005": "jax.jit call site missing donate/static argnums its module "
+             "siblings use",
+    "GL006": "shared attribute written from a thread target without a "
+             "held lock",
+}
+
+#: wrappers whose function arguments are traced when called
+_TRACE_WRAPPERS = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "scan",
+    "while_loop", "fori_loop", "cond", "switch", "checkify", "remat",
+    "checkpoint", "shard_map", "shard_map_compat", "xmap", "linearize",
+    "vjp", "jvp", "associative_scan", "map",
+}
+#: decorators that make the decorated def traced
+_TRACE_DECORATORS = _TRACE_WRAPPERS | {"custom_vjp", "custom_jvp",
+                                       "custom_gradient"}
+#: modules where GL002 (python loop over dims) applies — the hot paths
+_HOT_DIRS = ("kernels", "models", "nn", "parallel")
+#: attribute reads on a traced value that are static at trace time
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding",
+                 "aval"}
+#: numpy calls that are NOT promotion hazards (dtype constructors, array
+#: creation handled by GL001, index/meta helpers)
+_NP_SAFE = {"asarray", "array", "float32", "float64", "float16", "int32",
+            "int64", "int8", "uint8", "bool_", "dtype", "zeros", "ones",
+            "empty", "arange", "shape", "ndim", "broadcast_to", "save"}
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str           # repo-relative, forward slashes
+    line: int
+    func: str           # enclosing function qualname ("<module>" if none)
+    message: str
+    snippet: str        # stripped source line
+
+    @property
+    def key(self) -> str:
+        h = hashlib.md5(self.snippet.encode("utf-8")).hexdigest()[:8]
+        return f"{self.rule}:{self.path}:{self.func}:{h}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.func}] "
+                f"{self.message}\n    {self.snippet}")
+
+
+def _dotted_tail(node: ast.AST) -> str:
+    """Last attribute/name segment of a call target ('jax.lax.scan' ->
+    'scan')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _dotted_name(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_np_call(func: ast.AST) -> Optional[str]:
+    """'np.sqrt(x)' / 'numpy.sqrt(x)' -> 'sqrt'; None otherwise."""
+    if isinstance(func, ast.Attribute) and \
+            isinstance(func.value, ast.Name) and \
+            func.value.id in ("np", "numpy", "onp"):
+        return func.attr
+    return None
+
+
+def _call_wraps_traced(call: ast.Call) -> bool:
+    """True when ``call`` is a trace wrapper (jax.jit(f), lax.scan(f, ..),
+    functools.partial(jax.jit, ...))."""
+    tail = _dotted_tail(call.func)
+    if tail in _TRACE_WRAPPERS:
+        return True
+    if tail == "partial" and call.args:
+        return _dotted_tail(call.args[0]) in _TRACE_WRAPPERS
+    return False
+
+
+class _ParentMap(ast.NodeVisitor):
+    def __init__(self):
+        self.parents: Dict[ast.AST, ast.AST] = {}
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.parents[child] = node
+        super().generic_visit(node)
+
+
+class ModuleLint:
+    """All passes over one parsed module."""
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.relpath = relpath
+        self.source_lines = source.splitlines()
+        self.tree = ast.parse(source, filename=abspath)
+        pm = _ParentMap()
+        pm.visit(self.tree)
+        self.parents = pm.parents
+        self._disabled = self._scan_suppressions()
+        self._traced_markers = self._scan_traced_markers()
+
+    # ------------------------------------------------------------ comments
+    def _scan_suppressions(self) -> Dict[int, Set[str]]:
+        """{line_no: {rule, ...}} from '# graftlint: disable=...' comments.
+        A TRAILING comment suppresses its own line only; a standalone
+        comment line suppresses the line below. (A trailing comment must
+        NOT spill onto the next line — a new violation written directly
+        under an existing suppression has to trip the --fail-on-new
+        gate.)"""
+        out: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.source_lines, start=1):
+            if "graftlint:" not in text:
+                continue
+            frag = text.split("graftlint:", 1)[1]
+            if "disable=" not in frag:
+                continue
+            rules = {r.strip() for r in
+                     frag.split("disable=", 1)[1].split("#")[0].split(",")
+                     if r.strip()}
+            out.setdefault(i, set()).update(rules)
+            if text.strip().startswith("#"):      # standalone comment line
+                out.setdefault(i + 1, set()).update(rules)
+        return out
+
+    def _scan_traced_markers(self) -> Set[int]:
+        """Lines carrying '# graftlint: traced': a trailing marker tags the
+        def on its own line; a standalone comment line tags the def
+        below (same spillover rule as suppressions)."""
+        out: Set[int] = set()
+        for i, text in enumerate(self.source_lines, start=1):
+            if "graftlint:" in text and "traced" in \
+                    text.split("graftlint:", 1)[1]:
+                out.add(i)
+                if text.strip().startswith("#"):
+                    out.add(i + 1)
+        return out
+
+    def _suppressed(self, rule: str, line: int) -> bool:
+        return rule in self._disabled.get(line, set())
+
+    def _snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.source_lines):
+            return self.source_lines[line - 1].strip()
+        return ""
+
+    def _emit(self, out: List[Finding], rule: str, node: ast.AST,
+              func: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if self._suppressed(rule, line):
+            return
+        out.append(Finding(rule=rule, path=self.relpath, line=line,
+                           func=func, message=message,
+                           snippet=self._snippet(line)))
+
+    # ------------------------------------------------------- jit contexts
+    def _collect_jit_functions(self) -> List[Tuple[ast.AST, str]]:
+        """(def/lambda node, qualname) for every jit-context function."""
+        wrapped_names: Set[str] = set()
+        wrapped_nodes: Set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and _call_wraps_traced(node):
+                args = node.args
+                tail = _dotted_tail(node.func)
+                if tail == "partial":     # partial(jax.jit, f?) rare; skip f0
+                    args = node.args[1:]
+                for a in args:
+                    if isinstance(a, ast.Name):
+                        wrapped_names.add(a.id)
+                    elif isinstance(a, (ast.Lambda, ast.FunctionDef)):
+                        wrapped_nodes.add(id(a))
+        # lambdas assigned to a wrapped name:  upd = lambda ...; vmap(upd)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Lambda):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in wrapped_names:
+                        wrapped_nodes.add(id(node.value))
+
+        roots: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced = node.name in wrapped_names or \
+                    id(node) in wrapped_nodes or \
+                    node.lineno in self._traced_markers or any(
+                        (isinstance(d, ast.Call) and _call_wraps_traced(d))
+                        or _dotted_tail(d) in _TRACE_DECORATORS
+                        for d in node.decorator_list)
+                if traced:
+                    roots.append((node, self._qualname(node)))
+            elif isinstance(node, ast.Lambda) and id(node) in wrapped_nodes:
+                roots.append((node, self._qualname(node)))
+        return roots
+
+    def _qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.Module):
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                parts.append(cur.name)
+            elif isinstance(cur, ast.ClassDef):
+                parts.append(cur.name)
+            elif isinstance(cur, ast.Lambda):
+                parts.append("<lambda>")
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    @staticmethod
+    def _traced_params(fn: ast.AST) -> Set[str]:
+        """Parameter names plausibly bound to traced arrays: positional
+        params without defaults, minus self/cls (config flags like
+        ``train=False`` / ``mask=None`` carry Python values) and minus
+        anything the jit decorator marks static via
+        ``static_argnames``/``static_argnums``."""
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        n_default = len(a.defaults)
+        names = {p.arg for p in (pos[:-n_default] if n_default else pos)}
+        names.discard("self")
+        names.discard("cls")
+        for dec in getattr(fn, "decorator_list", ()):
+            if not (isinstance(dec, ast.Call) and _call_wraps_traced(dec)):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) and \
+                                isinstance(n.value, str):
+                            names.discard(n.value)
+                elif kw.arg == "static_argnums":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) and \
+                                isinstance(n.value, int) and \
+                                0 <= n.value < len(pos):
+                            names.discard(pos[n.value].arg)
+        return names
+
+    def _name_is_static_use(self, name: ast.Name) -> bool:
+        """x.shape / x.ndim / x.dtype reads are static at trace time."""
+        parent = self.parents.get(name)
+        return isinstance(parent, ast.Attribute) and \
+            parent.attr in _STATIC_ATTRS
+
+    # ------------------------------------------------------------ GL001-4
+    def _check_jit_body(self, out: List[Finding], fn: ast.AST,
+                        qual: str, enabled: Set[str]) -> None:
+        traced = self._traced_params(fn)
+        hot = any(f"/{d}/" in f"/{self.relpath}" for d in _HOT_DIRS)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in [n for b in body for n in ast.walk(b)]:
+            if isinstance(node, ast.Call) and "GL001" in enabled:
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in (
+                        "item", "tolist", "block_until_ready"):
+                    self._emit(out, "GL001", node, qual,
+                               f".{f.attr}() forces a host sync under "
+                               "trace — return the array instead")
+                np_fn = _is_np_call(f)
+                if np_fn in ("asarray", "array", "save"):
+                    self._emit(out, "GL001", node, qual,
+                               f"np.{np_fn}() materializes a traced value "
+                               "on host — use jnp")
+                if isinstance(f, ast.Name) and f.id in ("float", "int",
+                                                        "bool") and \
+                        node.args and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in traced:
+                    self._emit(out, "GL001", node, qual,
+                               f"{f.id}({node.args[0].id}) forces a host "
+                               "sync on a traced value")
+                if _dotted_name(f) in ("jax.device_get", "device_get"):
+                    self._emit(out, "GL001", node, qual,
+                               "device_get inside traced code is a host "
+                               "sync")
+            if isinstance(node, ast.Call) and "GL004" in enabled:
+                np_fn = _is_np_call(node.func)
+                if np_fn and np_fn not in _NP_SAFE and \
+                        not np_fn.startswith("random"):
+                    self._emit(out, "GL004", node, qual,
+                               f"np.{np_fn}() under trace yields a float64 "
+                               "weak scalar (x64) or fails on tracers — "
+                               "use jnp or a Python literal")
+            if "GL002" in enabled and hot and \
+                    isinstance(node, (ast.For, ast.While)):
+                probe = node.iter if isinstance(node, ast.For) else node.test
+                if any(isinstance(n, ast.Attribute) and n.attr == "shape"
+                       for n in ast.walk(probe)):
+                    kind = "for" if isinstance(node, ast.For) else "while"
+                    self._emit(out, "GL002", node, qual,
+                               f"Python {kind} over an array dim unrolls "
+                               "the trace (and retraces per shape) — use "
+                               "lax.scan/fori_loop")
+            if isinstance(node, ast.If) and "GL003" in enabled:
+                test = node.test
+                if isinstance(test, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops):
+                    continue                      # `x is None` guards
+                hits = [n for n in ast.walk(test)
+                        if isinstance(n, ast.Name) and n.id in traced
+                        and not self._name_is_static_use(n)]
+                if hits:
+                    self._emit(out, "GL003", node, qual,
+                               f"`if` on traced value(s) "
+                               f"{sorted({h.id for h in hits})} — "
+                               "concretization error or silent retrace; "
+                               "use lax.cond/jnp.where")
+
+    # -------------------------------------------------------------- GL005
+    def _check_jit_sites(self, out: List[Finding],
+                         enabled: Set[str]) -> None:
+        if "GL005" not in enabled:
+            return
+        sites: List[Tuple[ast.Call, bool, bool]] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _dotted_tail(node.func)
+            target = node
+            if tail == "partial" and node.args and \
+                    _dotted_tail(node.args[0]) in ("jit", "pjit"):
+                pass
+            elif tail in ("jit", "pjit") and \
+                    _dotted_name(node.func) in ("jax.jit", "jit", "pjit",
+                                                "jax.experimental.pjit"):
+                pass
+            else:
+                continue
+            kws = {k.arg for k in target.keywords}
+            sites.append((target,
+                          bool(kws & {"donate_argnums", "donate_argnames"}),
+                          bool(kws & {"static_argnums", "static_argnames"})))
+        if not sites:
+            return
+        any_donate = any(d for _, d, _ in sites)
+        any_static = any(s for _, _, s in sites)
+        for node, donate, static in sites:
+            missing = []
+            if any_donate and not donate:
+                missing.append("donate_argnums")
+            if any_static and not static:
+                missing.append("static_argnums")
+            if missing:
+                self._emit(out, "GL005", node, self._qualname(node),
+                           f"jit site lacks {'/'.join(missing)} while "
+                           "sibling sites in this module pass them — "
+                           "confirm and annotate")
+
+    # -------------------------------------------------------------- GL006
+    def _check_lock_discipline(self, out: List[Finding],
+                               enabled: Set[str]) -> None:
+        if "GL006" not in enabled:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class_locks(out, node)
+
+    def _check_class_locks(self, out: List[Finding],
+                           cls: ast.ClassDef) -> None:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        if not methods:
+            return
+        # thread entry points: threading.Thread(target=self.X) anywhere in
+        # the class, expanded to self._y() calls made from them (fixpoint)
+        entries: Set[str] = set()
+        lock_attrs: Set[str] = set()
+        writes: Dict[str, Dict[str, List[ast.AST]]] = {}   # meth -> attr
+        reads: Dict[str, Set[str]] = {}
+        calls: Dict[str, Set[str]] = {}
+        for mname, m in methods.items():
+            writes[mname] = {}
+            reads[mname] = set()
+            calls[mname] = set()
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call):
+                    if _dotted_tail(n.func) == "Thread":
+                        for kw in n.keywords:
+                            if kw.arg == "target" and \
+                                    isinstance(kw.value, ast.Attribute) and \
+                                    isinstance(kw.value.value, ast.Name) \
+                                    and kw.value.value.id == "self":
+                                entries.add(kw.value.attr)
+                    if isinstance(n.func, ast.Attribute) and \
+                            isinstance(n.func.value, ast.Name) and \
+                            n.func.value.id == "self":
+                        calls[mname].add(n.func.attr)
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if self._self_attr(t):
+                            writes[mname].setdefault(
+                                self._self_attr(t), []).append(n)
+                    if isinstance(n.value, ast.Call) and \
+                            _dotted_tail(n.value.func) in _LOCK_FACTORIES:
+                        for t in n.targets:
+                            if self._self_attr(t):
+                                lock_attrs.add(self._self_attr(t))
+                elif isinstance(n, ast.AugAssign) and \
+                        self._self_attr(n.target):
+                    writes[mname].setdefault(
+                        self._self_attr(n.target), []).append(n)
+                elif isinstance(n, ast.Attribute) and \
+                        isinstance(n.value, ast.Name) and \
+                        n.value.id == "self" and \
+                        isinstance(n.ctx, ast.Load):
+                    reads[mname].add(n.attr)
+        if not entries:
+            return
+        # fixpoint: self-methods called from thread context run in it too
+        ctx = set(entries)
+        changed = True
+        while changed:
+            changed = False
+            for m in list(ctx):
+                for callee in calls.get(m, ()):
+                    if callee in methods and callee not in ctx:
+                        ctx.add(callee)
+                        changed = True
+        for mname in sorted(ctx):
+            m = methods.get(mname)
+            if m is None:
+                continue
+            for attr, nodes in writes[mname].items():
+                if attr in lock_attrs:
+                    continue
+                shared = any(attr in writes[o] for o in methods
+                             if o not in ctx and o != "__init__") or \
+                    any(attr in reads[o] for o in methods
+                        if o not in ctx)
+                for n in nodes:
+                    racy_rmw = isinstance(n, ast.AugAssign)
+                    if not (shared or racy_rmw):
+                        continue
+                    if self._under_lock(n, lock_attrs):
+                        continue
+                    what = "read-modify-write of" if racy_rmw else "write to"
+                    self._emit(out, "GL006", n, f"{cls.name}.{mname}",
+                               f"unlocked {what} self.{attr} in "
+                               "thread-context method — guard with the "
+                               "instance lock")
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _under_lock(self, node: ast.AST, lock_attrs: Set[str]) -> bool:
+        """Is ``node`` inside a ``with self.<lock>`` block (any lock-like
+        attr, or any attr containing 'lock' when the class builds its
+        locks elsewhere)?"""
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    expr = item.context_expr
+                    for n in ast.walk(expr):
+                        attr = self._self_attr(n)
+                        if attr and (attr in lock_attrs or
+                                     "lock" in attr.lower()):
+                            return True
+            cur = self.parents.get(cur)
+        return False
+
+    # ---------------------------------------------------------------- run
+    def run(self, enabled: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        for fn, qual in self._collect_jit_functions():
+            self._check_jit_body(out, fn, qual, enabled)
+        self._check_jit_sites(out, enabled)
+        self._check_lock_discipline(out, enabled)
+        return out
+
+
+class LintRunner:
+    """Walk .py files under roots, lint each, aggregate findings."""
+
+    def __init__(self, repo_root: str, rules: Optional[Iterable[str]] = None):
+        self.repo_root = os.path.abspath(repo_root)
+        self.enabled = set(rules) if rules else set(RULES)
+        self.errors: List[str] = []   # unparseable files (reported, not fatal)
+
+    def lint_file(self, path: str) -> List[Finding]:
+        rel = os.path.relpath(os.path.abspath(path),
+                              self.repo_root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            module = ModuleLint(path, rel, src)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            self.errors.append(f"{rel}: {e}")
+            return []
+        return module.run(self.enabled)
+
+    def lint(self, paths: Sequence[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = [d for d in dirnames
+                                   if d not in ("__pycache__", ".git")]
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            findings.extend(
+                                self.lint_file(os.path.join(dirpath, fn)))
+            elif os.path.isfile(p) and p.endswith(".py"):
+                findings.extend(self.lint_file(p))
+            else:
+                # a stale/misspelled path must not silently shrink the
+                # gate's coverage — surface it like a parse error
+                self.errors.append(f"{p}: not a directory or .py file")
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return findings
+
+
+def lint_paths(paths: Sequence[str], repo_root: str,
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    return LintRunner(repo_root, rules).lint(paths)
+
+
+# ------------------------------------------------------------- baseline
+def baseline_counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    return dict(Counter(f.key for f in findings))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> dict:
+    data = {
+        "version": 1,
+        "rules": sorted({f.rule for f in findings}),
+        "total": len(findings),
+        "suppressed": baseline_counts(findings),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return dict(data.get("suppressed", {}))
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Dict[str, int]) -> List[Finding]:
+    """Findings beyond the baselined count for their key (line-number
+    drift does not churn keys; adding a second identical violation in the
+    same function DOES trip the gate)."""
+    seen: Counter = Counter()
+    out: List[Finding] = []
+    for f in findings:
+        seen[f.key] += 1
+        if seen[f.key] > baseline.get(f.key, 0):
+            out.append(f)
+    return out

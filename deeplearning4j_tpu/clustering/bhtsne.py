@@ -101,7 +101,7 @@ def _apply_update(Y, vel, gains, grad, momentum, lr):
     gains = jnp.maximum(gains, 0.01)
     vel = momentum * vel - lr * gains * grad
     Y = Y + vel
-    return Y - jnp.mean(Y, axis=0), vel, gains
+    return Y - jnp.mean(Y, axis=0)[None, :], vel, gains
 
 
 @functools.partial(jax.jit, static_argnames=("chunk",))

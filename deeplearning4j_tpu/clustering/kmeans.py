@@ -15,7 +15,7 @@ import numpy as np
 @functools.partial(jax.jit, static_argnames=("k",))
 def _lloyd_step(points, centers, k: int):
     d2 = jnp.sum(points ** 2, 1, keepdims=True) - \
-        2 * points @ centers.T + jnp.sum(centers ** 2, 1)
+        2 * points @ centers.T + jnp.sum(centers ** 2, 1)[None, :]
     assign = jnp.argmin(d2, axis=1)
     onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)     # [N, k]
     counts = jnp.sum(onehot, axis=0)

@@ -59,7 +59,7 @@ def _perplexity_search(D2: np.ndarray, perplexity: float,
 @jax.jit
 def _tsne_grad(Y, P):
     D2 = jnp.sum(Y ** 2, 1, keepdims=True) - 2 * Y @ Y.T + \
-        jnp.sum(Y ** 2, 1)
+        jnp.sum(Y ** 2, 1)[None, :]
     num = 1.0 / (1.0 + D2)
     num = num * (1 - jnp.eye(Y.shape[0]))
     Q = num / jnp.maximum(jnp.sum(num), 1e-12)
@@ -133,7 +133,7 @@ class Tsne:
             mom = 0.5 if it < 20 else self.momentum
             vel = mom * vel - self.learning_rate * gains * grad
             Y = Y + vel
-            Y = Y - jnp.mean(Y, axis=0)
+            Y = Y - jnp.mean(Y, axis=0)[None, :]
         self.kl_divergence_ = float(kl)
         return np.asarray(Y)
 

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.shapes import chan
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def bn_train_fused(x, gamma, beta, shift_hint, eps):
@@ -66,7 +68,7 @@ def _bn_fwd_impl(x, gamma, beta, shift_hint, eps):
     xf = x.astype(jnp.float32)
     s = lax.stop_gradient(shift_hint.astype(jnp.float32))
     # one fused sweep of x: sibling reductions of (x-s) and (x-s)^2
-    d = xf - s
+    d = xf - chan(s, xf.ndim)
     m1 = jnp.sum(d, axis=axes) / n
     m2 = jnp.sum(d * d, axis=axes) / n
     mean = s + m1
@@ -75,7 +77,8 @@ def _bn_fwd_impl(x, gamma, beta, shift_hint, eps):
     scale = gamma.astype(jnp.float32) * rstd
     shift = beta.astype(jnp.float32) - mean * scale
     # single FMA pass in the compute dtype
-    y = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    y = x * chan(scale.astype(x.dtype), x.ndim) + \
+        chan(shift.astype(x.dtype), x.ndim)
     return (y, mean, var), (x, gamma, mean, rstd)
 
 
@@ -90,16 +93,16 @@ def _bn_bwd(eps, res, cots):
         n *= x.shape[a]
     dyf = dy.astype(jnp.float32)
     xf = x.astype(jnp.float32)
-    xhat = (xf - mean) * rstd
+    xhat = (xf - chan(mean, xf.ndim)) * chan(rstd, xf.ndim)
     # pass 1: both reductions share the same inputs -> one HBM sweep
     dbeta = jnp.sum(dyf, axis=axes)
     dgamma = jnp.sum(dyf * xhat, axis=axes)
     # pass 2: dx by the analytic formula
     g32 = gamma.astype(jnp.float32)
-    k = (g32 * rstd).astype(x.dtype)
+    k = chan((g32 * rstd).astype(x.dtype), x.ndim)
     dx = k * (dy
-              - (dbeta / n).astype(x.dtype)
-              - (xhat * (dgamma / n)).astype(x.dtype))
+              - chan((dbeta / n).astype(x.dtype), x.ndim)
+              - (xhat * chan((dgamma / n).astype(x.dtype), x.ndim)))
     return (dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype),
             jnp.zeros_like(mean))
     # zero cotangent for shift_hint: it only conditions the arithmetic
@@ -132,7 +135,7 @@ def _bn_add_act_fwd_impl(x, gamma, beta, shift_hint, res, eps, act):
         n *= x.shape[a]
     xf = x.astype(jnp.float32)
     s = lax.stop_gradient(shift_hint.astype(jnp.float32))
-    d = xf - s
+    d = xf - chan(s, xf.ndim)
     m1 = jnp.sum(d, axis=axes) / n
     m2 = jnp.sum(d * d, axis=axes) / n
     mean = s + m1
@@ -140,7 +143,8 @@ def _bn_add_act_fwd_impl(x, gamma, beta, shift_hint, res, eps, act):
     rstd = lax.rsqrt(var + eps)
     scale = gamma.astype(jnp.float32) * rstd
     shift = beta.astype(jnp.float32) - mean * scale
-    y = x * scale.astype(x.dtype) + shift.astype(x.dtype) + res
+    y = x * chan(scale.astype(x.dtype), x.ndim) + \
+        chan(shift.astype(x.dtype), x.ndim) + res
     if act == "relu":
         y = jnp.maximum(y, 0)
     return (y, mean, var), (x, gamma, mean, rstd, y)
@@ -158,14 +162,14 @@ def _bn_add_act_bwd(eps, act, resids, cots):
         n *= x.shape[a]
     dyf = dy.astype(jnp.float32)
     xf = x.astype(jnp.float32)
-    xhat = (xf - mean) * rstd
+    xhat = (xf - chan(mean, xf.ndim)) * chan(rstd, xf.ndim)
     dbeta = jnp.sum(dyf, axis=axes)
     dgamma = jnp.sum(dyf * xhat, axis=axes)
     g32 = gamma.astype(jnp.float32)
-    k = (g32 * rstd).astype(x.dtype)
+    k = chan((g32 * rstd).astype(x.dtype), x.ndim)
     dx = k * (dy
-              - (dbeta / n).astype(x.dtype)
-              - (xhat * (dgamma / n)).astype(x.dtype))
+              - chan((dbeta / n).astype(x.dtype), x.ndim)
+              - (xhat * chan((dgamma / n).astype(x.dtype), x.ndim)))
     return (dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype),
             jnp.zeros_like(mean), dres)
 

@@ -63,7 +63,7 @@ def _lse_tgt_from(logits, ids):
 
 
 def _lse_tgt(x2, W, b, ids):
-    return _lse_tgt_from(x2 @ W + b, ids)
+    return _lse_tgt_from(x2 @ W + b[None, :], ids)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
@@ -96,7 +96,7 @@ def _ce_fwd(x2, W, b, ids, w, _chunked):
     else:
         # store the compute-dtype logits: one [R, V] write+read beats
         # recomputing the projection matmul at moderate shapes
-        logits = x2 @ W + b
+        logits = x2 @ W + b[None, :]
         lse, tgt = _lse_tgt_from(logits, ids)
         res = (x2, W, b, ids, w, lse, logits)
     total = jnp.sum((lse - tgt) * w)
@@ -141,7 +141,7 @@ def _ce_bwd(_chunked, res, g):
     def chunk(carry, parts):
         dW_acc, db_acc = carry
         xci, ici, lci, sci = parts
-        dl = _dlogits(xci @ W + b, lci, ici, sci)
+        dl = _dlogits(xci @ W + b[None, :], lci, ici, sci)
         dxi = dl @ W.T
         dW_acc = dW_acc + (xci.T @ dl).astype(acc)
         db_acc = db_acc + jnp.sum(dl.astype(acc), axis=0)

@@ -25,6 +25,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..ops.shapes import chan
+
 
 def _sd(dtype):
     return jnp.promote_types(dtype, jnp.float32)
@@ -45,7 +47,7 @@ def _ln_forward(x, gamma, beta, eps):
     var = jnp.mean(jnp.square(xf - mean[..., None]), axis=-1)
     rstd = jax.lax.rsqrt(var + eps)
     y = (xf - mean[..., None]) * rstd[..., None]
-    y = y * gamma.astype(sd) + beta.astype(sd)
+    y = y * chan(gamma.astype(sd), y.ndim) + chan(beta.astype(sd), y.ndim)
     return y.astype(x.dtype), mean, rstd
 
 
@@ -64,7 +66,7 @@ def _ln_bwd(eps, res, dy):
     dgamma = jnp.sum(dyf * xhat, axis=axes).astype(gamma.dtype)
     dbeta = jnp.sum(dyf, axis=axes).astype(gamma.dtype)
     # dx = rstd * (t - mean(t) - xhat * mean(t * xhat)),  t = dy * gamma
-    t = dyf * gamma.astype(sd)
+    t = dyf * chan(gamma.astype(sd), dyf.ndim)
     mt = jnp.mean(t, axis=-1)
     mtx = jnp.mean(t * xhat, axis=-1)
     dx = rstd[..., None] * (t - mt[..., None] - xhat * mtx[..., None])

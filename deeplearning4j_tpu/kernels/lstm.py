@@ -62,14 +62,14 @@ def _make_kernel(peephole: bool):
         pre_g = pre[:, 2 * H:3 * H]
         pre_o = pre[:, 3 * H:]
         if peephole:
-            pre_i = pre_i + c_prev * pi_ref[:]
-            pre_f = pre_f + c_prev * pf_ref[:]
+            pre_i = pre_i + c_prev * pi_ref[:][None, :]
+            pre_f = pre_f + c_prev * pf_ref[:][None, :]
         i = jax.nn.sigmoid(pre_i)
         f = jax.nn.sigmoid(pre_f)
         g = jnp.tanh(pre_g)
         c = f * c_prev + i * g
         if peephole:
-            pre_o = pre_o + c * po_ref[:]
+            pre_o = pre_o + c * po_ref[:][None, :]
         o = jax.nn.sigmoid(pre_o)
         h = (o * jnp.tanh(c)).astype(h_scr.dtype)
         c = c.astype(c_scr.dtype)
@@ -176,7 +176,7 @@ def lstm_helper(conf, params, x, h0, c0, mask):
     n, t, _ = x.shape
     H = conf.n_out
     xw = (x.reshape(n * t, -1) @ params["W"]).reshape(n, t, 4 * H) \
-        + params["b"]
+        + params["b"][None, None, :]
     xw_t = jnp.transpose(xw, (1, 0, 2))
     pi, pf, po = peep if peep is not None else (None, None, None)
     y_t, hT, cT = _fused(xw_t, params["R"], h0, c0, pi, pf, po)

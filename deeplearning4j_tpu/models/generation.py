@@ -129,6 +129,7 @@ class TransformerDecoder:
                 for name in self.attn_names}
 
     # -------------------------------------------------------------- walks
+    # graftlint: traced
     def _walk_prefill(self, params, state, caches, tokens, lengths):
         """One teacher-forced pass over padded prompts [B, Tp]: fills
         cache[:, :, :Tp] at every attention vertex (the attention itself
@@ -161,6 +162,7 @@ class TransformerDecoder:
                 acts[name] = y
         return logits.astype(jnp.float32), new_caches
 
+    # graftlint: traced
     def _walk_decode(self, params, state, caches, ids, positions):
         """One single-token step: ids [B] at per-row ``positions`` [B] →
         (logits [B, V] f32, new caches)."""
@@ -186,6 +188,7 @@ class TransformerDecoder:
                 acts[name] = y
         return logits.astype(jnp.float32), new_caches
 
+    # graftlint: traced
     def _walk_recompute(self, params, state, tokens, lengths):
         """Full teacher-forced forward over the padded context + gather of
         the last real position's logits — the per-token program of the
@@ -225,10 +228,12 @@ class TransformerDecoder:
             else np.broadcast_to(np.asarray(temps, np.float32), (b,))
         fn = self._jit.get("recompute")
         if fn is None:
-            def impl(params, state, tokens, lengths, temps, key):
+            def recompute_impl(params, state, tokens, lengths, temps, key):
                 logits = self._walk_recompute(params, state, tokens, lengths)
                 return self._select(logits, temps, key), logits
-            fn = jax.jit(impl)
+            # no donation on purpose: the baseline recomputes from the SAME
+            # tokens every step and mutates no carried state
+            fn = jax.jit(recompute_impl)   # graftlint: disable=GL005
             self._jit["recompute"] = fn
         return fn(self._device_params(), self.net._inference_state(),
                   jnp.asarray(tokens, jnp.int32),
@@ -236,6 +241,7 @@ class TransformerDecoder:
                   jax.random.PRNGKey(seed))
 
     @staticmethod
+    # graftlint: traced
     def _select(logits, temps, key):
         """Per-row next token: greedy where temps <= 0, temperature
         sampling elsewhere — one compile serves mixed batches."""
@@ -250,20 +256,28 @@ class TransformerDecoder:
         fn = self._jit.get(name)
         if fn is not None:
             return fn
+        # distinct impl names: the compile auditor attributes compiles by
+        # the wrapped function's __name__ (three fns named "impl" would
+        # collapse into one audit row)
         if name == "prefill":
-            def impl(params, state, caches, tokens, lengths, temps, key):
+            def prefill_impl(params, state, caches, tokens, lengths, temps,
+                             key):
                 logits, caches = self._walk_prefill(params, state, caches,
                                                     tokens, lengths)
                 return self._select(logits, temps, key), logits, caches
-            fn = jax.jit(impl, donate_argnums=train_donate_argnums((2,)))
+            fn = jax.jit(prefill_impl,
+                         donate_argnums=train_donate_argnums((2,)))
         elif name == "step":
-            def impl(params, state, caches, ids, positions, temps, key):
+            def decode_step_impl(params, state, caches, ids, positions,
+                                 temps, key):
                 logits, caches = self._walk_decode(params, state, caches,
                                                    ids, positions)
                 return self._select(logits, temps, key), logits, caches
-            fn = jax.jit(impl, donate_argnums=train_donate_argnums((2,)))
+            fn = jax.jit(decode_step_impl,
+                         donate_argnums=train_donate_argnums((2,)))
         elif name == "prefill_slot":
-            def impl(params, state, caches, tokens, length, slot, temp, key):
+            def prefill_slot_impl(params, state, caches, tokens, length,
+                                  slot, temp, key):
                 c1 = {n: self.net.conf.vertices[n].layer.init_cache(
                           1, self.t_max, self.net.compute_dtype)
                       for n in self.attn_names}
@@ -277,7 +291,8 @@ class TransformerDecoder:
                     for n in self.attn_names}
                 nxt = self._select(logits, temp[None], key)
                 return nxt[0], logits[0], merged
-            fn = jax.jit(impl, donate_argnums=train_donate_argnums((2,)))
+            fn = jax.jit(prefill_slot_impl,
+                         donate_argnums=train_donate_argnums((2,)))
         else:                                 # pragma: no cover
             raise KeyError(name)
         self._jit[name] = fn
@@ -451,10 +466,13 @@ class SlotGenerationEngine:
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                eos_id: Optional[int] = None) -> GenerationRequest:
         req = GenerationRequest(prompt, max_new_tokens, temperature, eos_id)
-        if self._shutdown or self._dead is not None:
-            # fail fast instead of queueing onto a dead/stopped worker —
-            # a caller blocked in result(None) would never return
-            req._fail(self._dead or RuntimeError(
+        with self._lock:
+            dead = self._dead
+            stopped = self._shutdown or dead is not None
+        if stopped:
+            # a dead/stopped engine beats argument validation: the caller
+            # must learn the engine is gone even for no-op requests
+            req._fail(dead or RuntimeError(
                 "SlotGenerationEngine shut down"))
             return req
         if len(req.prompt) < 1:
@@ -468,8 +486,21 @@ class SlotGenerationEngine:
                 f"prompt length {len(req.prompt)} leaves no room to "
                 f"generate within t_max {self.t_max}"))
             return req
+        # RE-check under the same critical section as the append: a dying
+        # worker sets _dead under this lock BEFORE draining the queue
+        # (shutdown() likewise flags before draining), so either we see
+        # the flag here and fail fast, or our append lands before the
+        # drain and the drain fails it — a request can never be queued
+        # after the last drain and strand its caller in result(None)
         with self._lock:
-            self._pending.append(req)
+            dead = self._dead
+            queued = not (self._shutdown or dead is not None)
+            if queued:
+                self._pending.append(req)
+        if not queued:
+            req._fail(dead or RuntimeError(
+                "SlotGenerationEngine shut down"))
+            return req
         self._work.set()
         return req
 
@@ -481,7 +512,8 @@ class SlotGenerationEngine:
     def _finish(self, slot: int):
         req = self._slots[slot]
         self._slots[slot] = None
-        self.completed += 1
+        with self._lock:       # stats race external readers (bench/serving)
+            self.completed += 1
         req._complete()
 
     def _admit(self):
@@ -497,7 +529,8 @@ class SlotGenerationEngine:
             tp = min(_round_up_pow2(plen), self.t_max)
             tokens = np.zeros((1, tp), np.int32)
             tokens[0, :plen] = req.prompt
-            self.prefills += 1
+            with self._lock:
+                self.prefills += 1
             nxt, _, self._caches = self.decoder._fn("prefill_slot")(
                 self.decoder._device_params(),
                 self.decoder.net._inference_state(), self._caches,
@@ -507,7 +540,8 @@ class SlotGenerationEngine:
                 jax.random.fold_in(self._key, self.prefills))
             tok = int(np.asarray(nxt))
             req.generated.append(tok)
-            self.emitted_tokens += 1
+            with self._lock:
+                self.emitted_tokens += 1
             if (req.eos_id is not None and tok == req.eos_id) or \
                     req.max_new_tokens <= 1 or plen + 1 >= self.t_max:
                 self._finish(s)               # done at the first token
@@ -523,26 +557,31 @@ class SlotGenerationEngine:
     def _step(self):
         """One batched decode step over every slot (free slots ride along
         at clamped positions; their output is ignored)."""
-        self._step_no += 1
-        self.decode_steps += 1
+        with self._lock:
+            self._step_no += 1
+            self.decode_steps += 1
         nxt, _, self._caches = self.decoder.decode_step(
             self._caches, self._last_ids,
             np.minimum(self._positions, self.t_max - 1), self._temps,
             key=jax.random.fold_in(self._key, 1 << 20 | self._step_no))
         nxt_host = np.asarray(nxt)
-        for s in range(self.num_slots):
+        emitted = 0                    # one locked update per STEP, not
+        for s in range(self.num_slots):    # per token (hot decode loop)
             req = self._slots[s]
             if req is None:
                 continue
             tok = int(nxt_host[s])
             req.generated.append(tok)
-            self.emitted_tokens += 1
+            emitted += 1
             self._positions[s] += 1
             self._last_ids[s] = tok
             if (req.eos_id is not None and tok == req.eos_id) or \
                     len(req.generated) >= req.max_new_tokens or \
                     len(req.prompt) + len(req.generated) >= self.t_max:
                 self._finish(s)
+        if emitted:
+            with self._lock:
+                self.emitted_tokens += emitted
 
     # ---------------------------------------------------------- execution
     def run_until_drained(self):
@@ -576,7 +615,8 @@ class SlotGenerationEngine:
             # a dying worker (device error, OOM) fails every outstanding
             # request instead of leaving result() blocked forever, and
             # marks the engine dead so later submit()s fail fast
-            self._dead = exc
+            with self._lock:
+                self._dead = exc
             for s in range(self.num_slots):
                 if self._slots[s] is not None:
                     self._slots[s]._fail(exc)

@@ -8,11 +8,11 @@ this fresh). Integrates with the framework seams: helper registry kind
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..input_type import InputType
 from ..serde import register_config
@@ -75,6 +75,7 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
             v = (x @ params["Wv"]).reshape(n, t, hcount, hs)
         return q, k, v
 
+    # graftlint: traced
     def _attend(self, q, k, v, mask, dtype):
         """Full [N, T, H, Dh] attention through the helper seam (flash /
         short-T Pallas kernels) with the materialized-softmax path as the
@@ -104,7 +105,7 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         n, t = out.shape[:2]
         out = out.reshape(n, t, self.num_heads * self._head_size())
         if self.project_out:
-            out = out @ params["Wo"] + params["bo"]
+            out = out @ params["Wo"] + params["bo"][None, None, :]
         return self.activation_fn()(out)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
@@ -123,6 +124,7 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         shape = (batch, self.num_heads, t_max, hs)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
+    # graftlint: traced
     def prefill_forward(self, params, x, cache: Dict, mask=None):
         """Teacher-forced pass over the prompt [B, T, n_in] that also fills
         cache[:, :, :T] with this layer's k/v — attention itself rides the
@@ -141,6 +143,7 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
                 (0, 0, 0, 0))}
         return self._project_out(params, out), new_cache
 
+    # graftlint: traced
     def decode_forward(self, params, x, cache: Dict, positions):
         """One decode step: x [B, 1, n_in] is the token at ``positions``
         ([B] int32, per-row — slots in a continuous batch sit at different
@@ -168,7 +171,9 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         out = helper(self, q, ck, cv, pos) if helper is not None else None
         if out is None:
             hs = self._head_size()
-            scale = 1.0 / np.sqrt(hs)
+            # math.sqrt, not np.sqrt: an np.float64 scale would promote the
+            # f32 decode logits to f64 under x64 mode (GL004)
+            scale = 1.0 / math.sqrt(hs)
             logits = jnp.einsum("bhd,bhtd->bht", q[:, 0], ck,
                                 preferred_element_type=jnp.float32) * scale
             kpos = jnp.arange(ck.shape[2], dtype=jnp.int32)
@@ -244,9 +249,9 @@ class TransformerFeedForward(BaseRecurrentLayerConf):
         return ("W1", "W2")
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        h = jax.nn.gelu(x @ params["W1"] + params["b1"])
+        h = jax.nn.gelu(x @ params["W1"] + params["b1"][None, None, :])
         h = self.maybe_dropout(h, train=train, rng=rng)
-        return h @ params["W2"] + params["b2"], state
+        return h @ params["W2"] + params["b2"][None, None, :], state
 
 
 @register_config
@@ -279,6 +284,7 @@ class TokenAndPositionEmbedding(BaseRecurrentLayerConf):
         out = params["W"][ids] + params["P"][None, :t]
         return self.maybe_dropout(out, train=train, rng=rng), state
 
+    # graftlint: traced
     def embed_at(self, params, ids, positions):
         """Single-position decode embedding: ids [B] + per-row positions
         [B] → [B, 1, n_out]. The decode loop guards positions <

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ....ops.shapes import chan
 from ..input_type import InputType
 from ..serde import register_config
 from .base import LayerConf, FeedForwardLayerConf
@@ -94,7 +95,7 @@ class ConvolutionLayer(FeedForwardLayerConf):
                 rhs_dilation=_pair(self.dilation),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
             if self.has_bias:
-                pre = pre + params["b"]
+                pre = pre + chan(params["b"], pre.ndim)
         return self.activation_fn()(pre), state
 
 
@@ -142,7 +143,7 @@ class Convolution1DLayer(ConvolutionLayer):
             padding=pad, rhs_dilation=(_pair(self.dilation)[0],),
             dimension_numbers=("NWC", "WIO", "NWC"))
         if self.has_bias:
-            pre = pre + params["b"]
+            pre = pre + chan(params["b"], pre.ndim)
         return self.activation_fn()(pre), state
 
 
@@ -300,9 +301,11 @@ class BatchNormalization(LayerConf):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        xhat = (x - chan(mean, x.ndim)) / \
+            jnp.sqrt(chan(var, x.ndim) + self.eps)
         if not self.lock_gamma_beta and params:
-            xhat = xhat * params["gamma"] + params["beta"]
+            xhat = xhat * chan(params["gamma"], x.ndim) + \
+                chan(params["beta"], x.ndim)
         else:
             xhat = xhat * self.gamma + self.beta
         return self.activation_fn()(xhat), new_state

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ....ops.losses import get_loss, compute_loss
+from ....ops.shapes import chan
 from ..input_type import InputType
 from ..serde import register_config
 from .base import FeedForwardLayerConf, LayerConf
@@ -32,7 +33,7 @@ class DenseLayer(FeedForwardLayerConf):
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
-        pre = x @ params["W"] + params["b"]
+        pre = x @ params["W"] + chan(params["b"], x.ndim)
         return self.activation_fn()(pre), state
 
 
@@ -49,7 +50,7 @@ class OutputLayer(DenseLayer):
                             self.activation or "identity", mask, average)
 
     def preoutput(self, params, x):
-        return x @ params["W"] + params["b"]
+        return x @ params["W"] + chan(params["b"], x.ndim)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
@@ -132,7 +133,7 @@ class EmbeddingLayer(FeedForwardLayerConf):
             ids = jnp.argmax(x, axis=-1)        # one-hot input
         else:
             ids = x.astype(jnp.int32).reshape(x.shape[0])
-        out = W[ids] + params["b"]
+        out = W[ids] + chan(params["b"], 2)
         return self.activation_fn()(out), state
 
     def init_params(self, key, dtype=jnp.float32) -> Dict:
@@ -160,10 +161,10 @@ class AutoEncoder(FeedForwardLayerConf):
                 "vb": jnp.zeros((self.n_in,), dtype)}
 
     def encode(self, params, x):
-        return self.activation_fn()(x @ params["W"] + params["b"])
+        return self.activation_fn()(x @ params["W"] + chan(params["b"], x.ndim))
 
     def decode(self, params, h):
-        return self.activation_fn()(h @ params["W"].T + params["vb"])
+        return self.activation_fn()(h @ params["W"].T + chan(params["vb"], h.ndim))
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         return self.encode(params, x), state
@@ -173,7 +174,8 @@ class AutoEncoder(FeedForwardLayerConf):
         if self.corruption_level > 0 and rng is not None:
             keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
             corrupted = x * keep
-        recon_pre = self.encode(params, corrupted) @ params["W"].T + params["vb"]
+        h = self.encode(params, corrupted)
+        recon_pre = h @ params["W"].T + chan(params["vb"], h.ndim)
         per = get_loss(self.loss)(x, recon_pre, self.activation or "sigmoid")
         return jnp.mean(per)
 
@@ -195,14 +197,14 @@ class RBM(FeedForwardLayerConf):
                 "vb": jnp.zeros((self.n_in,), dtype)}     # visible bias
 
     def propup(self, params, v):
-        return jax.nn.sigmoid(v @ params["W"] + params["b"])
+        return jax.nn.sigmoid(v @ params["W"] + chan(params["b"], v.ndim))
 
     def propdown(self, params, h):
-        pre = h @ params["W"].T + params["vb"]
+        pre = h @ params["W"].T + chan(params["vb"], h.ndim)
         return pre if self.visible_unit == "gaussian" else jax.nn.sigmoid(pre)
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
-        return self.activation_fn()(x @ params["W"] + params["b"]), state
+        return self.activation_fn()(x @ params["W"] + chan(params["b"], x.ndim)), state
 
     def cd_gradient(self, params, v0, rng):
         """One CD-k step → param gradients (to be fed to the updater)."""

@@ -48,14 +48,14 @@ def _lstm_recurrence(xw_t, R, peepholes, h0, c0, mask_t, gate_act, cell_act):
         pre = xw_step + h_prev @ R
         pre_i, pre_f, pre_g, pre_o = jnp.split(pre, 4, axis=-1)
         if pi is not None:
-            pre_i = pre_i + c_prev * pi
-            pre_f = pre_f + c_prev * pf
+            pre_i = pre_i + c_prev * pi[None, :]
+            pre_f = pre_f + c_prev * pf[None, :]
         i = gate_act(pre_i)
         f = gate_act(pre_f)
         g = cell_act(pre_g)
         c = f * c_prev + i * g
         if po is not None:
-            pre_o = pre_o + c * po
+            pre_o = pre_o + c * po[None, :]
         o = gate_act(pre_o)
         h = o * cell_act(c)
         if m is not None:
@@ -72,7 +72,7 @@ def _lstm_scan(conf, W, R, b, peepholes, x, h0, c0, mask, gate_act, cell_act):
     """Shared scan core. x: [N,T,nIn] → y: [N,T,H], final (h, c)."""
     n, t, _ = x.shape
     hsize = R.shape[0]
-    xw = (x.reshape(n * t, -1) @ W).reshape(n, t, 4 * hsize) + b
+    xw = (x.reshape(n * t, -1) @ W).reshape(n, t, 4 * hsize) + b[None, None, :]
     xw_t = jnp.transpose(xw, (1, 0, 2))          # [T, N, 4H] scan order
     mask_t = None
     if mask is not None:

@@ -69,19 +69,19 @@ class VariationalAutoencoder(FeedForwardLayerConf):
         act = self.activation_fn()
         h = x
         for i in range(len(self.encoder_layer_sizes)):
-            h = act(h @ params[f"eW{i}"] + params[f"eb{i}"])
+            h = act(h @ params[f"eW{i}"] + params[f"eb{i}"][None, :])
         from ....ops.activations import get_activation
         pzx = get_activation(self.pzx_activation)
-        mu = pzx(h @ params["muW"] + params["mub"])
-        logvar = h @ params["lvW"] + params["lvb"]
+        mu = pzx(h @ params["muW"] + params["mub"][None, :])
+        logvar = h @ params["lvW"] + params["lvb"][None, :]
         return mu, logvar
 
     def _decode(self, params, z):
         act = self.activation_fn()
         h = z
         for i in range(len(self.decoder_layer_sizes)):
-            h = act(h @ params[f"dW{i}"] + params[f"db{i}"])
-        return h @ params["oW"] + params["ob"]
+            h = act(h @ params[f"dW{i}"] + params[f"db{i}"][None, :])
+        return h @ params["oW"] + params["ob"][None, :]
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None):
         mu, _ = self._encode(params, x)

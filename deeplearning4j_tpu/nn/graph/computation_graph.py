@@ -239,7 +239,9 @@ class ComputationGraph:
                 acts, *_ = self._forward(params, state, inputs, train=False,
                                          rng=None)
                 return [acts[o] for o in self.conf.network_outputs]
-            fn = jax.jit(_out)
+            # inference seam: donating would free params/state the next
+            # call still needs (GL005 siblings donate TRAIN-step buffers)
+            fn = jax.jit(_out)   # graftlint: disable=GL005
             self._jit_cache["output"] = fn
         outs = fn(self.params, self._inference_state(), inputs)
         return [np.asarray(o) for o in outs]
@@ -609,7 +611,8 @@ class ComputationGraph:
                     newu[pname] = ns
                 return newp, newu, loss
 
-            fn = jax.jit(_ptrain)
+            # layerwise pretrain is cold-path; donation unmeasured here
+            fn = jax.jit(_ptrain)   # graftlint: disable=GL005
             self._jit_cache[key] = fn
         for _ in range(num_epochs):
             for ds in as_iterator(data):
@@ -676,7 +679,8 @@ class ComputationGraph:
                            and ("h" in ns or "c" in ns)}
                 return [acts[o] for o in self.conf.network_outputs], carries
 
-            fn = jax.jit(_step)
+            # inference seam: params/state must survive the call
+            fn = jax.jit(_step)   # graftlint: disable=GL005
             self._jit_cache["rnn_step"] = fn
         outs_dev, carries = fn(self.params, state, inputs)
         self._rnn_state.update(carries)

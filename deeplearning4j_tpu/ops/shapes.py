@@ -1,0 +1,25 @@
+"""Explicit-broadcast helpers.
+
+The test suite runs ``jax_numpy_rank_promotion="raise"`` (graftlint
+ISSUE 2 satellite): implicit rank promotion is how a [B] vector silently
+broadcasts against [B, T] with a missing axis. Every INTENDED mixed-rank
+broadcast in library code goes through these helpers (or a literal
+``[None, :]`` when the ranks are statically known), which makes the
+intent grep-able and keeps 'raise' viable repo-wide.
+"""
+
+from __future__ import annotations
+
+
+def chan(p, ref):
+    """Per-channel parameter ``p`` [C] (or any rank-k tail) explicitly
+    promoted to broadcast against ``ref``'s rank: [1, ..., 1, C].
+    ``ref`` may be an array or an int ndim."""
+    ndim = ref if isinstance(ref, int) else ref.ndim
+    missing = ndim - p.ndim
+    if missing <= 0:
+        return p
+    return p.reshape((1,) * missing + tuple(p.shape))
+
+
+__all__ = ["chan"]

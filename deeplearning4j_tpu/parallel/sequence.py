@@ -352,7 +352,7 @@ class SequenceParallelTrainer:
                                   causal=conf.causal)
         out = out.reshape(n, t, hcount * hs)
         if conf.project_out:
-            out = out @ params["Wo"] + params["bo"]
+            out = out @ params["Wo"] + params["bo"][None, None, :]
         out = conf.activation_fn()(out)
         return jnp.mean((out - y) ** 2)
 

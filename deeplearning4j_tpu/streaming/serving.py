@@ -44,6 +44,10 @@ class ModelServingRoute:
         self.batch_window = max(0.0, float(batch_window))
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # guards the serving counters: the route thread writes them while
+        # callers (tests, dashboards) read — and a future multi-route net
+        # may share one instance
+        self._stats_lock = threading.Lock()
         self.served = 0
         self.batches = 0      # coalesced (>=2 message) dispatch attempts
         self.singles = 0      # single-message dispatches (incl. fallbacks)
@@ -83,14 +87,16 @@ class ModelServingRoute:
                 # provably singletons
                 self._serve_single(run[0])
             else:
-                self.batches += 1    # one coalesced dispatch attempt
+                with self._stats_lock:
+                    self.batches += 1   # one coalesced dispatch attempt
                 try:
                     stacked = np.concatenate(
                         [a.astype(np.float32) for a in run], axis=0)
                     out = np.asarray(self.net.output(stacked))
                     splits = np.cumsum([a.shape[0] for a in run])[:-1]
                     pieces = np.split(out, splits, axis=0)
-                    self.served += len(pieces)
+                    with self._stats_lock:
+                        self.served += len(pieces)
                     for piece in pieces:
                         self.pub.publish(piece)
                 except Exception:
@@ -103,15 +109,18 @@ class ModelServingRoute:
             i = j
 
     def _serve_single(self, a: np.ndarray) -> None:
-        self.singles += 1
+        with self._stats_lock:
+            self.singles += 1
         try:
             out = np.asarray(self.net.output(a.astype(np.float32)))
-            self.served += 1
+            with self._stats_lock:
+                self.served += 1
             self.pub.publish(out)
         except Exception:
             # a bad payload must not kill the route (Camel's route
             # error-handling role); counted per message
-            self.errors += 1
+            with self._stats_lock:
+                self.errors += 1
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -169,6 +178,8 @@ class GenerationServingRoute:
         self._inflight: "List" = []          # submission-ordered handles
         self._inflight_lock = threading.Lock()
         self.max_inflight = max(1, int(max_inflight))
+        # consumer and publisher threads both bump counters; callers read
+        self._stats_lock = threading.Lock()
         self.served = 0
         self.errors = 0
 
@@ -193,7 +204,8 @@ class GenerationServingRoute:
                 with self._inflight_lock:
                     self._inflight.append(req)
             except Exception:
-                self.errors += 1             # bad payload must not kill it
+                with self._stats_lock:       # bad payload must not kill it
+                    self.errors += 1
 
     def _publish_in_order(self) -> None:
         while not self._stop.is_set():
@@ -207,13 +219,15 @@ class GenerationServingRoute:
             except TimeoutError:
                 continue
             except Exception:
-                self.errors += 1
+                with self._stats_lock:
+                    self.errors += 1
                 out = None
             with self._inflight_lock:
                 self._inflight.pop(0)
             if out is not None:
                 self.pub.publish(np.asarray(out, np.int32))
-                self.served += 1
+                with self._stats_lock:
+                    self.served += 1
 
     def start(self) -> "GenerationServingRoute":
         self.engine.start()

@@ -126,7 +126,8 @@ class TcpBrokerServer:
     def start(self) -> "TcpBrokerServer":
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
-        self._threads.append(t)
+        with self._lock:
+            self._threads.append(t)
         return self
 
     def _accept_loop(self) -> None:
@@ -142,8 +143,9 @@ class TcpBrokerServer:
             t.start()
             # prune finished per-connection threads so a long-lived server
             # doesn't leak one dead Thread object per connection ever made
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+            with self._lock:
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
 
     def _evict(self, conn: socket.socket) -> None:
         """Drop a dead/stalled connection from every topic and close it.
@@ -185,7 +187,8 @@ class TcpBrokerServer:
                     for c, out in targets:
                         if out is None or not out.send(frame):
                             # overflowed (stalled) or already gone: evict
-                            self.disconnects += 1
+                            with self._lock:   # reader threads race here
+                                self.disconnects += 1
                             self._evict(c)
         except (ConnectionError, struct.error, OSError):
             pass

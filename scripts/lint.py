@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""graftlint CLI — trace-discipline static analysis with a baseline gate.
+
+    python scripts/lint.py                       # report all findings
+    python scripts/lint.py --fail-on-new         # CI gate: exit 1 only on
+                                                 # findings NOT in
+                                                 # analysis/baseline.json
+    python scripts/lint.py --write-baseline      # re-record the baseline
+    python scripts/lint.py --rules GL001,GL006 path/to/file.py
+    python scripts/lint.py --format json
+
+The gate contract: the checked-in baseline suppresses day-0 violations;
+any NEW violation (or a second instance of a baselined one) fails fast.
+Fix it or — only with a reviewed justification — re-record the baseline.
+No jax import, no device: pure AST, safe anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from deeplearning4j_tpu.analysis.lint import (RULES, LintRunner,  # noqa: E402
+                                              load_baseline, new_findings,
+                                              write_baseline)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "deeplearning4j_tpu", "analysis",
+                                "baseline.json")
+DEFAULT_PATHS = [os.path.join(REPO_ROOT, "deeplearning4j_tpu"),
+                 os.path.join(REPO_ROOT, "bench.py"),
+                 os.path.join(REPO_ROOT, "examples")]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the package + "
+                         "bench.py + examples)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit 1 only on findings not covered by the "
+                         "baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as the new baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(RULES.items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = set(rules) - set(RULES)
+        if unknown:
+            ap.error(f"unknown rules: {sorted(unknown)}")
+
+    paths = args.paths or DEFAULT_PATHS
+    runner = LintRunner(REPO_ROOT, rules)
+    findings = runner.lint(paths)
+
+    if args.write_baseline:
+        data = write_baseline(args.baseline, findings)
+        print(f"baseline: {data['total']} finding(s) across "
+              f"{len(data['suppressed'])} key(s) -> {args.baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    fresh = new_findings(findings, baseline)
+    shown = fresh if args.fail_on_new else findings
+
+    if args.format == "json":
+        print(json.dumps({
+            "total": len(findings),
+            "new": len(fresh),
+            "baseline_keys": len(baseline),
+            "parse_errors": runner.errors,
+            "findings": [f.to_dict() for f in shown],
+        }, indent=1))
+    else:
+        for f in shown:
+            print(f)
+        for e in runner.errors:
+            print(f"PARSE ERROR: {e}", file=sys.stderr)
+        tag = "new " if args.fail_on_new else ""
+        print(f"graftlint: {len(shown)} {tag}finding(s) "
+              f"({len(findings)} total, {len(baseline)} baselined key(s))")
+
+    # fail CLOSED: unreadable/unparseable/missing inputs mean unknown
+    # coverage — code the gate cannot see must not pass it green
+    if runner.errors:
+        return 2
+    if args.fail_on_new:
+        return 1 if fresh else 0
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,12 @@ import jax
 # Robust even if a pytest plugin imported jax before this conftest ran:
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# graftlint satellite (ISSUE 2): implicit rank promotion is a silent
+# correctness hazard (a [B] vector broadcasting against [B, T] hides a
+# missing axis); library code annotates every INTENDED mixed-rank
+# broadcast explicitly ([None, :]-style), so tests run with promotion
+# errors FATAL to keep it that way.
+jax.config.update("jax_numpy_rank_promotion", "raise")
 
 import numpy as np
 import pytest

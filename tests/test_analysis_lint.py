@@ -1,0 +1,436 @@
+"""graftlint analyzer tests: per-rule fixture snippets (positive AND
+negative), inline suppression, the traced-marker escape hatch, the
+baseline round-trip, and the runtime compile auditor (retrace detection
+on a deliberately shape-unstable function; zero-retrace invariants on
+the real serving engine)."""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import (CompileAudit, CompileBudgetError,
+                                         lint_paths, load_baseline,
+                                         new_findings, write_baseline)
+
+
+def _lint_src(tmp_path, src, rel="deeplearning4j_tpu/kernels/mod.py",
+              rules=None):
+    """Write ``src`` at ``rel`` under tmp_path and lint it; rel defaults
+    to a hot-module path so every rule is in scope."""
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return lint_paths([str(p)], repo_root=str(tmp_path), rules=rules)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestHostSyncRule:
+    def test_item_inside_jit_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                return x.item()
+        """)
+        assert _rules(out) == ["GL001"]
+        assert out[0].func == "f"
+
+    def test_item_outside_jit_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            def f(x):
+                return x.item()
+        """)
+        assert out == []
+
+    def test_float_of_traced_param_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            def step(x):
+                return float(x)
+            g = jax.jit(step)
+        """)
+        assert _rules(out) == ["GL001"]
+
+    def test_float_of_static_param_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import functools, jax
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                return x * int(n)
+        """)
+        assert out == []
+
+    def test_np_asarray_inside_scan_body_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            import numpy as np
+            def body(carry, t):
+                return carry, np.asarray(t)
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+        """)
+        assert "GL001" in _rules(out)
+
+
+class TestLoopAndBranchRules:
+    def test_shape_loop_in_hot_module_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                acc = 0.0
+                for i in range(x.shape[0]):
+                    acc = acc + x[i]
+                return acc
+        """)
+        assert "GL002" in _rules(out)
+
+    def test_shape_loop_outside_hot_module_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                acc = 0.0
+                for i in range(x.shape[0]):
+                    acc = acc + x[i]
+                return acc
+        """, rel="deeplearning4j_tpu/ui/mod.py", rules=["GL002"])
+        assert out == []
+
+    def test_branch_on_traced_value_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert _rules(out) == ["GL003"]
+
+    def test_is_none_and_shape_branches_are_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x, mask=None):
+                if mask is not None:
+                    x = x * mask
+                if x.ndim == 3:
+                    x = x[0]
+                return x
+        """)
+        assert out == []
+
+
+class TestPromotionAndJitSiteRules:
+    def test_np_math_in_jit_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return x * np.sqrt(4)
+        """, rules=["GL004"])
+        assert _rules(out) == ["GL004"]
+
+    def test_jnp_math_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def f(x):
+                return x * jnp.sqrt(4.0)
+        """, rules=["GL004"])
+        assert out == []
+
+    def test_inconsistent_donation_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            def a(x):
+                return x
+            def b(x):
+                return x
+            fa = jax.jit(a, donate_argnums=(0,))
+            fb = jax.jit(b)
+        """, rules=["GL005"])
+        assert len(out) == 1 and out[0].rule == "GL005"
+
+    def test_consistent_donation_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            def a(x):
+                return x
+            def b(x):
+                return x
+            fa = jax.jit(a, donate_argnums=(0,))
+            fb = jax.jit(b, donate_argnums=(0,))
+        """, rules=["GL005"])
+        assert out == []
+
+
+class TestLockDisciplineRule:
+    def test_unlocked_shared_write_in_thread_target_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+                def start(self):
+                    threading.Thread(target=self._run).start()
+                def _run(self):
+                    self.count += 1
+                def snapshot(self):
+                    return self.count
+        """, rel="deeplearning4j_tpu/streaming/mod.py", rules=["GL006"])
+        assert len(out) == 1 and out[0].rule == "GL006"
+        assert "count" in out[0].message
+
+    def test_locked_write_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+                def start(self):
+                    threading.Thread(target=self._run).start()
+                def _run(self):
+                    with self._lock:
+                        self.count += 1
+                def snapshot(self):
+                    return self.count
+        """, rel="deeplearning4j_tpu/streaming/mod.py", rules=["GL006"])
+        assert out == []
+
+    def test_transitive_thread_context_is_tracked(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self.done = 0
+                    self._lock = threading.Lock()
+                def start(self):
+                    threading.Thread(target=self._run).start()
+                def _run(self):
+                    self._step()
+                def _step(self):
+                    self.done += 1
+        """, rel="deeplearning4j_tpu/streaming/mod.py", rules=["GL006"])
+        assert len(out) == 1 and out[0].func.endswith("._step")
+
+
+class TestSuppressionAndBaseline:
+    def test_inline_disable_suppresses(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                return x.item()   # graftlint: disable=GL001
+        """)
+        assert out == []
+
+    def test_trailing_disable_does_not_spill_to_next_line(self, tmp_path):
+        """A new violation written directly below an existing trailing
+        suppression must still trip the gate."""
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                a = x.item()   # graftlint: disable=GL001
+                b = x.item()
+                return a + b
+        """)
+        assert len(out) == 1 and out[0].rule == "GL001"
+
+    def test_standalone_disable_covers_line_below(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                # graftlint: disable=GL001
+                return x.item()
+        """)
+        assert out == []
+
+    def test_traced_marker_opts_method_in(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            class Layer:
+                # graftlint: traced
+                def decode(self, params, x):
+                    return x.item()
+        """)
+        assert _rules(out) == ["GL001"]
+
+    def test_baseline_round_trip(self, tmp_path):
+        src = """
+            import jax
+            @jax.jit
+            def f(x):
+                return x.item()
+        """
+        found = _lint_src(tmp_path, src)
+        assert len(found) == 1
+        bpath = tmp_path / "baseline.json"
+        write_baseline(str(bpath), found)
+        baseline = load_baseline(str(bpath))
+        # same findings -> nothing new
+        again = _lint_src(tmp_path, src)
+        assert new_findings(again, baseline) == []
+        # a SECOND violation in the same function -> exactly it is new
+        worse = _lint_src(tmp_path, src + """
+            @jax.jit
+            def g(x):
+                return x.tolist()
+        """)
+        fresh = new_findings(worse, baseline)
+        assert len(fresh) == 1 and fresh[0].func == "g"
+
+    def test_baseline_file_shape(self, tmp_path):
+        found = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x):
+                return x.item()
+        """)
+        bpath = tmp_path / "baseline.json"
+        data = write_baseline(str(bpath), found)
+        on_disk = json.loads(bpath.read_text())
+        assert on_disk == data
+        assert on_disk["total"] == 1 and on_disk["rules"] == ["GL001"]
+
+    def test_missing_and_unparseable_paths_are_surfaced(self, tmp_path):
+        """Coverage the gate cannot see must not pass silently: stale
+        paths and unparseable files land in runner.errors (the CLI exits
+        non-zero on any)."""
+        from deeplearning4j_tpu.analysis.lint import LintRunner
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        runner = LintRunner(str(tmp_path))
+        found = runner.lint([str(tmp_path / "nope"), str(bad),
+                             str(tmp_path / "not_python.txt")])
+        assert found == []
+        assert len(runner.errors) == 3
+
+    def test_repo_baseline_is_clean(self):
+        """The checked-in gate invariant: lint over the real package has
+        ZERO findings beyond analysis/baseline.json."""
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "deeplearning4j_tpu")
+        baseline = load_baseline(os.path.join(pkg, "analysis",
+                                              "baseline.json"))
+        found = lint_paths([pkg, os.path.join(root, "bench.py")],
+                           repo_root=root)
+        fresh = new_findings(found, baseline)
+        assert fresh == [], "\n".join(str(f) for f in fresh)
+
+
+class TestCompileAudit:
+    def test_shape_unstable_function_is_caught(self):
+        import jax
+        import jax.numpy as jnp
+
+        with CompileAudit() as audit:
+            @jax.jit
+            def unstable(x):
+                return x * 2.0
+            for n in (3, 4, 5):          # deliberately retraces per shape
+                unstable(jnp.ones(n))
+            for _ in range(5):           # steady calls: no new compiles
+                unstable(jnp.ones(3))
+        assert audit.compiles("unstable") == 3
+        info = audit.retraces()["unstable"]
+        assert info["compiles"] == 3
+        assert info["distinct_signatures"] == 3
+        assert info["duplicate_signature_compiles"] == 0
+        with pytest.raises(CompileBudgetError):
+            audit.check(budget={"unstable": 1})
+        audit.check(budget={"unstable": 3})      # at budget: fine
+
+    def test_stable_function_compiles_once(self):
+        import jax
+        import jax.numpy as jnp
+
+        with CompileAudit(budget={"stable": 1}) as audit:
+            @jax.jit
+            def stable(x):
+                return x + 1.0
+            snap = None
+            for i in range(4):
+                stable(jnp.arange(7.0))
+                if i == 0:
+                    snap = audit.snapshot()
+        assert audit.compiles("stable") == 1
+        assert audit.delta(snap) == {}           # steady state: no compiles
+        assert audit.duplicate_signature_compiles == 0
+
+    def test_exit_restores_log_compiles(self):
+        import jax
+        prev = bool(getattr(jax.config, "jax_log_compiles", False))
+        with CompileAudit():
+            pass
+        assert bool(getattr(jax.config, "jax_log_compiles", False)) == prev
+
+
+def _tiny_lm(vocab=37, d=16, heads=2, layers=1, t_max=32):
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = transformer_lm_conf(vocab_size=vocab, d_model=d, num_heads=heads,
+                               num_layers=layers, max_length=t_max)
+    return ComputationGraph(conf, compute_dtype=jnp.float32).init()
+
+
+class TestServingCompileInvariants:
+    def test_three_wave_engine_run_has_no_retraces(self):
+        """Acceptance invariant: a 3-wave SlotGenerationEngine run compiles
+        prefill_slot_impl and decode_step_impl exactly ONCE each — slot
+        refills, mixed prompt lengths, and later waves reuse the programs."""
+        from deeplearning4j_tpu.models import SlotGenerationEngine
+        net = _tiny_lm()
+        eng = SlotGenerationEngine(net, num_slots=3, refill=True, seed=0)
+        rng = np.random.default_rng(5)
+        with CompileAudit() as audit:
+            for wave in range(3):
+                reqs = [eng.submit(rng.integers(0, 37, int(n)), 4)
+                        for n in rng.integers(2, 9, 6)]
+                eng.run_until_drained()
+                assert all(r.done() for r in reqs)
+        assert audit.compiles("prefill_slot_impl") == 1
+        assert audit.compiles("decode_step_impl") == 1
+        assert audit.duplicate_signature_compiles == 0
+        audit.check(budget={"prefill_slot_impl": 1, "decode_step_impl": 1})
+
+    def test_submit_after_shutdown_fails_fast_not_hangs(self):
+        """The shutdown/dead check and the queue append are one atomic
+        section: a request can never be queued after the final drain (its
+        caller would hang forever in result(None))."""
+        from deeplearning4j_tpu.models import SlotGenerationEngine
+        net = _tiny_lm()
+        eng = SlotGenerationEngine(net, num_slots=2).start()
+        ok = eng.submit([1, 2, 3], 3)
+        assert ok.result(timeout=60) is not None
+        eng.shutdown()
+        late = eng.submit([1, 2, 3], 3)
+        with pytest.raises(RuntimeError):
+            late.result(timeout=5)
+
+    def test_bucketed_generate_compiles_once_across_lengths(self):
+        """models.generate's fixed bucket: mixed prompt lengths share ONE
+        [1, bucket] program (the compile-per-token failure mode this
+        bucket exists to prevent)."""
+        from deeplearning4j_tpu.models import generate
+        net = _tiny_lm()
+        with CompileAudit() as audit:
+            for plen in (2, 5, 9):
+                generate(net, list(range(1, plen + 1)), 4, temperature=0,
+                         bucket=16)
+        assert audit.compiles("_out") == 1
+        assert audit.duplicate_signature_compiles == 0

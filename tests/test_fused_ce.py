@@ -36,7 +36,7 @@ class TestFusedOpEquivalence:
     def test_score_matches_materialized(self):
         x, W, b, ids = self._setup()
         y1 = jnp.asarray(_one_hot(ids, W.shape[1]))
-        ref = compute_loss("mcxent", y1, x @ W + b, "softmax", None, True)
+        ref = compute_loss("mcxent", y1, x @ W + b[None, None, :], "softmax", None, True)
         got = fused_sparse_ce_score({"W": W, "b": b}, x,
                                     jnp.asarray(ids, jnp.int32), None, True)
         np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
@@ -47,7 +47,7 @@ class TestFusedOpEquivalence:
         mask[1, 3:] = 0.0
         mask[2, 1:] = 0.0
         y1 = jnp.asarray(_one_hot(ids, W.shape[1]))
-        ref = compute_loss("mcxent", y1, x @ W + b, "softmax",
+        ref = compute_loss("mcxent", y1, x @ W + b[None, None, :], "softmax",
                            jnp.asarray(mask), True)
         got = fused_sparse_ce_score({"W": W, "b": b}, x,
                                     jnp.asarray(ids, jnp.int32),
@@ -60,7 +60,7 @@ class TestFusedOpEquivalence:
         ids_j = jnp.asarray(ids, jnp.int32)
 
         def f_ref(x, W, b):
-            return compute_loss("mcxent", y1, x @ W + b, "softmax", None,
+            return compute_loss("mcxent", y1, x @ W + b[None, None, :], "softmax", None,
                                 True)
 
         def f_fused(x, W, b):

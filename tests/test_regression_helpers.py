@@ -240,9 +240,10 @@ class TestBnHelperEquivalence:
         eps = 1e-5
 
         def ref(x, gamma, beta):
-            mean = jnp.mean(x, axis=0)
-            var = jnp.var(x, axis=0)
-            return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+            mean = jnp.mean(x, axis=0)[None, :]
+            var = jnp.var(x, axis=0)[None, :]
+            return (x - mean) / jnp.sqrt(var + eps) * gamma[None, :] + \
+                beta[None, :]
 
         hint = jnp.zeros(5, jnp.float32)
         y, mean, var = bn_train_fused(x, gamma, beta, hint, eps)
