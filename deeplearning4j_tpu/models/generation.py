@@ -38,7 +38,8 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Dict, List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +49,8 @@ from ..nn.conf.layers import (RnnOutputLayer, SelfAttentionLayer,
                               TokenAndPositionEmbedding)
 from ..nn.graph.vertices import LayerVertex
 from ..ops.platform import train_donate_argnums
+from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
+                               RejectedError)
 
 
 def _round_up_pow2(n: int, floor: int = 16) -> int:
@@ -379,30 +382,78 @@ class TransformerDecoder:
 
 class GenerationRequest:
     """Handle for one queued prompt; ``result()`` blocks until the
-    engine completes it (the full [prompt + generated] id array)."""
+    engine completes it (the full [prompt + generated] id array).
+
+    Lifecycle states (``.state``): PENDING (queued), RUNNING (holds a
+    cache slot), DONE, FAILED, CANCELLED. ``deadline`` (seconds from
+    submission) is enforced by the engine mid-decode — an expired
+    request's slot is freed for the queue and ``result()`` raises
+    :class:`DeadlineExceeded`. ``cancel()`` requests the same slot-free
+    path with :class:`Cancelled`; it is honored at the next engine
+    sweep, whether the request is still queued or already decoding."""
+
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
 
     def __init__(self, prompt, max_new_tokens: int, temperature: float,
-                 eos_id: Optional[int]):
+                 eos_id: Optional[int], deadline: Optional[float] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        self.deadline = None if deadline is None else float(deadline)
+        self._deadline_t = None if deadline is None \
+            else time.monotonic() + float(deadline)
         self.generated: List[int] = []
         self._done = threading.Event()
         self._result: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        self._running = False              # holds a cache slot right now
+        self._cancel_requested = False
+        self._engine = None                # set at submit; woken on cancel
 
     def _complete(self):
         self._result = np.concatenate(
             [self.prompt, np.asarray(self.generated, np.int32)])
+        self._running = False
         self._done.set()
 
     def _fail(self, exc: BaseException):
         self._error = exc
+        self._running = False
         self._done.set()
+
+    def _expired(self, now: Optional[float] = None) -> bool:
+        return self._deadline_t is not None and \
+            (now if now is not None else time.monotonic()) > self._deadline_t
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    @property
+    def state(self) -> str:
+        if self._done.is_set():
+            if self._error is None:
+                return self.DONE
+            if isinstance(self._error, Cancelled):
+                return self.CANCELLED
+            return self.FAILED
+        return self.RUNNING if self._running else self.PENDING
+
+    def cancel(self) -> bool:
+        """Request cancellation; returns False if already finished.
+        The engine honors it at its next sweep: a queued request fails
+        before ever taking a slot, a decoding one frees its slot."""
+        if self._done.is_set():
+            return False
+        self._cancel_requested = True
+        eng = self._engine
+        if eng is not None:
+            eng._work.set()               # wake an idle serve loop promptly
+        return True
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._done.wait(timeout):
@@ -410,6 +461,14 @@ class GenerationRequest:
         if self._error is not None:
             raise self._error
         return self._result
+
+    def __repr__(self) -> str:
+        dl = "" if self.deadline is None else f" deadline={self.deadline}s"
+        err = "" if self._error is None \
+            else f" error={type(self._error).__name__}"
+        return (f"<GenerationRequest {self.state} prompt_len="
+                f"{len(self.prompt)} generated={len(self.generated)}/"
+                f"{self.max_new_tokens}{dl}{err}>")
 
 
 class SlotGenerationEngine:
@@ -424,24 +483,44 @@ class SlotGenerationEngine:
     a wave is admitted, decoded until EVERY slot drains, then the next
     wave starts (the A/B in BENCH_MODE=generate).
 
+    Resilience surface (ISSUE 3): ``max_pending`` bounds the queue —
+    submissions beyond it are SHED with :class:`RejectedError` carrying
+    the observed depth, instead of growing without limit. Per-request
+    ``deadline`` and ``cancel()`` are enforced mid-decode by freeing the
+    slot (the refill seam immediately reuses it). A supervisor
+    (parallel/failures.py EngineSupervisor) may attach: the engine then
+    beats a heartbeat each loop iteration, reports crashes through
+    ``_on_crash`` instead of failing in-flight requests, and
+    ``quarantine()``/``requeue()`` implement exactly-once takeover —
+    recovered requests resume by re-prefilling prompt + tokens emitted
+    so far. ``fault_injector`` arms the ``engine.step`` /
+    ``engine.prefill`` injection points (parallel/faults.py).
+
     Synchronous use: ``submit(...)`` then ``run_until_drained()``.
     Serving use: ``start()`` spins a worker thread that blocks on the
     queue (ParallelInference.generate / GenerationServingRoute)."""
 
     def __init__(self, net, num_slots: int = 8,
                  t_max: Optional[int] = None, refill: bool = True,
-                 seed: int = 0, decoder: Optional[TransformerDecoder] = None):
+                 seed: int = 0, decoder: Optional[TransformerDecoder] = None,
+                 max_pending: int = 256, fault_injector=None):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
                              f"engine asked for {t_max}")
         # a shared decoder reuses its jitted prefill/decode programs
-        # across engines (the A/B benches build several engines per run)
+        # across engines (the A/B benches build several engines per run,
+        # and a supervisor restart MUST reuse it: zero new compiles in
+        # the post-restart steady state is the acceptance bar)
         self.decoder = decoder if decoder is not None \
             else TransformerDecoder(net, t_max=t_max)
         self.num_slots = int(num_slots)
         self.refill = bool(refill)
+        self.seed = int(seed)
+        self.max_pending = int(max_pending)
         self.t_max = self.decoder.t_max
+        self._faults = fault_injector if fault_injector is not None \
+            else NULL_INJECTOR
         self._caches = self.decoder.init_cache(self.num_slots)
         self._slots: List[Optional[GenerationRequest]] = \
             [None] * self.num_slots
@@ -449,6 +528,7 @@ class SlotGenerationEngine:
         self._positions = np.zeros(self.num_slots, np.int32)
         self._temps = np.zeros(self.num_slots, np.float32)
         self._pending: collections.deque = collections.deque()
+        self._admitting: Optional[GenerationRequest] = None
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._key = jax.random.PRNGKey(seed)
@@ -456,16 +536,31 @@ class SlotGenerationEngine:
         self._worker: Optional[threading.Thread] = None
         self._shutdown = False
         self._dead: Optional[BaseException] = None   # worker crash cause
+        # supervision hooks (EngineSupervisor._attach)
+        self._supervised = False
+        self._quarantined = False
+        self._first_step_done = False   # gates wedge detection: a first
+        # decode/prefill LOWERING can exceed any sane heartbeat timeout
+        self._on_crash = None       # callable(engine, exc)
+        self._beat = None           # callable() — heartbeat per iteration
         # serving stats
         self.emitted_tokens = 0
         self.completed = 0
         self.decode_steps = 0
         self.prefills = 0
+        self.rejected = 0           # admission-control sheds
+        self.deadline_exceeded = 0
+        self.cancelled = 0
+        self.requeued = 0           # requests recovered into this engine
+        self.failed = 0             # requests failed by crash/shutdown
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
-               eos_id: Optional[int] = None) -> GenerationRequest:
-        req = GenerationRequest(prompt, max_new_tokens, temperature, eos_id)
+               eos_id: Optional[int] = None,
+               deadline: Optional[float] = None) -> GenerationRequest:
+        req = GenerationRequest(prompt, max_new_tokens, temperature, eos_id,
+                                deadline=deadline)
+        req._engine = self
         with self._lock:
             dead = self._dead
             stopped = self._shutdown or dead is not None
@@ -491,12 +586,27 @@ class SlotGenerationEngine:
         # (shutdown() likewise flags before draining), so either we see
         # the flag here and fail fast, or our append lands before the
         # drain and the drain fails it — a request can never be queued
-        # after the last drain and strand its caller in result(None)
+        # after the last drain and strand its caller in result(None).
+        # Admission control shares the section: the observed depth and
+        # the append/shed decision are atomic.
+        shed_depth = None
         with self._lock:
             dead = self._dead
             queued = not (self._shutdown or dead is not None)
             if queued:
-                self._pending.append(req)
+                depth = len(self._pending)
+                if depth >= self.max_pending:
+                    self.rejected += 1
+                    shed_depth = depth
+                    queued = False
+                else:
+                    self._pending.append(req)
+        if shed_depth is not None:
+            req._fail(RejectedError(
+                f"pending queue full ({shed_depth} queued, "
+                f"max_pending={self.max_pending}) — request shed",
+                queue_depth=shed_depth))
+            return req
         if not queued:
             req._fail(dead or RuntimeError(
                 "SlotGenerationEngine shut down"))
@@ -504,52 +614,180 @@ class SlotGenerationEngine:
         self._work.set()
         return req
 
-    # -------------------------------------------------------------- slots
-    def _pop_pending(self) -> Optional[GenerationRequest]:
+    def requeue(self, req: GenerationRequest) -> None:
+        """Re-queue a recovered request (supervisor restart path): it
+        resumes by re-prefilling prompt + tokens emitted so far, then
+        decoding on — exactly-once, token-for-token with an
+        uninterrupted run under greedy selection. Recovery bypasses
+        admission control: a restart must not shed work it inherited."""
         with self._lock:
-            return self._pending.popleft() if self._pending else None
+            dead = self._dead
+            alive = not (self._shutdown or dead is not None)
+            if alive:
+                req._running = False
+                req._engine = self
+                self._pending.append(req)
+                self.requeued += 1
+        if not alive:
+            req._fail(dead or RuntimeError(
+                "SlotGenerationEngine shut down"))
+            return
+        self._work.set()
 
-    def _finish(self, slot: int):
-        req = self._slots[slot]
-        self._slots[slot] = None
-        with self._lock:       # stats race external readers (bench/serving)
-            self.completed += 1
-        req._complete()
+    # -------------------------------------------------------------- slots
+    def _pop_for_admit(self) -> Optional[GenerationRequest]:
+        """Pop the next queued request AND park it in ``_admitting`` in
+        one critical section: from this moment until it lands in a slot
+        (or is failed), a concurrent quarantine()/shutdown() drain can
+        always see it — a request is never invisible to takeover."""
+        with self._lock:
+            req = self._pending.popleft() if self._pending else None
+            self._admitting = req
+            return req
+
+    def _req_finished(self, req: GenerationRequest, tok: int) -> bool:
+        return (req.eos_id is not None and tok == req.eos_id) or \
+            len(req.generated) >= req.max_new_tokens or \
+            len(req.prompt) + len(req.generated) >= self.t_max
+
+    def _sweep_pending(self):
+        """Fail queued requests that were cancelled or ran out of
+        deadline before ever taking a slot — a caller must not wait on
+        a request the engine will never run."""
+        now = time.monotonic()
+        doomed: List[Tuple[GenerationRequest, BaseException]] = []
+        with self._lock:
+            if self._pending:
+                keep: collections.deque = collections.deque()
+                for req in self._pending:
+                    if req._cancel_requested:
+                        self.cancelled += 1
+                        doomed.append((req, Cancelled(
+                            "cancelled while queued")))
+                    elif req._expired(now):
+                        self.deadline_exceeded += 1
+                        doomed.append((req, DeadlineExceeded(
+                            f"deadline of {req.deadline}s passed while "
+                            "queued")))
+                    else:
+                        keep.append(req)
+                self._pending = keep
+        for req, exc in doomed:
+            req._fail(exc)
+
+    def _enforce_slots(self):
+        """Free slots whose requests were cancelled or exceeded their
+        deadline MID-DECODE; the refill seam reuses the slot for the
+        next queued prompt."""
+        now = time.monotonic()
+        doomed: List[Tuple[GenerationRequest, BaseException]] = []
+        with self._lock:
+            for s in range(self.num_slots):
+                req = self._slots[s]
+                if req is None:
+                    continue
+                if req._cancel_requested:
+                    self._slots[s] = None
+                    self.cancelled += 1
+                    doomed.append((req, Cancelled(
+                        f"cancelled mid-decode after "
+                        f"{len(req.generated)} tokens")))
+                elif req._expired(now):
+                    self._slots[s] = None
+                    self.deadline_exceeded += 1
+                    doomed.append((req, DeadlineExceeded(
+                        f"deadline of {req.deadline}s exceeded after "
+                        f"{len(req.generated)} tokens")))
+        for req, exc in doomed:
+            req._fail(exc)
 
     def _admit(self):
         """Prefill queued prompts into free slots (per-slot batch-1
-        prefill scattered into the shared cache at the slot index)."""
+        prefill scattered into the shared cache at the slot index). A
+        recovered request re-prefills prompt + generated-so-far, so
+        decoding resumes exactly where the dead engine stopped."""
         for s in range(self.num_slots):
-            if self._slots[s] is not None:
-                continue
-            req = self._pop_pending()
-            if req is None:
-                return
-            plen = len(req.prompt)
-            tp = min(_round_up_pow2(plen), self.t_max)
-            tokens = np.zeros((1, tp), np.int32)
-            tokens[0, :plen] = req.prompt
             with self._lock:
+                occupied = self._slots[s] is not None
+            if occupied:
+                continue
+            req = None
+            while req is None:
+                req = self._pop_for_admit()
+                if req is None:
+                    return
+                # lifecycle beats admission: never spend a prefill on a
+                # request that is already cancelled / out of deadline
+                exc = None
+                if req._cancel_requested:
+                    exc = Cancelled("cancelled while queued")
+                elif req._expired():
+                    exc = DeadlineExceeded(
+                        f"deadline of {req.deadline}s passed while queued")
+                if exc is not None:
+                    with self._lock:
+                        if self._admitting is not req:
+                            return    # harvested by a concurrent takeover
+                        self._admitting = None
+                        if isinstance(exc, Cancelled):
+                            self.cancelled += 1
+                        else:
+                            self.deadline_exceeded += 1
+                    req._fail(exc)
+                    req = None
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            if len(ctx) >= self.t_max or \
+                    len(req.generated) >= req.max_new_tokens:
+                # a recovered request that already hit a stop condition
+                with self._lock:
+                    if self._admitting is not req:
+                        return        # harvested by a concurrent takeover
+                    self._admitting = None
+                    self.completed += 1
+                req._complete()
+                continue
+            clen = len(ctx)
+            tp = min(_round_up_pow2(clen), self.t_max)
+            tokens = np.zeros((1, tp), np.int32)
+            tokens[0, :clen] = ctx
+            with self._lock:
+                if self._shutdown or self._quarantined:
+                    return   # req stays parked in _admitting; the
+                             # quarantine/shutdown drain owns it now
                 self.prefills += 1
+                prefill_no = self.prefills
+            self._faults.fire("engine.prefill")
             nxt, _, self._caches = self.decoder._fn("prefill_slot")(
                 self.decoder._device_params(),
                 self.decoder.net._inference_state(), self._caches,
-                jnp.asarray(tokens), jnp.asarray(plen, jnp.int32),
+                jnp.asarray(tokens), jnp.asarray(clen, jnp.int32),
                 jnp.asarray(s, jnp.int32),
                 jnp.asarray(req.temperature, jnp.float32),
-                jax.random.fold_in(self._key, self.prefills))
+                jax.random.fold_in(self._key, prefill_no))
             tok = int(np.asarray(nxt))
-            req.generated.append(tok)
+            finish = None
             with self._lock:
+                if self._admitting is not req:
+                    # a quarantine/shutdown drain harvested this request
+                    # while we were in the device call; it owns the
+                    # request now — drop our token (re-prefill
+                    # regenerates it deterministically)
+                    return
+                self._admitting = None
+                req._running = True
+                req.generated.append(tok)
                 self.emitted_tokens += 1
-            if (req.eos_id is not None and tok == req.eos_id) or \
-                    req.max_new_tokens <= 1 or plen + 1 >= self.t_max:
-                self._finish(s)               # done at the first token
-                continue
-            self._slots[s] = req
-            self._last_ids[s] = tok
-            self._positions[s] = plen         # where tok is written next
-            self._temps[s] = req.temperature
+                if self._req_finished(req, tok):
+                    self.completed += 1
+                    finish = req          # done at the first token
+                else:
+                    self._slots[s] = req
+                    self._last_ids[s] = tok
+                    self._positions[s] = clen  # where tok is written next
+                    self._temps[s] = req.temperature
+            if finish is not None:
+                finish._complete()
 
     def _any_active(self) -> bool:
         return any(r is not None for r in self._slots)
@@ -557,38 +795,99 @@ class SlotGenerationEngine:
     def _step(self):
         """One batched decode step over every slot (free slots ride along
         at clamped positions; their output is ignored)."""
+        self._enforce_slots()
         with self._lock:
-            self._step_no += 1
-            self.decode_steps += 1
+            active = any(r is not None for r in self._slots)
+            if active:
+                self._step_no += 1
+                self.decode_steps += 1
+            step_no = self._step_no
+        if not active:
+            return                # lifecycle enforcement freed every slot
+        self._faults.fire("engine.step")
         nxt, _, self._caches = self.decoder.decode_step(
             self._caches, self._last_ids,
             np.minimum(self._positions, self.t_max - 1), self._temps,
-            key=jax.random.fold_in(self._key, 1 << 20 | self._step_no))
+            key=jax.random.fold_in(self._key, 1 << 20 | step_no))
         nxt_host = np.asarray(nxt)
-        emitted = 0                    # one locked update per STEP, not
-        for s in range(self.num_slots):    # per token (hot decode loop)
-            req = self._slots[s]
-            if req is None:
-                continue
-            tok = int(nxt_host[s])
-            req.generated.append(tok)
-            emitted += 1
-            self._positions[s] += 1
-            self._last_ids[s] = tok
-            if (req.eos_id is not None and tok == req.eos_id) or \
-                    len(req.generated) >= req.max_new_tokens or \
-                    len(req.prompt) + len(req.generated) >= self.t_max:
-                self._finish(s)
-        if emitted:
-            with self._lock:
-                self.emitted_tokens += emitted
+        finished: List[GenerationRequest] = []
+        # token appends and slot frees are one critical section: a
+        # concurrent quarantine() either runs before (we see empty slots
+        # and append nothing) or after (it harvests the post-append
+        # state) — a recovered request never loses or duplicates a token
+        with self._lock:
+            emitted = 0
+            for s in range(self.num_slots):
+                req = self._slots[s]
+                if req is None:
+                    continue
+                tok = int(nxt_host[s])
+                req.generated.append(tok)
+                emitted += 1
+                self._positions[s] += 1
+                self._last_ids[s] = tok
+                if self._req_finished(req, tok):
+                    self._slots[s] = None
+                    self.completed += 1
+                    finished.append(req)
+            self.emitted_tokens += emitted
+            self._first_step_done = True
+        for req in finished:
+            req._complete()
+
+    # ------------------------------------------------------- supervision
+    def quarantine(self) -> Tuple[List[GenerationRequest],
+                                  Optional[BaseException]]:
+        """Detach this engine for supervised takeover: stop the loop and
+        harvest every recoverable request (mid-admit, in-slot, queued —
+        in that deterministic order) exactly once. The wedged/dead
+        worker thread, whenever it wakes, sees ``_quarantined`` and
+        touches nothing. Returns (recoverable requests, death cause)."""
+        harvested: List[GenerationRequest] = []
+        with self._lock:
+            self._quarantined = True
+            self._shutdown = True
+            self._beat = None   # a stale worker must not mask the NEW
+                                # engine's heartbeat when it wakes
+            if self._admitting is not None:
+                harvested.append(self._admitting)
+                self._admitting = None
+            for s in range(self.num_slots):
+                if self._slots[s] is not None:
+                    harvested.append(self._slots[s])
+                    self._slots[s] = None
+            harvested.extend(self._pending)
+            self._pending.clear()
+            cause = self._dead
+        self._work.set()
+        return [r for r in harvested if not r.done()], cause
+
+    def stats(self) -> Dict[str, int]:
+        """Snapshot of the serving counters (one lock acquisition)."""
+        with self._lock:
+            return {
+                "emitted_tokens": self.emitted_tokens,
+                "completed": self.completed,
+                "decode_steps": self.decode_steps,
+                "prefills": self.prefills,
+                "rejected": self.rejected,
+                "deadline_exceeded": self.deadline_exceeded,
+                "cancelled": self.cancelled,
+                "requeued": self.requeued,
+                "failed": self.failed,
+                "queue_depth": len(self._pending),
+                "active_slots": sum(r is not None for r in self._slots),
+            }
 
     # ---------------------------------------------------------- execution
     def run_until_drained(self):
         """Synchronous mode: process the queue to empty. With refill on,
         finished slots re-admit mid-loop; with refill off, each admitted
-        wave drains fully before the next wave starts."""
+        wave drains fully before the next wave starts. (Injected faults
+        propagate to the caller here; supervised recovery applies to the
+        ``start()`` serving mode.)"""
         while True:
+            self._sweep_pending()
             self._admit()
             if not self._any_active():
                 if not self._pending:
@@ -602,6 +901,10 @@ class SlotGenerationEngine:
     def _serve_loop(self):
         try:
             while not self._shutdown:
+                beat = self._beat
+                if beat is not None:
+                    beat()                    # supervisor liveness signal
+                self._sweep_pending()
                 if not self._any_active():
                     self._admit()
                 if not self._any_active():
@@ -612,19 +915,35 @@ class SlotGenerationEngine:
                 if self.refill:
                     self._admit()
         except BaseException as exc:  # noqa: BLE001 — don't strand callers
-            # a dying worker (device error, OOM) fails every outstanding
-            # request instead of leaving result() blocked forever, and
-            # marks the engine dead so later submit()s fail fast
             with self._lock:
                 self._dead = exc
-            for s in range(self.num_slots):
-                if self._slots[s] is not None:
-                    self._slots[s]._fail(exc)
-                    self._slots[s] = None
-            while True:
-                req = self._pop_pending()
-                if req is None:
-                    break
+                quarantined = self._quarantined
+                on_crash = self._on_crash if self._supervised else None
+            if quarantined:
+                return   # superseded: a supervisor already harvested
+            if on_crash is not None:
+                # supervised: the supervisor quarantines, harvests, and
+                # restarts — in-flight requests are NOT failed here
+                # (exactly-once: failed and re-run are mutually exclusive)
+                on_crash(self, exc)
+                return
+            # unsupervised: a dying worker (device error, OOM) fails every
+            # outstanding request instead of leaving result() blocked
+            # forever, and marks the engine dead so later submit()s fail
+            # fast with the death CAUSE, not a generic error
+            doomed: List[GenerationRequest] = []
+            with self._lock:
+                if self._admitting is not None:
+                    doomed.append(self._admitting)
+                    self._admitting = None
+                for s in range(self.num_slots):
+                    if self._slots[s] is not None:
+                        doomed.append(self._slots[s])
+                        self._slots[s] = None
+                doomed.extend(self._pending)
+                self._pending.clear()
+                self.failed += len(doomed)
+            for req in doomed:
                 req._fail(exc)
             raise
 
@@ -637,19 +956,28 @@ class SlotGenerationEngine:
         return self
 
     def shutdown(self):
-        self._shutdown = True
+        with self._lock:
+            self._shutdown = True
         self._work.set()
-        if self._worker is not None:
+        if self._worker is not None and \
+                self._worker is not threading.current_thread():
             self._worker.join(timeout=5)
         # fail whatever is still in flight/queued — a caller blocked in
-        # result() with no timeout must not hang forever
-        exc = RuntimeError("SlotGenerationEngine shut down")
-        for s in range(self.num_slots):
-            if self._slots[s] is not None:
-                self._slots[s]._fail(exc)
-                self._slots[s] = None
-        while True:
-            req = self._pop_pending()
-            if req is None:
-                break
+        # result() with no timeout must not hang forever; a dead engine
+        # reports its death cause, a merely-stopped one the shutdown
+        doomed: List[GenerationRequest] = []
+        with self._lock:
+            exc = self._dead or RuntimeError(
+                "SlotGenerationEngine shut down")
+            if self._admitting is not None:
+                doomed.append(self._admitting)
+                self._admitting = None
+            for s in range(self.num_slots):
+                if self._slots[s] is not None:
+                    doomed.append(self._slots[s])
+                    self._slots[s] = None
+            doomed.extend(self._pending)
+            self._pending.clear()
+            self.failed += len(doomed)
+        for req in doomed:
             req._fail(exc)

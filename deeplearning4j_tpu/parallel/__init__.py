@@ -21,6 +21,10 @@ from .param_server import (InMemoryParameterServer, ParameterServerNode,
                            ParameterServerParallelWrapper)
 from .early_stopping_parallel import EarlyStoppingParallelTrainer
 from .magic_queue import MagicQueue
+from .failures import (EngineSupervisor, HeartbeatMonitor,
+                       PreemptionHandler, run_elastic)
+from .faults import (Cancelled, DeadlineExceeded, FaultInjector,
+                     RejectedError)
 
 __all__ = ["make_mesh", "replicated", "batch_sharded", "ParallelWrapper",
            "GraphDataParallelTrainer", "ShardedTrainer",
@@ -31,4 +35,7 @@ __all__ = ["make_mesh", "replicated", "batch_sharded", "ParallelWrapper",
            "SequenceParallelTrainer", "InMemoryParameterServer",
            "ParameterServerNode", "ParameterServerClient",
            "ParameterServerTrainer", "ParameterServerParallelWrapper",
-           "EarlyStoppingParallelTrainer", "MagicQueue"]
+           "EarlyStoppingParallelTrainer", "MagicQueue",
+           "EngineSupervisor", "HeartbeatMonitor", "PreemptionHandler",
+           "run_elastic", "FaultInjector", "Cancelled", "DeadlineExceeded",
+           "RejectedError"]

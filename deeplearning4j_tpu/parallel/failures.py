@@ -100,6 +100,174 @@ class HeartbeatMonitor:
             self._thread = None
 
 
+class EngineSupervisor(HeartbeatMonitor):
+    """Supervises a SlotGenerationEngine's serve loop: restart-on-crash,
+    restart-on-wedge, and exactly-once recovery of in-flight requests.
+
+    The engine beats this monitor once per loop iteration; a loop that
+    stops beating for ``timeout`` seconds (wedged in a device call, hung
+    by an injected fault) or that crashes outright (reported immediately
+    through the engine's ``_on_crash`` hook) triggers a takeover:
+
+    1. ``engine.quarantine()`` — stop the old loop and harvest every
+       recoverable request exactly once (the wedged thread, whenever it
+       wakes, sees the quarantine flag and touches nothing);
+    2. rebuild the engine AROUND THE SAME TransformerDecoder — the
+       jitted prefill/decode programs survive, so the post-restart
+       steady state compiles NOTHING new (CompileAudit-enforced);
+    3. ``requeue()`` each harvested request on the new engine: it
+       resumes by re-prefilling prompt + tokens emitted so far
+       (token-for-token equal to an uninterrupted greedy run).
+
+    After ``max_restarts`` takeovers the supervisor gives up: harvested
+    requests are failed with the underlying cause and later submissions
+    fail fast. ``submit()`` proxies to the current engine under the
+    supervisor lock, so callers never race a takeover."""
+
+    def __init__(self, engine, timeout: float = 10.0,
+                 interval: float = 0.25, max_restarts: int = 3,
+                 warmup_grace: float = 300.0, name: str = "slot-engine"):
+        super().__init__(timeout=timeout, interval=interval,
+                         on_failure=self._on_wedge)
+        self._engine = engine
+        self._name = name
+        self.max_restarts = int(max_restarts)
+        # first-lowering grace: until the engine completes its first
+        # decode step, a silent heartbeat more likely means "compiling"
+        # than "wedged" — restarting into the same still-compiling
+        # programs would burn the whole restart budget on a cold start
+        self.warmup_grace = float(warmup_grace)
+        self._started_t = time.monotonic()
+        # reentrant: submit() may trigger a restart which re-enters
+        # engine bookkeeping under the same lock
+        self._sup_lock = threading.RLock()
+        self.restarts = 0
+        self.recovered_requests = 0
+        self.given_up: Optional[BaseException] = None
+        self._stopped = False
+        # counters carried over from quarantined engines so stats()
+        # stays monotonic across takeovers (a dashboard must never see
+        # completed/emitted_tokens reset to zero after a restart)
+        self._prior_stats: Dict[str, int] = {}
+        self._attach(engine)
+
+    # ------------------------------------------------------------ wiring
+    def _attach(self, engine) -> None:
+        engine._supervised = True
+        engine._on_crash = self._on_crash
+        engine._beat = lambda: self.beat(self._name)
+        self.register(self._name)
+
+    @property
+    def engine(self):
+        with self._sup_lock:
+            return self._engine
+
+    def start(self) -> "EngineSupervisor":
+        with self._sup_lock:
+            self._engine.start()
+        HeartbeatMonitor.start(self)
+        return self
+
+    def stop(self) -> None:
+        # latch first: a crash/wedge callback racing stop() must not
+        # spin up a replacement engine nobody will ever shut down
+        with self._sup_lock:
+            self._stopped = True
+        HeartbeatMonitor.stop(self)
+        with self._sup_lock:
+            self._engine.shutdown()
+
+    # ---------------------------------------------------------- takeover
+    def _on_crash(self, engine, exc: BaseException) -> None:
+        """Called from the dying worker thread itself — restart
+        immediately instead of waiting out a heartbeat timeout."""
+        with self._sup_lock:
+            if self._stopped:
+                return
+            if engine is self._engine and self.given_up is None:
+                self._restart(cause=exc)
+
+    def _on_wedge(self, worker_id: str) -> None:
+        """Heartbeat timeout: the loop is alive but stuck (device hang,
+        injected wedge). The stuck thread cannot be killed — quarantine
+        strands it harmlessly and a fresh engine takes the traffic."""
+        with self._sup_lock:
+            if self._stopped:
+                return
+            if worker_id == self._name and self.given_up is None:
+                eng = self._engine
+                if not eng._first_step_done and \
+                        time.monotonic() - self._started_t < \
+                        self.warmup_grace:
+                    # silent because it is still LOWERING, not wedged:
+                    # push the liveness deadline out and look again
+                    self.register(self._name)
+                    return
+                if eng._worker is not None and eng._worker.is_alive():
+                    self._restart(cause=RuntimeError(
+                        f"serve loop wedged: no progress beat for "
+                        f"{self.timeout}s"))
+
+    def _restart(self, cause: Optional[BaseException]) -> None:
+        # callers hold _sup_lock
+        from ..models.generation import SlotGenerationEngine
+        old = self._engine
+        recoverable, dead = old.quarantine()
+        for k, v in old.stats().items():
+            if k not in ("queue_depth", "active_slots"):   # gauges
+                self._prior_stats[k] = self._prior_stats.get(k, 0) + v
+        cause = dead or cause or RuntimeError("engine restarted")
+        if self.restarts >= self.max_restarts:
+            self.given_up = cause
+            self.deregister(self._name)
+            exc = RuntimeError(
+                f"engine restart budget exhausted "
+                f"({self.max_restarts} restarts)")
+            exc.__cause__ = cause
+            for req in recoverable:
+                req._fail(exc)
+            return
+        self.restarts += 1
+        new = SlotGenerationEngine(
+            old.decoder.net, num_slots=old.num_slots, refill=old.refill,
+            seed=old.seed, decoder=old.decoder,      # SAME jit programs
+            max_pending=old.max_pending, fault_injector=old._faults)
+        for req in recoverable:      # harvest order: admitting, slots,
+            new.requeue(req)         # queue — deterministic resumption
+        self.recovered_requests += len(recoverable)
+        self._attach(new)
+        self._engine = new
+        new.start()
+
+    # ------------------------------------------------------------ facade
+    def submit(self, *args, **kwargs):
+        """Submit through the CURRENT engine; serialized against
+        takeovers, so a request is never dropped into a dead engine that
+        no one will ever restart."""
+        with self._sup_lock:
+            eng = self._engine
+            with eng._lock:
+                dead = eng._dead
+            if dead is not None and self.given_up is None:
+                # crashed but the crash callback lost the race — restart
+                # now, then submit to the replacement
+                self._restart(cause=dead)
+                eng = self._engine
+            return eng.submit(*args, **kwargs)
+
+    def stats(self) -> dict:
+        """Current engine's counters PLUS everything quarantined engines
+        accrued before their takeover — monotonic across restarts."""
+        with self._sup_lock:
+            s = self._engine.stats()
+            for k, v in self._prior_stats.items():
+                s[k] = s.get(k, 0) + v
+            s["restarts"] = self.restarts
+            s["recovered_requests"] = self.recovered_requests
+        return s
+
+
 class PreemptionHandler:
     """SIGTERM/SIGINT → force checkpoint + drain flag.
 

@@ -1,0 +1,187 @@
+"""Deterministic fault injection + serving-lifecycle error types.
+
+SURVEY §5.3: the reference has no failure detector and no fault
+injection; on TPU pods preemption and partial failure are routine and
+multi-host SPMD jobs die whole. The resilience layer built on top of the
+PR 1 serving stack (engine supervision, broker reconnect, route retry,
+request deadlines) is only trustworthy if every recovery path is
+EXERCISED — under tier-1, without real networks, real clocks, or real
+preemptions. That is this module's job:
+
+- :class:`FaultInjector` — named injection points compiled into the
+  serving stack (``engine.step``, ``engine.prefill``, ``broker.send``,
+  ``broker.recv``, ``route.publish``, ``route.consume``). Tests and
+  chaos runs arm a point with scripted failures — raise-once, raise-N,
+  hang-for, drop-frame — keyed to the point's HIT COUNT, so a schedule
+  like "crash the 7th decode step" is reproducible bit-for-bit. An
+  unarmed injector is a single dict lookup per hit; components default
+  to the shared :data:`NULL_INJECTOR` whose ``fire`` is a constant
+  ``False`` (the hot decode loop pays nothing).
+
+- Serving lifecycle errors: :class:`DeadlineExceeded` (per-request
+  deadline enforced mid-decode), :class:`Cancelled` (caller-initiated
+  abort), :class:`RejectedError` (admission control shed the request;
+  carries ``queue_depth``). They live here — not in models/ — because
+  the engine, the inference facade, and both serving routes all raise
+  or translate them.
+
+Injection points fire OUTSIDE jit boundaries only (host-side seams): a
+raise propagates like a real device/socket error, a hang wedges the
+thread like a stuck collective, a drop loses a frame like a lossy
+transport. Nothing is injected into traced code.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before generation finished; the
+    engine freed its slot mid-decode and failed the caller."""
+
+
+class Cancelled(RuntimeError):
+    """The caller cancelled the request; if it was decoding, its slot
+    was freed mid-loop."""
+
+
+class RejectedError(RuntimeError):
+    """Admission control shed the request instead of growing the pending
+    queue without bound. ``queue_depth`` is the depth observed at
+    rejection time."""
+
+    def __init__(self, message: str, queue_depth: int = 0):
+        super().__init__(message)
+        self.queue_depth = int(queue_depth)
+
+
+#: documented injection points — components fire these names
+POINTS = ("engine.step", "engine.prefill", "broker.send", "broker.recv",
+          "route.publish", "route.consume")
+
+
+class _NullInjector:
+    """Inert injector: the default wired into every component. ``fire``
+    never raises, never sleeps, never drops."""
+
+    def fire(self, point: str) -> bool:
+        return False
+
+
+NULL_INJECTOR = _NullInjector()
+
+
+class FaultInjector:
+    """Scripted, hit-count-keyed fault injection.
+
+    Arm a point with one or more plans; every ``fire(point)`` call
+    increments the point's hit counter and executes any plan whose
+    window covers the hit::
+
+        inj = FaultInjector()
+        inj.raise_once("engine.step", RuntimeError("boom"), at=7)
+        inj.raise_n("broker.send", ConnectionError, n=3)
+        inj.hang_for("engine.step", seconds=0.5, at=4)
+        inj.drop("route.publish", n=2)
+
+    ``at`` is the 1-based hit index where the plan starts; raise/drop
+    plans cover ``n`` consecutive hits from there. ``fire`` returns True
+    when the operation should be DROPPED (the caller skips the send /
+    discards the frame and counts it); raise plans raise; hang plans
+    sleep (outside the injector lock) and return False. Counters
+    (``hits``/``fired``) make schedules auditable after a run.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: Dict[str, List[dict]] = defaultdict(list)
+        self._hits: Dict[str, int] = defaultdict(int)
+        self._fired: Dict[str, int] = defaultdict(int)
+
+    # ------------------------------------------------------------- arming
+    def raise_once(self, point: str, exc, at: int = 1) -> "FaultInjector":
+        return self.raise_n(point, exc, n=1, at=at)
+
+    def raise_n(self, point: str, exc, n: int,
+                at: int = 1) -> "FaultInjector":
+        """Raise ``exc`` on ``n`` consecutive hits starting at hit
+        ``at``. ``exc`` may be an exception class (instantiated per
+        raise with a descriptive message) or an instance (raised
+        as-is)."""
+        with self._lock:
+            self._plans[point].append(
+                {"kind": "raise", "at": int(at), "remaining": int(n),
+                 "exc": exc})
+        return self
+
+    def hang_for(self, point: str, seconds: float, at: int = 1,
+                 times: int = 1) -> "FaultInjector":
+        """Sleep ``seconds`` at hits [at, at+times) — a wedged loop /
+        stuck collective, visible to heartbeat supervision."""
+        with self._lock:
+            self._plans[point].append(
+                {"kind": "hang", "at": int(at), "remaining": int(times),
+                 "seconds": float(seconds)})
+        return self
+
+    def drop(self, point: str, n: int = 1, at: int = 1) -> "FaultInjector":
+        """Signal the call site to drop the frame/operation on ``n``
+        consecutive hits starting at ``at``."""
+        with self._lock:
+            self._plans[point].append(
+                {"kind": "drop", "at": int(at), "remaining": int(n)})
+        return self
+
+    def clear(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            if point is None:
+                self._plans.clear()
+            else:
+                self._plans.pop(point, None)
+
+    # ------------------------------------------------------------ firing
+    def fire(self, point: str) -> bool:
+        """Execute the point's due plans. Returns True iff the caller
+        should drop the operation; raise plans raise instead."""
+        hang_s = 0.0
+        drop = False
+        raise_exc = None
+        with self._lock:
+            self._hits[point] += 1
+            hit = self._hits[point]
+            for plan in self._plans.get(point, ()):
+                if plan["remaining"] <= 0 or hit < plan["at"]:
+                    continue
+                plan["remaining"] -= 1
+                self._fired[point] += 1
+                if plan["kind"] == "hang":
+                    hang_s += plan["seconds"]
+                elif plan["kind"] == "drop":
+                    drop = True
+                elif raise_exc is None:
+                    raise_exc = plan["exc"]
+        if hang_s > 0.0:
+            time.sleep(hang_s)          # outside the lock: a hung point
+        if raise_exc is not None:       # must not block arming/counters
+            if isinstance(raise_exc, type):
+                raise raise_exc(f"injected fault at {point}")
+            raise raise_exc
+        return drop
+
+    # ---------------------------------------------------------- counters
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits[point]
+
+    def fired(self, point: str) -> int:
+        with self._lock:
+            return self._fired[point]
+
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {p: {"hits": self._hits[p], "fired": self._fired[p]}
+                    for p in set(self._hits) | set(self._fired)}

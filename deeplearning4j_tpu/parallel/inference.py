@@ -32,7 +32,12 @@ class ParallelInference:
                  inference_mode: str = InferenceMode.BATCHED,
                  max_batch_size: int = 64, queue_timeout: float = 0.005,
                  generation_slots: int = 8,
-                 generation_t_max: Optional[int] = None):
+                 generation_t_max: Optional[int] = None,
+                 generation_max_pending: int = 256,
+                 generation_supervised: bool = False,
+                 generation_supervisor_timeout: float = 10.0,
+                 generation_max_restarts: int = 3,
+                 generation_fault_injector=None):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
@@ -40,11 +45,22 @@ class ParallelInference:
         self.queue_timeout = queue_timeout
         self.generation_slots = int(generation_slots)
         self.generation_t_max = generation_t_max
+        # resilience knobs (ISSUE 3): bounded pending queue + optional
+        # EngineSupervisor wrapping (crash/wedge restart with exactly-once
+        # request recovery); the injector threads through to the engine's
+        # engine.step/engine.prefill points for chaos tests
+        self.generation_max_pending = int(generation_max_pending)
+        self.generation_supervised = bool(generation_supervised)
+        self.generation_supervisor_timeout = float(
+            generation_supervisor_timeout)
+        self.generation_max_restarts = int(generation_max_restarts)
+        self.generation_fault_injector = generation_fault_injector
         self._jit_fwd = None
         self._lock = threading.Lock()
         self._requests: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._gen_engine = None
+        self._gen_supervisor = None
         self._gen_lock = threading.Lock()
         self._shutdown = False
 
@@ -165,34 +181,62 @@ class ParallelInference:
                 raise RuntimeError("ParallelInference is shut down")
             if self._gen_engine is None:
                 from ..models.generation import SlotGenerationEngine
-                self._gen_engine = SlotGenerationEngine(
+                engine = SlotGenerationEngine(
                     self.net, num_slots=self.generation_slots,
-                    t_max=self.generation_t_max).start()
-            return self._gen_engine
+                    t_max=self.generation_t_max,
+                    max_pending=self.generation_max_pending,
+                    fault_injector=self.generation_fault_injector)
+                if self.generation_supervised:
+                    from .failures import EngineSupervisor
+                    self._gen_supervisor = EngineSupervisor(
+                        engine,
+                        timeout=self.generation_supervisor_timeout,
+                        max_restarts=self.generation_max_restarts).start()
+                else:
+                    engine.start()
+                self._gen_engine = engine
+            return self._gen_supervisor or self._gen_engine
 
     def generate(self, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, eos_id=None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 deadline: Optional[float] = None):
         """Generate a continuation for ONE prompt (1-D int array) through
         the shared continuous-batching engine; blocks until complete and
         returns the full [prompt + generated] id array. Thread-safe —
-        concurrent callers share the device batch."""
+        concurrent callers share the device batch. ``deadline`` (seconds)
+        is enforced BY THE ENGINE mid-decode (the slot is freed and
+        DeadlineExceeded raised); ``timeout`` only bounds this caller's
+        wait."""
         engine = self._ensure_gen_engine()
         req = engine.submit(prompt_ids, max_new_tokens,
-                            temperature=temperature, eos_id=eos_id)
+                            temperature=temperature, eos_id=eos_id,
+                            deadline=deadline)
         return req.result(timeout)
 
     def generate_async(self, prompt_ids, max_new_tokens: int,
-                       temperature: float = 0.0, eos_id=None):
+                       temperature: float = 0.0, eos_id=None,
+                       deadline: Optional[float] = None):
         """Queue a prompt and return its GenerationRequest handle
-        (``.result()`` blocks; ``.done()`` polls)."""
+        (``.result()`` blocks; ``.done()`` polls; ``.cancel()`` frees
+        its slot at the engine's next sweep)."""
         return self._ensure_gen_engine().submit(
             prompt_ids, max_new_tokens, temperature=temperature,
-            eos_id=eos_id)
+            eos_id=eos_id, deadline=deadline)
+
+    def generation_stats(self) -> Optional[dict]:
+        """Engine/supervisor counters (None before the first generate)."""
+        with self._gen_lock:
+            target = self._gen_supervisor or self._gen_engine
+            return None if target is None else target.stats()
 
     def shutdown(self):
         self._shutdown = True
         with self._gen_lock:
-            if self._gen_engine is not None:
+            if self._gen_supervisor is not None:
+                self._gen_supervisor.stop()
+                self._gen_supervisor = None
+                self._gen_engine = None
+            elif self._gen_engine is not None:
                 self._gen_engine.shutdown()
                 self._gen_engine = None

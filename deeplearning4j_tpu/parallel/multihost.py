@@ -52,6 +52,59 @@ def global_mesh(axis_names=("data",), shape=None):
     return Mesh(np.array(devs).reshape(shape), axis_names)
 
 
+def distributed_client():
+    """The jax.distributed coordinator's key-value client (None when not
+    initialized). It rides the SAME coordinator connection initialize()
+    set up — no extra transport — and works on every backend, including
+    CPU, where XLA cannot run multi-process computations."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:   # noqa: BLE001 — private-module layout moved
+        return None
+
+
+def host_allreduce_mean(tree, tag: str, timeout_ms: int = 60_000):
+    """Gloo-style HOST-side mean of a pytree across all processes, via
+    the coordinator key-value store: each process publishes its flat f64
+    leaf buffer under ``tag``, blocks for every peer's, and averages.
+
+    This is the CPU-backend fallback collective (ParallelWrapper uses it
+    when a multi-process mesh meets ``XlaRuntimeError: Multiprocess
+    computations aren't implemented on the CPU backend``): slow but
+    correct, exactly the staged-through-host parameter averaging the
+    reference's Spark TrainingMaster performs. ``tag`` must be unique
+    per logical reduction AND identical across processes (keys are
+    write-once in the store)."""
+    import base64
+
+    import jax
+    import numpy as np
+
+    client = distributed_client()
+    n = jax.process_count()
+    if client is None or n <= 1:
+        return tree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.asarray(leaf) for leaf in leaves]
+    flat = np.concatenate([a.astype(np.float64).ravel() for a in arrs]) \
+        if arrs else np.zeros(0, np.float64)
+    key = f"dl4j/hostavg/{tag}"
+    client.key_value_set(f"{key}/{jax.process_index()}",
+                         base64.b64encode(flat.tobytes()).decode("ascii"))
+    acc = np.zeros_like(flat)
+    for p in range(n):
+        blob = client.blocking_key_value_get(f"{key}/{p}", timeout_ms)
+        acc += np.frombuffer(base64.b64decode(blob), np.float64)
+    acc /= n
+    out, off = [], 0
+    for a in arrs:
+        piece = acc[off:off + a.size].reshape(a.shape).astype(a.dtype)
+        out.append(jax.numpy.asarray(piece))
+        off += a.size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 class CheckpointManager:
     """Interval-based atomic checkpointing for preemption-safe resume."""
 

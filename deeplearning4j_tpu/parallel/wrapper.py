@@ -47,12 +47,37 @@ class ParallelWrapper:
             .average_updaters(True).build().fit(iterator)
     """
 
+    _ns_counter = 0      # cross-process-consistent KV namespace source
+
     def __init__(self, net, mesh: Optional[Mesh] = None,
                  averaging_frequency: int = 1, average_updaters: bool = True,
                  prefetch_buffer: int = 2, report_score: bool = True,
                  gradient_compression: Optional[float] = None):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
+        # XLA's CPU backend cannot execute multi-process computations: a
+        # mesh spanning other processes' CPU devices would die inside the
+        # first jitted step with XlaRuntimeError. Fall back to an
+        # EMULATED collective: each process computes over the full global
+        # batch on a mesh of its LOCAL devices (replicated compute — the
+        # result every process holds is exactly what the all-reduce would
+        # have produced), and _host_sync() then pins the replicas
+        # together with a gloo-style host-side parameter mean through the
+        # jax.distributed coordinator's KV store (multihost.py). The
+        # multi-host checkpoint/resume contract stays fully exercised.
+        self._emulated_hosts = 1
+        self._sync_no = 0
+        # KV-store keys are write-once and must MATCH across processes:
+        # namespace them by construction order (identical on every
+        # process — same program), never by id()
+        self._sync_ns = ParallelWrapper._ns_counter
+        ParallelWrapper._ns_counter += 1
+        if self._needs_cpu_emulation(self.mesh):
+            import jax
+            local = [d for d in self.mesh.devices.flat
+                     if d.process_index == jax.process_index()]
+            self._emulated_hosts = jax.process_count()
+            self.mesh = Mesh(np.array(local).reshape(-1), ("data",))
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.average_updaters = average_updaters
         self.prefetch_buffer = prefetch_buffer
@@ -113,6 +138,54 @@ class ParallelWrapper:
                                    gradient_compression=self._compression)
 
     # ------------------------------------------------------------------ fit
+    @staticmethod
+    def _needs_cpu_emulation(mesh: Mesh) -> bool:
+        import jax
+        try:
+            if jax.process_count() <= 1:
+                return False
+        except RuntimeError:
+            return False
+        if jax.default_backend() != "cpu":
+            return False
+        pid = jax.process_index()
+        return any(d.process_index != pid for d in mesh.devices.flat)
+
+    def _host_sync(self):
+        """Emulated-collective mode only: average params (+ updater state,
+        matching averageUpdaters) across processes on the HOST, at the
+        same cadence the real collective would run (per sync step / per
+        averaging round — NOT once at fit() exit, which would leave
+        params divergent mid-fit under per-process data and break
+        mid-fit checkpoints). With the full global batch replicated per
+        process the mean is a bitwise no-op that still proves every
+        process agrees; with per-process data it IS the parameter
+        averaging the reference TrainingMaster performs."""
+        from .multihost import host_allreduce_mean
+        net = self.net
+        self._sync_no += 1
+        tag = f"n{self._sync_ns}-s{self._sync_no}"
+        net.params = host_allreduce_mean(net.params, tag + "/p")
+        if self.average_updaters:
+            net.updater_state = host_allreduce_mean(net.updater_state,
+                                                    tag + "/u")
+
+    def _host_sync_stacked(self):
+        """Local-steps emulation: complete the round's pmean across
+        processes by host-averaging the stacked replica trees (every
+        local device already holds the local mean, so the cross-process
+        mean of equal-sized hosts IS the global mean)."""
+        from .multihost import host_allreduce_mean
+        sp, su, ss, sr = self._stacked
+        self._sync_no += 1
+        tag = f"n{self._sync_ns}-r{self._sync_no}"
+        sp = host_allreduce_mean(sp, tag + "/p")
+        if self.average_updaters:
+            su = host_allreduce_mean(su, tag + "/u")
+        ss = host_allreduce_mean(ss, tag + "/s")
+        # the residual (error-feedback carry) is per-replica by design
+        self._stacked = (sp, su, ss, sr)
+
     @property
     def num_workers(self) -> int:
         return int(np.prod(list(self.mesh.shape.values())))
@@ -169,6 +242,9 @@ class ParallelWrapper:
                 if hasattr(net, "_strip_rnn_carry") else new_states
             net.score_value = score   # device scalar; sync deferred to reader
             net.iteration += 1
+            if self._emulated_hosts > 1:
+                self._host_sync()     # the grad all-reduce this step's
+                # local-mesh GSPMD could not span is completed on the host
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration)
 
@@ -341,6 +417,9 @@ class ParallelWrapper:
             jnp.asarray(labels, net.compute_dtype), fmask, lmask,
             net.iteration)
         self._stacked = (sp, su, ss, sr)
+        if self._emulated_hosts > 1:
+            self._host_sync_stacked()    # per averaging round, the same
+            # cadence the cross-host pmean would have run at
         self.last_sent_fraction = sent    # device scalar (1.0 when dense)
         net.score_value = score   # device scalar; sync deferred to reader
         net.iteration += k

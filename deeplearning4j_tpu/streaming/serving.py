@@ -16,10 +16,56 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
+                               RejectedError)
 from .pubsub import MessageBroker, NDArrayPublisher, NDArraySubscriber
 
 
-class ModelServingRoute:
+class _RoutePublishMixin:
+    """Retry-with-backoff publish shared by both routes: a transient
+    broker failure is retried ``publish_retries`` times with exponential
+    backoff; a persistent one DROPS the message and counts it
+    (``publish_drops``) — graceful degradation, never a dead route
+    thread. The ``route.publish`` injection point can force either
+    path (a raise exercises retry, a drop-signal exercises shedding)."""
+
+    def _publish_safe(self, arr: np.ndarray) -> bool:
+        for attempt in range(self.publish_retries + 1):
+            try:
+                if self._faults.fire("route.publish"):
+                    with self._stats_lock:
+                        self.publish_drops += 1
+                    return False          # injected drop: counted
+                self.pub.publish(arr)
+                return True
+            except Exception:   # noqa: BLE001 — broker down ≠ route dead
+                if attempt >= self.publish_retries:
+                    break
+                time.sleep(self.retry_backoff * (2 ** attempt))
+        with self._stats_lock:
+            self.publish_drops += 1
+        return False
+
+    def _poll_safe(self, timeout: float) -> Optional[np.ndarray]:
+        """Consume with the same degradation contract: a transient
+        consume failure (or injected ``route.consume`` fault) is counted
+        and skipped, never allowed to kill the consumer thread."""
+        try:
+            if self._faults.fire("route.consume"):
+                # injected consume drop: swallow one message if present
+                self.sub.poll(timeout=timeout)
+                with self._stats_lock:
+                    self.consume_errors += 1
+                return None
+            return self.sub.poll(timeout=timeout)
+        except Exception:       # noqa: BLE001
+            with self._stats_lock:
+                self.consume_errors += 1
+            time.sleep(self.retry_backoff)
+            return None
+
+
+class ModelServingRoute(_RoutePublishMixin):
     """Consume feature arrays from ``input_topic``, publish ``net.output``
     results to ``output_topic`` — the serve-route the reference builds with
     Camel. ``start()`` spins the consumer thread; ``stop()`` drains it.
@@ -35,13 +81,19 @@ class ModelServingRoute:
                  input_topic: str = "dl4j-input",
                  output_topic: str = "dl4j-output",
                  max_batch: int = 32,
-                 batch_window: float = 0.0):
+                 batch_window: float = 0.0,
+                 publish_retries: int = 3, retry_backoff: float = 0.05,
+                 fault_injector=None):
         self.net = net
         self.broker = broker
         self.sub = NDArraySubscriber(broker, input_topic)
         self.pub = NDArrayPublisher(broker, output_topic)
         self.max_batch = max(1, int(max_batch))
         self.batch_window = max(0.0, float(batch_window))
+        self.publish_retries = int(publish_retries)
+        self.retry_backoff = float(retry_backoff)
+        self._faults = fault_injector if fault_injector is not None \
+            else NULL_INJECTOR
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # guards the serving counters: the route thread writes them while
@@ -52,6 +104,8 @@ class ModelServingRoute:
         self.batches = 0      # coalesced (>=2 message) dispatch attempts
         self.singles = 0      # single-message dispatches (incl. fallbacks)
         self.errors = 0
+        self.publish_drops = 0   # messages dropped after retry exhaustion
+        self.consume_errors = 0  # transient consume failures skipped
 
     def _drain(self, first: np.ndarray) -> List[np.ndarray]:
         arrs = [first]
@@ -60,11 +114,11 @@ class ModelServingRoute:
             # cap each wait so stop() is observed promptly even mid-window
             wait = min(deadline - time.monotonic(), 0.05)
             if wait > 0 and not self._stop.is_set():
-                nxt = self.sub.poll(timeout=wait)
+                nxt = self._poll_safe(timeout=wait)
                 if nxt is None:
                     continue
             else:
-                nxt = self.sub.poll()
+                nxt = self._poll_safe(timeout=None)
                 if nxt is None:
                     break
             arrs.append(nxt)
@@ -98,7 +152,7 @@ class ModelServingRoute:
                     with self._stats_lock:
                         self.served += len(pieces)
                     for piece in pieces:
-                        self.pub.publish(piece)
+                        self._publish_safe(piece)
                 except Exception:
                     # the COALESCED forward failed (e.g. the stacked
                     # batch is too big, or one payload is bad): retry
@@ -115,7 +169,7 @@ class ModelServingRoute:
             out = np.asarray(self.net.output(a.astype(np.float32)))
             with self._stats_lock:
                 self.served += 1
-            self.pub.publish(out)
+            self._publish_safe(out)
         except Exception:
             # a bad payload must not kill the route (Camel's route
             # error-handling role); counted per message
@@ -124,7 +178,7 @@ class ModelServingRoute:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            first = self.sub.poll(timeout=0.1)
+            first = self._poll_safe(timeout=0.1)
             if first is None:
                 continue
             self._serve_batch(self._drain(first))
@@ -141,7 +195,7 @@ class ModelServingRoute:
         self.sub.close()
 
 
-class GenerationServingRoute:
+class GenerationServingRoute(_RoutePublishMixin):
     """Autoregressive-generation serve route: consume int token-id prompt
     arrays from ``input_topic``, generate through a shared slot-based
     continuous-batching engine (models/generation.py), publish the full
@@ -150,8 +204,15 @@ class GenerationServingRoute:
     loop, where "coalescing" means prompts from the stream keep the
     engine's cache slots full while earlier requests are still decoding.
 
-    ``engine`` may be a prebuilt SlotGenerationEngine (shared with other
-    routes/callers) or None to build one from ``net``."""
+    ``engine`` may be a prebuilt SlotGenerationEngine, an
+    EngineSupervisor wrapping one (crash/wedge restart with exactly-once
+    recovery — parallel/failures.py), or None to build a plain engine
+    from ``net``. Resilience: a shed request (engine admission control,
+    RejectedError) or one that missed its ``deadline`` / was cancelled
+    is counted (``shed`` / ``deadline_errors``) and dropped from the
+    output stream instead of wedging the in-order publisher; publish
+    failures retry with backoff then degrade to a counted drop
+    (``publish_drops``) — the route threads never die."""
 
     def __init__(self, net, broker: MessageBroker,
                  input_topic: str = "dl4j-gen-input",
@@ -159,12 +220,17 @@ class GenerationServingRoute:
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  eos_id: Optional[int] = None, num_slots: int = 8,
                  t_max: Optional[int] = None, engine=None,
-                 max_inflight: int = 64):
+                 max_inflight: int = 64, deadline: Optional[float] = None,
+                 publish_retries: int = 3, retry_backoff: float = 0.05,
+                 fault_injector=None):
         self._owns_engine = engine is None
+        self._faults = fault_injector if fault_injector is not None \
+            else NULL_INJECTOR
         if engine is None:
             from ..models.generation import SlotGenerationEngine
             engine = SlotGenerationEngine(net, num_slots=num_slots,
-                                          t_max=t_max)
+                                          t_max=t_max,
+                                          fault_injector=self._faults)
         self.engine = engine
         self.broker = broker
         self.sub = NDArraySubscriber(broker, input_topic)
@@ -172,6 +238,9 @@ class GenerationServingRoute:
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        self.deadline = None if deadline is None else float(deadline)
+        self.publish_retries = int(publish_retries)
+        self.retry_backoff = float(retry_backoff)
         self._stop = threading.Event()
         self._consumer: Optional[threading.Thread] = None
         self._publisher: Optional[threading.Thread] = None
@@ -182,6 +251,10 @@ class GenerationServingRoute:
         self._stats_lock = threading.Lock()
         self.served = 0
         self.errors = 0
+        self.shed = 0            # admission-control rejections observed
+        self.deadline_errors = 0  # deadline-exceeded / cancelled requests
+        self.publish_drops = 0
+        self.consume_errors = 0
 
     def _consume(self) -> None:
         while not self._stop.is_set():
@@ -193,14 +266,15 @@ class GenerationServingRoute:
                 # growing the engine's pending deque without limit
                 time.sleep(0.02)
                 continue
-            arr = self.sub.poll(timeout=0.1)
+            arr = self._poll_safe(timeout=0.1)
             if arr is None:
                 continue
             try:
                 prompt = np.asarray(arr).astype(np.int64).reshape(-1)
                 req = self.engine.submit(prompt, self.max_new_tokens,
                                          temperature=self.temperature,
-                                         eos_id=self.eos_id)
+                                         eos_id=self.eos_id,
+                                         deadline=self.deadline)
                 with self._inflight_lock:
                     self._inflight.append(req)
             except Exception:
@@ -216,8 +290,19 @@ class GenerationServingRoute:
                 continue
             try:
                 out = req.result(timeout=0.2)
+            except (DeadlineExceeded, Cancelled):
+                # ordered BEFORE TimeoutError: DeadlineExceeded IS a
+                # TimeoutError, but means the REQUEST is finished (shed
+                # mid-decode) — pop it, or the publisher spins forever
+                with self._stats_lock:
+                    self.deadline_errors += 1
+                out = None
+            except RejectedError:
+                with self._stats_lock:       # engine shed it at intake
+                    self.shed += 1
+                out = None
             except TimeoutError:
-                continue
+                continue                     # still decoding: wait more
             except Exception:
                 with self._stats_lock:
                     self.errors += 1
@@ -225,9 +310,9 @@ class GenerationServingRoute:
             with self._inflight_lock:
                 self._inflight.pop(0)
             if out is not None:
-                self.pub.publish(np.asarray(out, np.int32))
-                with self._stats_lock:
-                    self.served += 1
+                if self._publish_safe(np.asarray(out, np.int32)):
+                    with self._stats_lock:
+                        self.served += 1
 
     def start(self) -> "GenerationServingRoute":
         self.engine.start()

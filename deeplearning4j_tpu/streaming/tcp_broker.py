@@ -20,12 +20,15 @@ surface, so every publisher/subscriber/route runs unchanged over it.
 from __future__ import annotations
 
 import queue
+import random
 import socket
 import struct
 import threading
+import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..parallel.faults import NULL_INJECTOR
 from .pubsub import MessageBroker, register_broker_driver
 
 
@@ -70,8 +73,9 @@ class _Outbound:
         self.conn = conn
         self.queue: "queue.Queue[Optional[bytes]]" = queue.Queue(max_queued)
         self.dropped = False
+        self._lag = 0.0     # grace consumed across CONSECUTIVE congested
         self.thread = threading.Thread(target=self._drain, daemon=True)
-        self.thread.start()
+        self.thread.start()   # sends; reset whenever the queue has room
 
     def _drain(self) -> None:
         while True:
@@ -83,11 +87,32 @@ class _Outbound:
             except OSError:
                 return                       # reader side cleans up
 
-    def send(self, frame: bytes) -> bool:
+    def send(self, frame: bytes, grace: float = 0.0) -> bool:
         """Enqueue; False means the consumer overflowed (caller should
-        disconnect it)."""
+        disconnect it). ``grace`` is a BUDGET of waiting for the writer
+        to make progress on a FULL queue, accumulated across consecutive
+        congested sends and reset whenever the queue has room again: a
+        healthy consumer that is merely behind on a burst drains within
+        it, while a stalled one (writer wedged in sendall on a full TCP
+        window) or a chronically-too-slow one exhausts it and is evicted
+        — so overflow-eviction means "no progress within grace", not
+        "momentarily full" (which evicted healthy subscribers under
+        scheduling jitter), and a slow-but-draining consumer cannot
+        head-of-line-tax every frame forever."""
         try:
             self.queue.put_nowait(frame)
+            self._lag = 0.0
+            return True
+        except queue.Full:
+            pass
+        budget = grace - self._lag
+        if budget <= 0.0:
+            self.dropped = True
+            return False
+        t0 = time.monotonic()
+        try:
+            self.queue.put(frame, timeout=budget)
+            self._lag += time.monotonic() - t0
             return True
         except queue.Full:
             self.dropped = True
@@ -108,7 +133,8 @@ class TcpBrokerServer:
     the publisher's reader thread."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_queued_frames: int = 256):
+                 max_queued_frames: int = 256,
+                 overflow_grace: float = 0.25):
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self._subs: Dict[str, Set[socket.socket]] = defaultdict(set)
@@ -117,6 +143,11 @@ class TcpBrokerServer:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.max_queued_frames = int(max_queued_frames)
+        # budget of waiting for writer progress (per consumer, per
+        # congestion episode) before an overflow becomes an eviction; a
+        # stalled or chronically slow peer exhausts it once and is
+        # dropped, so it cannot head-of-line-block delivery indefinitely
+        self.overflow_grace = float(overflow_grace)
         self.disconnects = 0                 # stalled-subscriber evictions
 
     @property
@@ -185,7 +216,9 @@ class TcpBrokerServer:
                         targets = [(c, self._outs.get(c))
                                    for c in self._subs[topic]]
                     for c, out in targets:
-                        if out is None or not out.send(frame):
+                        if out is None or \
+                                not out.send(frame,
+                                             grace=self.overflow_grace):
                             # overflowed (stalled) or already gone: evict
                             with self._lock:   # reader threads race here
                                 self.disconnects += 1
@@ -217,25 +250,82 @@ class TcpMessageBroker(MessageBroker):
     """MessageBroker over a TcpBrokerServer connection. Local fan-out
     mirrors the in-process broker (bounded per-subscriber queues with
     drop-oldest backpressure); the server-side subscription is held while
-    ANY local queue wants the topic (refcounted)."""
+    ANY local queue wants the topic (refcounted).
 
-    def __init__(self, host: str, port: int, capacity: int = 1024):
+    Resilience (ISSUE 3): with ``reconnect=True`` (default) a lost
+    connection triggers auto-reconnect in the reader thread —
+    exponential backoff + jitter up to ``max_reconnect_attempts`` — and
+    on success every topic with live local subscribers is RE-SUBSCRIBED
+    server-side, so consumers ride through a broker restart. Publishers
+    that hit a dead socket wait for the reconnect (bounded retries with
+    backoff) instead of failing on the first broken frame; frames sent
+    while the broker is down are lost (at-most-once, Kafka-less
+    semantics) and the retry itself is counted in ``publish_retries``.
+    ``fault_injector`` arms ``broker.send`` / ``broker.recv``
+    (parallel/faults.py): an injected raise exercises exactly the
+    reconnect/retry paths a real dead socket would."""
+
+    def __init__(self, host: str, port: int, capacity: int = 1024,
+                 reconnect: bool = True, max_reconnect_attempts: int = 20,
+                 backoff_base: float = 0.05, backoff_cap: float = 2.0,
+                 publish_max_retries: int = 8, fault_injector=None):
         super().__init__(capacity)
+        self.host, self.port = host, int(port)
+        self.reconnect = bool(reconnect)
+        self.max_reconnect_attempts = int(max_reconnect_attempts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.publish_max_retries = int(publish_max_retries)
+        self._faults = fault_injector if fault_injector is not None \
+            else NULL_INJECTOR
         self._sock = socket.create_connection((host, port), timeout=10)
         self._sock.settimeout(None)
         self._send_lock = threading.Lock()
         # serializes the (refcount check, queue mutation, S/U frame) unit —
         # without it a concurrent last-unsubscribe + first-subscribe could
         # leave a live local queue with no server-side subscription. The
-        # reader thread never takes this lock, so delivery can't deadlock.
+        # reader thread only takes it in _reconnect, where delivery is
+        # necessarily idle (the connection is down), so no deadlock.
         self._sub_lock = threading.Lock()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._stats_lock = threading.Lock()
+        self.reconnects = 0          # successful re-connections
+        self.publish_retries = 0     # sends that had to wait/retry
+        # deterministic jitter stream: chaos runs stay reproducible
+        self._jitter = random.Random(0xC0FFEE ^ self.port)
+        self._conn_ok = threading.Event()   # cleared while reconnecting
+        self._conn_ok.set()
         self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
     # MessageBroker surface -------------------------------------------------
     def publish(self, topic: str, payload: bytes) -> None:
-        _send_frame(self._sock, self._send_lock, b"P", topic, payload)
+        attempts = 0
+        while True:
+            try:
+                if self._faults.fire("broker.send"):
+                    return               # injected frame drop (lossy link)
+                _send_frame(self._sock, self._send_lock, b"P", topic,
+                            payload)
+                return
+            except (OSError, ConnectionError):
+                if self._closed.is_set() or not self.reconnect:
+                    raise
+                attempts += 1
+                with self._stats_lock:
+                    self.publish_retries += 1
+                if attempts > self.publish_max_retries:
+                    raise
+                backoff = min(self.backoff_base * (2 ** attempts),
+                              self.backoff_cap)
+                if self._conn_ok.is_set():
+                    # the reader hasn't observed the outage yet (or the
+                    # fault was injected on a healthy socket): waiting on
+                    # a SET event returns instantly, so sleep the real
+                    # backoff instead of burning every retry at once
+                    time.sleep(backoff)
+                else:
+                    self._conn_ok.wait(timeout=backoff)
 
     def subscribe(self, topic: str) -> queue.Queue:
         with self._sub_lock:
@@ -243,7 +333,13 @@ class TcpMessageBroker(MessageBroker):
                 first = not self._subs[topic]
             q = super().subscribe(topic)
             if first:
-                _send_frame(self._sock, self._send_lock, b"S", topic)
+                try:
+                    _send_frame(self._sock, self._send_lock, b"S", topic)
+                except OSError:
+                    if not self.reconnect:
+                        raise
+                    # connection is down: the local queue is registered,
+                    # so _reconnect() re-subscribes this topic on success
         return q
 
     def unsubscribe(self, topic: str, q: queue.Queue) -> None:
@@ -259,20 +355,78 @@ class TcpMessageBroker(MessageBroker):
 
     # ----------------------------------------------------------------------
     def _read_loop(self) -> None:
-        try:
-            while not self._closed.is_set():
+        while not self._closed.is_set():
+            try:
+                drop = self._faults.fire("broker.recv")
                 op, topic, body = _recv_frame(self._sock)
-                if op == b"M":
-                    # local fan-out via the in-process broker's delivery
-                    # (drop-oldest bounded queues)
-                    MessageBroker.publish(self, topic, body)
-        except (ConnectionError, struct.error, OSError):
+            except (ConnectionError, struct.error, OSError):
+                if self._closed.is_set() or not self.reconnect:
+                    return
+                if not self._reconnect():
+                    return
+                continue
+            if drop:
+                continue                 # injected frame drop (lossy link)
+            if op == b"M":
+                # local fan-out via the in-process broker's delivery
+                # (drop-oldest bounded queues)
+                MessageBroker.publish(self, topic, body)
+
+    def _reconnect(self) -> bool:
+        """Reader-thread only: tear down the dead socket, dial with
+        exponential backoff + jitter, re-subscribe live topics."""
+        self._conn_ok.clear()
+        try:
+            self._sock.close()
+        except OSError:
             pass
+        delay = self.backoff_base
+        for _ in range(self.max_reconnect_attempts):
+            if self._closed.is_set():
+                return False
+            try:
+                s = socket.create_connection((self.host, self.port),
+                                             timeout=5)
+                s.settimeout(None)
+            except OSError:
+                time.sleep(min(delay, self.backoff_cap) *
+                           (1.0 + 0.25 * self._jitter.random()))
+                delay *= 2
+                continue
+            with self._send_lock:
+                self._sock = s
+            try:
+                # re-subscribe every topic with live local subscribers:
+                # consumers must not silently stop receiving after a
+                # broker restart
+                with self._sub_lock:
+                    with self._lock:
+                        topics = [t for t, qs in self._subs.items() if qs]
+                    for t in topics:
+                        _send_frame(s, self._send_lock, b"S", t)
+            except OSError:
+                # fresh socket died before the S frames landed (flapping
+                # broker): close it (no fd leak) and back off like a
+                # failed dial — never a tight redial loop
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                time.sleep(min(delay, self.backoff_cap) *
+                           (1.0 + 0.25 * self._jitter.random()))
+                delay *= 2
+                continue
+            with self._stats_lock:
+                self.reconnects += 1
+            self._conn_ok.set()
+            return True
+        return False
 
     def close(self) -> None:
         self._closed.set()
-        try:
-            self._sock.close()
+        self._conn_ok.set()              # unblock publishers: they fail
+        try:                             # fast instead of waiting out a
+            self._sock.close()           # reconnect that will never come
         except OSError:
             pass
 

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Bounded chaos soak for the serving resilience layer (ISSUE 3).
+
+Runs the slot generation engine under a RANDOMIZED-BUT-SEEDED fault
+schedule (crashes and wedges injected at engine.step via
+parallel/faults.FaultInjector, recovered by an EngineSupervisor) and
+asserts the two invariants the resilience layer promises:
+
+1. zero stranded requests — every submitted request terminates
+   (completed / failed-with-cause / deadline / shed), none left blocked
+   in result();
+2. zero new compiles in the post-restart steady state — supervisor
+   restarts rebuild the engine around the SAME TransformerDecoder, so a
+   post-recovery request wave re-lowers nothing
+   (analysis/compile_audit.CompileAudit enforces it);
+
+plus the correctness bar: every COMPLETED request's tokens equal the
+uninterrupted clean-engine run, token for token (greedy).
+
+    python scripts/chaos_soak.py --seed 7 --requests 24 --crashes 3
+    python scripts/chaos_soak.py --seed 7 --json
+
+The same seed reproduces the same schedule bit-for-bit (the injector is
+hit-count keyed, the engine's decode loop deterministic). A short seeded
+profile runs under tier-1 (tests/test_resilience.py); longer soaks are
+for chaos CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
+             max_new: int = 6, crashes: int = 2, hangs: int = 1,
+             vocab: int = 12, supervisor_timeout: float = 2.0,
+             hang_seconds: float = None, wait_s: float = 180.0,
+             steady_wave: int = 4) -> dict:
+    """One soak iteration; returns a summary dict (see keys below).
+
+    Prompt lengths and generation budgets are drawn so every prefill —
+    including a recovery re-prefill of prompt + generated-so-far — stays
+    inside the tp=16 padding bucket: the clean warmup run compiles every
+    program the chaos run will ever need, which is what makes the
+    zero-new-compiles assertion exact rather than probabilistic."""
+    import numpy as np
+
+    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                      TransformerDecoder)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+    from deeplearning4j_tpu.parallel.faults import FaultInjector
+
+    if hang_seconds is None:
+        hang_seconds = 2.0 * supervisor_timeout
+    rng = np.random.default_rng(seed)
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=32, num_heads=2, num_layers=2, max_length=32,
+        learning_rate=1e-2, seed=5)).init()
+    dec = TransformerDecoder(net)
+
+    # prompt len 2..4, gens 2..max_new, max_new <= 11: prompt + generated
+    # <= 15 < 16 keeps every (re-)prefill in the same tp=16 bucket
+    assert max_new <= 11, "max_new > 11 would leave the tp=16 bucket"
+    prompts = [rng.integers(0, vocab, int(rng.integers(2, 5)))
+               for _ in range(n_requests)]
+    gens = [int(rng.integers(2, max_new + 1)) for _ in range(n_requests)]
+
+    summary = {"seed": seed, "requests": n_requests, "crashes": crashes,
+               "hangs": hangs}
+    with CompileAudit() as audit:
+        # --- clean reference run: the uninterrupted ground truth, and
+        # the compile warmup (same decoder => same jitted programs)
+        clean = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec)
+        clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+        clean.run_until_drained()
+        expected = [r.result(1) for r in clean_reqs]
+
+        # --- seeded fault schedule against the decode-step hit counter.
+        # Total clean steps ~= sum(gens)/num_slots; crashes land in the
+        # first half so they actually fire, the wedge right after.
+        est_steps = max(4, sum(gens) // max(1, num_slots))
+        inj = FaultInjector()
+        crash_hits = sorted(
+            {int(h) for h in rng.integers(2, max(3, est_steps), crashes)})
+        for h in crash_hits:
+            inj.raise_once("engine.step",
+                           RuntimeError(f"soak: injected crash at step "
+                                        f"hit {h}"), at=h)
+        hang_hits = sorted(
+            {int(h) for h in rng.integers(2, max(3, est_steps), hangs)}
+            - set(crash_hits))
+        for h in hang_hits:
+            inj.hang_for("engine.step", seconds=hang_seconds, at=h)
+        summary["crash_hits"] = crash_hits
+        summary["hang_hits"] = hang_hits
+
+        # --- chaos run under supervision
+        eng = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec,
+                                   fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=supervisor_timeout,
+                               interval=0.1,
+                               max_restarts=crashes + hangs + 2).start()
+        reqs = [sup.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + wait_s
+        for r in reqs:
+            r._done.wait(max(0.0, deadline - time.monotonic()))
+        stranded = [r for r in reqs if not r.done()]
+
+        # --- post-restart steady state: faults cleared, a fresh wave
+        # must complete without ONE new lowering
+        inj.clear()
+        snap = audit.snapshot()
+        wave = [sup.submit(p, g)
+                for p, g in zip(prompts[:steady_wave], gens[:steady_wave])]
+        wave_deadline = time.monotonic() + 60.0
+        for r in wave:
+            r._done.wait(max(0.0, wave_deadline - time.monotonic()))
+        steady_delta = audit.delta(snap)
+        stranded += [r for r in wave if not r.done()]
+        stats = sup.stats()
+        sup.stop()
+
+    mismatches = 0
+    completed = failed = 0
+    for r, want in zip(reqs, expected):
+        if r.state == r.DONE:
+            completed += 1
+            if not np.array_equal(r.result(0), want):
+                mismatches += 1
+        else:
+            failed += 1
+    summary.update({
+        "stranded": len(stranded),
+        "mismatches": mismatches,
+        "completed": completed,
+        "failed": failed,
+        "restarts": stats["restarts"],
+        "recovered_requests": stats["recovered_requests"],
+        "steady_new_compiles": steady_delta,
+        "injector": inj.counters(),
+    })
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--max-new", type=int, default=6)
+    ap.add_argument("--crashes", type=int, default=2)
+    ap.add_argument("--hangs", type=int, default=1)
+    ap.add_argument("--supervisor-timeout", type=float, default=2.0)
+    ap.add_argument("--iterations", type=int, default=1,
+                    help="soak rounds; seed advances per round")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    ok = True
+    for i in range(args.iterations):
+        s = run_soak(seed=args.seed + i, n_requests=args.requests,
+                     num_slots=args.slots, max_new=args.max_new,
+                     crashes=args.crashes, hangs=args.hangs,
+                     supervisor_timeout=args.supervisor_timeout)
+        bad = s["stranded"] or s["mismatches"] or s["failed"] or \
+            s["steady_new_compiles"]
+        ok = ok and not bad
+        if args.json:
+            print(json.dumps(s, default=str))
+        else:
+            print(f"round {i}: seed={s['seed']} restarts={s['restarts']} "
+                  f"recovered={s['recovered_requests']} "
+                  f"completed={s['completed']}/{s['requests']} "
+                  f"stranded={s['stranded']} mismatches={s['mismatches']} "
+                  f"steady_new_compiles={s['steady_new_compiles'] or '{}'}"
+                  f" -> {'FAIL' if bad else 'ok'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,567 @@
+"""Serving resilience layer (ISSUE 3): deterministic chaos tests driving
+parallel/faults.FaultInjector through every recovery path — engine crash
+and wedge with supervised exactly-once restart, deadline/cancel enforced
+mid-decode, admission-control shedding, broker kill/reconnect with
+re-subscribe, and route retry/degradation — plus the acceptance
+invariant: under injected faults every request terminates, recovered
+sequences equal uninterrupted greedy decoding token-for-token, and the
+post-restart steady state compiles nothing new."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                       TransformerDecoder,
+                                       generate as nocache_generate,
+                                       transformer_lm_conf)
+from deeplearning4j_tpu.models.generation import GenerationRequest
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+from deeplearning4j_tpu.parallel.faults import (Cancelled, DeadlineExceeded,
+                                                FaultInjector, NULL_INJECTOR,
+                                                RejectedError)
+from deeplearning4j_tpu.streaming.pubsub import (MessageBroker,
+                                                 NDArrayPublisher,
+                                                 NDArraySubscriber,
+                                                 NDArrayStreamClient)
+from deeplearning4j_tpu.streaming.serving import GenerationServingRoute
+from deeplearning4j_tpu.streaming.tcp_broker import (TcpBrokerServer,
+                                                     TcpMessageBroker)
+
+VOCAB = 12
+
+
+@pytest.fixture(scope="module")
+def shared_decoder():
+    """One net + decoder for the whole module: every engine (and every
+    supervisor REBUILD) shares the jitted prefill/decode programs, the
+    same sharing that makes restart recovery compile-free in prod."""
+    net = ComputationGraph(transformer_lm_conf(
+        VOCAB, d_model=32, num_heads=2, num_layers=2, max_length=32,
+        learning_rate=1e-2, seed=5)).init()
+    dec = TransformerDecoder(net)
+    # warm the decode/prefill programs so supervision timeouts in these
+    # tests never race a first-compile pause
+    eng = SlotGenerationEngine(net, num_slots=2, decoder=dec)
+    eng.submit([1, 2], 3)
+    eng.run_until_drained()
+    return net, dec
+
+
+def _engine(dec_tuple, **kw):
+    net, dec = dec_tuple
+    kw.setdefault("num_slots", 2)
+    return SlotGenerationEngine(net, decoder=dec, **kw)
+
+
+def _wait(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestFaultInjector:
+    def test_raise_once_at_hit(self):
+        inj = FaultInjector()
+        inj.raise_once("engine.step", RuntimeError("boom"), at=3)
+        inj.fire("engine.step")
+        inj.fire("engine.step")
+        with pytest.raises(RuntimeError, match="boom"):
+            inj.fire("engine.step")
+        inj.fire("engine.step")               # armed once: 4th hit clean
+        assert inj.hits("engine.step") == 4
+        assert inj.fired("engine.step") == 1
+
+    def test_raise_n_and_class_exceptions(self):
+        inj = FaultInjector()
+        inj.raise_n("broker.send", ConnectionError, n=2)
+        for _ in range(2):
+            with pytest.raises(ConnectionError, match="broker.send"):
+                inj.fire("broker.send")
+        inj.fire("broker.send")
+
+    def test_drop_and_hang(self):
+        inj = FaultInjector()
+        inj.drop("route.publish", n=2, at=2)
+        assert inj.fire("route.publish") is False
+        assert inj.fire("route.publish") is True
+        assert inj.fire("route.publish") is True
+        assert inj.fire("route.publish") is False
+        inj.hang_for("engine.step", seconds=0.05)
+        t0 = time.monotonic()
+        assert inj.fire("engine.step") is False
+        assert time.monotonic() - t0 >= 0.05
+        inj.clear()
+        assert inj.fire("route.publish") is False
+
+    def test_null_injector_is_inert(self):
+        assert NULL_INJECTOR.fire("engine.step") is False
+
+
+class TestRequestLifecycle:
+    def test_states_and_repr(self, shared_decoder):
+        eng = _engine(shared_decoder)
+        req = eng.submit([1, 2, 3], 4)
+        assert req.state == GenerationRequest.PENDING
+        assert "PENDING" in repr(req) and "prompt_len=3" in repr(req)
+        eng.run_until_drained()
+        assert req.state == GenerationRequest.DONE
+        assert "DONE" in repr(req)
+        bad = eng.submit([], 4)
+        assert bad.state == GenerationRequest.FAILED
+        assert "error=ValueError" in repr(bad)
+
+    def test_cancel_while_queued(self, shared_decoder):
+        eng = _engine(shared_decoder)
+        req = eng.submit([1, 2], 8)
+        assert req.cancel() is True
+        eng.run_until_drained()
+        with pytest.raises(Cancelled):
+            req.result(1)
+        assert req.state == GenerationRequest.CANCELLED
+        assert req.cancel() is False          # already finished
+        assert eng.stats()["cancelled"] == 1
+        assert eng.stats()["prefills"] == 0   # never took a slot
+
+    def test_deadline_expired_while_queued(self, shared_decoder):
+        eng = _engine(shared_decoder)
+        req = eng.submit([1, 2], 8, deadline=0.0)
+        time.sleep(0.01)
+        eng.run_until_drained()
+        with pytest.raises(DeadlineExceeded):
+            req.result(1)
+        assert eng.stats()["deadline_exceeded"] == 1
+
+    def test_deadline_enforced_mid_decode(self, shared_decoder):
+        # wedge every decode step long enough that the deadline passes
+        # AFTER some tokens were emitted — the slot must be freed
+        # mid-decode and reused by the follow-up request
+        inj = FaultInjector()
+        inj.hang_for("engine.step", seconds=0.15, times=100)
+        eng = _engine(shared_decoder, num_slots=1,
+                      fault_injector=inj).start()
+        try:
+            doomed = eng.submit([1, 2, 3], 50, deadline=0.4)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(30)
+            assert doomed.state == GenerationRequest.FAILED
+            assert len(doomed.generated) >= 1     # it WAS decoding
+            assert len(doomed.generated) < 50
+            inj.clear()                            # un-wedge the loop
+            ok = eng.submit([4, 5], 3)
+            assert len(ok.result(30)) == 5         # slot was freed/reused
+            assert eng.stats()["deadline_exceeded"] == 1
+        finally:
+            eng.shutdown()
+
+    def test_cancel_mid_decode(self, shared_decoder):
+        inj = FaultInjector()
+        inj.hang_for("engine.step", seconds=0.1, times=1000)
+        eng = _engine(shared_decoder, num_slots=1,
+                      fault_injector=inj).start()
+        try:
+            req = eng.submit([1, 2, 3], 1000)
+            assert _wait(lambda: len(req.generated) >= 2, timeout=30)
+            assert req.cancel() is True
+            with pytest.raises(Cancelled):
+                req.result(30)
+            assert req.state == GenerationRequest.CANCELLED
+            inj.clear()
+            ok = eng.submit([4], 3)
+            assert len(ok.result(30)) == 4
+        finally:
+            eng.shutdown()
+
+
+class TestAdmissionControl:
+    def test_queue_full_sheds_with_depth(self, shared_decoder):
+        eng = _engine(shared_decoder, num_slots=1, max_pending=2)
+        held = [eng.submit([1, 2], 3) for _ in range(2)]  # engine idle:
+        shed = eng.submit([3, 4], 3)                      # both queued
+        assert shed.state == GenerationRequest.FAILED
+        with pytest.raises(RejectedError) as ei:
+            shed.result(1)
+        assert ei.value.queue_depth == 2
+        assert eng.stats()["rejected"] == 1
+        eng.run_until_drained()                # queued work still runs
+        for r in held:
+            assert len(r.result(1)) == 5
+        # queue drained: submissions are admitted again
+        again = eng.submit([5, 6], 2)
+        eng.run_until_drained()
+        assert len(again.result(1)) == 4
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+class TestDeathCause:
+    def test_result_without_timeout_raises_death_cause(self, shared_decoder):
+        inj = FaultInjector()
+        inj.raise_once("engine.step", RuntimeError("device melted"))
+        eng = _engine(shared_decoder, fault_injector=inj).start()
+        req = eng.submit([1, 2, 3], 8)
+        assert _wait(req.done, timeout=30)     # crash fails it promptly
+        with pytest.raises(RuntimeError, match="device melted"):
+            req.result()                       # NO timeout: death cause,
+        assert req.state == GenerationRequest.FAILED   # not a hang / a
+        late = eng.submit([4, 5], 3)                   # generic error
+        with pytest.raises(RuntimeError, match="device melted"):
+            late.result()
+        assert eng.stats()["failed"] >= 1
+
+    def test_unsupervised_crash_fails_queued_too(self, shared_decoder):
+        inj = FaultInjector()
+        inj.raise_once("engine.prefill", RuntimeError("prefill died"))
+        eng = _engine(shared_decoder, num_slots=1,
+                      fault_injector=inj).start()
+        reqs = [eng.submit([1, 2], 4) for _ in range(3)]
+        for r in reqs:
+            assert _wait(r.done, timeout=30)
+            with pytest.raises(RuntimeError, match="prefill died"):
+                r.result()
+
+
+class TestEngineSupervision:
+    def _expected(self, net, prompts, gens):
+        return [nocache_generate(net, p, g, temperature=0)
+                for p, g in zip(prompts, gens)]
+
+    def test_crash_restart_recovers_inflight_token_for_token(
+            self, shared_decoder, rng_np):
+        net, dec = shared_decoder
+        prompts = [rng_np.integers(0, VOCAB, n) for n in (3, 4, 2, 3, 4)]
+        gens = [6, 8, 5, 7, 6]
+        want = self._expected(net, prompts, gens)
+        inj = FaultInjector()
+        inj.raise_once("engine.step", RuntimeError("injected crash"), at=4)
+        eng = _engine(shared_decoder, fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=10.0, interval=0.1,
+                               max_restarts=2).start()
+        try:
+            reqs = [sup.submit(p, g) for p, g in zip(prompts, gens)]
+            outs = [r.result(60) for r in reqs]
+            for o, w in zip(outs, want):
+                np.testing.assert_array_equal(o, w)
+            assert sup.restarts == 1
+            assert sup.recovered_requests >= 1
+            s = sup.stats()
+            # exactly-once: every request completed exactly once across
+            # both engines (supervisor stats accumulate the quarantined
+            # engine's counters — monotonic across takeovers), none
+            # double-counted, none failed
+            assert s["completed"] == len(reqs)
+            assert s["failed"] == 0
+            # recovery observed: the replacement engine re-prefilled
+            # crashed requests mid-generation
+            assert s["requeued"] >= 1
+        finally:
+            sup.stop()
+
+    def test_wedge_detected_and_restarted(self, shared_decoder, rng_np):
+        net, dec = shared_decoder
+        prompts = [rng_np.integers(0, VOCAB, 3) for _ in range(3)]
+        gens = [6, 6, 6]
+        want = self._expected(net, prompts, gens)
+        inj = FaultInjector()
+        inj.hang_for("engine.step", seconds=3.0, at=2)
+        eng = _engine(shared_decoder, fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=0.6, interval=0.1,
+                               max_restarts=2).start()
+        try:
+            reqs = [sup.submit(p, g) for p, g in zip(prompts, gens)]
+            outs = [r.result(60) for r in reqs]
+            for o, w in zip(outs, want):
+                np.testing.assert_array_equal(o, w)
+            assert sup.restarts == 1
+        finally:
+            sup.stop()
+
+    def test_first_step_silence_is_grace_not_wedge(self, shared_decoder):
+        # a hang BEFORE the engine's first completed decode step mimics
+        # a long first lowering: the supervisor must wait it out
+        # (warmup_grace), not burn restarts on a cold start
+        inj = FaultInjector()
+        inj.hang_for("engine.step", seconds=1.5, at=1)
+        eng = _engine(shared_decoder, fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=0.3, interval=0.1,
+                               max_restarts=2).start()
+        try:
+            req = sup.submit([1, 2, 3], 4)
+            assert len(req.result(30)) == 7
+            assert sup.restarts == 0
+        finally:
+            sup.stop()
+
+    def test_restart_budget_exhausted_fails_with_cause(
+            self, shared_decoder):
+        inj = FaultInjector()
+        inj.raise_n("engine.step", RuntimeError, n=10_000)
+        eng = _engine(shared_decoder, fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=10.0, interval=0.1,
+                               max_restarts=2).start()
+        try:
+            req = sup.submit([1, 2, 3], 8)
+            assert _wait(req.done, timeout=60)
+            with pytest.raises(RuntimeError, match="restart budget"):
+                req.result()
+            assert sup.given_up is not None
+            assert sup.restarts == 2
+            late = sup.submit([1, 2], 2)
+            assert _wait(late.done, timeout=5)
+            with pytest.raises(RuntimeError):
+                late.result()
+        finally:
+            sup.stop()
+
+
+def _bind_server(port, timeout=20.0):
+    """(Re)start a broker server on a fixed port; retries while the old
+    connection's FIN handshake drains (exactly what a restarting broker
+    process does)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return TcpBrokerServer(port=port).start()
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+class TestBrokerReconnect:
+    def _restartable_server(self):
+        # reserve a port we can re-bind after the kill (SO_REUSEADDR via
+        # socket.create_server)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def test_kill_reconnect_resubscribe_delivers(self):
+        port = self._restartable_server()
+        srv = TcpBrokerServer(port=port).start()
+        client = TcpMessageBroker("127.0.0.1", port, backoff_base=0.02,
+                                  backoff_cap=0.2,
+                                  max_reconnect_attempts=100)
+        sub = NDArrayStreamClient(broker=client).subscriber("topic-r")
+        pub = NDArrayStreamClient(broker=client).publisher("topic-r")
+        try:
+            time.sleep(0.05)                   # let the S frame land
+            pub.publish(np.arange(3, dtype=np.float32))
+            assert sub.poll(timeout=5) is not None
+            srv.close()                        # broker dies
+            assert _wait(lambda: not client._conn_ok.is_set(), timeout=10)
+            srv = _bind_server(port)           # broker returns
+            assert _wait(lambda: client.reconnects >= 1, timeout=20)
+            time.sleep(0.05)                   # re-subscribe frame lands
+            pub.publish(np.arange(4, dtype=np.float32))
+            got = sub.poll(timeout=10)
+            # the client re-subscribed on the NEW connection: delivery
+            # works with no client-side re-setup at all
+            assert got is not None and got.tolist() == [0.0, 1.0, 2.0, 3.0]
+            assert client.reconnects >= 1
+        finally:
+            client.close()
+            srv.close()
+
+    def test_publish_survives_outage_with_retries(self):
+        port = self._restartable_server()
+        srv = TcpBrokerServer(port=port).start()
+        client = TcpMessageBroker("127.0.0.1", port, backoff_base=0.02,
+                                  backoff_cap=0.2,
+                                  max_reconnect_attempts=200,
+                                  publish_max_retries=200)
+        sub = NDArrayStreamClient(broker=client).subscriber("topic-o")
+        try:
+            time.sleep(0.05)
+            srv.close()
+            # publish a STREAM spanning the outage from another thread:
+            # sends must block in bounded retries, not die. (A single
+            # send can slip into the kernel buffer before the RST
+            # arrives and "succeed"; a stream across a >=0.3s outage
+            # is guaranteed to hit the dead socket at least once.)
+            state = {}
+
+            def pub_during_outage():
+                try:
+                    pub = NDArrayStreamClient(broker=client).publisher(
+                        "topic-o")
+                    for _ in range(50):
+                        pub.publish(np.zeros(2, np.float32))
+                        time.sleep(0.02)
+                    state["ok"] = True
+                except Exception as e:   # noqa: BLE001
+                    state["err"] = e
+
+            t = threading.Thread(target=pub_during_outage, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            srv = _bind_server(port)
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert state.get("ok"), state.get("err")
+            assert client.publish_retries >= 1
+        finally:
+            client.close()
+            srv.close()
+
+    def test_injected_send_faults_retry_then_deliver(self):
+        inj = FaultInjector()
+        inj.raise_n("broker.send", ConnectionError, n=2, at=2)
+        srv = TcpBrokerServer().start()
+        client = TcpMessageBroker(srv.host, srv.port, backoff_base=0.01,
+                                  fault_injector=inj)
+        sub = NDArrayStreamClient(broker=client).subscriber("topic-i")
+        pub = NDArrayStreamClient(broker=client).publisher("topic-i")
+        try:
+            time.sleep(0.05)
+            pub.publish(np.arange(2, dtype=np.float32))   # hit 1: clean
+            pub.publish(np.arange(3, dtype=np.float32))   # hits 2,3 raise
+            assert sub.poll(timeout=5) is not None        # then retry
+            assert sub.poll(timeout=5) is not None        # delivers both
+            assert client.publish_retries >= 2
+        finally:
+            client.close()
+            srv.close()
+
+
+class TestRouteDegradation:
+    def test_publish_drop_counted_not_fatal(self, shared_decoder, rng_np):
+        net, dec = shared_decoder
+        inj = FaultInjector()
+        inj.drop("route.publish", n=1)        # first output frame lost
+        broker = MessageBroker()
+        out = NDArraySubscriber(broker, "dl4j-gen-output")
+        eng = _engine(shared_decoder)
+        route = GenerationServingRoute(net, broker, engine=eng,
+                                       max_new_tokens=4,
+                                       fault_injector=inj).start()
+        try:
+            pub = NDArrayPublisher(broker, "dl4j-gen-input")
+            p1, p2 = (rng_np.integers(0, VOCAB, 3) for _ in range(2))
+            pub.publish(np.asarray(p1, np.int32))
+            pub.publish(np.asarray(p2, np.int32))
+            got = out.poll(timeout=30)
+            # first was dropped (counted), second delivered; thread alive
+            assert got is not None
+            np.testing.assert_array_equal(
+                np.asarray(got, np.int64),
+                nocache_generate(net, p2, 4, temperature=0))
+            assert route.publish_drops == 1
+            assert route.served == 1
+            assert route._publisher.is_alive()
+        finally:
+            route.stop()
+
+    def test_deadline_shed_requests_do_not_wedge_order(
+            self, shared_decoder, rng_np):
+        net, dec = shared_decoder
+        # deadline=0: every request expires in queue — the in-order
+        # publisher must pop them (DeadlineExceeded is a TimeoutError;
+        # the route must not spin on it forever)
+        broker = MessageBroker()
+        out = NDArraySubscriber(broker, "dl4j-gen-output")
+        eng = _engine(shared_decoder)
+        route = GenerationServingRoute(net, broker, engine=eng,
+                                       max_new_tokens=4,
+                                       deadline=0.0).start()
+        try:
+            pub = NDArrayPublisher(broker, "dl4j-gen-input")
+            pub.publish(np.asarray(rng_np.integers(0, VOCAB, 3), np.int32))
+            assert _wait(lambda: route.deadline_errors >= 1, timeout=30)
+            with route._inflight_lock:
+                assert route._inflight == []   # popped, not wedged
+            assert out.poll(timeout=0.2) is None
+        finally:
+            route.stop()
+
+
+class TestChaosAcceptance:
+    """The ISSUE 3 acceptance bar, end to end over the real TCP stack:
+    seeded faults at engine.step AND broker.send; every submitted
+    request terminates; recovered sequences equal uninterrupted greedy
+    decoding token-for-token; zero new compiles post-restart."""
+
+    def test_seeded_faults_end_to_end(self, shared_decoder, rng_np):
+        net, dec = shared_decoder
+        prompts = [rng_np.integers(0, VOCAB, int(n))
+                   for n in rng_np.integers(2, 5, 6)]
+        want = [nocache_generate(net, p, 5, temperature=0) for p in prompts]
+
+        inj = FaultInjector()
+        inj.raise_once("engine.step", RuntimeError("chaos: step"), at=3)
+        inj.raise_n("broker.send", ConnectionError, n=2, at=3)
+
+        srv = TcpBrokerServer().start()
+        route_broker = TcpMessageBroker(srv.host, srv.port,
+                                        backoff_base=0.01,
+                                        fault_injector=inj)
+        feed = NDArrayStreamClient(url=f"tcp://{srv.host}:{srv.port}")
+        out_sub = feed.subscriber("dl4j-gen-output")
+        feed_pub = feed.publisher("dl4j-gen-input")
+
+        eng = _engine(shared_decoder, fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=10.0, interval=0.1,
+                               max_restarts=3)
+        route = GenerationServingRoute(net, route_broker, engine=sup,
+                                       max_new_tokens=5)
+        with CompileAudit() as audit:
+            route.start()
+            try:
+                time.sleep(0.1)               # S frames land server-side
+                for p in prompts:
+                    feed_pub.publish(np.asarray(p, np.int32))
+                outs = [out_sub.poll(timeout=60) for _ in prompts]
+                assert all(o is not None for o in outs)
+                # in-order, token-for-token with the uninterrupted run
+                for o, w in zip(outs, want):
+                    np.testing.assert_array_equal(np.asarray(o, np.int64),
+                                                  w)
+                assert sup.restarts == 1      # the crash was recovered
+                assert route_broker.publish_retries >= 2   # send faults
+                # --- post-restart steady state: zero new compiles
+                inj.clear()
+                snap = audit.snapshot()
+                for p in prompts[:3]:
+                    feed_pub.publish(np.asarray(p, np.int32))
+                outs2 = [out_sub.poll(timeout=60) for _ in range(3)]
+                assert all(o is not None for o in outs2)
+                assert audit.delta(snap) == {}, audit.delta(snap)
+                # nothing stranded anywhere
+                assert _wait(lambda: not route._inflight, timeout=10)
+            finally:
+                route.stop()
+                sup.stop()
+                route_broker.close()
+                feed.broker.close()
+                srv.close()
+
+
+class TestChaosSoakProfile:
+    """The tier-1 seeded soak profile (scripts/chaos_soak.py): zero
+    stranded requests, zero steady-state compiles, zero mismatches."""
+
+    def test_short_seeded_soak(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "chaos_soak", os.path.join(os.path.dirname(__file__),
+                                       "..", "scripts", "chaos_soak.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        s = mod.run_soak(seed=0, n_requests=8, num_slots=2, max_new=5,
+                         crashes=1, hangs=1, supervisor_timeout=1.0)
+        assert s["stranded"] == 0
+        assert s["mismatches"] == 0
+        assert s["failed"] == 0
+        assert s["steady_new_compiles"] == {}, s["steady_new_compiles"]
+        assert s["restarts"] >= 1
