@@ -15,14 +15,19 @@ machine-checks those invariants:
 - :mod:`.compile_audit` — a context manager that counts XLA compilations
   per jitted function (via the ``jax_log_compiles`` lowering hook),
   detects retrace storms, and asserts expected-compile budgets in the
-  benches (``BENCH_MODE=generate --audit-compiles``).
+  benches (``BENCH_MODE=generate --audit-compiles``); plus
+  :class:`TransferAudit`, its sibling for host syncs — per-tag
+  device→host readback counts through the ``ops.transfer.device_fetch``
+  seam, with a ≤1-readback-per-decode-block budget check.
 """
 
-from .compile_audit import CompileAudit, CompileBudgetError
+from .compile_audit import (CompileAudit, CompileBudgetError, TransferAudit,
+                            TransferBudgetError)
 from .lint import (Finding, LintRunner, RULES, load_baseline, lint_paths,
                    new_findings, write_baseline)
 
 __all__ = [
-    "CompileAudit", "CompileBudgetError", "Finding", "LintRunner", "RULES",
+    "CompileAudit", "CompileBudgetError", "TransferAudit",
+    "TransferBudgetError", "Finding", "LintRunner", "RULES",
     "lint_paths", "load_baseline", "new_findings", "write_baseline",
 ]

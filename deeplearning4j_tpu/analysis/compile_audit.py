@@ -53,6 +53,80 @@ class CompileBudgetError(AssertionError):
     """An audited region compiled more than its budget allows."""
 
 
+class TransferBudgetError(AssertionError):
+    """An audited region read back from device more than its budget
+    allows (e.g. more than one host sync per decode block)."""
+
+
+class TransferAudit:
+    """Counts device→host readbacks within a ``with`` block.
+
+    The compile auditor's sibling: where CompileAudit catches the
+    retrace-per-step failure mode, this catches the SYNC-per-step one —
+    a decode loop that blocks on ``np.asarray`` after every dispatched
+    step serializes host time behind device time and caps tok/s at
+    1/RTT regardless of how fast the step program is. The serving path
+    routes every deliberate readback through the
+    :func:`..ops.transfer.device_fetch` seam with a tag
+    (``engine.decode``, ``engine.prefill``, ``generate.decode``, ...);
+    this audit snapshots the per-tag counters on entry and reports the
+    delta, so concurrent engines/audits never clobber each other.
+
+    ``check_per_block(tag, blocks)`` asserts the pipelined-decode
+    invariant: at most ``max_per_block`` readbacks per decode block
+    (the engine's ``decode_blocks`` stat / one ``decode_block`` call).
+
+    Usage::
+
+        with TransferAudit() as transfers:
+            engine.run_until_drained()
+        transfers.check_per_block("engine.decode",
+                                  engine.stats()["decode_blocks"])
+    """
+
+    def __init__(self):
+        self._start: Dict[str, int] = {}
+        self._end: Optional[Dict[str, int]] = None
+
+    def __enter__(self) -> "TransferAudit":
+        from ..ops import transfer
+        self._transfer = transfer
+        self._start = transfer.fetch_counts()
+        self._end = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._end = self._transfer.fetch_counts()
+
+    def fetches(self, tag: Optional[str] = None) -> int:
+        """Readbacks since entry (one tag, or all tags summed). Live
+        inside the block; frozen at exit."""
+        now = self._end if self._end is not None \
+            else self._transfer.fetch_counts()
+        delta = {t: c - self._start.get(t, 0) for t, c in now.items()}
+        if tag is not None:
+            return delta.get(tag, 0)
+        return sum(delta.values())
+
+    def report(self) -> Dict[str, int]:
+        """Per-tag readback deltas (zero-delta tags omitted)."""
+        now = self._end if self._end is not None \
+            else self._transfer.fetch_counts()
+        return {t: c - self._start.get(t, 0) for t, c in sorted(now.items())
+                if c - self._start.get(t, 0) > 0}
+
+    def check_per_block(self, tag: str, blocks: int,
+                        max_per_block: float = 1.0) -> None:
+        """Assert ≤ ``max_per_block`` readbacks under ``tag`` per decode
+        block; raises :class:`TransferBudgetError` otherwise. ``blocks``
+        of 0 demands zero readbacks."""
+        got = self.fetches(tag)
+        if got > max_per_block * blocks:
+            raise TransferBudgetError(
+                f"{tag}: {got} host readbacks over {blocks} decode "
+                f"block(s) exceeds {max_per_block}/block")
+
+
 class _CompileLogHandler(logging.Handler):
     def __init__(self, audit: "CompileAudit"):
         super().__init__(level=logging.DEBUG)

@@ -46,6 +46,8 @@ RULES: Dict[str, str] = {
              "siblings use",
     "GL006": "shared attribute written from a thread target without a "
              "held lock",
+    "GL007": "blocking host readback of a just-dispatched result inside "
+             "a loop in a hot module",
 }
 
 #: wrappers whose function arguments are traced when called
@@ -70,6 +72,14 @@ _NP_SAFE = {"asarray", "array", "float32", "float64", "float16", "int32",
             "empty", "arange", "shape", "ndim", "broadcast_to", "save"}
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
                    "BoundedSemaphore"}
+#: callees whose results are NOT "just-dispatched device work" for GL007:
+#: python builtins and host-side helpers a loop legitimately materializes
+_GL007_SAFE_CALLEES = {"range", "len", "list", "tuple", "dict", "set",
+                       "zip", "enumerate", "sorted", "reversed", "min",
+                       "max", "sum", "abs", "int", "float", "bool", "str",
+                       "copy", "deepcopy", "append", "pop", "popleft",
+                       "get", "items", "keys", "values", "split", "join",
+                       "format", "device_fetch"}
 
 
 @dataclasses.dataclass
@@ -495,6 +505,85 @@ class ModuleLint:
                                "thread-context method — guard with the "
                                "instance lock")
 
+    # -------------------------------------------------------------- GL007
+    def _check_host_loop_syncs(self, out: List[Finding],
+                               enabled: Set[str],
+                               jit_ids: Set[int]) -> None:
+        """Flag a blocking readback (np.asarray / .item() / .tolist() /
+        device_get) of a name assigned from a call INSIDE the same loop,
+        in hot modules — the dispatch-then-immediately-sync pattern that
+        serializes XLA dispatch with host RTT once per iteration. The
+        sanctioned crossings are (a) one audited ``device_fetch`` per
+        decode BLOCK and (b) fetching the PREVIOUS dispatch's result
+        after launching the next (double buffering) — both restructure
+        the loop rather than silence the rule. Traced functions are
+        GL001's domain and are skipped here."""
+        if "GL007" not in enabled:
+            return
+        if not any(f"/{d}/" in f"/{self.relpath}" for d in _HOT_DIRS):
+            return
+        flagged: Set[int] = set()
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(fn) in jit_ids:
+                continue
+            qual = self._qualname(fn)
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                dispatched: Set[str] = set()
+                for n in ast.walk(loop):
+                    if isinstance(n, ast.Assign) and \
+                            isinstance(n.value, ast.Call) and \
+                            not self._gl007_safe_call(n.value):
+                        for t in n.targets:
+                            for el in ([t.elts] if isinstance(
+                                    t, (ast.Tuple, ast.List)) else [[t]]):
+                                for e in el:
+                                    if isinstance(e, ast.Name):
+                                        dispatched.add(e.id)
+                if not dispatched:
+                    continue
+                for n in ast.walk(loop):
+                    if not isinstance(n, ast.Call) or n.lineno in flagged:
+                        continue
+                    f = n.func
+                    target = None
+                    np_fn = _is_np_call(f)
+                    if np_fn in ("asarray", "array") and n.args and \
+                            isinstance(n.args[0], ast.Name):
+                        target = n.args[0].id
+                    elif isinstance(f, ast.Attribute) and f.attr in (
+                            "item", "tolist", "block_until_ready") and \
+                            isinstance(f.value, ast.Name):
+                        target = f.value.id
+                    elif _dotted_name(f) in ("jax.device_get",
+                                             "device_get") and n.args and \
+                            isinstance(n.args[0], ast.Name):
+                        target = n.args[0].id
+                    if target in dispatched:
+                        flagged.add(n.lineno)
+                        self._emit(out, "GL007", n, qual,
+                                   f"blocking readback of '{target}' "
+                                   "dispatched in the same loop "
+                                   "serializes dispatch with host sync — "
+                                   "fuse steps into a device block and/or "
+                                   "fetch the previous dispatch via "
+                                   "ops.transfer.device_fetch")
+
+    @staticmethod
+    def _gl007_safe_call(call: ast.Call) -> bool:
+        """Callees whose results are host values, not dispatched device
+        work (builtins, np.*/math.* helpers, the audited fetch seam)."""
+        if _is_np_call(call.func) is not None:
+            return True
+        tail = _dotted_tail(call.func)
+        if tail in _GL007_SAFE_CALLEES:
+            return True
+        dn = _dotted_name(call.func)
+        return dn.startswith("math.") or dn.startswith("time.")
+
     @staticmethod
     def _self_attr(node: ast.AST) -> Optional[str]:
         if isinstance(node, ast.Attribute) and \
@@ -523,10 +612,16 @@ class ModuleLint:
     # ---------------------------------------------------------------- run
     def run(self, enabled: Set[str]) -> List[Finding]:
         out: List[Finding] = []
+        jit_ids: Set[int] = set()
         for fn, qual in self._collect_jit_functions():
             self._check_jit_body(out, fn, qual, enabled)
+            jit_ids.add(id(fn))
+            for n in ast.walk(fn):     # nested defs trace with their root
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    jit_ids.add(id(n))
         self._check_jit_sites(out, enabled)
         self._check_lock_discipline(out, enabled)
+        self._check_host_loop_syncs(out, enabled, jit_ids)
         return out
 
 

@@ -49,8 +49,15 @@ from ..nn.conf.layers import (RnnOutputLayer, SelfAttentionLayer,
                               TokenAndPositionEmbedding)
 from ..nn.graph.vertices import LayerVertex
 from ..ops.platform import train_donate_argnums
+from ..ops.transfer import device_fetch
 from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
                                RejectedError)
+
+#: decode-block key-schedule salts: the engine's sampling keys must never
+#: collide with TransformerDecoder.generate's (legacy: 1 << 20 | step_no)
+#: or with batched-admission prefill keys
+ENGINE_KEY_SALT = 1 << 20
+PREFILL_BATCH_SALT = 1 << 21
 
 
 def _round_up_pow2(n: int, floor: int = 16) -> int:
@@ -278,23 +285,70 @@ class TransformerDecoder:
                 return self._select(logits, temps, key), logits, caches
             fn = jax.jit(decode_step_impl,
                          donate_argnums=train_donate_argnums((2,)))
-        elif name == "prefill_slot":
-            def prefill_slot_impl(params, state, caches, tokens, length,
-                                  slot, temp, key):
+        elif name == "prefill_slots":
+            def prefill_slots_impl(params, state, caches, tokens, lengths,
+                                   slots, temps, key):
+                # batched admission: ONE forward over [M, Tp] fills a
+                # fresh M-slot cache, then each row scatters into the
+                # shared cache at its slot index. M and Tp are bucketed
+                # by the caller (pow2), so the signature set is finite.
+                m, tp = tokens.shape
                 c1 = {n: self.net.conf.vertices[n].layer.init_cache(
-                          1, self.t_max, self.net.compute_dtype)
+                          m, self.t_max, self.net.compute_dtype)
                       for n in self.attn_names}
                 logits, c1 = self._walk_prefill(params, state, c1, tokens,
-                                                length[None])
+                                                lengths)
                 z = jnp.zeros((), jnp.int32)  # match slot dtype under x64
-                merged = {
-                    n: {kk: jax.lax.dynamic_update_slice(
-                            caches[n][kk], c1[n][kk], (slot, z, z, z))
-                        for kk in ("k", "v")}
-                    for n in self.attn_names}
-                nxt = self._select(logits, temp[None], key)
-                return nxt[0], logits[0], merged
-            fn = jax.jit(prefill_slot_impl,
+                merged = caches
+                for i in range(m):    # static unroll: M <= num_slots
+                    merged = {
+                        n: {kk: jax.lax.dynamic_update_slice(
+                                merged[n][kk],
+                                jax.lax.dynamic_slice_in_dim(
+                                    c1[n][kk], i, 1, axis=0)[:, :, :tp],
+                                (slots[i], z, z, z))
+                            for kk in ("k", "v")}
+                        for n in self.attn_names}
+                return self._select(logits, temps, key), logits, merged
+            fn = jax.jit(prefill_slots_impl,
+                         donate_argnums=train_donate_argnums((2,)))
+        elif isinstance(name, tuple) and name[0] == "block":
+            k_steps = int(name[1])
+
+            def decode_block_impl(params, state, caches, ids, positions,
+                                  stopped, temps, eos_ids, key, step0,
+                                  key_salt):
+                # K decode steps fused into ONE device program
+                # (lax.scan): cache state, per-row stop flags, and the
+                # absolute step counter ride the carry; only the [B, K]
+                # token matrix ever needs to cross to the host. The key
+                # schedule folds the ABSOLUTE step index, so a given
+                # lane samples identically for every block size.
+                def body(carry, _):
+                    caches, ids, pos, stop, step = carry
+                    pos_c = jnp.minimum(pos, self.t_max - 1)
+                    logits, caches = self._walk_decode(params, state,
+                                                       caches, ids, pos_c)
+                    kk = jax.random.fold_in(
+                        key, jnp.bitwise_or(key_salt, step + 1))
+                    nxt = self._select(logits, temps, kk)
+                    # a stopped lane re-emits its last token and freezes
+                    # its position: overshoot past eos/t_max stays inside
+                    # the lane's own cache cell and is truncated on host
+                    nxt = jnp.where(stop, ids, nxt)
+                    hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
+                    new_pos = jnp.where(stop, pos, pos + 1)
+                    new_stop = stop | hit_eos | (new_pos >= self.t_max)
+                    return (caches, nxt, new_pos, new_stop, step + 1), nxt
+                (caches, ids, positions, stopped, _), toks = jax.lax.scan(
+                    body, (caches, ids, positions, stopped, step0), None,
+                    length=k_steps)
+                return toks.T, ids, positions, stopped, caches
+            # per-K name: the compile auditor attributes by __name__, and
+            # two K values share every input shape — one shared name
+            # would read as a blown-cache duplicate-signature compile
+            decode_block_impl.__name__ = f"decode_block{k_steps}_impl"
+            fn = jax.jit(decode_block_impl,
                          donate_argnums=train_donate_argnums((2,)))
         else:                                 # pragma: no cover
             raise KeyError(name)
@@ -326,17 +380,57 @@ class TransformerDecoder:
             jnp.asarray(ids, jnp.int32), jnp.asarray(positions, jnp.int32),
             jnp.asarray(temps), key)
 
+    def decode_block(self, caches, ids, positions, temps=None, key=None, *,
+                     block_size: int, eos_ids=None, stopped=None,
+                     step0=0, key_salt: int = 0):
+        """``block_size`` fused decode steps in ONE device program.
+
+        Returns ``(toks [B, K] int32, ids [B], positions [B], stopped
+        [B] bool, caches)`` — everything device-resident, so the caller
+        can dispatch the NEXT block from the carry before reading this
+        block's tokens (double buffering: one host readback per block,
+        overlapped with the next block's compute). ``eos_ids`` ([B]
+        int32, -1 = no eos) freezes a lane on device the step after it
+        emits its eos; frozen lanes re-emit their last token (truncated
+        on host), so greedy output is token-for-token identical to the
+        K=1 loop. ``step0`` is the absolute index of this block's first
+        step: sampling keys fold the absolute step (+ ``key_salt``), so
+        a fixed seed draws the same tokens for every block size."""
+        b = np.shape(ids)[0]
+        temps = np.zeros(b, np.float32) if temps is None \
+            else np.broadcast_to(np.asarray(temps, np.float32), (b,))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        eos = np.full(b, -1, np.int32) if eos_ids is None \
+            else np.broadcast_to(np.asarray(eos_ids, np.int32), (b,))
+        if stopped is None:
+            stopped = np.zeros(b, bool)
+        return self._fn(("block", int(block_size)))(
+            self._device_params(), self.net._inference_state(), caches,
+            jnp.asarray(ids, jnp.int32), jnp.asarray(positions, jnp.int32),
+            jnp.asarray(stopped, jnp.bool_), jnp.asarray(temps),
+            jnp.asarray(eos), key, jnp.asarray(step0, jnp.int32),
+            jnp.asarray(key_salt, jnp.int32))
+
     # ----------------------------------------------------------- generate
     def generate(self, prompts: Sequence, max_new_tokens: int,
                  temperature=0.0, eos_id: Optional[int] = None,
-                 seed: int = 0) -> List[np.ndarray]:
+                 seed: int = 0, block_size: int = 1) -> List[np.ndarray]:
         """Batched autoregressive generation: ragged int prompts →
         [prompt + generated] per row. Greedy where the (scalar or
         per-row) temperature is <= 0, temperature sampling elsewhere;
         per-row stop on ``eos_id``, ``max_new_tokens``, or a full
         context (t_max). The decode loop is fixed-shape — ONE compile
-        serves every request mix; only [B] ids cross to the host per
-        step."""
+        serves every request mix.
+
+        ``block_size=1`` is the legacy per-step loop ([B] ids cross to
+        the host every step). ``block_size=K>1`` runs K steps per device
+        program and pipelines: block t+1 is dispatched from the
+        on-device carry BEFORE block t's [B, K] token matrix is read
+        back, so host bookkeeping overlaps device compute and there is
+        exactly ONE readback per block. Outputs are token-for-token
+        identical across block sizes (greedy AND fixed-seed sampling:
+        the key schedule folds the absolute step index)."""
         prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
         b = len(prompts)
         if b == 0:
@@ -356,26 +450,67 @@ class TransformerDecoder:
         key = jax.random.PRNGKey(seed)
         nxt, _, caches = self.prefill(self.init_cache(b), tokens, lengths,
                                       temps, seed=seed)
-        nxt_host = np.asarray(nxt)
         gen: List[List[int]] = [[] for _ in range(b)]
         finished = np.zeros(b, bool)
-        for step in range(int(max_new_tokens)):
-            for i in range(b):
-                if finished[i]:
-                    continue
-                tok = int(nxt_host[i])
-                gen[i].append(tok)
-                if (eos_id is not None and tok == eos_id) or \
-                        len(gen[i]) >= max_new_tokens or \
-                        int(lengths[i]) + len(gen[i]) >= self.t_max:
-                    finished[i] = True
-            if finished.all():
-                break
-            positions = np.minimum(lengths + step, self.t_max - 1)
-            nxt, _, caches = self.decode_step(
-                caches, nxt_host, positions, temps,
-                key=jax.random.fold_in(key, step + 1))
+
+        def consume(tok_cols: np.ndarray) -> None:
+            """Host bookkeeping for a [B, k] column block: append until a
+            row's stop (eos / budget / full context); later columns of a
+            finished row are device overshoot and are dropped."""
+            for c in range(tok_cols.shape[1]):
+                for i in range(b):
+                    if finished[i]:
+                        continue
+                    tok = int(tok_cols[i, c])
+                    gen[i].append(tok)
+                    if (eos_id is not None and tok == eos_id) or \
+                            len(gen[i]) >= max_new_tokens or \
+                            int(lengths[i]) + len(gen[i]) >= self.t_max:
+                        finished[i] = True
+
+        if int(block_size) <= 1:
+            # legacy per-step loop: dispatch, read [B] ids, repeat — the
+            # K=1 baseline of the block-sweep A/B (GL007-baselined)
             nxt_host = np.asarray(nxt)
+            for step in range(int(max_new_tokens)):
+                consume(nxt_host[:, None])
+                if finished.all() or step == int(max_new_tokens) - 1:
+                    break
+                positions = np.minimum(lengths + step, self.t_max - 1)
+                nxt, _, caches = self.decode_step(
+                    caches, nxt_host, positions, temps,
+                    key=jax.random.fold_in(key, step + 1))
+                nxt_host = np.asarray(nxt)
+            return [np.concatenate([p, np.asarray(g, np.int32)])
+                    for p, g in zip(prompts, gen)]
+
+        # ---- pipelined block path ----
+        k = int(block_size)
+        if int(max_new_tokens) >= 1:     # K=1 parity: no tokens requested,
+            consume(device_fetch(          # none emitted (prefill included)
+                nxt, tag="generate.prefill")[:, None])
+        n_steps = int(max_new_tokens) - 1
+        if finished.all() or n_steps <= 0:
+            return [np.concatenate([p, np.asarray(g, np.int32)])
+                    for p, g in zip(prompts, gen)]
+        eos_arr = np.full(b, -1 if eos_id is None else int(eos_id), np.int32)
+        ids_d, pos_d = nxt, jnp.asarray(lengths, jnp.int32)
+        stop_d = np.zeros(b, bool)
+        n_blocks = -(-n_steps // k)          # ceil
+        pending = None
+        for blk in range(n_blocks):
+            toks, ids_d, pos_d, stop_d, caches = self.decode_block(
+                caches, ids_d, pos_d, temps, key=key, block_size=k,
+                eos_ids=eos_arr, stopped=stop_d, step0=blk * k)
+            if pending is not None:
+                # read block t WHILE block t+1 computes (double buffer)
+                consume(device_fetch(pending, tag="generate.decode"))
+                if finished.all():
+                    pending = None     # in-flight block is pure overshoot
+                    break
+            pending = toks
+        if pending is not None:
+            consume(device_fetch(pending, tag="generate.decode"))
         return [np.concatenate([p, np.asarray(g, np.int32)])
                 for p, g in zip(prompts, gen)]
 
@@ -483,6 +618,17 @@ class SlotGenerationEngine:
     a wave is admitted, decoded until EVERY slot drains, then the next
     wave starts (the A/B in BENCH_MODE=generate).
 
+    ``block_size=K>1`` pipelines the decode hot loop (ISSUE 4): each
+    dispatch runs K steps on device (``decode_block{K}_impl``), the
+    next block launches from the on-device carry BEFORE the previous
+    block's [S, K] token matrix is read back (double buffering — host
+    bookkeeping overlaps device compute, ONE readback per block), and
+    slot frees/refills land at block boundaries. Admission is batched
+    either way: every admittable pending request coalesces into one
+    bucketed ``prefill_slots_impl`` call with a single readback.
+    Greedy outputs are token-for-token identical across block sizes;
+    a lane's overshoot past its stop is truncated on host.
+
     Resilience surface (ISSUE 3): ``max_pending`` bounds the queue —
     submissions beyond it are SHED with :class:`RejectedError` carrying
     the observed depth, instead of growing without limit. Per-request
@@ -503,7 +649,8 @@ class SlotGenerationEngine:
     def __init__(self, net, num_slots: int = 8,
                  t_max: Optional[int] = None, refill: bool = True,
                  seed: int = 0, decoder: Optional[TransformerDecoder] = None,
-                 max_pending: int = 256, fault_injector=None):
+                 max_pending: int = 256, fault_injector=None,
+                 block_size: int = 1):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -518,6 +665,7 @@ class SlotGenerationEngine:
         self.refill = bool(refill)
         self.seed = int(seed)
         self.max_pending = int(max_pending)
+        self.block_size = max(1, int(block_size))
         self.t_max = self.decoder.t_max
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
@@ -527,8 +675,19 @@ class SlotGenerationEngine:
         self._last_ids = np.zeros(self.num_slots, np.int32)
         self._positions = np.zeros(self.num_slots, np.int32)
         self._temps = np.zeros(self.num_slots, np.float32)
+        self._eos_ids = np.full(self.num_slots, -1, np.int32)
+        # block-decode pipeline state (block_size > 1): the device-side
+        # carry of the LAST dispatched block (ids/positions/stop flags —
+        # lets the next block launch without any host readback) and the
+        # dispatched-but-unread block whose [S, K] token matrix is
+        # fetched one cycle later (double buffering)
+        self._carry = None
+        self._inflight = None
         self._pending: collections.deque = collections.deque()
-        self._admitting: Optional[GenerationRequest] = None
+        # requests popped from the queue but not yet landed in a slot:
+        # parked here so a concurrent quarantine()/shutdown() drain can
+        # always harvest them (batched admission parks the whole batch)
+        self._admitting: List[GenerationRequest] = []
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._key = jax.random.PRNGKey(seed)
@@ -547,7 +706,10 @@ class SlotGenerationEngine:
         self.emitted_tokens = 0
         self.completed = 0
         self.decode_steps = 0
-        self.prefills = 0
+        self.decode_blocks = 0      # device programs dispatched (=steps/K)
+        self.host_readbacks = 0     # device→host syncs the loop performed
+        self.prefills = 0           # admitted requests
+        self.prefill_batches = 0    # coalesced admission prefill calls
         self.rejected = 0           # admission-control sheds
         self.deadline_exceeded = 0
         self.cancelled = 0
@@ -642,8 +804,19 @@ class SlotGenerationEngine:
         always see it — a request is never invisible to takeover."""
         with self._lock:
             req = self._pending.popleft() if self._pending else None
-            self._admitting = req
+            if req is not None:
+                self._admitting.append(req)
             return req
+
+    def _unpark(self, req: GenerationRequest) -> bool:
+        """Remove ``req`` from the admission park under the caller's
+        held lock; False means a takeover drain already harvested it
+        (the drain owns the request now — touch nothing)."""
+        if self._quarantined or self._shutdown or \
+                req not in self._admitting:
+            return False
+        self._admitting.remove(req)
+        return True
 
     def _req_finished(self, req: GenerationRequest, tok: int) -> bool:
         return (req.eos_id is not None and tok == req.eos_id) or \
@@ -701,106 +874,157 @@ class SlotGenerationEngine:
         for req, exc in doomed:
             req._fail(exc)
 
+    def _count_bucket(self, m: int) -> int:
+        """Admission-count bucket: pow2 capped at num_slots, so the
+        batched-prefill signature set is finite ({1, 2, 4, ...} × the
+        pow2 prompt buckets) and steady serving compiles nothing new."""
+        b = 1
+        while b < m:
+            b *= 2
+        return min(b, self.num_slots)
+
     def _admit(self):
-        """Prefill queued prompts into free slots (per-slot batch-1
-        prefill scattered into the shared cache at the slot index). A
-        recovered request re-prefills prompt + generated-so-far, so
-        decoding resumes exactly where the dead engine stopped."""
-        for s in range(self.num_slots):
+        """Batched admission: coalesce EVERY admittable pending request
+        into one bucketed prefill call with a single host readback —
+        the per-request prefill + per-token ``int(np.asarray(...))``
+        sync of the r6 loop cost (requests × RTT) per refill wave, and
+        supervisor recovery (``requeue``) re-prefills through this same
+        path. A recovered request re-prefills prompt + generated-so-far,
+        so decoding resumes exactly where the dead engine stopped.
+        Count and prompt-length are both pow2-bucketed; padded rows
+        replicate row 0 (identical scatter → harmless write ordering)."""
+        while True:
             with self._lock:
-                occupied = self._slots[s] is not None
-            if occupied:
-                continue
-            req = None
-            while req is None:
-                req = self._pop_for_admit()
-                if req is None:
-                    return
-                # lifecycle beats admission: never spend a prefill on a
-                # request that is already cancelled / out of deadline
-                exc = None
-                if req._cancel_requested:
-                    exc = Cancelled("cancelled while queued")
-                elif req._expired():
-                    exc = DeadlineExceeded(
-                        f"deadline of {req.deadline}s passed while queued")
-                if exc is not None:
-                    with self._lock:
-                        if self._admitting is not req:
-                            return    # harvested by a concurrent takeover
-                        self._admitting = None
-                        if isinstance(exc, Cancelled):
-                            self.cancelled += 1
-                        else:
-                            self.deadline_exceeded += 1
-                    req._fail(exc)
-                    req = None
-            ctx = np.concatenate(
-                [req.prompt, np.asarray(req.generated, np.int32)])
-            if len(ctx) >= self.t_max or \
-                    len(req.generated) >= req.max_new_tokens:
-                # a recovered request that already hit a stop condition
-                with self._lock:
-                    if self._admitting is not req:
-                        return        # harvested by a concurrent takeover
-                    self._admitting = None
-                    self.completed += 1
-                req._complete()
-                continue
-            clen = len(ctx)
-            tp = min(_round_up_pow2(clen), self.t_max)
-            tokens = np.zeros((1, tp), np.int32)
-            tokens[0, :clen] = ctx
+                free = [s for s in range(self.num_slots)
+                        if self._slots[s] is None]
+            if not free:
+                return
+            batch: List[Tuple[GenerationRequest, int, np.ndarray]] = []
+            drained = False
+            for s in free:
+                req = None
+                while req is None:
+                    req = self._pop_for_admit()
+                    if req is None:
+                        drained = True
+                        break
+                    # lifecycle beats admission: never spend prefill
+                    # compute on a request that is already cancelled /
+                    # out of deadline / (recovered) already finished
+                    exc = None
+                    if req._cancel_requested:
+                        exc = Cancelled("cancelled while queued")
+                    elif req._expired():
+                        exc = DeadlineExceeded(
+                            f"deadline of {req.deadline}s passed while "
+                            "queued")
+                    if exc is not None:
+                        with self._lock:
+                            if not self._unpark(req):
+                                return   # a takeover drain owns it now
+                            if isinstance(exc, Cancelled):
+                                self.cancelled += 1
+                            else:
+                                self.deadline_exceeded += 1
+                        req._fail(exc)
+                        req = None
+                        continue
+                    ctx = np.concatenate(
+                        [req.prompt, np.asarray(req.generated, np.int32)])
+                    if len(ctx) >= self.t_max or \
+                            len(req.generated) >= req.max_new_tokens:
+                        # recovered request already at a stop condition
+                        with self._lock:
+                            if not self._unpark(req):
+                                return
+                            self.completed += 1
+                        req._complete()
+                        req = None
+                        continue
+                    batch.append((req, s, ctx))
+                if drained:
+                    break
+            if not batch:
+                return
+            m = len(batch)
+            mb = self._count_bucket(m)
+            tp = min(_round_up_pow2(max(len(c) for _, _, c in batch)),
+                     self.t_max)
+            tokens = np.zeros((mb, tp), np.int32)
+            lengths = np.zeros(mb, np.int32)
+            slot_idx = np.zeros(mb, np.int32)
+            temps = np.zeros(mb, np.float32)
+            for i in range(mb):
+                req, s, ctx = batch[i if i < m else 0]   # pad = row 0
+                tokens[i, :len(ctx)] = ctx
+                lengths[i] = len(ctx)
+                slot_idx[i] = s
+                temps[i] = req.temperature
             with self._lock:
                 if self._shutdown or self._quarantined:
-                    return   # req stays parked in _admitting; the
+                    return   # batch stays parked in _admitting; the
                              # quarantine/shutdown drain owns it now
-                self.prefills += 1
-                prefill_no = self.prefills
+                self.prefills += m
+                self.prefill_batches += 1
+                batch_no = self.prefill_batches
             self._faults.fire("engine.prefill")
-            nxt, _, self._caches = self.decoder._fn("prefill_slot")(
+            nxt, _, self._caches = self.decoder._fn("prefill_slots")(
                 self.decoder._device_params(),
                 self.decoder.net._inference_state(), self._caches,
-                jnp.asarray(tokens), jnp.asarray(clen, jnp.int32),
-                jnp.asarray(s, jnp.int32),
-                jnp.asarray(req.temperature, jnp.float32),
-                jax.random.fold_in(self._key, prefill_no))
-            tok = int(np.asarray(nxt))
-            finish = None
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(slot_idx), jnp.asarray(temps),
+                jax.random.fold_in(self._key,
+                                   PREFILL_BATCH_SALT | batch_no))
+            toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
+            finishers: List[GenerationRequest] = []
             with self._lock:
-                if self._admitting is not req:
-                    # a quarantine/shutdown drain harvested this request
-                    # while we were in the device call; it owns the
-                    # request now — drop our token (re-prefill
-                    # regenerates it deterministically)
+                if self._shutdown or self._quarantined:
+                    # a drain harvested the batch while we were in the
+                    # device call; it owns the requests now — drop our
+                    # tokens (re-prefill regenerates them)
                     return
-                self._admitting = None
-                req._running = True
-                req.generated.append(tok)
-                self.emitted_tokens += 1
-                if self._req_finished(req, tok):
-                    self.completed += 1
-                    finish = req          # done at the first token
-                else:
-                    self._slots[s] = req
-                    self._last_ids[s] = tok
-                    self._positions[s] = clen  # where tok is written next
-                    self._temps[s] = req.temperature
-            if finish is not None:
-                finish._complete()
+                self.host_readbacks += 1
+                for i, (req, s, ctx) in enumerate(batch):
+                    if req not in self._admitting:
+                        continue          # pragma: no cover — defensive
+                    self._admitting.remove(req)
+                    tok = int(toks[i])
+                    req._running = True
+                    req.generated.append(tok)
+                    self.emitted_tokens += 1
+                    if self._req_finished(req, tok):
+                        self.completed += 1
+                        finishers.append(req)   # done at the first token
+                    else:
+                        self._slots[s] = req
+                        self._last_ids[s] = tok
+                        self._positions[s] = len(ctx)  # next write pos
+                        self._temps[s] = req.temperature
+                        self._eos_ids[s] = -1 if req.eos_id is None \
+                            else int(req.eos_id)
+                # slot contents changed: the block-decode pipeline must
+                # resync its device carry from host state
+                self._carry = None
+            for req in finishers:
+                req._complete()
+            if drained:
+                return
 
     def _any_active(self) -> bool:
         return any(r is not None for r in self._slots)
 
     def _step(self):
-        """One batched decode step over every slot (free slots ride along
-        at clamped positions; their output is ignored)."""
+        """One decode dispatch: a single batched step (block_size=1, the
+        legacy loop) or one pipelined K-step block cycle."""
+        if self.block_size > 1:
+            return self._step_block()
         self._enforce_slots()
         with self._lock:
             active = any(r is not None for r in self._slots)
             if active:
                 self._step_no += 1
                 self.decode_steps += 1
+                self.decode_blocks += 1   # a K=1 block
             step_no = self._step_no
         if not active:
             return                # lifecycle enforcement freed every slot
@@ -808,14 +1032,15 @@ class SlotGenerationEngine:
         nxt, _, self._caches = self.decoder.decode_step(
             self._caches, self._last_ids,
             np.minimum(self._positions, self.t_max - 1), self._temps,
-            key=jax.random.fold_in(self._key, 1 << 20 | step_no))
-        nxt_host = np.asarray(nxt)
+            key=jax.random.fold_in(self._key, ENGINE_KEY_SALT | step_no))
+        nxt_host = device_fetch(nxt, tag="engine.decode")
         finished: List[GenerationRequest] = []
         # token appends and slot frees are one critical section: a
         # concurrent quarantine() either runs before (we see empty slots
         # and append nothing) or after (it harvests the post-append
         # state) — a recovered request never loses or duplicates a token
         with self._lock:
+            self.host_readbacks += 1
             emitted = 0
             for s in range(self.num_slots):
                 req = self._slots[s]
@@ -835,6 +1060,110 @@ class SlotGenerationEngine:
         for req in finished:
             req._complete()
 
+    def _step_block(self):
+        """One pipelined block cycle (block_size=K): dispatch the next
+        K-step device program from the ON-DEVICE carry of the previous
+        block, THEN read back and bookkeep the previous block's [S, K]
+        token matrix — the fetch and all host-side work (appends, stop
+        detection, request completions feeding streaming publishes)
+        overlap the new block's device compute. Slot frees and refills
+        land at block boundaries; a lane whose request finished or was
+        cancelled mid-pipeline simply has its remaining in-flight tokens
+        dropped as overshoot (the dispatch snapshot pins which request
+        each lane's tokens belong to)."""
+        k = self.block_size
+        self._enforce_slots()
+        # resync boundary: the device carry was invalidated (slots were
+        # refilled or freed) while a block is still in flight. Host state
+        # lags that block by K steps, so a host-state dispatch now would
+        # REPLAY them — retire the in-flight block first (serializing
+        # this one boundary), then dispatch from caught-up host state.
+        with self._lock:
+            stale = self._inflight if self._carry is None else None
+            if stale is not None:
+                self._inflight = None
+        if stale is not None:
+            self._retire_block(stale)
+        dispatch = None
+        with self._lock:
+            snapshot = [(s, self._slots[s]) for s in range(self.num_slots)
+                        if self._slots[s] is not None]
+            prev = self._inflight
+            self._inflight = None
+            if snapshot:
+                self._step_no += k
+                self.decode_steps += k
+                self.decode_blocks += 1
+                carry = self._carry
+                if carry is None:
+                    # resync from host state (after admission / frees):
+                    # free lanes launch frozen so they stop touching
+                    # their cache cells until a refill re-prefills them
+                    carry = (self._last_ids.copy(), self._positions.copy(),
+                             np.asarray([self._slots[s] is None
+                                         for s in range(self.num_slots)],
+                                        bool))
+                dispatch = (carry, self._step_no - k, self._temps.copy(),
+                            self._eos_ids.copy())
+        if dispatch is not None:
+            (ids, pos, stop), step0, temps, eos = dispatch
+            self._faults.fire("engine.step")
+            toks, ids_d, pos_d, stop_d, self._caches = \
+                self.decoder.decode_block(
+                    self._caches, ids, pos, temps, key=self._key,
+                    block_size=k, eos_ids=eos, stopped=stop, step0=step0,
+                    key_salt=ENGINE_KEY_SALT)
+            with self._lock:
+                if not (self._quarantined or self._shutdown):
+                    self._carry = (ids_d, pos_d, stop_d)
+                    self._inflight = (toks, snapshot, k)
+        # prev was dispatched LAST cycle and has been computing since;
+        # its fetch + bookkeeping overlap the block dispatched above.
+        # With no active lanes left, prev's tokens are pure overshoot
+        # (every snapshot request finished/cancelled) — dropped unread.
+        if prev is not None and dispatch is not None:
+            self._retire_block(prev)
+
+    def _retire_block(self, block):
+        """Fetch one block's [S, K] token matrix (ONE host readback) and
+        run its host bookkeeping: per-lane appends until a stop, slot
+        frees, request completions."""
+        toks_dev, snapshot, k = block
+        host = device_fetch(toks_dev, tag="engine.decode")
+        finished: List[GenerationRequest] = []
+        with self._lock:
+            if self._quarantined or self._shutdown:
+                return   # the drain owns the requests; recovery
+                         # re-prefills and regenerates these tokens
+            self.host_readbacks += 1
+            emitted = 0
+            for s, req in snapshot:
+                if req.done() or self._slots[s] is not req:
+                    continue   # finished/cancelled since dispatch:
+                               # the lane's tokens are overshoot
+                closed = False
+                for c in range(k):
+                    tok = int(host[s, c])
+                    req.generated.append(tok)
+                    emitted += 1
+                    if self._req_finished(req, tok):
+                        self._slots[s] = None
+                        self.completed += 1
+                        finished.append(req)
+                        closed = True
+                        break
+                if not closed:
+                    self._positions[s] += k
+                    self._last_ids[s] = int(host[s, k - 1])
+            self.emitted_tokens += emitted
+            self._first_step_done = True
+            if finished:
+                # freed lanes must not keep decoding from the device
+                # carry: resync (and let _admit refill) next dispatch
+                self._carry = None
+        for req in finished:
+            req._complete()
+
     # ------------------------------------------------------- supervision
     def quarantine(self) -> Tuple[List[GenerationRequest],
                                   Optional[BaseException]]:
@@ -849,15 +1178,18 @@ class SlotGenerationEngine:
             self._shutdown = True
             self._beat = None   # a stale worker must not mask the NEW
                                 # engine's heartbeat when it wakes
-            if self._admitting is not None:
-                harvested.append(self._admitting)
-                self._admitting = None
+            harvested.extend(self._admitting)
+            self._admitting = []
             for s in range(self.num_slots):
                 if self._slots[s] is not None:
                     harvested.append(self._slots[s])
                     self._slots[s] = None
             harvested.extend(self._pending)
             self._pending.clear()
+            # drop the decode pipeline: in-flight tokens are never read
+            # (recovery re-prefills and regenerates them exactly)
+            self._inflight = None
+            self._carry = None
             cause = self._dead
         self._work.set()
         return [r for r in harvested if not r.done()], cause
@@ -869,7 +1201,10 @@ class SlotGenerationEngine:
                 "emitted_tokens": self.emitted_tokens,
                 "completed": self.completed,
                 "decode_steps": self.decode_steps,
+                "decode_blocks": self.decode_blocks,
+                "host_readbacks": self.host_readbacks,
                 "prefills": self.prefills,
+                "prefill_batches": self.prefill_batches,
                 "rejected": self.rejected,
                 "deadline_exceeded": self.deadline_exceeded,
                 "cancelled": self.cancelled,
@@ -933,15 +1268,16 @@ class SlotGenerationEngine:
             # fast with the death CAUSE, not a generic error
             doomed: List[GenerationRequest] = []
             with self._lock:
-                if self._admitting is not None:
-                    doomed.append(self._admitting)
-                    self._admitting = None
+                doomed.extend(self._admitting)
+                self._admitting = []
                 for s in range(self.num_slots):
                     if self._slots[s] is not None:
                         doomed.append(self._slots[s])
                         self._slots[s] = None
                 doomed.extend(self._pending)
                 self._pending.clear()
+                self._inflight = None
+                self._carry = None
                 self.failed += len(doomed)
             for req in doomed:
                 req._fail(exc)
@@ -969,15 +1305,16 @@ class SlotGenerationEngine:
         with self._lock:
             exc = self._dead or RuntimeError(
                 "SlotGenerationEngine shut down")
-            if self._admitting is not None:
-                doomed.append(self._admitting)
-                self._admitting = None
+            doomed.extend(self._admitting)
+            self._admitting = []
             for s in range(self.num_slots):
                 if self._slots[s] is not None:
                     doomed.append(self._slots[s])
                     self._slots[s] = None
             doomed.extend(self._pending)
             self._pending.clear()
+            self._inflight = None
+            self._carry = None
             self.failed += len(doomed)
         for req in doomed:
             req._fail(exc)

@@ -153,9 +153,16 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         length mask. Routed through the kind="decode_attention" helper
         seam so a future Pallas decode kernel can slot in; the built-in
         path is length-masked dot-product attention with f32 softmax.
-        Returns (out [B, 1, n_out], new_cache)."""
+        Returns (out [B, 1, n_out], new_cache).
+
+        Positions are clamped to the cache depth: a fused decode block
+        (models/generation.py decode_block) lets finished lanes overshoot
+        their stop on device, and an overshooting lane must keep writing
+        inside its own last cell rather than rely on the backend's
+        out-of-range scatter behaviour."""
         q, k, v = self._project_qkv(params, x)       # [B, 1, H, Dh]
-        pos = jnp.asarray(positions, jnp.int32).reshape(-1)
+        pos = jnp.minimum(jnp.asarray(positions, jnp.int32).reshape(-1),
+                          cache["k"].shape[2] - 1)
         zero = jnp.zeros((), jnp.int32)   # match pos dtype under x64 mode
         upd = lambda c, u, p: jax.lax.dynamic_update_slice(c, u,
                                                            (zero, p, zero))
@@ -287,8 +294,10 @@ class TokenAndPositionEmbedding(BaseRecurrentLayerConf):
     # graftlint: traced
     def embed_at(self, params, ids, positions):
         """Single-position decode embedding: ids [B] + per-row positions
-        [B] → [B, 1, n_out]. The decode loop guards positions <
-        max_length; no dropout (inference only)."""
+        [B] → [B, 1, n_out]. Positions clamp to max_length - 1 (a fused
+        decode block's overshooting lanes sit at the context edge); no
+        dropout (inference only)."""
         ids = jnp.asarray(ids, jnp.int32).reshape(-1)
-        pos = jnp.asarray(positions, jnp.int32).reshape(-1)
+        pos = jnp.minimum(jnp.asarray(positions, jnp.int32).reshape(-1),
+                          self.max_length - 1)
         return (params["W"][ids] + params["P"][pos])[:, None, :]

@@ -1,0 +1,52 @@
+"""Host-transfer seam: every deliberate device→host readback on the
+serving hot path goes through :func:`device_fetch`, so the transfer
+auditor (analysis/compile_audit.py ``TransferAudit``) can count them the
+same way the compile auditor counts lowerings.
+
+Why a seam instead of hooking jax: the dispatch layer performs many
+*implicit* transfers (scalar bools in user code, debug prints, donation
+bookkeeping) that are not the serialization hazard the decode loop cares
+about. What kills decode throughput is the *blocking* readback of a
+just-dispatched step result — host time serialized behind device time,
+once per token. Those are exactly the reads the serving path makes on
+purpose, so counting at the call site is both precise and cheap (one
+Counter bump per BLOCK, not per element).
+
+The counter is process-global and monotonic; audits snapshot-and-diff
+(``TransferAudit``) rather than reset, so concurrent engines never
+clobber each other. graftlint's GL007 flags raw ``np.asarray``/
+``.item()`` on just-dispatched results inside hot-module loops;
+``device_fetch`` is the sanctioned (because audited) way to cross.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from typing import Dict, Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_COUNTS: Counter = Counter()
+
+
+def device_fetch(x, tag: str = "default") -> np.ndarray:
+    """Blocking device→host readback, counted under ``tag``.
+
+    Semantically ``np.asarray(x)`` — it waits for ``x``'s computation and
+    materializes it in host memory. Use one call per decode BLOCK (the
+    [B, K] token matrix), never per token, and fetch the *previous*
+    block's result after dispatching the next one so the wait overlaps
+    device compute (double buffering)."""
+    with _LOCK:
+        _COUNTS[tag] += 1
+    return np.asarray(x)
+
+
+def fetch_counts(tag: Optional[str] = None) -> Dict[str, int]:
+    """Snapshot of the per-tag readback counters (all tags, or one)."""
+    with _LOCK:
+        if tag is not None:
+            return {tag: _COUNTS.get(tag, 0)}
+        return dict(_COUNTS)

@@ -232,7 +232,8 @@ class EngineSupervisor(HeartbeatMonitor):
         new = SlotGenerationEngine(
             old.decoder.net, num_slots=old.num_slots, refill=old.refill,
             seed=old.seed, decoder=old.decoder,      # SAME jit programs
-            max_pending=old.max_pending, fault_injector=old._faults)
+            max_pending=old.max_pending, fault_injector=old._faults,
+            block_size=old.block_size)   # same decode_block{K} program too
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)

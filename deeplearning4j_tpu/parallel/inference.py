@@ -37,7 +37,8 @@ class ParallelInference:
                  generation_supervised: bool = False,
                  generation_supervisor_timeout: float = 10.0,
                  generation_max_restarts: int = 3,
-                 generation_fault_injector=None):
+                 generation_fault_injector=None,
+                 generation_block_size: int = 1):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
@@ -50,6 +51,9 @@ class ParallelInference:
         # request recovery); the injector threads through to the engine's
         # engine.step/engine.prefill points for chaos tests
         self.generation_max_pending = int(generation_max_pending)
+        # decode-pipeline knob: K>1 fuses K decode steps per device
+        # program and double-buffers the readback (models/generation.py)
+        self.generation_block_size = int(generation_block_size)
         self.generation_supervised = bool(generation_supervised)
         self.generation_supervisor_timeout = float(
             generation_supervisor_timeout)
@@ -185,7 +189,8 @@ class ParallelInference:
                     self.net, num_slots=self.generation_slots,
                     t_max=self.generation_t_max,
                     max_pending=self.generation_max_pending,
-                    fault_injector=self.generation_fault_injector)
+                    fault_injector=self.generation_fault_injector,
+                    block_size=self.generation_block_size)
                 if self.generation_supervised:
                     from .failures import EngineSupervisor
                     self._gen_supervisor = EngineSupervisor(

@@ -222,15 +222,19 @@ class GenerationServingRoute(_RoutePublishMixin):
                  t_max: Optional[int] = None, engine=None,
                  max_inflight: int = 64, deadline: Optional[float] = None,
                  publish_retries: int = 3, retry_backoff: float = 0.05,
-                 fault_injector=None):
+                 fault_injector=None, block_size: int = 1):
         self._owns_engine = engine is None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
         if engine is None:
             from ..models.generation import SlotGenerationEngine
+            # block_size > 1: requests complete (and publish) at decode-
+            # block boundaries — K-step device programs, one readback
+            # per block, admission batched at the boundary
             engine = SlotGenerationEngine(net, num_slots=num_slots,
                                           t_max=t_max,
-                                          fault_injector=self._faults)
+                                          fault_injector=self._faults,
+                                          block_size=block_size)
         self.engine = engine
         self.broker = broker
         self.sub = NDArraySubscriber(broker, input_topic)

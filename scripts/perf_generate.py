@@ -15,7 +15,17 @@ Sweep knobs (comma-separated):
   GEN_TOKENS    (default "32,64")
 Protocol: GEN_RUNS median-of-N (default 3) after one warmup per compile.
 
-Run: [JAX_PLATFORMS=...] python scripts/perf_generate.py
+--block-sweep runs the decode-pipeline A/B instead: for each fused-block
+size K in GEN_BLOCKS (default "1,4,8"), the serving-pattern loop (K
+steps per device program, ONE [B, K] readback per block, K>1
+double-buffered) at the default serving shape (the largest
+batch/prompt/gen-T of the grid knobs; GEN_SWEEP_BATCH/PROMPT/TOKENS
+override) — one JSON object with per-K steady decode tok/s, p50/p99
+per-token latency, and readbacks/step. Exits NON-ZERO if no K>1 beats
+the K=1 baseline: the pipelined path must never ship slower than the
+loop it replaces.
+
+Run: [JAX_PLATFORMS=...] python scripts/perf_generate.py [--block-sweep]
 """
 
 from __future__ import annotations
@@ -45,6 +55,100 @@ def _median(fn, runs=RUNS):
     med = float(np.median(vals))
     spread = 100.0 * (max(vals) - min(vals)) / med if med else 0.0
     return med, round(spread, 2)
+
+
+def block_sweep() -> int:
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import (TransformerDecoder,
+                                           transformer_lm_conf)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.ops.transfer import device_fetch, fetch_counts
+
+    ks = []
+    for tok in os.environ.get("GEN_BLOCKS", "1,4,8").split(","):
+        k = int(tok)
+        if k >= 1 and k not in ks:
+            ks.append(k)
+    b = int(os.environ.get("GEN_SWEEP_BATCH", str(max(BATCHES))))
+    tp = int(os.environ.get("GEN_SWEEP_PROMPT", str(max(PROMPTS))))
+    gen_t = int(os.environ.get("GEN_SWEEP_TOKENS", str(max(TOKENS))))
+    conf = transformer_lm_conf(vocab_size=VOCAB, d_model=DMODEL,
+                               num_heads=HEADS, num_layers=LAYERS,
+                               max_length=tp + gen_t + 1)
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
+    dec = TransformerDecoder(net)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, VOCAB, (b, tp)).astype(np.int32)
+    lengths = np.full(b, tp, np.int32)
+
+    def run_once(k):
+        """One serving-pattern run at block size k: (tok/s, per-token
+        latencies, readbacks per step)."""
+        reads0 = fetch_counts().get("perf.decode", 0)
+        nx, _, cs = dec.prefill(dec.init_cache(b), tokens, lengths)
+        marks = []
+        if k == 1:                           # legacy baseline loop
+            ids, pos = np.asarray(nx), lengths.copy()
+            nb = gen_t
+            t0 = time.perf_counter()
+            for _ in range(gen_t):
+                nx2, _, cs = dec.decode_step(cs, ids, pos)
+                ids = device_fetch(nx2, tag="perf.decode")
+                marks.append(time.perf_counter())
+                pos = pos + 1
+        else:                                # pipelined block loop
+            ids, pos = nx, jnp.asarray(lengths)
+            stop = np.zeros(b, bool)
+            pending = None
+            nb = max(1, gen_t // k)
+            t0 = time.perf_counter()
+            for blk in range(nb):
+                toks, ids, pos, stop, cs = dec.decode_block(
+                    cs, ids, pos, block_size=k, stopped=stop,
+                    step0=blk * k)
+                if pending is not None:
+                    device_fetch(pending, tag="perf.decode")
+                    marks.append(time.perf_counter())
+                pending = toks
+            device_fetch(pending, tag="perf.decode")
+            marks.append(time.perf_counter())
+        total = time.perf_counter() - t0
+        lats = np.diff([t0] + marks) / k
+        reads = fetch_counts().get("perf.decode", 0) - reads0
+        return b * nb * k / total, lats, reads / (nb * k)
+
+    table = {}
+    for k in ks:
+        run_once(k)                          # warm the K-block program
+        vals, lats, rps = [], [], []
+        for _ in range(RUNS):
+            tps, ls, rp = run_once(k)
+            vals.append(tps)
+            lats.extend(ls)
+            rps.append(rp)
+        med = float(np.median(vals))
+        table[str(k)] = {
+            "decode_tok_s": round(med, 1),
+            "spread_pct": round(
+                100.0 * (max(vals) - min(vals)) / med, 2) if med else 0.0,
+            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "readbacks_per_step": round(float(np.mean(rps)), 4),
+        }
+    k1 = table.get("1", {}).get("decode_tok_s", 0.0)
+    best_gt1 = max((t["decode_tok_s"] for kk, t in table.items()
+                    if int(kk) > 1), default=None)
+    ok = best_gt1 is None or k1 == 0 or best_gt1 >= k1
+    print(json.dumps({
+        "block_sweep": table,
+        "shape": {"batch": b, "prompt_t": tp, "gen_t": gen_t,
+                  "vocab": VOCAB, "d_model": DMODEL, "layers": LAYERS},
+        "best_gt1_vs_k1": round(best_gt1 / k1, 3)
+        if best_gt1 and k1 else None,
+        "ok": ok,
+    }, indent=1), flush=True)
+    return 0 if ok else 1
 
 
 def main() -> int:
@@ -165,4 +269,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--block-sweep" in sys.argv[1:]:
+        sys.exit(block_sweep())
     sys.exit(main())

@@ -191,6 +191,195 @@ class TestDecodeParity:
             TransformerDecoder(net)
 
 
+class TestBlockDecode:
+    """Fused K-step decode blocks + the pipelined double-buffered loop
+    (decode hot-loop pipelining): token-for-token parity across block
+    sizes is the contract — the block path may only change WHEN tokens
+    cross to the host, never WHICH tokens."""
+
+    def _trained(self, rng_np):
+        net = _tiny_lm()
+        ds = _cyclic_batch(rng_np)
+        for _ in range(150):
+            net.fit_batch(ds)
+        return net
+
+    def test_greedy_parity_across_block_sizes(self, rng_np):
+        """Ragged prompts, rows stopping at different depths: K=4 and
+        K=8 emit exactly the K=1 token stream (overshoot truncated)."""
+        net = self._trained(rng_np)
+        dec = TransformerDecoder(net)
+        prompts = [rng_np.integers(0, 12, n) for n in (3, 7, 5, 2)]
+        ref = dec.generate(prompts, 10, temperature=0.0, block_size=1)
+        for k in (4, 8):
+            out = dec.generate(prompts, 10, temperature=0.0, block_size=k)
+            for a, b in zip(ref, out):
+                np.testing.assert_array_equal(a, b, err_msg=f"K={k}")
+
+    def test_eos_mid_block_truncates_overshoot(self, rng_np):
+        """A row hitting eos inside a block is frozen on device and its
+        overshoot tokens dropped on host: greedy from [3] on the cyclic
+        language stops at 6 regardless of block size."""
+        net = self._trained(rng_np)
+        dec = TransformerDecoder(net)
+        for k in (1, 4, 8):
+            out = dec.generate([[3]], 10, temperature=0.0, eos_id=6,
+                               block_size=k)[0]
+            np.testing.assert_array_equal(out, [3, 4, 5, 6],
+                                          err_msg=f"K={k}")
+
+    def test_context_stop_mid_block(self, rng_np):
+        """t_max landing inside a block: the lane freezes at the context
+        edge and the host truncates at exactly t_max tokens."""
+        net = self._trained(rng_np)
+        dec = TransformerDecoder(net, t_max=6)
+        for k in (1, 4):
+            out = dec.generate([[3, 4]], 100, temperature=0.0,
+                               block_size=k)[0]
+            assert len(out) == 6, f"K={k}"
+
+    def test_sampling_determinism_across_block_sizes(self, rng_np):
+        """The key schedule folds the ABSOLUTE step index, so a fixed
+        seed draws the same tokens for every block size."""
+        net = _tiny_lm()
+        dec = TransformerDecoder(net)
+        prompts = [rng_np.integers(0, 12, 4), rng_np.integers(0, 12, 6)]
+        ref = dec.generate(prompts, 10, temperature=1.0, seed=11,
+                           block_size=1)
+        for k in (4, 8):
+            out = dec.generate(prompts, 10, temperature=1.0, seed=11,
+                               block_size=k)
+            for a, b in zip(ref, out):
+                np.testing.assert_array_equal(a, b, err_msg=f"K={k}")
+        other = dec.generate(prompts, 10, temperature=1.0, seed=12,
+                             block_size=4)
+        assert any(not np.array_equal(a, c) for a, c in zip(ref, other))
+
+    def test_one_readback_per_block(self, rng_np):
+        """The pipelined loop performs at most ONE host readback per
+        dispatched block (+ the prefill token read)."""
+        from deeplearning4j_tpu.analysis import TransferAudit
+        net = _tiny_lm()
+        dec = TransformerDecoder(net)
+        prompts = [rng_np.integers(0, 12, 4) for _ in range(3)]
+        with TransferAudit() as transfers:
+            dec.generate(prompts, 9, temperature=0.0, block_size=4)
+        # 9 tokens = 1 prefill token + ceil(8/4) = 2 blocks
+        assert transfers.fetches("generate.prefill") == 1
+        assert transfers.fetches("generate.decode") <= 2
+        transfers.check_per_block("generate.decode", 2)
+
+    def test_engine_block_mixed_stream_matches_reference(self, rng_np):
+        """Continuous batching at block_size=4: mid-stream refills land
+        at block boundaries, results still match the no-cache reference
+        token-for-token, and the loop reads back at most once per
+        dispatched block (prefills batched: one readback per batch)."""
+        from deeplearning4j_tpu.analysis import TransferAudit
+        net = self._trained(rng_np)
+        eng = SlotGenerationEngine(net, num_slots=2, block_size=4)
+        prompts = [rng_np.integers(0, 12, n) for n in (3, 6, 2, 5, 4)]
+        gens = [4, 7, 3, 6, 5]
+        with TransferAudit() as transfers:
+            reqs = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+            eng.run_until_drained()
+        for p, g, r in zip(prompts, gens, reqs):
+            want = nocache_generate(net, p, g, temperature=0)
+            np.testing.assert_array_equal(r.result(5), want)
+        stats = eng.stats()
+        assert stats["completed"] == 5 and stats["prefills"] == 5
+        assert stats["decode_steps"] == 4 * stats["decode_blocks"]
+        transfers.check_per_block("engine.decode", stats["decode_blocks"])
+        transfers.check_per_block("engine.prefill",
+                                  stats["prefill_batches"])
+        assert stats["host_readbacks"] == \
+            transfers.fetches("engine.decode") + \
+            transfers.fetches("engine.prefill")
+
+    def test_engine_block_deadline_and_cancel_inside_block(self, rng_np):
+        """A deadline expiring / cancel arriving while a block is in
+        flight frees the slot at the next boundary; the lane's in-flight
+        tokens are dropped and other requests keep decoding."""
+        from deeplearning4j_tpu.parallel.faults import (Cancelled,
+                                                        DeadlineExceeded,
+                                                        FaultInjector)
+        net = _tiny_lm()
+        inj = FaultInjector()
+        inj.hang_for("engine.step", seconds=0.4, at=2)
+        eng = SlotGenerationEngine(net, num_slots=3, block_size=4,
+                                   fault_injector=inj).start()
+        try:
+            doomed = eng.submit([1, 2], 24, deadline=0.15)
+            victim = eng.submit([2, 3], 24)
+            ok = eng.submit([3, 4], 6)
+            victim.cancel()
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(30)
+            with pytest.raises(Cancelled):
+                victim.result(30)
+            assert len(ok.result(30)) == 8
+        finally:
+            eng.shutdown()
+
+    def test_engine_block_via_parallel_inference_and_route(self, rng_np):
+        """block_size threads through the serving facades."""
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        from deeplearning4j_tpu.streaming.pubsub import (MessageBroker,
+                                                         NDArrayPublisher,
+                                                         NDArraySubscriber)
+        from deeplearning4j_tpu.streaming.serving import \
+            GenerationServingRoute
+        net = self._trained(rng_np)
+        pi = ParallelInference(net, generation_slots=2,
+                               generation_block_size=4)
+        try:
+            p = rng_np.integers(0, 12, 3)
+            want = nocache_generate(net, p, 6, temperature=0)
+            np.testing.assert_array_equal(pi.generate(p, 6, timeout=60),
+                                          want)
+            assert pi._gen_engine.block_size == 4
+        finally:
+            pi.shutdown()
+        broker = MessageBroker()
+        out_sub = NDArraySubscriber(broker, "dl4j-gen-output")
+        route = GenerationServingRoute(net, broker, max_new_tokens=5,
+                                       num_slots=2, block_size=4).start()
+        try:
+            assert route.engine.block_size == 4
+            pub = NDArrayPublisher(broker, "dl4j-gen-input")
+            p2 = rng_np.integers(0, 12, 4)
+            pub.publish(np.asarray(p2, np.int32))
+            out = out_sub.poll(timeout=60)
+            want = nocache_generate(net, p2, 5, temperature=0)
+            np.testing.assert_array_equal(np.asarray(out, np.int64), want)
+        finally:
+            route.stop()
+
+    def test_supervisor_restart_preserves_block_size(self, rng_np):
+        """Crash recovery rebuilds the engine with the SAME block size
+        (and the same jitted decode_block program via the shared
+        decoder) and still resumes token-for-token."""
+        from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+        from deeplearning4j_tpu.parallel.faults import FaultInjector
+        net = self._trained(rng_np)
+        inj = FaultInjector()
+        inj.raise_once("engine.step", RuntimeError("injected crash"), at=2)
+        eng = SlotGenerationEngine(net, num_slots=2, block_size=4,
+                                   fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=10.0, interval=0.1,
+                               max_restarts=2).start()
+        try:
+            prompts = [rng_np.integers(0, 12, n) for n in (3, 5, 4)]
+            reqs = [sup.submit(p, 6) for p in prompts]
+            outs = [r.result(60) for r in reqs]
+            for p, o in zip(prompts, outs):
+                want = nocache_generate(net, p, 6, temperature=0)
+                np.testing.assert_array_equal(o, want)
+            assert sup.restarts == 1
+            assert sup.engine.block_size == 4
+        finally:
+            sup.stop()
+
+
 class TestSlotEngine:
     """Slot-based continuous batching: correctness per request, mid-loop
     refill, and the refill-on-beats-off step count."""
