@@ -372,6 +372,7 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
     # previous block's tokens are fetched (double buffering). K=1 is the
     # legacy dispatch→sync→dispatch loop — the PR 3 baseline of the A/B.
     import jax.numpy as jnp
+    from deeplearning4j_tpu.observability.metrics import percentiles
     from deeplearning4j_tpu.ops.transfer import device_fetch, fetch_counts
 
     def sweep_point(k):
@@ -428,12 +429,16 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
             blocks += nb
             reads += rd
         med = float(np.median(vals))
+        # per-token latency percentiles through the SHARED Histogram
+        # implementation (observability/metrics.py) — the same math the
+        # telemetry endpoint and the other perf scripts use
+        pct = percentiles(lats, (50, 99))
         sweep[k] = {
             "decode_tokens_per_sec": round(med, 2),
             "spread_pct": round(100.0 * (max(vals) - min(vals)) / med, 2)
             if med else 0.0,
-            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
-            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "p50_ms": round(pct["p50"] * 1e3, 3),
+            "p99_ms": round(pct["p99"] * 1e3, 3),
             "readbacks_per_block": round(reads / blocks, 3) if blocks
             else None,
         }
@@ -531,6 +536,12 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
         # steady-state decode runs
         rep["steady_decode_new_compiles"] = steady_new
         result["side_metrics"]["compile_audit"] = rep
+    # the engines above published onto the process-default registry: ship
+    # the full metrics snapshot with the run (ISSUE 5 — one telemetry
+    # account alongside the measured numbers)
+    from deeplearning4j_tpu.observability.metrics import default_registry
+    result["side_metrics"]["metrics_snapshot"] = \
+        default_registry().snapshot()
     return result
 
 
@@ -616,7 +627,9 @@ def _side_metrics() -> dict:
         side["lm_generate"] = {k: gen[k] for k in
                                ("metric", "value", "unit", "vs_baseline",
                                 "spread_pct", "runs")}
-        side["lm_generate"].update(gen["side_metrics"])
+        side["lm_generate"].update(
+            {k: v for k, v in gen["side_metrics"].items()
+             if k != "metrics_snapshot"})   # re-snapshotted at the end
     except Exception as e:  # noqa: BLE001
         side["lm_generate"] = {"error": str(e)[:200]}
     try:
@@ -643,6 +656,15 @@ def _side_metrics() -> dict:
                     "warm_error"] = str(e)[:200]
     except Exception as e:  # noqa: BLE001
         side["word2vec_single_pass_tokens_per_sec"] = {"error": str(e)[:200]}
+    # final observability snapshot for the whole driver run (ISSUE 5):
+    # every engine/route the configs above spun up published onto the
+    # process-default registry
+    try:
+        from deeplearning4j_tpu.observability.metrics import \
+            default_registry
+        side["metrics_snapshot"] = default_registry().snapshot()
+    except Exception as e:  # noqa: BLE001
+        side["metrics_snapshot"] = {"error": str(e)[:200]}
     return side
 
 

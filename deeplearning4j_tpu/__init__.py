@@ -22,6 +22,9 @@ reference's capability areas (see SURVEY.md):
 - ``models``     — model zoo (LeNet, ResNet-50, char-RNN).
 - ``utils``      — ModelSerializer (checkpoint zip), ModelGuesser, misc.
 - ``ui``         — training-stats storage + web UI.
+- ``observability`` — serving telemetry: unified metrics registry,
+                   per-request tracing, live /metrics + /snapshot +
+                   /traces endpoint.
 """
 
 __version__ = "0.1.0"

@@ -48,6 +48,8 @@ RULES: Dict[str, str] = {
              "held lock",
     "GL007": "blocking host readback of a just-dispatched result inside "
              "a loop in a hot module",
+    "GL008": "metric/trace recording inside jitted/traced code "
+             "(instrumentation must stay host-side)",
 }
 
 #: wrappers whose function arguments are traced when called
@@ -72,6 +74,16 @@ _NP_SAFE = {"asarray", "array", "float32", "float64", "float16", "int32",
             "empty", "arange", "shape", "ndim", "broadcast_to", "save"}
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
                    "BoundedSemaphore"}
+#: GL008 — method names that ARE observability recording wherever they
+#: appear (nothing else in this codebase calls .inc()/.observe()/span
+#: methods), vs names generic enough (.set(), .event(), ...) that they
+#: only count when the receiver expression names an observability object
+_OBS_RECORD_METHODS = {"inc", "observe", "observe_many", "add_span",
+                       "start_span", "end_span", "record_span"}
+_OBS_HINTED_METHODS = {"set", "dec", "event", "finish", "labels",
+                       "annotate"}
+_OBS_NAME_HINTS = ("metric", "gauge", "counter", "hist", "trace", "span",
+                   "registry", "telemetry")
 #: callees whose results are NOT "just-dispatched device work" for GL007:
 #: python builtins and host-side helpers a loop legitimately materializes
 _GL007_SAFE_CALLEES = {"range", "len", "list", "tuple", "dict", "set",
@@ -338,6 +350,19 @@ class ModuleLint:
                     self._emit(out, "GL001", node, qual,
                                "device_get inside traced code is a host "
                                "sync")
+            if isinstance(node, ast.Call) and "GL008" in enabled:
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    recv = _dotted_name(f.value).lower()
+                    hinted = any(w in recv for w in _OBS_NAME_HINTS)
+                    if f.attr in _OBS_RECORD_METHODS or \
+                            (hinted and f.attr in _OBS_HINTED_METHODS):
+                        self._emit(out, "GL008", node, qual,
+                                   f".{f.attr}() records telemetry under "
+                                   "trace — it would run at TRACE time "
+                                   "(once per compile, never per step) "
+                                   "and host-syncs any traced value; "
+                                   "record outside the jitted region")
             if isinstance(node, ast.Call) and "GL004" in enabled:
                 np_fn = _is_np_call(node.func)
                 if np_fn and np_fn not in _NP_SAFE and \

@@ -37,8 +37,10 @@ autoregressive loop that dominates LM serving traffic.
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -48,6 +50,8 @@ import numpy as np
 from ..nn.conf.layers import (RnnOutputLayer, SelfAttentionLayer,
                               TokenAndPositionEmbedding)
 from ..nn.graph.vertices import LayerVertex
+from ..observability.metrics import default_registry
+from ..observability.tracing import Trace, default_trace_ring
 from ..ops.platform import train_donate_argnums
 from ..ops.transfer import device_fetch
 from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
@@ -58,6 +62,29 @@ from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
 #: or with batched-admission prefill keys
 ENGINE_KEY_SALT = 1 << 20
 PREFILL_BATCH_SALT = 1 << 21
+
+#: registry-backed serving counters (ISSUE 5): stats() keys → help text.
+#: The source of truth is the metrics registry (one labeled child per
+#: engine instance); the engine's legacy integer attributes
+#: (``eng.emitted_tokens`` etc.) are read-only properties over the same
+#: children, so stats() and four PRs of callers stay exact per engine
+#: while ``/metrics`` aggregates across the process.
+_ENGINE_COUNTERS = {
+    "emitted_tokens": "tokens emitted to requests",
+    "completed": "requests completed",
+    "decode_steps": "decode steps executed (K per fused block)",
+    "decode_blocks": "decode device programs dispatched",
+    "host_readbacks": "deliberate device→host syncs in the serve loop",
+    "prefills": "requests admitted (prefilled into a cache slot)",
+    "prefill_batches": "coalesced batched-admission prefill calls",
+    "rejected": "admission-control sheds (bounded pending queue)",
+    "deadline_exceeded": "requests failed by per-request deadline",
+    "cancelled": "requests cancelled by their caller",
+    "requeued": "requests recovered into this engine after a takeover",
+    "failed": "requests failed by engine crash/shutdown",
+}
+#: unique per-engine metric label values (e0, e1, ...)
+_ENGINE_SEQ = itertools.count()
 
 
 def _round_up_pow2(n: int, floor: int = 16) -> int:
@@ -549,16 +576,27 @@ class GenerationRequest:
         self._running = False              # holds a cache slot right now
         self._cancel_requested = False
         self._engine = None                # set at submit; woken on cancel
+        # observability: one Trace per request for its WHOLE life — it
+        # rides on the request through supervisor quarantine/requeue, so
+        # a recovered request keeps its original timeline (plus a
+        # `takeover` span per restart) instead of starting a second one
+        self.trace: Optional[Trace] = None
+        self._submit_t = time.monotonic()
 
     def _complete(self):
         self._result = np.concatenate(
             [self.prompt, np.asarray(self.generated, np.int32)])
         self._running = False
+        if self.trace is not None:
+            self.trace.finish("ok", tokens=len(self.generated))
         self._done.set()
 
     def _fail(self, exc: BaseException):
         self._error = exc
         self._running = False
+        if self.trace is not None:
+            self.trace.finish(f"failed:{type(exc).__name__}",
+                              tokens=len(self.generated))
         self._done.set()
 
     def _expired(self, now: Optional[float] = None) -> bool:
@@ -650,7 +688,8 @@ class SlotGenerationEngine:
                  t_max: Optional[int] = None, refill: bool = True,
                  seed: int = 0, decoder: Optional[TransformerDecoder] = None,
                  max_pending: int = 256, fault_injector=None,
-                 block_size: int = 1):
+                 block_size: int = 1, registry=None, trace_store=None,
+                 tracing: bool = True):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -702,19 +741,37 @@ class SlotGenerationEngine:
         # decode/prefill LOWERING can exceed any sane heartbeat timeout
         self._on_crash = None       # callable(engine, exc)
         self._beat = None           # callable() — heartbeat per iteration
-        # serving stats
-        self.emitted_tokens = 0
-        self.completed = 0
-        self.decode_steps = 0
-        self.decode_blocks = 0      # device programs dispatched (=steps/K)
-        self.host_readbacks = 0     # device→host syncs the loop performed
-        self.prefills = 0           # admitted requests
-        self.prefill_batches = 0    # coalesced admission prefill calls
-        self.rejected = 0           # admission-control sheds
-        self.deadline_exceeded = 0
-        self.cancelled = 0
-        self.requeued = 0           # requests recovered into this engine
-        self.failed = 0             # requests failed by crash/shutdown
+        # serving stats (ISSUE 5): registry-backed counters, one labeled
+        # child per engine instance. stats() and the legacy attribute
+        # reads (properties below the class) are thin views over these.
+        self._registry = registry if registry is not None \
+            else default_registry()
+        self._trace_store = trace_store if trace_store is not None \
+            else default_trace_ring()
+        self._tracing = bool(tracing)
+        self.engine_id = f"e{next(_ENGINE_SEQ)}"
+        reg = self._registry
+        self._m = {key: reg.counter(f"generation_{key}_total", desc,
+                                    ("engine",)).labels(self.engine_id)
+                   for key, desc in _ENGINE_COUNTERS.items()}
+        # host wall time per decode block (dispatch→retire) — the p50/p99
+        # the telemetry endpoint serves; recorded only while tracing is
+        # on (the telemetry-off A/B baseline skips it)
+        self._h_block = reg.histogram(
+            "generation_decode_block_seconds",
+            "host wall time per decode block, dispatch to retire",
+            ("engine",)).labels(self.engine_id)
+        # depth gauges evaluate lazily at collection time through a WEAK
+        # reference: the process-default registry must never keep a dead
+        # engine (and its device caches) alive
+        wself = weakref.ref(self)
+        reg.gauge("generation_queue_depth", "pending requests queued",
+                  ("engine",)).labels(self.engine_id).set_function(
+            lambda: (lambda s: 0 if s is None else len(s._pending))(wself()))
+        reg.gauge("generation_active_slots", "cache slots decoding",
+                  ("engine",)).labels(self.engine_id).set_function(
+            lambda: (lambda s: 0 if s is None else
+                     sum(r is not None for r in s._slots))(wself()))
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
@@ -723,6 +780,13 @@ class SlotGenerationEngine:
         req = GenerationRequest(prompt, max_new_tokens, temperature, eos_id,
                                 deadline=deadline)
         req._engine = self
+        # the engine opens the request's trace; route-side spans
+        # (consume/publish) are appended onto it afterwards. The
+        # early-failure paths below finish it through req._fail.
+        if self._tracing:
+            req.trace = Trace(store=self._trace_store)
+            req.trace.event("submit", engine=self.engine_id,
+                            prompt_len=len(req.prompt))
         with self._lock:
             dead = self._dead
             stopped = self._shutdown or dead is not None
@@ -758,7 +822,7 @@ class SlotGenerationEngine:
             if queued:
                 depth = len(self._pending)
                 if depth >= self.max_pending:
-                    self.rejected += 1
+                    self._m["rejected"].inc()
                     shed_depth = depth
                     queued = False
                 else:
@@ -782,6 +846,12 @@ class SlotGenerationEngine:
         decoding on — exactly-once, token-for-token with an
         uninterrupted run under greedy selection. Recovery bypasses
         admission control: a restart must not shed work it inherited."""
+        if req.trace is not None:
+            # same trace, new engine: the takeover span is the ONLY seam
+            # a restarted request shows in its timeline
+            req.trace.event("takeover", engine=self.engine_id,
+                            generated=len(req.generated))
+        req._submit_t = time.monotonic()
         with self._lock:
             dead = self._dead
             alive = not (self._shutdown or dead is not None)
@@ -789,7 +859,7 @@ class SlotGenerationEngine:
                 req._running = False
                 req._engine = self
                 self._pending.append(req)
-                self.requeued += 1
+                self._m["requeued"].inc()
         if not alive:
             req._fail(dead or RuntimeError(
                 "SlotGenerationEngine shut down"))
@@ -834,11 +904,11 @@ class SlotGenerationEngine:
                 keep: collections.deque = collections.deque()
                 for req in self._pending:
                     if req._cancel_requested:
-                        self.cancelled += 1
+                        self._m["cancelled"].inc()
                         doomed.append((req, Cancelled(
                             "cancelled while queued")))
                     elif req._expired(now):
-                        self.deadline_exceeded += 1
+                        self._m["deadline_exceeded"].inc()
                         doomed.append((req, DeadlineExceeded(
                             f"deadline of {req.deadline}s passed while "
                             "queued")))
@@ -861,13 +931,13 @@ class SlotGenerationEngine:
                     continue
                 if req._cancel_requested:
                     self._slots[s] = None
-                    self.cancelled += 1
+                    self._m["cancelled"].inc()
                     doomed.append((req, Cancelled(
                         f"cancelled mid-decode after "
                         f"{len(req.generated)} tokens")))
                 elif req._expired(now):
                     self._slots[s] = None
-                    self.deadline_exceeded += 1
+                    self._m["deadline_exceeded"].inc()
                     doomed.append((req, DeadlineExceeded(
                         f"deadline of {req.deadline}s exceeded after "
                         f"{len(req.generated)} tokens")))
@@ -923,9 +993,9 @@ class SlotGenerationEngine:
                             if not self._unpark(req):
                                 return   # a takeover drain owns it now
                             if isinstance(exc, Cancelled):
-                                self.cancelled += 1
+                                self._m["cancelled"].inc()
                             else:
-                                self.deadline_exceeded += 1
+                                self._m["deadline_exceeded"].inc()
                         req._fail(exc)
                         req = None
                         continue
@@ -937,7 +1007,7 @@ class SlotGenerationEngine:
                         with self._lock:
                             if not self._unpark(req):
                                 return
-                            self.completed += 1
+                            self._m["completed"].inc()
                         req._complete()
                         req = None
                         continue
@@ -964,9 +1034,9 @@ class SlotGenerationEngine:
                 if self._shutdown or self._quarantined:
                     return   # batch stays parked in _admitting; the
                              # quarantine/shutdown drain owns it now
-                self.prefills += m
-                self.prefill_batches += 1
-                batch_no = self.prefill_batches
+                self._m["prefills"].inc(m)
+                batch_no = self._m["prefill_batches"].inc()
+            t_pre0 = time.monotonic()
             self._faults.fire("engine.prefill")
             nxt, _, self._caches = self.decoder._fn("prefill_slots")(
                 self.decoder._device_params(),
@@ -976,6 +1046,7 @@ class SlotGenerationEngine:
                 jax.random.fold_in(self._key,
                                    PREFILL_BATCH_SALT | batch_no))
             toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
+            t_pre1 = time.monotonic()
             finishers: List[GenerationRequest] = []
             with self._lock:
                 if self._shutdown or self._quarantined:
@@ -983,7 +1054,7 @@ class SlotGenerationEngine:
                     # device call; it owns the requests now — drop our
                     # tokens (re-prefill regenerates them)
                     return
-                self.host_readbacks += 1
+                self._m["host_readbacks"].inc()
                 for i, (req, s, ctx) in enumerate(batch):
                     if req not in self._admitting:
                         continue          # pragma: no cover — defensive
@@ -991,9 +1062,14 @@ class SlotGenerationEngine:
                     tok = int(toks[i])
                     req._running = True
                     req.generated.append(tok)
-                    self.emitted_tokens += 1
+                    self._m["emitted_tokens"].inc()
+                    if req.trace is not None:
+                        req.trace.add_span("queued", req._submit_t, t_pre0)
+                        req.trace.add_span("prefill", t_pre0, t_pre1,
+                                           batch=m, bucket=mb, tp=tp,
+                                           ctx=len(ctx))
                     if self._req_finished(req, tok):
-                        self.completed += 1
+                        self._m["completed"].inc()
                         finishers.append(req)   # done at the first token
                     else:
                         self._slots[s] = req
@@ -1023,24 +1099,28 @@ class SlotGenerationEngine:
             active = any(r is not None for r in self._slots)
             if active:
                 self._step_no += 1
-                self.decode_steps += 1
-                self.decode_blocks += 1   # a K=1 block
+                self._m["decode_steps"].inc()
+                self._m["decode_blocks"].inc()   # a K=1 block
             step_no = self._step_no
         if not active:
             return                # lifecycle enforcement freed every slot
+        t_disp = time.monotonic()
         self._faults.fire("engine.step")
         nxt, _, self._caches = self.decoder.decode_step(
             self._caches, self._last_ids,
             np.minimum(self._positions, self.t_max - 1), self._temps,
             key=jax.random.fold_in(self._key, ENGINE_KEY_SALT | step_no))
         nxt_host = device_fetch(nxt, tag="engine.decode")
+        t_ret = time.monotonic()
+        if self._tracing:
+            self._h_block.observe(t_ret - t_disp)
         finished: List[GenerationRequest] = []
         # token appends and slot frees are one critical section: a
         # concurrent quarantine() either runs before (we see empty slots
         # and append nothing) or after (it harvests the post-append
         # state) — a recovered request never loses or duplicates a token
         with self._lock:
-            self.host_readbacks += 1
+            self._m["host_readbacks"].inc()
             emitted = 0
             for s in range(self.num_slots):
                 req = self._slots[s]
@@ -1051,11 +1131,13 @@ class SlotGenerationEngine:
                 emitted += 1
                 self._positions[s] += 1
                 self._last_ids[s] = tok
+                if req.trace is not None:
+                    req.trace.add_span("decode_block", t_disp, t_ret, k=1)
                 if self._req_finished(req, tok):
                     self._slots[s] = None
-                    self.completed += 1
+                    self._m["completed"].inc()
                     finished.append(req)
-            self.emitted_tokens += emitted
+            self._m["emitted_tokens"].inc(emitted)
             self._first_step_done = True
         for req in finished:
             req._complete()
@@ -1092,8 +1174,8 @@ class SlotGenerationEngine:
             self._inflight = None
             if snapshot:
                 self._step_no += k
-                self.decode_steps += k
-                self.decode_blocks += 1
+                self._m["decode_steps"].inc(k)
+                self._m["decode_blocks"].inc()
                 carry = self._carry
                 if carry is None:
                     # resync from host state (after admission / frees):
@@ -1107,6 +1189,7 @@ class SlotGenerationEngine:
                             self._eos_ids.copy())
         if dispatch is not None:
             (ids, pos, stop), step0, temps, eos = dispatch
+            t_disp = time.monotonic()
             self._faults.fire("engine.step")
             toks, ids_d, pos_d, stop_d, self._caches = \
                 self.decoder.decode_block(
@@ -1116,7 +1199,7 @@ class SlotGenerationEngine:
             with self._lock:
                 if not (self._quarantined or self._shutdown):
                     self._carry = (ids_d, pos_d, stop_d)
-                    self._inflight = (toks, snapshot, k)
+                    self._inflight = (toks, snapshot, k, t_disp)
         # prev was dispatched LAST cycle and has been computing since;
         # its fetch + bookkeeping overlap the block dispatched above.
         # With no active lanes left, prev's tokens are pure overshoot
@@ -1128,34 +1211,42 @@ class SlotGenerationEngine:
         """Fetch one block's [S, K] token matrix (ONE host readback) and
         run its host bookkeeping: per-lane appends until a stop, slot
         frees, request completions."""
-        toks_dev, snapshot, k = block
+        toks_dev, snapshot, k, t_disp = block
         host = device_fetch(toks_dev, tag="engine.decode")
+        t_ret = time.monotonic()
+        if self._tracing:
+            self._h_block.observe(t_ret - t_disp)
         finished: List[GenerationRequest] = []
         with self._lock:
             if self._quarantined or self._shutdown:
                 return   # the drain owns the requests; recovery
                          # re-prefills and regenerates these tokens
-            self.host_readbacks += 1
+            self._m["host_readbacks"].inc()
             emitted = 0
             for s, req in snapshot:
                 if req.done() or self._slots[s] is not req:
                     continue   # finished/cancelled since dispatch:
                                # the lane's tokens are overshoot
                 closed = False
+                took = 0
                 for c in range(k):
                     tok = int(host[s, c])
                     req.generated.append(tok)
                     emitted += 1
+                    took += 1
                     if self._req_finished(req, tok):
                         self._slots[s] = None
-                        self.completed += 1
+                        self._m["completed"].inc()
                         finished.append(req)
                         closed = True
                         break
+                if req.trace is not None:
+                    req.trace.add_span("decode_block", t_disp, t_ret,
+                                       k=k, tokens=took)
                 if not closed:
                     self._positions[s] += k
                     self._last_ids[s] = int(host[s, k - 1])
-            self.emitted_tokens += emitted
+            self._m["emitted_tokens"].inc(emitted)
             self._first_step_done = True
             if finished:
                 # freed lanes must not keep decoding from the device
@@ -1195,24 +1286,14 @@ class SlotGenerationEngine:
         return [r for r in harvested if not r.done()], cause
 
     def stats(self) -> Dict[str, int]:
-        """Snapshot of the serving counters (one lock acquisition)."""
+        """Serving-counter snapshot — a thin view over this engine's
+        labeled registry children (ISSUE 5), same keys as ever, plus the
+        two live gauges read under the engine lock."""
+        out = {key: int(self._m[key].value) for key in _ENGINE_COUNTERS}
         with self._lock:
-            return {
-                "emitted_tokens": self.emitted_tokens,
-                "completed": self.completed,
-                "decode_steps": self.decode_steps,
-                "decode_blocks": self.decode_blocks,
-                "host_readbacks": self.host_readbacks,
-                "prefills": self.prefills,
-                "prefill_batches": self.prefill_batches,
-                "rejected": self.rejected,
-                "deadline_exceeded": self.deadline_exceeded,
-                "cancelled": self.cancelled,
-                "requeued": self.requeued,
-                "failed": self.failed,
-                "queue_depth": len(self._pending),
-                "active_slots": sum(r is not None for r in self._slots),
-            }
+            out["queue_depth"] = len(self._pending)
+            out["active_slots"] = sum(r is not None for r in self._slots)
+        return out
 
     # ---------------------------------------------------------- execution
     def run_until_drained(self):
@@ -1278,7 +1359,7 @@ class SlotGenerationEngine:
                 self._pending.clear()
                 self._inflight = None
                 self._carry = None
-                self.failed += len(doomed)
+                self._m["failed"].inc(len(doomed))
             for req in doomed:
                 req._fail(exc)
             raise
@@ -1315,6 +1396,19 @@ class SlotGenerationEngine:
             self._pending.clear()
             self._inflight = None
             self._carry = None
-            self.failed += len(doomed)
+            self._m["failed"].inc(len(doomed))
         for req in doomed:
             req._fail(exc)
+
+
+# Legacy counter attributes (``eng.emitted_tokens``, ``eng.decode_steps``,
+# ...) as read-only properties over the engine's registry children: the
+# benches, perf scripts, and four PRs of tests keep reading them while the
+# registry owns the numbers. A missed write site fails loudly (properties
+# reject assignment) instead of silently forking the counts.
+for _counter_name in _ENGINE_COUNTERS:
+    setattr(SlotGenerationEngine, _counter_name,
+            property(lambda self, _k=_counter_name: int(self._m[_k].value),
+                     doc=f"registry view: generation_{_counter_name}_total"
+                         f"{{engine=<id>}}"))
+del _counter_name

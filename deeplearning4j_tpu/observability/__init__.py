@@ -1,0 +1,40 @@
+"""observability — unified metrics registry, per-request tracing, and a
+live serving telemetry endpoint.
+
+The reference DL4J ships observability as a first-class subsystem
+(deeplearning4j-ui-parent: StatsListener → StatsStorage → browser UI);
+this package is its SERVING-side counterpart for the jax_graft stack —
+where ``ui/`` watches training, ``observability/`` watches the decode
+hot path and everything around it:
+
+- :mod:`.metrics` — thread-safe :class:`MetricsRegistry` of labeled
+  Counters, Gauges, and fixed-bucket Histograms with a nested-dict
+  ``snapshot()`` and Prometheus-style text exposition. The engine /
+  supervisor / route / broker counters all live here now; their
+  ``stats()`` dicts and counter attributes are thin views.
+- :mod:`.tracing` — per-request :class:`Trace`/:class:`Span` timelines
+  threaded through consume → admission → prefill → decode blocks →
+  publish, carried ACROSS EngineSupervisor takeovers (one trace per
+  request, a ``takeover`` span marking each restart), with a fixed
+  :class:`TraceRing` of completed traces.
+- :mod:`.telemetry` — :class:`TelemetryServer`, a background HTTP
+  endpoint (``/metrics``, ``/snapshot``, ``/traces/recent``) reusing
+  the training UI's HTTP plumbing.
+
+Instrumentation is host-side only (wall clocks, counter bumps): it
+compiles nothing, adds no device syncs beyond the existing
+``device_fetch`` seam, and graftlint GL008 statically rejects any
+metric/trace record call that drifts into jit-traced code.
+"""
+
+from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
+                      MetricsRegistry, default_registry, percentiles)
+from .telemetry import TelemetryServer
+from .tracing import Span, Trace, TraceRing, default_trace_ring
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS", "default_registry", "percentiles",
+    "Span", "Trace", "TraceRing", "default_trace_ring",
+    "TelemetryServer",
+]

@@ -1,0 +1,184 @@
+"""Live serving-telemetry endpoint.
+
+:class:`TelemetryServer` is a background HTTP server (the training UI's
+``ui/server.py`` plumbing — ``JsonHTTPHandler`` + ``BackgroundHTTPServer``
+— reused wholesale) exposing the observability layer of a running
+serving process:
+
+- ``GET /metrics``        — Prometheus text exposition of the registry;
+- ``GET /snapshot``       — one JSON document: the nested registry
+  snapshot, per-tag device→host readback DELTAS since server start (the
+  TransferAudit view over ``ops.transfer.device_fetch``), the
+  CompileAudit report (per-function XLA compiles + delta since start,
+  when ``audit_compiles=True``), and every registered source
+  (engine/supervisor ``stats()`` dicts, broker counters, ...);
+- ``GET /traces/recent``  — the completed-trace ring as JSON timelines
+  (``?n=`` limits the count);
+- ``GET /healthz``        — liveness probe.
+
+Reading is free for the serving hot path: every endpoint renders from
+already-maintained state (registry children, the trace ring, the
+monotonic transfer counters); nothing queries the device and nothing
+compiles. Sources are callables evaluated per request and guarded — a
+dying engine must degrade the snapshot, not the endpoint.
+
+    srv = TelemetryServer(port=0).add_source(
+        "generation", engine.stats).start()
+    print(srv.url)           # scripts/telemetry_dump.py consumes this
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..ui.server import BackgroundHTTPServer, JsonHTTPHandler
+from .metrics import MetricsRegistry, default_registry
+from .tracing import TraceRing, default_trace_ring
+
+
+class _TelemetryHandler(JsonHTTPHandler):
+    """Per-TelemetryServer handler subclass (``server_obj`` is bound by
+    ``TelemetryServer.start`` via ``type()``, so several telemetry
+    servers in one process never share state the way a class attribute
+    would)."""
+
+    server_obj: "TelemetryServer" = None
+
+    def do_GET(self):
+        srv = type(self).server_obj
+        url = urlparse(self.path)
+        if srv is None:
+            self._json({"error": "server detached"}, code=503)
+        elif url.path == "/metrics":
+            self._text(srv.registry.render_prometheus(),
+                       "text/plain; version=0.0.4")
+        elif url.path == "/snapshot":
+            self._json(srv.snapshot())
+        elif url.path == "/traces/recent":
+            q = parse_qs(url.query)
+            try:
+                n = int(q.get("n", ["0"])[0]) or None
+            except ValueError:
+                n = None
+            traces = srv.trace_store.recent(n)
+            self._json({"count": len(traces),
+                        "total_completed": srv.trace_store.total_added,
+                        "traces": [t.to_dict() for t in traces]})
+        elif url.path == "/healthz":
+            self._json({"ok": True, "uptime_s": round(srv.uptime, 3)})
+        else:
+            self._json({"error": "not found", "endpoints": [
+                "/metrics", "/snapshot", "/traces/recent", "/healthz"]},
+                code=404)
+
+
+class TelemetryServer:
+    """Background telemetry endpoint over a registry + trace ring.
+
+    ``audit_compiles=True`` additionally arms a CompileAudit for the
+    server's lifetime (one logging call per XLA compile — free in steady
+    state, where the whole point is that there are none) so
+    ``/snapshot`` can report per-function compile counts and the delta
+    since serving started."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 trace_store: Optional[TraceRing] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 audit_compiles: bool = False):
+        # loopback by default: the endpoint is unauthenticated and
+        # /snapshot+/traces expose serving internals — exposing it
+        # beyond the host is an explicit host="0.0.0.0" decision
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.trace_store = trace_store if trace_store is not None \
+            else default_trace_ring()
+        self._http = BackgroundHTTPServer(None, host=host, port=port)
+        self._sources: Dict[str, Callable[[], dict]] = {}
+        self._audit = None
+        self._audit_snap = None
+        self._audit_compiles = bool(audit_compiles)
+        self._transfer_start: Dict[str, int] = {}
+        self._started_at: Optional[float] = None
+
+    # ------------------------------------------------------------ wiring
+    def add_source(self, name: str, fn: Callable[[], dict]
+                   ) -> "TelemetryServer":
+        """Register a snapshot source (an engine/supervisor ``stats``,
+        a broker's counters, an injector's ``counters`` — any zero-arg
+        callable returning JSON-serializable data)."""
+        self._sources[str(name)] = fn
+        return self
+
+    def start(self) -> "TelemetryServer":
+        if self._started_at is not None:
+            return self
+        from ..ops.transfer import fetch_counts
+        self._transfer_start = fetch_counts()
+        if self._audit_compiles:
+            from ..analysis.compile_audit import CompileAudit
+            self._audit = CompileAudit().__enter__()
+            self._audit_snap = self._audit.snapshot()
+        handler = type("_BoundTelemetryHandler", (_TelemetryHandler,),
+                       {"server_obj": self})
+        self._http.handler_cls = handler
+        self._http.start()
+        self._started_at = time.monotonic()
+        return self
+
+    def stop(self) -> None:
+        self._http.stop()
+        if self._audit is not None:
+            audit, self._audit = self._audit, None
+            audit.budget = {}            # lifetime audit: report, don't gate
+            audit.total_budget = None
+            audit.__exit__(None, None, None)
+        self._started_at = None
+
+    @property
+    def port(self) -> int:
+        return self._http.port
+
+    @property
+    def url(self) -> str:
+        return self._http.url
+
+    @property
+    def uptime(self) -> float:
+        if self._started_at is None:
+            return 0.0
+        return time.monotonic() - self._started_at
+
+    # ------------------------------------------------------------- views
+    def transfer_deltas(self) -> Dict[str, int]:
+        """Per-tag ``device_fetch`` readbacks since ``start()`` (the
+        TransferAudit snapshot-and-diff discipline, held open for the
+        server's lifetime)."""
+        from ..ops.transfer import fetch_counts
+        now = fetch_counts()
+        return {t: c - self._transfer_start.get(t, 0)
+                for t, c in sorted(now.items())
+                if c - self._transfer_start.get(t, 0) > 0}
+
+    def snapshot(self) -> dict:
+        out = {
+            "uptime_s": round(self.uptime, 3),
+            "metrics": self.registry.snapshot(),
+            "transfers": self.transfer_deltas(),
+            "traces": {"completed": self.trace_store.total_added,
+                       "ring": len(self.trace_store)},
+        }
+        if self._audit is not None:
+            rep = self._audit.report()
+            rep["new_since_start"] = self._audit.delta(self._audit_snap)
+            out["compile_audit"] = rep
+        sources = {}
+        for name, fn in self._sources.items():
+            try:
+                sources[name] = fn()
+            except Exception as e:   # noqa: BLE001 — degrade, don't 500
+                sources[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        if sources:
+            out["sources"] = sources
+        return out

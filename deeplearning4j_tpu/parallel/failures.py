@@ -131,6 +131,18 @@ class EngineSupervisor(HeartbeatMonitor):
                          on_failure=self._on_wedge)
         self._engine = engine
         self._name = name
+        # observability (ISSUE 5): takeovers are first-class telemetry —
+        # the supervisor publishes restart/recovery counters on the same
+        # registry its engine uses, labeled by supervisor name
+        reg = engine._registry
+        self._m_restarts = reg.counter(
+            "supervisor_restarts_total",
+            "engine takeovers (crash or wedge) performed",
+            ("supervisor",)).labels(name)
+        self._m_recovered = reg.counter(
+            "supervisor_recovered_requests_total",
+            "requests harvested and requeued across takeovers",
+            ("supervisor",)).labels(name)
         self.max_restarts = int(max_restarts)
         # first-lowering grace: until the engine completes its first
         # decode step, a silent heartbeat more likely means "compiling"
@@ -229,14 +241,19 @@ class EngineSupervisor(HeartbeatMonitor):
                 req._fail(exc)
             return
         self.restarts += 1
+        self._m_restarts.inc()
         new = SlotGenerationEngine(
             old.decoder.net, num_slots=old.num_slots, refill=old.refill,
             seed=old.seed, decoder=old.decoder,      # SAME jit programs
             max_pending=old.max_pending, fault_injector=old._faults,
-            block_size=old.block_size)   # same decode_block{K} program too
+            block_size=old.block_size,   # same decode_block{K} program too
+            registry=old._registry, trace_store=old._trace_store,
+            tracing=old._tracing)    # same telemetry sinks too: requeued
+        #                              requests CONTINUE their traces
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)
+        self._m_recovered.inc(len(recoverable))
         self._attach(new)
         self._engine = new
         new.start()

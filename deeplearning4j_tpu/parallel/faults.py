@@ -96,11 +96,21 @@ class FaultInjector:
     (``hits``/``fired``) make schedules auditable after a run.
     """
 
-    def __init__(self):
+    def __init__(self, registry=None):
         self._lock = threading.Lock()
         self._plans: Dict[str, List[dict]] = defaultdict(list)
         self._hits: Dict[str, int] = defaultdict(int)
         self._fired: Dict[str, int] = defaultdict(int)
+        # chaos visibility (ISSUE 5): fired faults surface on /metrics
+        # as fault_injections_total{point=...} — a soak's schedule is
+        # auditable from the telemetry endpoint, not just the injector.
+        # Lazy import: observability must stay importable without us.
+        from ..observability.metrics import default_registry
+        reg = registry if registry is not None else default_registry()
+        self._m_fired = reg.counter(
+            "fault_injections_total",
+            "injected faults that actually fired, by injection point",
+            ("point",))
 
     # ------------------------------------------------------------- arming
     def raise_once(self, point: str, exc, at: int = 1) -> "FaultInjector":
@@ -150,6 +160,7 @@ class FaultInjector:
         hang_s = 0.0
         drop = False
         raise_exc = None
+        fired = 0
         with self._lock:
             self._hits[point] += 1
             hit = self._hits[point]
@@ -158,12 +169,15 @@ class FaultInjector:
                     continue
                 plan["remaining"] -= 1
                 self._fired[point] += 1
+                fired += 1
                 if plan["kind"] == "hang":
                     hang_s += plan["seconds"]
                 elif plan["kind"] == "drop":
                     drop = True
                 elif raise_exc is None:
                     raise_exc = plan["exc"]
+        if fired:
+            self._m_fired.labels(point).inc(fired)
         if hang_s > 0.0:
             time.sleep(hang_s)          # outside the lock: a hung point
         if raise_exc is not None:       # must not block arming/counters

@@ -38,7 +38,10 @@ class ParallelInference:
                  generation_supervisor_timeout: float = 10.0,
                  generation_max_restarts: int = 3,
                  generation_fault_injector=None,
-                 generation_block_size: int = 1):
+                 generation_block_size: int = 1,
+                 generation_registry=None,
+                 generation_trace_store=None,
+                 generation_tracing: bool = True):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
@@ -59,6 +62,13 @@ class ParallelInference:
             generation_supervisor_timeout)
         self.generation_max_restarts = int(generation_max_restarts)
         self.generation_fault_injector = generation_fault_injector
+        # observability sinks threaded to the engine (ISSUE 5): registry
+        # for counters/histograms, trace store for completed request
+        # timelines; tracing=False is the telemetry-off A/B baseline
+        self.generation_registry = generation_registry
+        self.generation_trace_store = generation_trace_store
+        self.generation_tracing = bool(generation_tracing)
+        self._telemetry = None
         self._jit_fwd = None
         self._lock = threading.Lock()
         self._requests: "queue.Queue" = queue.Queue()
@@ -190,7 +200,10 @@ class ParallelInference:
                     t_max=self.generation_t_max,
                     max_pending=self.generation_max_pending,
                     fault_injector=self.generation_fault_injector,
-                    block_size=self.generation_block_size)
+                    block_size=self.generation_block_size,
+                    registry=self.generation_registry,
+                    trace_store=self.generation_trace_store,
+                    tracing=self.generation_tracing)
                 if self.generation_supervised:
                     from .failures import EngineSupervisor
                     self._gen_supervisor = EngineSupervisor(
@@ -235,8 +248,30 @@ class ParallelInference:
             target = self._gen_supervisor or self._gen_engine
             return None if target is None else target.stats()
 
+    def start_telemetry(self, port: int = 0, host: str = "127.0.0.1",
+                        audit_compiles: bool = False):
+        """Start (or return) the live telemetry endpoint for this
+        facade: ``/metrics``, ``/snapshot`` (generation stats wired in
+        as a source), ``/traces/recent``. Uses the same registry/trace
+        store the generation engine publishes to; stopped by
+        ``shutdown()``. Binds loopback by default (the endpoint is
+        unauthenticated); pass ``host="0.0.0.0"`` to expose it."""
+        if self._telemetry is None:
+            from ..observability.telemetry import TelemetryServer
+            self._telemetry = TelemetryServer(
+                registry=self.generation_registry,
+                trace_store=self.generation_trace_store,
+                host=host, port=port,
+                audit_compiles=audit_compiles).add_source(
+                "generation", lambda: self.generation_stats() or {})
+            self._telemetry.start()
+        return self._telemetry
+
     def shutdown(self):
         self._shutdown = True
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
         with self._gen_lock:
             if self._gen_supervisor is not None:
                 self._gen_supervisor.stop()

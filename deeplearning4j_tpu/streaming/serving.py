@@ -10,15 +10,42 @@ route level."""
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import List, Optional
 
 import numpy as np
 
+from ..observability.metrics import default_registry
 from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
                                RejectedError)
 from .pubsub import MessageBroker, NDArrayPublisher, NDArraySubscriber
+
+#: unique per-route metric label values (routes in tests reuse topics,
+#: so the topic alone cannot key exact per-instance assertions)
+_ROUTE_SEQ = itertools.count()
+
+#: registry counter schema shared by both routes (ISSUE 5): attribute
+#: name → help text; each route instance owns one labeled child per
+#: counter and exposes the legacy attributes as read-only views
+_ROUTE_COUNTERS = {
+    "served": "messages served to the output topic",
+    "errors": "bad payloads / dispatch failures (counted, not fatal)",
+    "batches": "coalesced (>=2 message) dispatch attempts",
+    "singles": "single-message dispatches (incl. fallbacks)",
+    "shed": "admission-control rejections observed",
+    "deadline_errors": "deadline-exceeded / cancelled requests popped",
+    "publish_drops": "messages dropped after publish-retry exhaustion",
+    "consume_errors": "transient consume failures skipped",
+}
+
+
+def _route_metrics(registry, label: str):
+    reg = registry if registry is not None else default_registry()
+    return {key: reg.counter(f"route_{key}_total", desc,
+                             ("route",)).labels(label)
+            for key, desc in _ROUTE_COUNTERS.items()}
 
 
 class _RoutePublishMixin:
@@ -27,14 +54,17 @@ class _RoutePublishMixin:
     backoff; a persistent one DROPS the message and counts it
     (``publish_drops``) — graceful degradation, never a dead route
     thread. The ``route.publish`` injection point can force either
-    path (a raise exercises retry, a drop-signal exercises shedding)."""
+    path (a raise exercises retry, a drop-signal exercises shedding).
+
+    Counters live on the metrics registry (``route_*_total{route=...}``);
+    the legacy attributes (``route.publish_drops``, ...) are properties
+    over the same children (installed at module bottom)."""
 
     def _publish_safe(self, arr: np.ndarray) -> bool:
         for attempt in range(self.publish_retries + 1):
             try:
                 if self._faults.fire("route.publish"):
-                    with self._stats_lock:
-                        self.publish_drops += 1
+                    self._m["publish_drops"].inc()
                     return False          # injected drop: counted
                 self.pub.publish(arr)
                 return True
@@ -42,8 +72,7 @@ class _RoutePublishMixin:
                 if attempt >= self.publish_retries:
                     break
                 time.sleep(self.retry_backoff * (2 ** attempt))
-        with self._stats_lock:
-            self.publish_drops += 1
+        self._m["publish_drops"].inc()
         return False
 
     def _poll_safe(self, timeout: float) -> Optional[np.ndarray]:
@@ -54,13 +83,11 @@ class _RoutePublishMixin:
             if self._faults.fire("route.consume"):
                 # injected consume drop: swallow one message if present
                 self.sub.poll(timeout=timeout)
-                with self._stats_lock:
-                    self.consume_errors += 1
+                self._m["consume_errors"].inc()
                 return None
             return self.sub.poll(timeout=timeout)
         except Exception:       # noqa: BLE001
-            with self._stats_lock:
-                self.consume_errors += 1
+            self._m["consume_errors"].inc()
             time.sleep(self.retry_backoff)
             return None
 
@@ -83,9 +110,10 @@ class ModelServingRoute(_RoutePublishMixin):
                  max_batch: int = 32,
                  batch_window: float = 0.0,
                  publish_retries: int = 3, retry_backoff: float = 0.05,
-                 fault_injector=None):
+                 fault_injector=None, registry=None):
         self.net = net
         self.broker = broker
+        self.input_topic = input_topic
         self.sub = NDArraySubscriber(broker, input_topic)
         self.pub = NDArrayPublisher(broker, output_topic)
         self.max_batch = max(1, int(max_batch))
@@ -96,16 +124,10 @@ class ModelServingRoute(_RoutePublishMixin):
             else NULL_INJECTOR
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        # guards the serving counters: the route thread writes them while
-        # callers (tests, dashboards) read — and a future multi-route net
-        # may share one instance
-        self._stats_lock = threading.Lock()
-        self.served = 0
-        self.batches = 0      # coalesced (>=2 message) dispatch attempts
-        self.singles = 0      # single-message dispatches (incl. fallbacks)
-        self.errors = 0
-        self.publish_drops = 0   # messages dropped after retry exhaustion
-        self.consume_errors = 0  # transient consume failures skipped
+        # serving counters: registry children (thread-safe by
+        # construction — the route thread writes, dashboards/tests read)
+        self.route_id = f"serve{next(_ROUTE_SEQ)}:{input_topic}"
+        self._m = _route_metrics(registry, self.route_id)
 
     def _drain(self, first: np.ndarray) -> List[np.ndarray]:
         arrs = [first]
@@ -141,16 +163,14 @@ class ModelServingRoute(_RoutePublishMixin):
                 # provably singletons
                 self._serve_single(run[0])
             else:
-                with self._stats_lock:
-                    self.batches += 1   # one coalesced dispatch attempt
+                self._m["batches"].inc()   # one coalesced dispatch attempt
                 try:
                     stacked = np.concatenate(
                         [a.astype(np.float32) for a in run], axis=0)
                     out = np.asarray(self.net.output(stacked))
                     splits = np.cumsum([a.shape[0] for a in run])[:-1]
                     pieces = np.split(out, splits, axis=0)
-                    with self._stats_lock:
-                        self.served += len(pieces)
+                    self._m["served"].inc(len(pieces))
                     for piece in pieces:
                         self._publish_safe(piece)
                 except Exception:
@@ -163,18 +183,15 @@ class ModelServingRoute(_RoutePublishMixin):
             i = j
 
     def _serve_single(self, a: np.ndarray) -> None:
-        with self._stats_lock:
-            self.singles += 1
+        self._m["singles"].inc()
         try:
             out = np.asarray(self.net.output(a.astype(np.float32)))
-            with self._stats_lock:
-                self.served += 1
+            self._m["served"].inc()
             self._publish_safe(out)
         except Exception:
             # a bad payload must not kill the route (Camel's route
             # error-handling role); counted per message
-            with self._stats_lock:
-                self.errors += 1
+            self._m["errors"].inc()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -222,7 +239,8 @@ class GenerationServingRoute(_RoutePublishMixin):
                  t_max: Optional[int] = None, engine=None,
                  max_inflight: int = 64, deadline: Optional[float] = None,
                  publish_retries: int = 3, retry_backoff: float = 0.05,
-                 fault_injector=None, block_size: int = 1):
+                 fault_injector=None, block_size: int = 1, registry=None,
+                 trace_store=None, tracing: bool = True):
         self._owns_engine = engine is None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
@@ -230,13 +248,19 @@ class GenerationServingRoute(_RoutePublishMixin):
             from ..models.generation import SlotGenerationEngine
             # block_size > 1: requests complete (and publish) at decode-
             # block boundaries — K-step device programs, one readback
-            # per block, admission batched at the boundary
+            # per block, admission batched at the boundary. The
+            # observability sinks thread through whole: an isolated
+            # registry/trace ring isolates the route-owned engine too.
             engine = SlotGenerationEngine(net, num_slots=num_slots,
                                           t_max=t_max,
                                           fault_injector=self._faults,
-                                          block_size=block_size)
+                                          block_size=block_size,
+                                          registry=registry,
+                                          trace_store=trace_store,
+                                          tracing=tracing)
         self.engine = engine
         self.broker = broker
+        self.input_topic = input_topic
         self.sub = NDArraySubscriber(broker, input_topic)
         self.pub = NDArrayPublisher(broker, output_topic)
         self.max_new_tokens = int(max_new_tokens)
@@ -251,14 +275,10 @@ class GenerationServingRoute(_RoutePublishMixin):
         self._inflight: "List" = []          # submission-ordered handles
         self._inflight_lock = threading.Lock()
         self.max_inflight = max(1, int(max_inflight))
-        # consumer and publisher threads both bump counters; callers read
-        self._stats_lock = threading.Lock()
-        self.served = 0
-        self.errors = 0
-        self.shed = 0            # admission-control rejections observed
-        self.deadline_errors = 0  # deadline-exceeded / cancelled requests
-        self.publish_drops = 0
-        self.consume_errors = 0
+        # counters: registry children shared-safe between the consumer
+        # and publisher threads; legacy attributes are property views
+        self.route_id = f"gen{next(_ROUTE_SEQ)}:{input_topic}"
+        self._m = _route_metrics(registry, self.route_id)
 
     def _consume(self) -> None:
         while not self._stop.is_set():
@@ -273,17 +293,25 @@ class GenerationServingRoute(_RoutePublishMixin):
             arr = self._poll_safe(timeout=0.1)
             if arr is None:
                 continue
+            t_c0 = time.monotonic()
             try:
                 prompt = np.asarray(arr).astype(np.int64).reshape(-1)
                 req = self.engine.submit(prompt, self.max_new_tokens,
                                          temperature=self.temperature,
                                          eos_id=self.eos_id,
                                          deadline=self.deadline)
+                # the engine opened the request's trace at submit; the
+                # consume span closes over the route-side intake work
+                # (message arrival → request queued)
+                tr = getattr(req, "trace", None)
+                if tr is not None:
+                    tr.add_span("consume", t_c0, time.monotonic(),
+                                topic=self.input_topic,
+                                route=self.route_id)
                 with self._inflight_lock:
                     self._inflight.append(req)
             except Exception:
-                with self._stats_lock:       # bad payload must not kill it
-                    self.errors += 1
+                self._m["errors"].inc()      # bad payload must not kill it
 
     def _publish_in_order(self) -> None:
         while not self._stop.is_set():
@@ -298,25 +326,30 @@ class GenerationServingRoute(_RoutePublishMixin):
                 # ordered BEFORE TimeoutError: DeadlineExceeded IS a
                 # TimeoutError, but means the REQUEST is finished (shed
                 # mid-decode) — pop it, or the publisher spins forever
-                with self._stats_lock:
-                    self.deadline_errors += 1
+                self._m["deadline_errors"].inc()
                 out = None
             except RejectedError:
-                with self._stats_lock:       # engine shed it at intake
-                    self.shed += 1
+                self._m["shed"].inc()        # engine shed it at intake
                 out = None
             except TimeoutError:
                 continue                     # still decoding: wait more
             except Exception:
-                with self._stats_lock:
-                    self.errors += 1
+                self._m["errors"].inc()
                 out = None
             with self._inflight_lock:
                 self._inflight.pop(0)
             if out is not None:
+                t_p0 = time.monotonic()
                 if self._publish_safe(np.asarray(out, np.int32)):
-                    with self._stats_lock:
-                        self.served += 1
+                    self._m["served"].inc()
+                    # close the request's timeline: its trace is already
+                    # in the ring (finished at completion); the publish
+                    # span lands on the same object, so /traces/recent
+                    # shows consume→publish coverage
+                    tr = getattr(req, "trace", None)
+                    if tr is not None:
+                        tr.add_span("publish", t_p0, time.monotonic(),
+                                    route=self.route_id)
 
     def start(self) -> "GenerationServingRoute":
         self.engine.start()
@@ -335,3 +368,16 @@ class GenerationServingRoute(_RoutePublishMixin):
         if self._owns_engine:                # an injected engine is shared;
             self.engine.shutdown()           # its owner stops it
         self.sub.close()
+
+
+# Legacy counter attributes (``route.served``, ``route.publish_drops``,
+# ...) as read-only properties over the registry children — the existing
+# tests and dashboards keep their API while the registry owns the counts.
+for _counter_name in _ROUTE_COUNTERS:
+    for _route_cls in (ModelServingRoute, GenerationServingRoute):
+        setattr(_route_cls, _counter_name,
+                property(lambda self, _k=_counter_name:
+                         int(self._m[_k].value),
+                         doc=f"registry view: route_{_counter_name}_total"
+                             f"{{route=<id>}}"))
+del _counter_name, _route_cls

@@ -19,6 +19,7 @@ surface, so every publisher/subscriber/route runs unchanged over it.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import random
 import socket
@@ -28,8 +29,13 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..observability.metrics import default_registry
 from ..parallel.faults import NULL_INJECTOR
 from .pubsub import MessageBroker, register_broker_driver
+
+#: unique per-instance metric label suffixes (several clients/servers of
+#: the same host:port coexist in tests; counters must stay per-instance)
+_BROKER_SEQ = itertools.count()
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, op: bytes,
@@ -134,7 +140,7 @@ class TcpBrokerServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  max_queued_frames: int = 256,
-                 overflow_grace: float = 0.25):
+                 overflow_grace: float = 0.25, registry=None):
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self._subs: Dict[str, Set[socket.socket]] = defaultdict(set)
@@ -148,7 +154,18 @@ class TcpBrokerServer:
         # stalled or chronically slow peer exhausts it once and is
         # dropped, so it cannot head-of-line-block delivery indefinitely
         self.overflow_grace = float(overflow_grace)
-        self.disconnects = 0                 # stalled-subscriber evictions
+        # stalled-subscriber evictions: a registry counter (the legacy
+        # ``server.disconnects`` attribute is a property view)
+        reg = registry if registry is not None else default_registry()
+        self._m_disconnects = reg.counter(
+            "broker_server_disconnects_total",
+            "stalled-subscriber evictions performed",
+            ("server",)).labels(f"{self.host}:{self.port}"
+                                f"#s{next(_BROKER_SEQ)}")
+
+    @property
+    def disconnects(self) -> int:
+        return int(self._m_disconnects.value)
 
     @property
     def url(self) -> str:
@@ -220,8 +237,9 @@ class TcpBrokerServer:
                                 not out.send(frame,
                                              grace=self.overflow_grace):
                             # overflowed (stalled) or already gone: evict
-                            with self._lock:   # reader threads race here
-                                self.disconnects += 1
+                            # (counter child is internally locked —
+                            # racing reader threads stay exact)
+                            self._m_disconnects.inc()
                             self._evict(c)
         except (ConnectionError, struct.error, OSError):
             pass
@@ -268,7 +286,8 @@ class TcpMessageBroker(MessageBroker):
     def __init__(self, host: str, port: int, capacity: int = 1024,
                  reconnect: bool = True, max_reconnect_attempts: int = 20,
                  backoff_base: float = 0.05, backoff_cap: float = 2.0,
-                 publish_max_retries: int = 8, fault_injector=None):
+                 publish_max_retries: int = 8, fault_injector=None,
+                 registry=None):
         super().__init__(capacity)
         self.host, self.port = host, int(port)
         self.reconnect = bool(reconnect)
@@ -287,9 +306,19 @@ class TcpMessageBroker(MessageBroker):
         # reader thread only takes it in _reconnect, where delivery is
         # necessarily idle (the connection is down), so no deadlock.
         self._sub_lock = threading.Lock()
-        self._stats_lock = threading.Lock()
-        self.reconnects = 0          # successful re-connections
-        self.publish_retries = 0     # sends that had to wait/retry
+        # resilience counters on the registry (ISSUE 5): per-instance
+        # labels keep test assertions exact; the legacy attributes
+        # (``client.reconnects`` / ``client.publish_retries``) are
+        # property views
+        reg = registry if registry is not None else default_registry()
+        label = f"{host}:{port}#c{next(_BROKER_SEQ)}"
+        self._m_reconnects = reg.counter(
+            "broker_reconnects_total", "successful re-connections",
+            ("broker",)).labels(label)
+        self._m_publish_retries = reg.counter(
+            "broker_publish_retries_total",
+            "publishes that had to wait/retry through an outage",
+            ("broker",)).labels(label)
         # deterministic jitter stream: chaos runs stay reproducible
         self._jitter = random.Random(0xC0FFEE ^ self.port)
         self._conn_ok = threading.Event()   # cleared while reconnecting
@@ -312,8 +341,7 @@ class TcpMessageBroker(MessageBroker):
                 if self._closed.is_set() or not self.reconnect:
                     raise
                 attempts += 1
-                with self._stats_lock:
-                    self.publish_retries += 1
+                self._m_publish_retries.inc()
                 if attempts > self.publish_max_retries:
                     raise
                 backoff = min(self.backoff_base * (2 ** attempts),
@@ -416,11 +444,18 @@ class TcpMessageBroker(MessageBroker):
                            (1.0 + 0.25 * self._jitter.random()))
                 delay *= 2
                 continue
-            with self._stats_lock:
-                self.reconnects += 1
+            self._m_reconnects.inc()
             self._conn_ok.set()
             return True
         return False
+
+    @property
+    def reconnects(self) -> int:
+        return int(self._m_reconnects.value)
+
+    @property
+    def publish_retries(self) -> int:
+        return int(self._m_publish_retries.value)
 
     def close(self) -> None:
         self._closed.set()

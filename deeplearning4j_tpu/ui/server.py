@@ -19,6 +19,72 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import urlparse, parse_qs
 
+
+class JsonHTTPHandler(BaseHTTPRequestHandler):
+    """Shared HTTP plumbing for the in-repo servers (this training UI,
+    observability/telemetry.py): quiet request logging plus tiny typed
+    response senders. Subclasses implement ``do_GET``/``do_POST``."""
+
+    def log_message(self, *args):
+        pass
+
+    def _send(self, body: bytes, ctype: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, payload, code: int = 200) -> None:
+        self._send(json.dumps(payload).encode(), "application/json", code)
+
+    def _html(self, page: str) -> None:
+        self._send(page.encode(), "text/html")
+
+    def _js(self, script: str) -> None:
+        self._send(script.encode(), "application/javascript")
+
+    def _text(self, body: str, ctype: str = "text/plain") -> None:
+        self._send(body.encode(), ctype)
+
+
+class BackgroundHTTPServer:
+    """A ThreadingHTTPServer on a daemon thread with start()/stop() —
+    the lifecycle both the training UI and the telemetry endpoint need
+    (bind, resolve the ephemeral port, serve in the background, shut
+    down cleanly)."""
+
+    def __init__(self, handler_cls, host: str = "0.0.0.0", port: int = 0):
+        self.handler_cls = handler_cls
+        self.host = host
+        self.port = int(port)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "BackgroundHTTPServer":
+        if self._server is None:
+            self._server = ThreadingHTTPServer((self.host, self.port),
+                                               self.handler_cls)
+            self.port = self._server.server_address[1]
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.host == "0.0.0.0" else self.host
+        return f"http://{host}:{self.port}"
+
 _CHART_JS = """
 function draw(svgId, xs, ys, cls) {
   const svg = document.getElementById(svgId);
@@ -312,36 +378,9 @@ refresh(); setInterval(refresh, 5000);
 </script></body></html>"""
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHTTPHandler):
     storage = None
     tsne_data = None          # {"labels": [...], "coords": [[x, y], ...]}
-
-    def log_message(self, *args):
-        pass
-
-    def _json(self, payload):
-        body = json.dumps(payload).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _html(self, page: str):
-        body = page.encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "text/html")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _js(self, script: str):
-        body = script.encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/javascript")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
 
     def _latest_conv_record(self, session: str = ""):
         """Most recent 'convolutional' record — in ``session`` when given
@@ -641,8 +680,7 @@ class UIServer:
 
     def __init__(self, port: int = 9000):
         self.port = port
-        self._server: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[BackgroundHTTPServer] = None
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -653,17 +691,14 @@ class UIServer:
     def attach(self, storage):
         _Handler.storage = storage
         if self._server is None:
-            self._server = ThreadingHTTPServer(("0.0.0.0", self.port),
-                                               _Handler)
-            self.port = self._server.server_address[1]
-            self._thread = threading.Thread(
-                target=self._server.serve_forever, daemon=True)
-            self._thread.start()
+            self._server = BackgroundHTTPServer(_Handler,
+                                                port=self.port).start()
+            self.port = self._server.port
         return self
 
     def stop(self):
         if self._server is not None:
-            self._server.shutdown()
+            self._server.stop()
             self._server = None
         UIServer._instance = None
 

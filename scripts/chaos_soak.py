@@ -1,10 +1,11 @@
 #!/usr/bin/env python
-"""Bounded chaos soak for the serving resilience layer (ISSUE 3).
+"""Bounded chaos soak for the serving resilience layer (ISSUE 3) with
+the observability acceptance checks layered on (ISSUE 5).
 
 Runs the slot generation engine under a RANDOMIZED-BUT-SEEDED fault
 schedule (crashes and wedges injected at engine.step via
 parallel/faults.FaultInjector, recovered by an EngineSupervisor) and
-asserts the two invariants the resilience layer promises:
+asserts the invariants the resilience + telemetry layers promise:
 
 1. zero stranded requests — every submitted request terminates
    (completed / failed-with-cause / deadline / shed), none left blocked
@@ -12,10 +13,21 @@ asserts the two invariants the resilience layer promises:
 2. zero new compiles in the post-restart steady state — supervisor
    restarts rebuild the engine around the SAME TransformerDecoder, so a
    post-recovery request wave re-lowers nothing
-   (analysis/compile_audit.CompileAudit enforces it);
+   (analysis/compile_audit.CompileAudit enforces it) — telemetry on
+   changes nothing: instrumentation compiles nothing;
+3. ≤ 1 host readback per decode block with telemetry enabled
+   (analysis TransferAudit over the ops.transfer.device_fetch seam);
+4. exactly ONE trace per request, takeover runs included — a recovered
+   request continues its original timeline (with `takeover` spans), it
+   never forks a second trace — and every completed request's trace is
+   finished with full span coverage;
 
 plus the correctness bar: every COMPLETED request's tokens equal the
-uninterrupted clean-engine run, token for token (greedy).
+uninterrupted clean-engine run, token for token (greedy). The summary
+also reports per-request latency p50/p99 (through the shared
+observability Histogram) and the telemetry-on vs telemetry-off decode
+throughput A/B (the ≤5% overhead budget); ``--json`` embeds the final
+metrics-registry snapshot.
 
     python scripts/chaos_soak.py --seed 7 --requests 24 --crashes 3
     python scripts/chaos_soak.py --seed 7 --json
@@ -44,7 +56,7 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
              max_new: int = 6, crashes: int = 2, hangs: int = 1,
              vocab: int = 12, supervisor_timeout: float = 2.0,
              hang_seconds: float = None, wait_s: float = 180.0,
-             steady_wave: int = 4) -> dict:
+             steady_wave: int = 4, overhead_ab: bool = True) -> dict:
     """One soak iteration; returns a summary dict (see keys below).
 
     Prompt lengths and generation budgets are drawn so every prefill —
@@ -54,11 +66,14 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
     zero-new-compiles assertion exact rather than probabilistic."""
     import numpy as np
 
-    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.analysis.compile_audit import (CompileAudit,
+                                                           TransferAudit)
     from deeplearning4j_tpu.models import transformer_lm_conf
     from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
                                                       TransformerDecoder)
     from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.metrics import (Histogram,
+                                                          default_registry)
     from deeplearning4j_tpu.parallel.failures import EngineSupervisor
     from deeplearning4j_tpu.parallel.faults import FaultInjector
 
@@ -79,13 +94,14 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
 
     summary = {"seed": seed, "requests": n_requests, "crashes": crashes,
                "hangs": hangs}
-    with CompileAudit() as audit:
+    with CompileAudit() as audit, TransferAudit() as transfers:
         # --- clean reference run: the uninterrupted ground truth, and
         # the compile warmup (same decoder => same jitted programs)
         clean = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec)
         clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
         clean.run_until_drained()
         expected = [r.result(1) for r in clean_reqs]
+        clean_blocks = clean.stats()["decode_blocks"]
 
         # --- seeded fault schedule against the decode-step hit counter.
         # Total clean steps ~= sum(gens)/num_slots; crashes land in the
@@ -141,6 +157,40 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
                 mismatches += 1
         else:
             failed += 1
+
+    # --- observability acceptance (ISSUE 5) -----------------------------
+    # (a) ≤ 1 host readback per decode block, telemetry enabled: every
+    # deliberate device→host crossing rides the audited device_fetch seam
+    blocks = clean_blocks + stats["decode_blocks"]
+    decode_readbacks = transfers.fetches("engine.decode")
+    # (b) exactly ONE finished trace per request, takeover runs included,
+    # with full span coverage on completed requests — a recovered request
+    # continues its timeline (takeover spans), it never forks a new trace
+    lat_h = Histogram("soak_request_latency_seconds", sample_limit=None)
+    trace_problems = 0
+    takeover_spans = 0
+    seen_trace_ids = set()
+    for r in list(reqs) + list(wave) + list(clean_reqs):
+        tr = r.trace
+        if tr is None or tr.trace_id in seen_trace_ids:
+            trace_problems += 1
+            continue
+        seen_trace_ids.add(tr.trace_id)
+        if not tr.finished:
+            trace_problems += 1
+            continue
+        names = tr.span_names()
+        takeover_spans += names.count("takeover")
+        if r.state == r.DONE:
+            if not {"submit", "prefill"} <= set(names):
+                trace_problems += 1
+            lat_h.observe(tr.duration)
+    # (c) the telemetry-on decode throughput must stay within 5% of the
+    # telemetry-off baseline (tracing/histograms disabled; counters are
+    # the stats machinery either way)
+    ab = _overhead_ab(SlotGenerationEngine, net, dec, prompts, gens,
+                      num_slots) if overhead_ab else None
+
     summary.update({
         "stranded": len(stranded),
         "mismatches": mismatches,
@@ -150,8 +200,59 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
         "recovered_requests": stats["recovered_requests"],
         "steady_new_compiles": steady_delta,
         "injector": inj.counters(),
+        "decode_blocks": blocks,
+        "decode_readbacks": decode_readbacks,
+        "readbacks_per_block": round(decode_readbacks / blocks, 4)
+        if blocks else None,
+        "trace_problems": trace_problems,
+        "takeover_spans": takeover_spans,
+        "request_latency_ms": {
+            "p50": round((lat_h.percentile(50) or 0.0) * 1e3, 3),
+            "p99": round((lat_h.percentile(99) or 0.0) * 1e3, 3),
+            "n": lat_h.count},
+        "metrics": default_registry().snapshot(),
     })
+    if ab is not None:
+        summary.update(ab)
     return summary
+
+
+def _overhead_ab(SlotGenerationEngine, net, dec, prompts, gens,
+                 num_slots, reps: int = 3) -> dict:
+    """Interleaved telemetry-on/off drain runs over the shared decoder
+    (no faults): medians of emitted tok/s both ways. Telemetry-off
+    disables tracing + block histograms; registry counters stay (they
+    ARE the stats machinery). Interleaving + medians keep scheduler
+    noise out of the comparison."""
+    import time as _t
+
+    import numpy as np
+
+    def drain(tracing: bool) -> float:
+        eng = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec,
+                                   tracing=tracing)
+        for p, g in zip(prompts, gens):
+            eng.submit(p, g)
+        t0 = _t.perf_counter()
+        eng.run_until_drained()
+        return eng.emitted_tokens / (_t.perf_counter() - t0)
+
+    drain(True)                                  # warm (all compiled)
+    on, off = [], []
+    for _ in range(reps):
+        on.append(drain(True))
+        off.append(drain(False))
+    # best-of: scheduler noise only ever slows a run, so each arm's max
+    # is its least-noisy sample (same policy as test_observability's A/B)
+    on_best, off_best = float(max(on)), float(max(off))
+    return {
+        "telemetry_on_tok_s": round(on_best, 1),
+        "telemetry_off_tok_s": round(off_best, 1),
+        "telemetry_on_tok_s_median": round(float(np.median(on)), 1),
+        "telemetry_off_tok_s_median": round(float(np.median(off)), 1),
+        "telemetry_overhead_pct": round(
+            100.0 * (1.0 - on_best / off_best), 2) if off_best else None,
+    }
 
 
 def main(argv=None) -> int:
@@ -165,7 +266,15 @@ def main(argv=None) -> int:
     ap.add_argument("--supervisor-timeout", type=float, default=2.0)
     ap.add_argument("--iterations", type=int, default=1,
                     help="soak rounds; seed advances per round")
-    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="full JSON summary incl. the final metrics-"
+                         "registry snapshot")
+    ap.add_argument("--no-overhead-ab", action="store_true",
+                    help="skip the telemetry-on/off throughput A/B")
+    ap.add_argument("--strict-overhead", action="store_true",
+                    help="fail the round if telemetry overhead exceeds "
+                         "5%% (advisory by default: the tiny-model soak "
+                         "shape is host-bound and scheduler-noisy)")
     args = ap.parse_args(argv)
 
     ok = True
@@ -173,19 +282,29 @@ def main(argv=None) -> int:
         s = run_soak(seed=args.seed + i, n_requests=args.requests,
                      num_slots=args.slots, max_new=args.max_new,
                      crashes=args.crashes, hangs=args.hangs,
-                     supervisor_timeout=args.supervisor_timeout)
+                     supervisor_timeout=args.supervisor_timeout,
+                     overhead_ab=not args.no_overhead_ab)
+        over_budget = (s.get("telemetry_overhead_pct") or 0.0) > 5.0
         bad = s["stranded"] or s["mismatches"] or s["failed"] or \
-            s["steady_new_compiles"]
+            s["steady_new_compiles"] or s["trace_problems"] or \
+            (s["readbacks_per_block"] or 0.0) > 1.0 or \
+            (args.strict_overhead and over_budget)
         ok = ok and not bad
         if args.json:
             print(json.dumps(s, default=str))
         else:
+            ab = "" if "telemetry_overhead_pct" not in s else \
+                (f" telemetry_overhead={s['telemetry_overhead_pct']}%"
+                 f"{' (OVER BUDGET)' if over_budget else ''}")
             print(f"round {i}: seed={s['seed']} restarts={s['restarts']} "
                   f"recovered={s['recovered_requests']} "
                   f"completed={s['completed']}/{s['requests']} "
                   f"stranded={s['stranded']} mismatches={s['mismatches']} "
-                  f"steady_new_compiles={s['steady_new_compiles'] or '{}'}"
-                  f" -> {'FAIL' if bad else 'ok'}")
+                  f"steady_new_compiles={s['steady_new_compiles'] or '{}'} "
+                  f"traces={'ok' if not s['trace_problems'] else 'FAIL'}"
+                  f"(+{s['takeover_spans']} takeover) "
+                  f"readbacks/block={s['readbacks_per_block']}"
+                  f"{ab} -> {'FAIL' if bad else 'ok'}")
     return 0 if ok else 1
 
 

@@ -65,6 +65,8 @@ def block_sweep() -> int:
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.ops.transfer import device_fetch, fetch_counts
 
+    from deeplearning4j_tpu.observability.metrics import percentiles
+
     ks = []
     for tok in os.environ.get("GEN_BLOCKS", "1,4,8").split(","):
         k = int(tok)
@@ -128,12 +130,15 @@ def block_sweep() -> int:
             lats.extend(ls)
             rps.append(rp)
         med = float(np.median(vals))
+        # p50/p99 via the shared Histogram implementation
+        # (observability/metrics.py) — not a private np.percentile copy
+        pct = percentiles(lats, (50, 99))
         table[str(k)] = {
             "decode_tok_s": round(med, 1),
             "spread_pct": round(
                 100.0 * (max(vals) - min(vals)) / med, 2) if med else 0.0,
-            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
-            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "p50_ms": round(pct["p50"] * 1e3, 3),
+            "p99_ms": round(pct["p99"] * 1e3, 3),
             "readbacks_per_step": round(float(np.mean(rps)), 4),
         }
     k1 = table.get("1", {}).get("decode_tok_s", 0.0)
@@ -158,6 +163,7 @@ def main() -> int:
                                            TransformerDecoder,
                                            transformer_lm_conf)
     from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.metrics import percentiles
 
     t_max = max(PROMPTS) + max(TOKENS) + 1
     conf = transformer_lm_conf(vocab_size=VOCAB, d_model=DMODEL,
@@ -216,16 +222,15 @@ def main() -> int:
                     lats.extend(lat)
                 med = float(np.median(vals))
                 spread = 100.0 * (max(vals) - min(vals)) / med if med else 0
+                pct = percentiles(lats, (50, 99))   # shared Histogram math
                 print(json.dumps({
                     "point": {"batch": b, "prompt_t": tp, "gen_t": gen_t},
                     "prefill_tok_s": round(pre_med, 1),
                     "prefill_spread_pct": pre_spread,
                     "decode_tok_s": round(med, 1),
                     "decode_spread_pct": round(spread, 2),
-                    "decode_p50_ms": round(
-                        float(np.percentile(lats, 50)) * 1e3, 3),
-                    "decode_p99_ms": round(
-                        float(np.percentile(lats, 99)) * 1e3, 3),
+                    "decode_p50_ms": round(pct["p50"] * 1e3, 3),
+                    "decode_p99_ms": round(pct["p99"] * 1e3, 3),
                     "nocache_tok_s": round(nc_med, 1),
                     "nocache_spread_pct": nc_spread,
                     "decode_vs_recompute": round(med / nc_med, 2)

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Fetch a running engine's telemetry snapshot and pretty-print it.
+
+Talks to an observability TelemetryServer (``/snapshot`` by default;
+``--metrics`` for the raw Prometheus text, ``--traces [N]`` for recent
+request timelines) over plain HTTP — no in-process imports, so it works
+against any serving process on any host:
+
+    python scripts/telemetry_dump.py http://127.0.0.1:9100
+    python scripts/telemetry_dump.py http://127.0.0.1:9100 --json
+    python scripts/telemetry_dump.py http://host:9100 --traces 5
+    python scripts/telemetry_dump.py http://host:9100 --metrics
+
+The pretty printer groups the nested registry snapshot by family:
+counters/gauges one line per labeled child, histograms as
+count/sum/p50/p99, then the transfer deltas, compile audit (when the
+server runs one), and every registered stats source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def fetch(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        body = resp.read().decode()
+        if resp.headers.get_content_type() == "application/json":
+            return json.loads(body)
+        return body
+
+
+def _fmt_hist(h: dict) -> str:
+    p50 = h.get("p50")
+    p99 = h.get("p99")
+    ms = (lambda v: "-" if v is None else f"{v * 1e3:.3f}ms")
+    return (f"count={h.get('count')} sum={h.get('sum'):.6g}s "
+            f"p50={ms(p50)} p99={ms(p99)}")
+
+
+def pretty(snapshot: dict, out=sys.stdout) -> None:
+    w = out.write
+    w(f"uptime: {snapshot.get('uptime_s', '?')}s\n")
+    metrics = snapshot.get("metrics", {})
+    for name in sorted(metrics):
+        fam = metrics[name]
+        w(f"\n{name}  [{fam.get('type')}]")
+        if fam.get("help"):
+            w(f"  — {fam['help']}")
+        w("\n")
+        for label, value in fam.get("values", {}).items():
+            tag = f"{{{label}}}" if label else ""
+            if isinstance(value, dict):          # histogram child
+                w(f"  {tag:<40} {_fmt_hist(value)}\n")
+            else:
+                w(f"  {tag:<40} {value}\n")
+    transfers = snapshot.get("transfers")
+    if transfers:
+        w("\ndevice→host readbacks since server start:\n")
+        for tag, n in transfers.items():
+            w(f"  {tag:<40} {n}\n")
+    audit = snapshot.get("compile_audit")
+    if audit:
+        w(f"\ncompile audit: total={audit.get('total_compiles')} "
+          f"duplicate_signature="
+          f"{audit.get('duplicate_signature_compiles')}\n")
+        new = audit.get("new_since_start")
+        w(f"  new since server start: {new if new else '{} (steady)'}\n")
+    traces = snapshot.get("traces")
+    if traces:
+        w(f"\ntraces: {traces.get('completed')} completed "
+          f"({traces.get('ring')} in ring)\n")
+    for name, src in (snapshot.get("sources") or {}).items():
+        w(f"\nsource {name}:\n")
+        if isinstance(src, dict):
+            for k in sorted(src):
+                w(f"  {k:<40} {src[k]}\n")
+        else:
+            w(f"  {src}\n")
+
+
+def pretty_traces(doc: dict, out=sys.stdout) -> None:
+    w = out.write
+    w(f"{doc.get('count', 0)} trace(s) "
+      f"(of {doc.get('total_completed', '?')} completed)\n")
+    for t in doc.get("traces", []):
+        w(f"\n{t['request_id']}  status={t.get('status')} "
+          f"duration={t.get('duration_ms')}ms"
+          f"{'  dropped=' + str(t['dropped_spans']) if t.get('dropped_spans') else ''}\n")
+        for s in t.get("spans", []):
+            attrs = "" if not s.get("attrs") else \
+                "  " + json.dumps(s["attrs"], default=str)
+            w(f"  {s['t0']:>10.4f}s  {s['name']:<14} "
+              f"{s['duration_ms']:>9.3f}ms{attrs}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", nargs="?", default="http://127.0.0.1:9100",
+                    help="TelemetryServer base URL "
+                         "(default http://127.0.0.1:9100)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw /snapshot JSON")
+    ap.add_argument("--metrics", action="store_true",
+                    help="print the raw Prometheus /metrics text")
+    ap.add_argument("--traces", type=int, nargs="?", const=10, default=None,
+                    metavar="N", help="print the last N request traces")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    base = args.url.rstrip("/")
+
+    try:
+        if args.metrics:
+            sys.stdout.write(fetch(f"{base}/metrics", args.timeout))
+            return 0
+        if args.traces is not None:
+            doc = fetch(f"{base}/traces/recent?n={args.traces}",
+                        args.timeout)
+            if args.json:
+                print(json.dumps(doc, indent=1, default=str))
+            else:
+                pretty_traces(doc)
+            return 0
+        snap = fetch(f"{base}/snapshot", args.timeout)
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        print(f"error: cannot reach {base}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(snap, indent=1, default=str))
+    else:
+        pretty(snap)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

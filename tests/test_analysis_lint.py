@@ -322,6 +322,77 @@ class TestHostLoopSyncRule:
         assert _rules(out) == ["GL001"]
 
 
+class TestObservabilityRule:
+    """GL008: metric/trace recording inside jitted/traced code — under
+    trace it runs once per COMPILE (never per step) and host-syncs any
+    traced value it touches; instrumentation must stay host-side."""
+
+    def test_counter_inc_inside_jit_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def step(x, m):
+                m.inc()
+                return x + 1
+        """, rules=["GL008"])
+        assert _rules(out) == ["GL008"]
+        assert ".inc()" in out[0].message
+
+    def test_histogram_observe_in_scan_body_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            def body(carry, t, hist):
+                hist.observe(t)
+                return carry, t
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+        """, rules=["GL008"])
+        assert _rules(out) == ["GL008"]
+
+    def test_span_record_in_traced_marker_method_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            class Layer:
+                # graftlint: traced
+                def decode(self, params, x):
+                    self._trace.add_span("decode", 0.0, 1.0)
+                    return x
+        """, rules=["GL008"])
+        assert _rules(out) == ["GL008"]
+
+    def test_hinted_method_needs_observability_receiver(self, tmp_path):
+        """Generic method names (.set()) flag only on receivers that name
+        an observability object — threading.Event().set() in traced code
+        is someone else's problem, not GL008's."""
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x, gauge, ev):
+                gauge.set(1.0)
+                ev.set()
+                return x
+        """, rules=["GL008"])
+        assert len(out) == 1 and "gauge.set" in out[0].snippet
+
+    def test_recording_outside_jit_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            def serve(m, hist, trace):
+                m.inc()
+                hist.observe(0.5)
+                trace.add_span("decode_block", 0.0, 0.5)
+        """, rules=["GL008"])
+        assert out == []
+
+    def test_inline_disable_suppresses_gl008(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+            @jax.jit
+            def f(x, m):
+                m.inc()   # graftlint: disable=GL008
+                return x
+        """, rules=["GL008"])
+        assert out == []
+
+
 class TestSuppressionAndBaseline:
     def test_inline_disable_suppresses(self, tmp_path):
         out = _lint_src(tmp_path, """
