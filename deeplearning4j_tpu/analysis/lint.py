@@ -50,7 +50,27 @@ RULES: Dict[str, str] = {
              "a loop in a hot module",
     "GL008": "metric/trace recording inside jitted/traced code "
              "(instrumentation must stay host-side)",
+    "GL009": "lock-order inversion: cycle in the cross-module "
+             "lock-acquisition graph (potential deadlock)",
+    "GL010": "blocking call (socket/join/sleep/device/queue/HTTP) "
+             "executed while holding a lock",
+    "GL011": "condition-wait discipline: wait outside a predicate "
+             "re-check loop, or wait/notify without the lock",
+    "GL012": "non-daemon thread started without a tracked join path",
+    "GL013": "PartitionSpec/mesh-axis inconsistency (unknown axis or "
+             "spec rank vs known parameter rank)",
+    "GL014": "host sync or metric/trace recording inside a "
+             "shard_map/pjit region",
 }
+
+#: rules decided per module (cacheable per file); the rest (GL009-GL012)
+#: need the whole-package call graph
+PER_FILE_RULES = frozenset({"GL001", "GL002", "GL003", "GL004", "GL005",
+                            "GL006", "GL007", "GL008", "GL013", "GL014"})
+PACKAGE_RULES = frozenset({"GL009", "GL010", "GL011", "GL012"})
+
+#: bump to invalidate cached per-file results when any pass changes
+LINT_VERSION = 11
 
 #: wrappers whose function arguments are traced when called
 _TRACE_WRAPPERS = {
@@ -111,9 +131,39 @@ class Finding:
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(**d)
+
     def __str__(self) -> str:
         return (f"{self.path}:{self.line}: {self.rule} [{self.func}] "
                 f"{self.message}\n    {self.snippet}")
+
+
+def scan_suppressions(source_lines: Sequence[str]) -> Dict[str, List[str]]:
+    """{line: [rules]} from ``# graftlint: disable=...`` comments. A
+    TRAILING comment suppresses its own line only; a standalone comment
+    line suppresses the line below. (A trailing comment must NOT spill
+    onto the next line — a new violation written directly under an
+    existing suppression has to trip the --fail-on-new gate.) The ONE
+    definition of this contract: the per-file passes (via ModuleLint)
+    and the package passes (via callgraph.ModuleFacts) both use it.
+    Keys are strings so the shape is identical fresh and after a JSON
+    cache round-trip."""
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source_lines, start=1):
+        if "graftlint:" not in text:
+            continue
+        frag = text.split("graftlint:", 1)[1]
+        if "disable=" not in frag:
+            continue
+        rules = {r.strip() for r in
+                 frag.split("disable=", 1)[1].split("#")[0].split(",")
+                 if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if text.strip().startswith("#"):      # standalone comment line
+            out.setdefault(i + 1, set()).update(rules)
+    return {str(k): sorted(v) for k, v in out.items()}
 
 
 def _dotted_tail(node: ast.AST) -> str:
@@ -167,12 +217,14 @@ class _ParentMap(ast.NodeVisitor):
 
 
 class ModuleLint:
-    """All passes over one parsed module."""
+    """All per-module passes over one parsed module."""
 
-    def __init__(self, abspath: str, relpath: str, source: str):
+    def __init__(self, abspath: str, relpath: str, source: str,
+                 tree: Optional[ast.Module] = None):
         self.relpath = relpath
         self.source_lines = source.splitlines()
-        self.tree = ast.parse(source, filename=abspath)
+        self.tree = tree if tree is not None \
+            else ast.parse(source, filename=abspath)
         pm = _ParentMap()
         pm.visit(self.tree)
         self.parents = pm.parents
@@ -181,26 +233,10 @@ class ModuleLint:
 
     # ------------------------------------------------------------ comments
     def _scan_suppressions(self) -> Dict[int, Set[str]]:
-        """{line_no: {rule, ...}} from '# graftlint: disable=...' comments.
-        A TRAILING comment suppresses its own line only; a standalone
-        comment line suppresses the line below. (A trailing comment must
-        NOT spill onto the next line — a new violation written directly
-        under an existing suppression has to trip the --fail-on-new
-        gate.)"""
-        out: Dict[int, Set[str]] = {}
-        for i, text in enumerate(self.source_lines, start=1):
-            if "graftlint:" not in text:
-                continue
-            frag = text.split("graftlint:", 1)[1]
-            if "disable=" not in frag:
-                continue
-            rules = {r.strip() for r in
-                     frag.split("disable=", 1)[1].split("#")[0].split(",")
-                     if r.strip()}
-            out.setdefault(i, set()).update(rules)
-            if text.strip().startswith("#"):      # standalone comment line
-                out.setdefault(i + 1, set()).update(rules)
-        return out
+        """Delegates to the module-level :func:`scan_suppressions` (the
+        one definition of the disable-comment contract)."""
+        return {int(k): set(v)
+                for k, v in scan_suppressions(self.source_lines).items()}
 
     def _scan_traced_markers(self) -> Set[int]:
         """Lines carrying '# graftlint: traced': a trailing marker tags the
@@ -225,7 +261,10 @@ class ModuleLint:
 
     def _emit(self, out: List[Finding], rule: str, node: ast.AST,
               func: str, message: str) -> None:
-        line = getattr(node, "lineno", 0)
+        self._emit_at(out, rule, getattr(node, "lineno", 0), func, message)
+
+    def _emit_at(self, out: List[Finding], rule: str, line: int,
+                 func: str, message: str) -> None:
         if self._suppressed(rule, line):
             return
         out.append(Finding(rule=rule, path=self.relpath, line=line,
@@ -647,31 +686,165 @@ class ModuleLint:
         self._check_jit_sites(out, enabled)
         self._check_lock_discipline(out, enabled)
         self._check_host_loop_syncs(out, enabled, jit_ids)
+        if enabled & {"GL013", "GL014"}:
+            from .sharding import run_sharding_pass
+            run_sharding_pass(
+                self.tree, sorted(enabled & {"GL013", "GL014"}),
+                lambda rule, line, func, message:
+                self._emit_at(out, rule, line, func, message))
         return out
 
 
-class LintRunner:
-    """Walk .py files under roots, lint each, aggregate findings."""
+class LintCache:
+    """Per-file result cache: mtime+size fast path, content-hash slow
+    path, keyed by repo-relative path and invalidated by LINT_VERSION.
+    Stores the per-file findings for ALL per-file rules (rule filters
+    apply at collection time, so one cache serves every ``--select``)
+    plus the module's callgraph facts for the package pass."""
 
-    def __init__(self, repo_root: str, rules: Optional[Iterable[str]] = None):
+    def __init__(self, path: str):
+        self.path = path
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._data: dict = {}
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("version") == LINT_VERSION:
+                self._data = data.get("files", {})
+        except (OSError, ValueError):
+            self._data = {}
+
+    @staticmethod
+    def _digest(src: str) -> str:
+        return hashlib.sha1(src.encode("utf-8")).hexdigest()
+
+    def get(self, rel: str, mtime: float, size: int,
+            src: str) -> Optional[dict]:
+        entry = self._data.get(rel)
+        if entry is None:
+            self.misses += 1
+            return None
+        if not (entry["mtime"] == mtime and entry["size"] == size):
+            if entry["sha1"] != self._digest(src):
+                self.misses += 1
+                return None
+            # content unchanged, file merely touched: refresh the
+            # stamps so the NEXT run takes the mtime fast path again
+            entry["mtime"], entry["size"] = mtime, size
+            self._dirty = True
+        self.hits += 1
+        return entry
+
+    def put(self, rel: str, mtime: float, size: int, src: str,
+            findings: Sequence["Finding"], facts) -> None:
+        self._data[rel] = {
+            "mtime": mtime, "size": size, "sha1": self._digest(src),
+            "findings": [f.to_dict() for f in findings],
+            "facts": facts.to_dict(),
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": LINT_VERSION, "files": self._data},
+                          f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass                        # cache is best-effort
+
+
+class LintRunner:
+    """Walk .py files under roots, run the per-module passes on each,
+    then the whole-package concurrency pass over the aggregated call
+    graph, and return every finding."""
+
+    def __init__(self, repo_root: str, rules: Optional[Iterable[str]] = None,
+                 cache: Optional[LintCache] = None,
+                 force_facts: bool = False):
         self.repo_root = os.path.abspath(repo_root)
         self.enabled = set(rules) if rules else set(RULES)
         self.errors: List[str] = []   # unparseable files (reported, not fatal)
+        self.cache = cache
+        # collect callgraph facts even when no package rule is enabled
+        # (collect_package_facts' contract)
+        self.force_facts = bool(force_facts)
+        self._facts: Dict[str, object] = {}
+        self._sources: Dict[str, List[str]] = {}
 
     def lint_file(self, path: str) -> List[Finding]:
+        from .callgraph import ModuleFacts, extract_module_facts
         rel = os.path.relpath(os.path.abspath(path),
                               self.repo_root).replace(os.sep, "/")
         try:
+            st = os.stat(path)
             with open(path, "r", encoding="utf-8") as f:
                 src = f.read()
-            module = ModuleLint(path, rel, src)
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        except (UnicodeDecodeError, OSError) as e:
             self.errors.append(f"{rel}: {e}")
             return []
-        return module.run(self.enabled)
+        entry = None
+        if self.cache is not None:
+            entry = self.cache.get(rel, st.st_mtime, st.st_size, src)
+        if entry is not None:
+            found = [Finding.from_dict(d) for d in entry["findings"]]
+            facts = ModuleFacts.from_dict(entry["facts"])
+        else:
+            try:
+                tree = ast.parse(src, filename=path)
+                module = ModuleLint(path, rel, src, tree=tree)
+            except SyntaxError as e:
+                self.errors.append(f"{rel}: {e}")
+                return []
+            # with a cache, run EVERY per-file pass so one entry serves
+            # any later --select; without one, run only what was asked
+            # (and skip facts extraction unless a package rule needs it)
+            if self.cache is not None:
+                found = module.run(set(PER_FILE_RULES))
+                facts = extract_module_facts(rel, tree, src.splitlines())
+                self.cache.put(rel, st.st_mtime, st.st_size, src,
+                               found, facts)
+            else:
+                found = module.run(self.enabled & PER_FILE_RULES)
+                facts = None
+                if self.force_facts or self.enabled & PACKAGE_RULES:
+                    facts = extract_module_facts(rel, tree,
+                                                 src.splitlines())
+        if facts is not None:
+            self._facts[rel] = facts
+        self._sources[rel] = src.splitlines()
+        return [f for f in found if f.rule in self.enabled]
+
+    def _package_pass(self, findings: List[Finding]) -> None:
+        pkg_rules = self.enabled & PACKAGE_RULES
+        if not pkg_rules or not self._facts:
+            return
+        from .concurrency import ConcurrencyAnalysis
+        analysis = ConcurrencyAnalysis(self._facts)
+
+        def emit(rule: str, module: str, line: int, func: str,
+                 message: str) -> None:
+            mf = self._facts[module]
+            if mf.suppressed_at(rule, line):
+                return
+            lines = self._sources.get(module, [])
+            snippet = lines[line - 1].strip() \
+                if 1 <= line <= len(lines) else ""
+            findings.append(Finding(rule=rule, path=module, line=line,
+                                    func=func, message=message,
+                                    snippet=snippet))
+
+        analysis.findings(pkg_rules, emit)
 
     def lint(self, paths: Sequence[str]) -> List[Finding]:
         findings: List[Finding] = []
+        self._facts.clear()
+        self._sources.clear()
         for p in paths:
             if os.path.isdir(p):
                 for dirpath, dirnames, filenames in os.walk(p):
@@ -687,13 +860,37 @@ class LintRunner:
                 # a stale/misspelled path must not silently shrink the
                 # gate's coverage — surface it like a parse error
                 self.errors.append(f"{p}: not a directory or .py file")
-        findings.sort(key=lambda f: (f.path, f.line, f.rule))
-        return findings
+        self._package_pass(findings)
+        if self.cache is not None:
+            self.cache.save()
+        # de-duplicate identical (rule, site) findings: an edge can be
+        # witnessed through several call paths; the gate needs one
+        seen: Set[Tuple[str, str, int, str]] = set()
+        unique: List[Finding] = []
+        for f in findings:
+            k = (f.rule, f.path, f.line, f.message)
+            if k not in seen:
+                seen.add(k)
+                unique.append(f)
+        unique.sort(key=lambda f: (f.path, f.line, f.rule))
+        return unique
 
 
 def lint_paths(paths: Sequence[str], repo_root: str,
                rules: Optional[Iterable[str]] = None) -> List[Finding]:
     return LintRunner(repo_root, rules).lint(paths)
+
+
+def collect_package_facts(paths: Sequence[str], repo_root: str,
+                          cache: Optional[LintCache] = None) -> Dict:
+    """Extract callgraph facts for every module under ``paths`` without
+    running the package rules — the static side of
+    ``lock_audit.LockAudit.cross_check`` and of the chaos soak's
+    ``--lock-audit`` gate."""
+    runner = LintRunner(repo_root, rules=["GL001"], cache=cache,
+                        force_facts=True)
+    runner.lint(paths)
+    return dict(runner._facts)
 
 
 # ------------------------------------------------------------- baseline

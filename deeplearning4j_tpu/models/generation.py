@@ -497,7 +497,9 @@ class TransformerDecoder:
 
         if int(block_size) <= 1:
             # legacy per-step loop: dispatch, read [B] ids, repeat — the
-            # K=1 baseline of the block-sweep A/B (GL007-baselined)
+            # deliberate K=1 baseline of the block-sweep A/B; the
+            # per-step sync IS the measured quantity, so GL007's fix
+            # (fuse into blocks) is the pipelined path below, not here
             nxt_host = np.asarray(nxt)
             for step in range(int(max_new_tokens)):
                 consume(nxt_host[:, None])
@@ -507,7 +509,7 @@ class TransformerDecoder:
                 nxt, _, caches = self.decode_step(
                     caches, nxt_host, positions, temps,
                     key=jax.random.fold_in(key, step + 1))
-                nxt_host = np.asarray(nxt)
+                nxt_host = np.asarray(nxt)   # graftlint: disable=GL007
             return [np.concatenate([p, np.asarray(g, np.int32)])
                     for p, g in zip(prompts, gen)]
 

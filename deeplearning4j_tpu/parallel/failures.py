@@ -187,8 +187,15 @@ class EngineSupervisor(HeartbeatMonitor):
         with self._sup_lock:
             self._stopped = True
         HeartbeatMonitor.stop(self)
+        # read the final engine ref under the lock, shut it down OUTSIDE
+        # it (GL010): shutdown() joins the serve loop, and a crashing
+        # worker's _on_crash callback needs _sup_lock — joining while
+        # holding it stalls both sides until the join times out. The
+        # _stopped latch makes the ref final: no takeover can swap the
+        # engine after it.
         with self._sup_lock:
-            self._engine.shutdown()
+            eng = self._engine
+        eng.shutdown()
 
     # ---------------------------------------------------------- takeover
     def _on_crash(self, engine, exc: BaseException) -> None:

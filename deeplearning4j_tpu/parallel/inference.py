@@ -272,11 +272,15 @@ class ParallelInference:
         if self._telemetry is not None:
             self._telemetry.stop()
             self._telemetry = None
+        # detach under the lock, stop OUTSIDE it (GL010): stop/shutdown
+        # join the serve loop, and a generate() caller blocked on
+        # _gen_lock would otherwise wait out the join too. _shutdown is
+        # already latched, so _ensure_gen_engine cannot resurrect one.
         with self._gen_lock:
-            if self._gen_supervisor is not None:
-                self._gen_supervisor.stop()
-                self._gen_supervisor = None
-                self._gen_engine = None
-            elif self._gen_engine is not None:
-                self._gen_engine.shutdown()
-                self._gen_engine = None
+            sup, eng = self._gen_supervisor, self._gen_engine
+            self._gen_supervisor = None
+            self._gen_engine = None
+        if sup is not None:
+            sup.stop()
+        elif eng is not None:
+            eng.shutdown()

@@ -38,13 +38,34 @@ from .pubsub import MessageBroker, register_broker_driver
 _BROKER_SEQ = itertools.count()
 
 
+def _shutdown_close(sock: socket.socket) -> None:
+    """shutdown(SHUT_RDWR) then close: closing the fd alone does NOT
+    wake a peer thread blocked in sendall on a full TCP window (or in
+    recv) — and that sender holds ``_send_lock``, so every teardown and
+    reconnect path MUST shutdown first or it deadlocks behind the
+    wedged send for as long as the kernel retries (GL009/GL010 census,
+    r11)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def _send_frame(sock: socket.socket, lock: threading.Lock, op: bytes,
                 topic: str, body: bytes = b"") -> None:
     t = topic.encode("utf-8")
     frame = op + struct.pack(">I", len(t)) + t + \
         struct.pack(">Q", len(body)) + body
     with lock:
-        sock.sendall(frame)
+        # the lock serializes frame writes (interleaved sendalls corrupt
+        # the length-prefixed protocol), so the send must happen under
+        # it; it is bounded because close()/_reconnect() shutdown() the
+        # fd, which wakes a sendall wedged on a stalled peer immediately
+        sock.sendall(frame)   # graftlint: disable=GL010
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -300,6 +321,10 @@ class TcpMessageBroker(MessageBroker):
         self._sock = socket.create_connection((host, port), timeout=10)
         self._sock.settimeout(None)
         self._send_lock = threading.Lock()
+        # guards the self._sock REFERENCE only (reconnect swap vs close
+        # teardown) — never held across I/O, so close() can always take
+        # it even while a sender is wedged in sendall under _send_lock
+        self._sock_lock = threading.Lock()
         # serializes the (refcount check, queue mutation, S/U frame) unit —
         # without it a concurrent last-unsubscribe + first-subscribe could
         # leave a live local queue with no server-side subscription. The
@@ -362,6 +387,12 @@ class TcpMessageBroker(MessageBroker):
             q = super().subscribe(topic)
             if first:
                 try:
+                    # under _sub_lock by design: the (refcount check,
+                    # queue mutation, S frame) unit must be atomic or a
+                    # racing last-unsubscribe strands a live queue with
+                    # no server-side subscription; the nested send is
+                    # bounded (teardown shutdown()s the fd)
+                    # graftlint: disable=GL010
                     _send_frame(self._sock, self._send_lock, b"S", topic)
                 except OSError:
                     if not self.reconnect:
@@ -377,6 +408,8 @@ class TcpMessageBroker(MessageBroker):
                 empty = not self._subs[topic]
             if empty and not self._closed.is_set():
                 try:
+                    # same atomic-unit argument as subscribe()
+                    # graftlint: disable=GL010
                     _send_frame(self._sock, self._send_lock, b"U", topic)
                 except OSError:
                     pass
@@ -404,10 +437,10 @@ class TcpMessageBroker(MessageBroker):
         """Reader-thread only: tear down the dead socket, dial with
         exponential backoff + jitter, re-subscribe live topics."""
         self._conn_ok.clear()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # shutdown-then-close: a publisher wedged in sendall on the dead
+        # socket HOLDS _send_lock; plain close() would not wake it and
+        # the swap below would block behind it for the whole outage
+        _shutdown_close(self._sock)
         delay = self.backoff_base
         for _ in range(self.max_reconnect_attempts):
             if self._closed.is_set():
@@ -421,7 +454,13 @@ class TcpMessageBroker(MessageBroker):
                            (1.0 + 0.25 * self._jitter.random()))
                 delay *= 2
                 continue
-            with self._send_lock:
+            with self._sock_lock:
+                if self._closed.is_set():
+                    # close() ran mid-dial and could only tear down the
+                    # OLD socket: this fresh one is ours to kill, or a
+                    # publisher wedged on it could never be woken
+                    _shutdown_close(s)
+                    return False
                 self._sock = s
             try:
                 # re-subscribe every topic with live local subscribers:
@@ -431,15 +470,20 @@ class TcpMessageBroker(MessageBroker):
                     with self._lock:
                         topics = [t for t, qs in self._subs.items() if qs]
                     for t in topics:
+                        # under _sub_lock by design: re-subscription must
+                        # not interleave with a concurrent (un)subscribe
+                        # or the refcount and the server state diverge;
+                        # delivery is idle (connection was down) and the
+                        # send is bounded (teardown shutdown()s the fd)
+                        # graftlint: disable=GL010
                         _send_frame(s, self._send_lock, b"S", t)
             except OSError:
                 # fresh socket died before the S frames landed (flapping
-                # broker): close it (no fd leak) and back off like a
-                # failed dial — never a tight redial loop
-                try:
-                    s.close()
-                except OSError:
-                    pass
+                # broker): tear it down (shutdown first — a publisher
+                # may ALREADY be wedged in sendall on it holding
+                # _send_lock) and back off like a failed dial — never a
+                # tight redial loop
+                _shutdown_close(s)
                 time.sleep(min(delay, self.backoff_cap) *
                            (1.0 + 0.25 * self._jitter.random()))
                 delay *= 2
@@ -460,10 +504,16 @@ class TcpMessageBroker(MessageBroker):
     def close(self) -> None:
         self._closed.set()
         self._conn_ok.set()              # unblock publishers: they fail
-        try:                             # fast instead of waiting out a
-            self._sock.close()           # reconnect that will never come
-        except OSError:
-            pass
+        # fast instead of waiting out a reconnect that will never come;
+        # shutdown-then-close also wakes a publisher wedged mid-sendall
+        # (which holds _send_lock) instead of stranding it. The ref is
+        # read under _sock_lock so a close racing _reconnect's swap
+        # tears down whichever socket wins — the loser is killed by
+        # _reconnect's pre-swap _closed check, which shares the same
+        # _sock_lock critical section as the swap itself.
+        with self._sock_lock:
+            sock = self._sock
+        _shutdown_close(sock)
 
 
 def _tcp_driver(url: str, capacity: int) -> TcpMessageBroker:

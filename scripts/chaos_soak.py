@@ -22,6 +22,12 @@ asserts the invariants the resilience + telemetry layers promise:
    never forks a second trace — and every completed request's trace is
    finished with full span coverage;
 
+5. with ``--lock-audit``: every lock constructed during the soak is
+   instrumented (analysis/lock_audit.LockAudit patch mode) and the
+   observed acquisition orders are cross-checked against graftlint's
+   static lock-order graph — zero cycles and zero unexplained
+   inversions among package locks, takeover-built engines included;
+
 plus the correctness bar: every COMPLETED request's tokens equal the
 uninterrupted clean-engine run, token for token (greedy). The summary
 also reports per-request latency p50/p99 (through the shared
@@ -56,7 +62,8 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
              max_new: int = 6, crashes: int = 2, hangs: int = 1,
              vocab: int = 12, supervisor_timeout: float = 2.0,
              hang_seconds: float = None, wait_s: float = 180.0,
-             steady_wave: int = 4, overhead_ab: bool = True) -> dict:
+             steady_wave: int = 4, overhead_ab: bool = True,
+             lock_audit: bool = False) -> dict:
     """One soak iteration; returns a summary dict (see keys below).
 
     Prompt lengths and generation budgets are drawn so every prefill —
@@ -94,7 +101,18 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
 
     summary = {"seed": seed, "requests": n_requests, "crashes": crashes,
                "hangs": hangs}
-    with CompileAudit() as audit, TransferAudit() as transfers:
+    # --lock-audit: every lock constructed during the soak (all three
+    # engines, the supervisor, replacement engines built by takeovers)
+    # is instrumented; observed acquisition orders are cross-checked
+    # against graftlint's static lock-order graph afterwards — zero
+    # unexplained inversions is the bar (each layer catches the other's
+    # false negatives)
+    import contextlib
+
+    from deeplearning4j_tpu.analysis.lock_audit import LockAudit
+    la = LockAudit(patch=True) if lock_audit else None
+    with CompileAudit() as audit, TransferAudit() as transfers, \
+            (la if la is not None else contextlib.nullcontext()):
         # --- clean reference run: the uninterrupted ground truth, and
         # the compile warmup (same decoder => same jitted programs)
         clean = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec)
@@ -214,6 +232,25 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
     })
     if ab is not None:
         summary.update(ab)
+    if la is not None:
+        from deeplearning4j_tpu.analysis.concurrency import \
+            lock_order_edges
+        from deeplearning4j_tpu.analysis.lint import (LintCache,
+                                                      collect_package_facts)
+        facts = collect_package_facts(
+            [os.path.join(REPO_ROOT, "deeplearning4j_tpu")], REPO_ROOT,
+            cache=LintCache(os.environ.get(
+                "GRAFTLINT_CACHE",
+                os.path.join(REPO_ROOT, ".graftlint_cache.json"))))
+        static = lock_order_edges(facts)
+        cc = la.cross_check(static.keys())
+        summary["lock_audit"] = {
+            "dynamic_edges": len(la.edges()),
+            "explained": len(cc["explained"]),
+            "novel": cc["novel"],
+            "inversions": cc["inversions"],
+            "cycles": la.cycles(),
+        }
     return summary
 
 
@@ -271,6 +308,12 @@ def main(argv=None) -> int:
                          "registry snapshot")
     ap.add_argument("--no-overhead-ab", action="store_true",
                     help="skip the telemetry-on/off throughput A/B")
+    ap.add_argument("--lock-audit", action="store_true",
+                    help="instrument every lock (LockAudit patch mode), "
+                         "cross-check observed acquisition orders "
+                         "against graftlint's static lock-order graph, "
+                         "and fail on any cycle or unexplained "
+                         "inversion")
     ap.add_argument("--strict-overhead", action="store_true",
                     help="fail the round if telemetry overhead exceeds "
                          "5%% (advisory by default: the tiny-model soak "
@@ -283,11 +326,14 @@ def main(argv=None) -> int:
                      num_slots=args.slots, max_new=args.max_new,
                      crashes=args.crashes, hangs=args.hangs,
                      supervisor_timeout=args.supervisor_timeout,
-                     overhead_ab=not args.no_overhead_ab)
+                     overhead_ab=not args.no_overhead_ab,
+                     lock_audit=args.lock_audit)
         over_budget = (s.get("telemetry_overhead_pct") or 0.0) > 5.0
+        lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
+                        s.get("lock_audit", {}).get("cycles"))
         bad = s["stranded"] or s["mismatches"] or s["failed"] or \
             s["steady_new_compiles"] or s["trace_problems"] or \
-            (s["readbacks_per_block"] or 0.0) > 1.0 or \
+            (s["readbacks_per_block"] or 0.0) > 1.0 or lock_bad or \
             (args.strict_overhead and over_budget)
         ok = ok and not bad
         if args.json:
@@ -296,6 +342,13 @@ def main(argv=None) -> int:
             ab = "" if "telemetry_overhead_pct" not in s else \
                 (f" telemetry_overhead={s['telemetry_overhead_pct']}%"
                  f"{' (OVER BUDGET)' if over_budget else ''}")
+            lk = ""
+            if "lock_audit" in s:
+                d = s["lock_audit"]
+                lk = (f" locks={d['dynamic_edges']}edges/"
+                      f"{d['explained']}explained/"
+                      f"{len(d['novel'])}novel/"
+                      f"{len(d['inversions'])}inversions")
             print(f"round {i}: seed={s['seed']} restarts={s['restarts']} "
                   f"recovered={s['recovered_requests']} "
                   f"completed={s['completed']}/{s['requests']} "
@@ -304,7 +357,7 @@ def main(argv=None) -> int:
                   f"traces={'ok' if not s['trace_problems'] else 'FAIL'}"
                   f"(+{s['takeover_spans']} takeover) "
                   f"readbacks/block={s['readbacks_per_block']}"
-                  f"{ab} -> {'FAIL' if bad else 'ok'}")
+                  f"{lk}{ab} -> {'FAIL' if bad else 'ok'}")
     return 0 if ok else 1
 
 

@@ -256,3 +256,64 @@ class TestReconnectStorm:
         assert client.publish_retries >= 1     # outage was really felt
         client.close()
         server.close()
+
+
+class TestWedgedPublisherTeardown:
+    """Regression for the r11 GL009/GL010 census finding: a publisher
+    wedged in ``sendall`` (peer stopped reading, TCP window full) holds
+    ``_send_lock``; ``close()``/``_reconnect()`` used to ``close()`` the
+    fd only, which does NOT wake a blocked ``sendall`` — so the socket
+    swap in ``_reconnect`` (and any subscribe/unsubscribe) sat behind
+    the wedged send for the whole outage. Teardown now
+    ``shutdown(SHUT_RDWR)``s first, which wakes the sender
+    immediately."""
+
+    def test_close_unblocks_wedged_publisher(self):
+        # a raw server that accepts and then never reads: the client's
+        # sendall wedges once the kernel buffers fill
+        srv = socket.create_server(("127.0.0.1", 0))
+        host, port = srv.getsockname()[:2]
+        conns = []
+
+        def accept_loop():
+            while True:
+                try:
+                    c, _ = srv.accept()
+                    conns.append(c)
+                except OSError:
+                    return
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+        client = TcpMessageBroker(host, port, reconnect=False)
+        payload = b"x" * (1 << 20)
+        done = threading.Event()
+
+        def publish_until_wedged():
+            try:
+                for _ in range(256):          # far beyond any buffering
+                    client.publish("t", payload)
+            except Exception:
+                pass                          # woken send fails: fine
+            done.set()
+
+        t = threading.Thread(target=publish_until_wedged, daemon=True)
+        t.start()
+        time.sleep(0.6)
+        assert not done.is_set(), \
+            "publisher never wedged — raise the payload size"
+        # the publisher is now blocked inside sendall HOLDING _send_lock;
+        # close() must shutdown() the fd and wake it promptly
+        t0 = time.monotonic()
+        client.close()
+        assert done.wait(timeout=3.0), \
+            "close() left the publisher wedged in sendall under " \
+            "_send_lock (fd closed without shutdown)"
+        assert time.monotonic() - t0 < 3.0
+        t.join(timeout=5)
+        assert not t.is_alive()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        srv.close()
