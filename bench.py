@@ -36,6 +36,14 @@ Modes (BENCH_MODE):
            "compile_audit" side metric reports per-function compile
            counts, retrace storms, and steady-state decode compiles
            (must be zero new after warmup, for EVERY swept block size).
+           r12: BENCH_GEN_MESH_SWEEP (default "1x1,2x1,1x2,4x1"; ""/0
+           disables) re-runs the serving pattern at the chosen K on
+           each named (data, tp) mesh shape that fits
+           jax.device_count() — per-shape tok/s, p50/p99,
+           readbacks/block, and (with --audit-compiles) the
+           steady-state compile delta, {} required on every shape
+           (token parity is gated at f32 by tests and
+           scripts/perf_generate.py --mesh-sweep).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
@@ -347,6 +355,50 @@ def _generate_result() -> dict:
     return _generate_protocol(SlotGenerationEngine, None)
 
 
+def serving_run(dec, k, b, tokens, lengths, gen_t, tag="bench.decode"):
+    """One serving-pattern decode run at block size ``k`` on ``dec`` —
+    THE canonical timing loop (scripts/perf_generate.py imports it for
+    both of its sweeps, so a timing fix cannot land in one table and
+    silently miss another). Returns (tok/s, per-token latencies, decode
+    blocks, readbacks)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.transfer import device_fetch, fetch_counts
+
+    reads0 = fetch_counts().get(tag, 0)
+    nx, _, cs = dec.prefill(dec.init_cache(b), tokens, lengths)
+    np.asarray(nx)   # sync: the decode timer must not absorb the
+    marks = []       # still-running prefill (K=1 syncs via ids)
+    if k == 1:                               # legacy baseline loop
+        ids, pos = np.asarray(nx), lengths.copy()
+        nb = gen_t
+        t0 = time.perf_counter()
+        for _ in range(gen_t):
+            nx2, _, cs = dec.decode_step(cs, ids, pos)
+            ids = device_fetch(nx2, tag=tag)
+            marks.append(time.perf_counter())
+            pos = pos + 1
+    else:                                    # pipelined block loop
+        ids, pos = nx, jnp.asarray(lengths)
+        stop = np.zeros(b, bool)
+        pending = None
+        nb = max(1, gen_t // k)
+        t0 = time.perf_counter()
+        for blk in range(nb):
+            toks, ids, pos, stop, cs = dec.decode_block(
+                cs, ids, pos, block_size=k, stopped=stop, step0=blk * k)
+            if pending is not None:
+                device_fetch(pending, tag=tag)
+                marks.append(time.perf_counter())
+            pending = toks
+        device_fetch(pending, tag=tag)
+        marks.append(time.perf_counter())
+    total = time.perf_counter() - t0
+    lats = np.diff([t0] + marks) / k         # per-token, from block times
+    reads = fetch_counts().get(tag, 0) - reads0
+    return b * nb * k / total, lats, nb, reads
+
+
 def _generate_protocol(SlotGenerationEngine, audit) -> dict:
     dec, v, b, tp, steps = _build_gen_decoder()
     rng = np.random.default_rng(0)
@@ -371,45 +423,14 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
     # the next block dispatched from the on-device carry BEFORE the
     # previous block's tokens are fetched (double buffering). K=1 is the
     # legacy dispatch→sync→dispatch loop — the PR 3 baseline of the A/B.
-    import jax.numpy as jnp
     from deeplearning4j_tpu.observability.metrics import percentiles
-    from deeplearning4j_tpu.ops.transfer import device_fetch, fetch_counts
 
-    def sweep_point(k):
-        """One timed serving-pattern run at block size k: returns
-        (tok/s, per-token latencies, decode blocks, readbacks)."""
-        fetches0 = fetch_counts().get("bench.decode", 0)
-        _, cs, nx = prefill_once()
-        marks = []
-        if k == 1:
-            ids, pos = np.asarray(nx), lengths.copy()
-            nb = steps
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                nx2, _, cs = dec.decode_step(cs, ids, pos)
-                ids = device_fetch(nx2, tag="bench.decode")
-                marks.append(time.perf_counter())
-                pos = pos + 1
-        else:
-            ids, pos = nx, jnp.asarray(lengths)
-            stop = np.zeros(b, bool)
-            pending = None
-            nb = max(1, steps // k)
-            t0 = time.perf_counter()
-            for blk in range(nb):
-                toks, ids, pos, stop, cs = dec.decode_block(
-                    cs, ids, pos, block_size=k, stopped=stop,
-                    step0=blk * k)
-                if pending is not None:
-                    device_fetch(pending, tag="bench.decode")
-                    marks.append(time.perf_counter())
-                pending = toks
-            device_fetch(pending, tag="bench.decode")
-            marks.append(time.perf_counter())
-        total = time.perf_counter() - t0
-        lats = np.diff([t0] + marks) / k     # per-token, from block times
-        reads = fetch_counts().get("bench.decode", 0) - fetches0
-        return b * nb * k / total, lats, nb, reads
+    def sweep_point(k, d=None):
+        """One timed serving-pattern run at block size k (optionally on
+        a mesh-sharded decoder ``d``): returns (tok/s, per-token
+        latencies, decode blocks, readbacks)."""
+        return serving_run(d if d is not None else dec, k, b, tokens,
+                           lengths, steps)
 
     sweep_ks = []
     for tok in os.environ.get("BENCH_GEN_BLOCK_SWEEP", "1,4,8").split(","):
@@ -451,6 +472,15 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
     dec_med = sweep[chosen]["decode_tokens_per_sec"]
     dec_spread, dec_runs = sweep[chosen]["spread_pct"], RUNS
     p50, p99 = sweep[chosen]["p50_ms"], sweep[chosen]["p99_ms"]
+
+    # ---- mesh sweep (r12): the serving-pattern loop at the chosen best
+    # K, re-run on each named (data, tp) mesh shape that fits the
+    # available devices — tok/s + p50/p99 + readbacks/block per shape
+    # and (with --audit-compiles) the per-shape steady-state compile
+    # delta. Shapes needing more devices than jax.device_count() are
+    # reported as skipped (on CPU, force more with
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N).
+    mesh_sweep = _mesh_sweep(dec, chosen, b, sweep_point, audit)
 
     # ---- no-cache recompute baseline ----
     nc_steps = int(os.environ.get("BENCH_GEN_NOCACHE_STEPS", "8"))
@@ -513,6 +543,7 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
             "block_speedup_vs_k1": round(
                 dec_med / sweep[1]["decode_tokens_per_sec"], 3)
             if 1 in sweep and sweep[1]["decode_tokens_per_sec"] else None,
+            "mesh_sweep": mesh_sweep,
             "nocache_recompute_tokens_per_sec": {
                 "value": round(nc_med, 2), "spread_pct": nc_spread,
                 "runs": nc_runs},
@@ -543,6 +574,89 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
     result["side_metrics"]["metrics_snapshot"] = \
         default_registry().snapshot()
     return result
+
+
+def _mesh_sweep(dec, k, b, sweep_point, audit):
+    """BENCH_GEN_MESH_SWEEP (r12): per-mesh-shape serving numbers at the
+    chosen best block size. Each entry: decode tok/s (median of
+    BENCH_RUNS), p50/p99 per-token latency, readbacks/block, and (when
+    auditing) the steady-state compile delta — {} required on every
+    shape. Token parity is GATED elsewhere (tests + scripts/
+    perf_generate.py --mesh-sweep, at f32): this bench model computes
+    in bf16, where GSPMD's reduction reorder sits at the quantum and
+    cross-mesh token drift on an untrained flat-logit model is a dtype
+    property, not a perf signal."""
+    import jax
+
+    shapes_env = os.environ.get("BENCH_GEN_MESH_SWEEP")
+    if shapes_env is None:
+        # default on, EXCEPT on a single-device host: every shape but
+        # 1x1 would be skipped, and 1x1 only re-lowers the whole decode
+        # path to duplicate the unsharded numbers just measured. Set
+        # the env var explicitly to force the 1x1 row anyway.
+        if jax.device_count() == 1:
+            return None
+        shapes_env = "1x1,2x1,1x2,4x1"
+    if not shapes_env.strip() or shapes_env.strip() in ("0", "off"):
+        return None
+
+    from deeplearning4j_tpu.models import TransformerDecoder
+    from deeplearning4j_tpu.observability.metrics import percentiles
+    from deeplearning4j_tpu.parallel.mesh import (generation_mesh,
+                                                  parse_mesh_shape)
+
+    out = {}
+    for shp in shapes_env.split(","):
+        shp = shp.strip()
+        if not shp:
+            continue
+        try:
+            data, tp_ax = parse_mesh_shape(shp)
+        except ValueError as e:
+            out[shp] = {"skipped": str(e)[:160]}
+            continue
+        if data * tp_ax > jax.device_count():
+            out[shp] = {"skipped": f"needs {data * tp_ax} devices, "
+                                   f"jax.device_count()="
+                                   f"{jax.device_count()}"}
+            continue
+        if b % data:
+            # the constructor only validates heads % tp; the timed loop
+            # drives prefill with exactly b rows, so gate the batch side
+            # here instead of leaving it to GSPMD's uneven-shard path
+            out[shp] = {"skipped": f"batch {b} not divisible by the "
+                                   f"data axis size {data}"}
+            continue
+        try:
+            mdec = TransformerDecoder(dec.net,
+                                      mesh=generation_mesh(data, tp_ax))
+        except ValueError as e:          # divisibility (heads % tp)
+            out[shp] = {"skipped": str(e)[:160]}
+            continue
+        sweep_point(k, d=mdec)           # warm this mesh's programs
+        snap = audit.snapshot() if audit is not None else None
+        vals, lats, blocks, reads = [], [], 0, 0
+        for _ in range(RUNS):
+            tps, ls, nb, rd = sweep_point(k, d=mdec)
+            vals.append(tps)
+            lats.extend(ls)
+            blocks += nb
+            reads += rd
+        med = float(np.median(vals))
+        pct = percentiles(lats, (50, 99))
+        entry = {
+            "decode_tokens_per_sec": round(med, 2),
+            "spread_pct": round(100.0 * (max(vals) - min(vals)) / med, 2)
+            if med else 0.0,
+            "p50_ms": round(pct["p50"] * 1e3, 3),
+            "p99_ms": round(pct["p99"] * 1e3, 3),
+            "readbacks_per_block": round(reads / blocks, 3) if blocks
+            else None,
+        }
+        if audit is not None:
+            entry["steady_new_compiles"] = audit.delta(snap)
+        out[shp] = entry
+    return out
 
 
 def _lenet() -> float:

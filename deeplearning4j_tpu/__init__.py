@@ -28,3 +28,22 @@ reference's capability areas (see SURVEY.md):
 """
 
 __version__ = "0.1.0"
+
+# Sharding-invariant random streams (r12, mesh-sharded generation): the
+# legacy threefry lowering generates DIFFERENT bits when its output is
+# sharded (GSPMD re-pairs the 2x32 lanes per shard), so a fixed-seed
+# sampled decode could never be token-identical across mesh shapes.
+# jax's partitionable threefry is sharding-invariant by construction;
+# enable it process-wide at import so every program — weight init,
+# training dropout, decode sampling, sharded or not — draws from ONE
+# consistent stream family. (Trace-time flag: flipping it mid-process
+# would fork already-compiled programs from new ones, hence here and
+# not inside the decoder.) Opt out with DL4J_TPU_PARTITIONABLE_RNG=0.
+import os as _os
+
+if _os.environ.get("DL4J_TPU_PARTITIONABLE_RNG", "1").lower() not in \
+        ("0", "false", "no"):
+    import jax as _jax
+    _jax.config.update("jax_threefry_partitionable", True)
+    del _jax
+del _os

@@ -19,7 +19,17 @@ for the duration of a ``with`` block and aggregates:
   (raises :class:`CompileBudgetError` with the offending functions).
 
 Works on any backend and costs one logging call per COMPILE (not per
-step), so wrapping a whole bench run is free. The monitoring-events API
+step), so wrapping a whole bench run is free.
+
+Attribution through the pjit seams (r12): a mesh-sharded decoder
+compiles the SAME function names with the SAME dynamic shape signatures
+as its single-device sibling — the compile log carries no sharding — so
+two meshes in one process would read as one function re-lowering an
+already-seen signature (a false blown-cache storm). The generation
+impls therefore carry a per-mesh ``__m<data>x<tp>`` name suffix
+(``decode_block4_impl__m2x1``), making every (function, mesh) pair its
+own audit row; unsharded decoders keep the bare names and existing
+budgets. The monitoring-events API
 (``jax.monitoring``) records the same compiles without names and its
 listeners cannot be unregistered individually, so the logging seam is
 the instrumentation of choice; our own jit wrappers need no changes.
@@ -115,6 +125,13 @@ class TransferAudit:
         return {t: c - self._start.get(t, 0) for t, c in sorted(now.items())
                 if c - self._start.get(t, 0) > 0}
 
+    def shards(self, tag: str) -> int:
+        """Device shards the most recent fetch under ``tag`` gathered —
+        attribution through the pjit seam: ONE logical readback off a
+        (data, tp) serving mesh reads data×tp shards, and the audit can
+        now say so instead of losing the mesh dimension entirely."""
+        return self._transfer.fetch_shards(tag).get(tag, 1)
+
     def check_per_block(self, tag: str, blocks: int,
                         max_per_block: float = 1.0) -> None:
         """Assert ≤ ``max_per_block`` readbacks under ``tag`` per decode
@@ -166,7 +183,12 @@ class CompileAudit:
                       "_argmax", "_where", "_normal", "_normal_real",
                       "_uniform", "_truncated_normal", "_categorical",
                       "_bernoulli", "_gumbel", "_threefry_fold_in",
-                      "fold_in")
+                      "fold_in",
+                      # jax's host-gather helper for fetching a SHARDED
+                      # array (np.asarray over a mesh) — a utility
+                      # program like the rest; the deliberate readback
+                      # itself is what TransferAudit counts
+                      "_multi_slice")
 
     def __init__(self, budget: Optional[Dict[str, int]] = None,
                  total_budget: Optional[int] = None,

@@ -145,6 +145,17 @@ class ShardingLint:
                 for t in node.targets:
                     if isinstance(t, ast.Name) and "axis" in t.id.lower():
                         vocab.add(node.value.value)
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str) and \
+                    isinstance(node.target, ast.Name) and \
+                    "axis" in node.target.id.lower():
+                # annotated axis declarations — module constants AND
+                # dataclass fields (`data_axis: Axis = "data"`, the
+                # SpecLayout idiom): an axis-typo'd literal spec in such
+                # a module must be checkable, not vocabulary-blind
+                vocab.add(node.value.value)
         return vocab, mesh_axes
 
     def check_gl013(self, emit) -> None:

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
 
 from ..nn.conf.layers import (RnnOutputLayer, SelfAttentionLayer,
                               TokenAndPositionEmbedding)
@@ -99,9 +100,23 @@ class TransformerDecoder:
 
     ``t_max`` bounds the context (prompt + generated) a cache slot can
     hold; it defaults to the embedding's max_length and may not exceed
-    it (position embeddings end there)."""
+    it (position embeddings end there).
 
-    def __init__(self, net, t_max: Optional[int] = None):
+    ``mesh`` (r12): a named device mesh — canonically ``(data, tp)``
+    from ``parallel.mesh.generation_mesh`` — shards the decoder
+    end-to-end: parameters by role through a
+    ``parallel.spec_layout.SpecLayout`` (embeddings/projections on
+    ``tp``, optional fsdp axis), the per-layer [B, H, T_max, Dh] KV
+    cache with heads on ``tp`` and batch/slots on ``data``, and every
+    jitted impl compiled with NamedSharding-constrained in/out
+    shardings (pure GSPMD — the traced math is unchanged, XLA inserts
+    the collectives). Divisibility (heads by tp, batch rows by data) is
+    validated up front; impl names gain a ``__m<data>x<tp>`` suffix so
+    the compile auditor attributes per-mesh lowerings instead of
+    misreading two meshes as one blown jit cache."""
+
+    def __init__(self, net, t_max: Optional[int] = None, mesh=None,
+                 spec_layout=None):
         net._ensure_init()
         self.net = net
         conf = net.conf
@@ -147,22 +162,103 @@ class TransformerDecoder:
         self._jit: Dict = {}
         self._cast_src = None
         self._cast_params = None
+        # ---- mesh sharding (r12) ----
+        self.mesh = mesh
+        self._layout = None
+        self._param_specs = None
+        self._cache_sharding = None
+        self._impl_suffix = ""          # per-mesh compile attribution
+        self._row_shardings = None
+        if mesh is not None:
+            from ..parallel.mesh import mesh_tag, validate_decode_mesh
+            from ..parallel.spec_layout import (SpecLayout,
+                                                decoder_param_specs,
+                                                validate_param_specs)
+            self._layout = spec_layout if spec_layout is not None \
+                else SpecLayout()
+            for name in self.attn_names:
+                validate_decode_mesh(
+                    mesh, num_heads=conf.vertices[name].layer.num_heads,
+                    data_axis=self._layout.data_axis,
+                    tp_axis=self._layout.tp_axis)
+            self._param_specs = decoder_param_specs(self, self._layout)
+            validate_param_specs(mesh, self._param_specs, net.params)
+            self._cache_sharding = NamedSharding(mesh,
+                                                 self._layout.kv_cache())
+            self._impl_suffix = "__m" + mesh_tag(mesh)
+
+    # ------------------------------------------------------------ sharding
+    @property
+    def data_axis_size(self) -> int:
+        """Rows-per-dispatch divisor: batch/slot counts must divide by
+        the data axis (1 for an unsharded decoder)."""
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.shape.get(self._layout.data_axis, 1))
+
+    def _ns(self, spec) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def _sharding_sets(self):
+        """(params tree, caches tree, row [B...], matrix [B, T]) —
+        NamedSharding pytrees for the jit in/out constraints, built once
+        per decoder (the structures never change)."""
+        if self._row_shardings is None:
+            from ..parallel.spec_layout import param_shardings
+            psh = param_shardings(self.mesh, self._param_specs,
+                                  self.net.params)
+            csh = {n: {"k": self._cache_sharding,
+                       "v": self._cache_sharding}
+                   for n in self.attn_names}
+            self._row_shardings = (psh, csh,
+                                   self._ns(self._layout.batch(1)),
+                                   self._ns(self._layout.batch(2)))
+        return self._row_shardings
 
     # ------------------------------------------------------------- params
     def _device_params(self):
         """Params cast once to the net's compute dtype (inference decode is
-        read-only; recast only when net.params is replaced by training)."""
+        read-only; recast only when net.params is replaced by training).
+        With a mesh, the cast params are also PLACED once per the
+        SpecLayout's role table — a model larger than one device lives
+        distributed from here on."""
         if self._cast_params is None or self._cast_src is not self.net.params:
-            self._cast_params = self.net._cast_params(self.net.params)
+            if self.mesh is not None:
+                # cast INSIDE a jit whose out_shardings are the role
+                # table: the bf16 copy is born sharded instead of
+                # materializing whole on one device and being re-put —
+                # for a model that only fits distributed, that interim
+                # replica is exactly the OOM tp exists to avoid
+                psh, _, _, _ = self._sharding_sets()
+
+                # no donation: the f32 master params stay live on the
+                # net (training updates them; this is a read-only cast)
+                def cast_params_impl(p):
+                    return self.net._cast_params(p)
+
+                # per-mesh audit name, like every other sharded impl:
+                # two meshes' casts share the dynamic signature and a
+                # bare shared name would read as a blown jit cache
+                cast_params_impl.__name__ += self._impl_suffix
+                cast = jax.jit(  # graftlint: disable=GL005
+                    cast_params_impl,
+                    out_shardings=psh)(self.net.params)
+            else:
+                cast = self.net._cast_params(self.net.params)
+            self._cast_params = cast
             self._cast_src = self.net.params
         return self._cast_params
 
     # -------------------------------------------------------------- cache
     def init_cache(self, batch: int) -> Dict[str, Dict]:
         """{attn_name: {"k","v" [B, H, t_max, Dh]}} for every attention
-        vertex, preallocated in the net's compute dtype."""
+        vertex, preallocated in the net's compute dtype. With a mesh the
+        cache is BORN sharded (slots over ``data``, heads over ``tp``) —
+        it is the dominant serving allocation and must never materialize
+        replicated."""
         return {name: self.net.conf.vertices[name].layer.init_cache(
-                    batch, self.t_max, self.net.compute_dtype)
+                    batch, self.t_max, self.net.compute_dtype,
+                    sharding=self._cache_sharding)
                 for name in self.attn_names}
 
     # -------------------------------------------------------------- walks
@@ -281,18 +377,48 @@ class TransformerDecoder:
     # graftlint: traced
     def _select(logits, temps, key):
         """Per-row next token: greedy where temps <= 0, temperature
-        sampling elsewhere — one compile serves mixed batches."""
+        sampling elsewhere — one compile serves mixed batches.
+
+        Sampling draws from bf16-ROUNDED logits (r12): GSPMD partitions
+        matmul reductions differently per mesh shape, wiggling f32
+        logits by ~1e-5, and a categorical draw that flips on that
+        noise forks the whole downstream token stream — so fixed-seed
+        sampled outputs could never be token-identical across meshes.
+        Rounding to bf16 (~0.4% quanta, far below the noise temperature
+        sampling injects by design) makes the sampled stream
+        insensitive to sub-quantum differences. Greedy stays on raw f32
+        logits: its argmax gaps are macroscopic for any trained model,
+        and the r6 contract (greedy == teacher-forced reference) must
+        not move."""
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         t = jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(key, logits / t,
+        ql = logits.astype(jnp.bfloat16).astype(jnp.float32)
+        sampled = jax.random.categorical(key, ql / t,
                                          axis=-1).astype(jnp.int32)
         return jnp.where(temps <= 0, greedy, sampled)
 
     # ---------------------------------------------------------- jit entry
+    def _jit_sharded(self, impl, donate, in_specs=None, out_specs=None):
+        """jit with optional NamedSharding-constrained in/out shardings.
+        Unsharded decoders compile exactly as before (and keep the bare
+        impl names the audit budgets reference); sharded ones pin the
+        param/cache/row layouts so steady state never reshards, and the
+        impl name carries the mesh suffix for per-mesh compile
+        attribution."""
+        if self.mesh is None:
+            return jax.jit(impl, donate_argnums=donate)
+        impl.__name__ = impl.__name__ + self._impl_suffix
+        return jax.jit(impl, donate_argnums=donate,
+                       in_shardings=in_specs, out_shardings=out_specs)
+
     def _fn(self, name):
         fn = self._jit.get(name)
         if fn is not None:
             return fn
+        donate = train_donate_argnums((2,))
+        psh = csh = row = mat = None
+        if self.mesh is not None:
+            psh, csh, row, mat = self._sharding_sets()
         # distinct impl names: the compile auditor attributes compiles by
         # the wrapped function's __name__ (three fns named "impl" would
         # collapse into one audit row)
@@ -302,16 +428,20 @@ class TransformerDecoder:
                 logits, caches = self._walk_prefill(params, state, caches,
                                                     tokens, lengths)
                 return self._select(logits, temps, key), logits, caches
-            fn = jax.jit(prefill_impl,
-                         donate_argnums=train_donate_argnums((2,)))
+            fn = self._jit_sharded(
+                prefill_impl, donate,
+                in_specs=(psh, None, csh, mat, row, row, None),
+                out_specs=(row, None, csh))
         elif name == "step":
             def decode_step_impl(params, state, caches, ids, positions,
                                  temps, key):
                 logits, caches = self._walk_decode(params, state, caches,
                                                    ids, positions)
                 return self._select(logits, temps, key), logits, caches
-            fn = jax.jit(decode_step_impl,
-                         donate_argnums=train_donate_argnums((2,)))
+            fn = self._jit_sharded(
+                decode_step_impl, donate,
+                in_specs=(psh, None, csh, row, row, row, None),
+                out_specs=(row, None, csh))
         elif name == "prefill_slots":
             def prefill_slots_impl(params, state, caches, tokens, lengths,
                                    slots, temps, key):
@@ -337,8 +467,14 @@ class TransformerDecoder:
                             for kk in ("k", "v")}
                         for n in self.attn_names}
                 return self._select(logits, temps, key), logits, merged
-            fn = jax.jit(prefill_slots_impl,
-                         donate_argnums=train_donate_argnums((2,)))
+            # admission buckets (M = pow2 <= num_slots) may undershoot
+            # the data axis, so the batch-side inputs stay unconstrained;
+            # the SHARED cache keeps its pinned layout through the
+            # scatter either way
+            fn = self._jit_sharded(
+                prefill_slots_impl, donate,
+                in_specs=(psh, None, csh, None, None, None, None, None),
+                out_specs=(None, None, csh))
         elif isinstance(name, tuple) and name[0] == "block":
             k_steps = int(name[1])
 
@@ -374,9 +510,13 @@ class TransformerDecoder:
             # per-K name: the compile auditor attributes by __name__, and
             # two K values share every input shape — one shared name
             # would read as a blown-cache duplicate-signature compile
+            # (_jit_sharded appends the per-mesh suffix the same way)
             decode_block_impl.__name__ = f"decode_block{k_steps}_impl"
-            fn = jax.jit(decode_block_impl,
-                         donate_argnums=train_donate_argnums((2,)))
+            fn = self._jit_sharded(
+                decode_block_impl, donate,
+                in_specs=(psh, None, csh, row, row, row, row, row, None,
+                          None, None),
+                out_specs=(mat, row, row, row, csh))
         else:                                 # pragma: no cover
             raise KeyError(name)
         self._jit[name] = fn
@@ -459,9 +599,16 @@ class TransformerDecoder:
         identical across block sizes (greedy AND fixed-seed sampling:
         the key schedule folds the absolute step index)."""
         prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
-        b = len(prompts)
-        if b == 0:
+        n_real = len(prompts)
+        if n_real == 0:
             return []
+        # mesh: batch rows shard over the data axis — pad to a multiple
+        # with copies of row 0 (their outputs are dropped below), so any
+        # request count decodes on the full mesh
+        pad = (-n_real) % self.data_axis_size
+        if pad:
+            prompts = prompts + [prompts[0].copy() for _ in range(pad)]
+        b = len(prompts)
         lengths = np.asarray([len(p) for p in prompts], np.int32)
         if (lengths < 1).any():
             raise ValueError("empty prompt")
@@ -472,8 +619,12 @@ class TransformerDecoder:
         tokens = np.zeros((b, tp), np.int32)
         for i, p in enumerate(prompts):
             tokens[i, :len(p)] = p
+        # per-row temps broadcast against the REAL row count; pad rows
+        # (outputs dropped) reuse row 0's temp like they reuse its prompt
         temps = np.broadcast_to(
-            np.asarray(temperature, np.float32), (b,)).copy()
+            np.asarray(temperature, np.float32), (n_real,)).copy()
+        if pad:
+            temps = np.concatenate([temps, np.repeat(temps[:1], pad)])
         key = jax.random.PRNGKey(seed)
         nxt, _, caches = self.prefill(self.init_cache(b), tokens, lengths,
                                       temps, seed=seed)
@@ -511,7 +662,7 @@ class TransformerDecoder:
                     key=jax.random.fold_in(key, step + 1))
                 nxt_host = np.asarray(nxt)   # graftlint: disable=GL007
             return [np.concatenate([p, np.asarray(g, np.int32)])
-                    for p, g in zip(prompts, gen)]
+                    for p, g in zip(prompts[:n_real], gen[:n_real])]
 
         # ---- pipelined block path ----
         k = int(block_size)
@@ -521,7 +672,7 @@ class TransformerDecoder:
         n_steps = int(max_new_tokens) - 1
         if finished.all() or n_steps <= 0:
             return [np.concatenate([p, np.asarray(g, np.int32)])
-                    for p, g in zip(prompts, gen)]
+                    for p, g in zip(prompts[:n_real], gen[:n_real])]
         eos_arr = np.full(b, -1 if eos_id is None else int(eos_id), np.int32)
         ids_d, pos_d = nxt, jnp.asarray(lengths, jnp.int32)
         stop_d = np.zeros(b, bool)
@@ -541,7 +692,7 @@ class TransformerDecoder:
         if pending is not None:
             consume(device_fetch(pending, tag="generate.decode"))
         return [np.concatenate([p, np.asarray(g, np.int32)])
-                for p, g in zip(prompts, gen)]
+                for p, g in zip(prompts[:n_real], gen[:n_real])]
 
 
 class GenerationRequest:
@@ -691,17 +842,32 @@ class SlotGenerationEngine:
                  seed: int = 0, decoder: Optional[TransformerDecoder] = None,
                  max_pending: int = 256, fault_injector=None,
                  block_size: int = 1, registry=None, trace_store=None,
-                 tracing: bool = True):
+                 tracing: bool = True, mesh=None, spec_layout=None):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
                              f"engine asked for {t_max}")
+        if decoder is not None and mesh is not None and \
+                decoder.mesh is not mesh:
+            raise ValueError("shared decoder was built for a different "
+                             "mesh; pass mesh= only when the engine owns "
+                             "its decoder")
         # a shared decoder reuses its jitted prefill/decode programs
         # across engines (the A/B benches build several engines per run,
         # and a supervisor restart MUST reuse it: zero new compiles in
-        # the post-restart steady state is the acceptance bar)
+        # the post-restart steady state is the acceptance bar); a
+        # sharded decoder carries its mesh/spec layout with it, so a
+        # restart rebuilds the SAME sharded decode path for free
         self.decoder = decoder if decoder is not None \
-            else TransformerDecoder(net, t_max=t_max)
+            else TransformerDecoder(net, t_max=t_max, mesh=mesh,
+                                    spec_layout=spec_layout)
+        self.mesh = self.decoder.mesh
+        if self.mesh is not None:
+            from ..parallel.mesh import validate_decode_mesh
+            layout = self.decoder._layout
+            validate_decode_mesh(self.mesh, num_slots=int(num_slots),
+                                 data_axis=layout.data_axis,
+                                 tp_axis=layout.tp_axis)
         self.num_slots = int(num_slots)
         self.refill = bool(refill)
         self.seed = int(seed)
@@ -774,6 +940,16 @@ class SlotGenerationEngine:
                   ("engine",)).labels(self.engine_id).set_function(
             lambda: (lambda s: 0 if s is None else
                      sum(r is not None for r in s._slots))(wself()))
+        # mesh topology gauges (r12): one child per mesh axis so the
+        # telemetry endpoint can chart per-axis sizes; set once — the
+        # mesh never changes for an engine's lifetime
+        if self.mesh is not None:
+            ax_g = reg.gauge("generation_mesh_axis_size",
+                             "serving-mesh axis size (data/tp)",
+                             ("engine", "axis"))
+            for ax in self.mesh.axis_names:
+                ax_g.labels(self.engine_id, str(ax)).set(
+                    int(self.mesh.shape[ax]))
 
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
@@ -1295,6 +1471,10 @@ class SlotGenerationEngine:
         with self._lock:
             out["queue_depth"] = len(self._pending)
             out["active_slots"] = sum(r is not None for r in self._slots)
+        # mesh topology (r12): "<data>x<tp>" for a sharded engine, None
+        # for single-device — /snapshot sources surface it verbatim
+        from ..parallel.mesh import mesh_tag
+        out["mesh_shape"] = mesh_tag(self.mesh) or None
         return out
 
     # ---------------------------------------------------------- execution

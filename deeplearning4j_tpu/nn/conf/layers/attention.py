@@ -115,13 +115,24 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         return self._project_out(params, out), state
 
     # ---- KV-cache autoregressive decoding (models/generation.py) ----
-    def init_cache(self, batch: int, t_max: int, dtype=jnp.float32) -> Dict:
-        """Preallocated decode cache: {"k", "v"} each [B, H, T_max, Dh]."""
+    def init_cache(self, batch: int, t_max: int, dtype=jnp.float32,
+                   sharding=None) -> Dict:
+        """Preallocated decode cache: {"k", "v"} each [B, H, T_max, Dh].
+        ``sharding`` (a NamedSharding, slots over data / heads over tp)
+        places the buffers distributed at birth — the cache is the
+        dominant serving allocation and must never materialize
+        replicated on one device of a mesh."""
         if not self.causal:
             raise ValueError("KV-cache decoding needs causal=True "
                              "(autoregressive attention)")
         hs = self._head_size()
         shape = (batch, self.num_heads, t_max, hs)
+        if sharding is not None:
+            # allocate UNDER the sharding: zeros-then-device_put would
+            # materialize the full buffer on one device first — the
+            # dominant serving allocation must be born distributed
+            return {"k": jnp.zeros(shape, dtype, device=sharding),
+                    "v": jnp.zeros(shape, dtype, device=sharding)}
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     # graftlint: traced

@@ -29,6 +29,14 @@ import numpy as np
 
 _LOCK = threading.Lock()
 _COUNTS: Counter = Counter()
+#: per-tag device-shard counts: how many device shards the LAST fetch
+#: under a tag gathered (1 = single-device; N = a cross-mesh gather).
+#: One logical fetch stays ONE count in ``_COUNTS`` — the ≤1-readback-
+#: per-block invariant is about host/device serialization, not about
+#: how many chips the gather touched — but the audit can now attribute
+#: readbacks THROUGH the pjit seam (a [S, K] token fetch off a (data,
+#: tp) mesh reads from data×tp shards).
+_SHARDS: Dict[str, int] = {}
 
 
 def device_fetch(x, tag: str = "default") -> np.ndarray:
@@ -38,9 +46,19 @@ def device_fetch(x, tag: str = "default") -> np.ndarray:
     materializes it in host memory. Use one call per decode BLOCK (the
     [B, K] token matrix), never per token, and fetch the *previous*
     block's result after dispatching the next one so the wait overlaps
-    device compute (double buffering)."""
+    device compute (double buffering). A sharded array (mesh-sharded
+    decode) gathers all its addressable shards in this ONE call; the
+    shard count is recorded per tag for the transfer audit."""
+    sharding = getattr(x, "sharding", None)
+    n_shards = 1
+    if sharding is not None:
+        try:
+            n_shards = len(sharding.device_set)
+        except Exception:       # noqa: BLE001 — attribution must not throw
+            n_shards = 1
     with _LOCK:
         _COUNTS[tag] += 1
+        _SHARDS[tag] = int(n_shards)
     return np.asarray(x)
 
 
@@ -50,3 +68,13 @@ def fetch_counts(tag: Optional[str] = None) -> Dict[str, int]:
         if tag is not None:
             return {tag: _COUNTS.get(tag, 0)}
         return dict(_COUNTS)
+
+
+def fetch_shards(tag: Optional[str] = None) -> Dict[str, int]:
+    """Device shards gathered by the most recent fetch per tag (1 on a
+    single device; data×tp on a serving mesh) — the TransferAudit's
+    attribution through the pjit seam."""
+    with _LOCK:
+        if tag is not None:
+            return {tag: _SHARDS.get(tag, 1)}
+        return dict(_SHARDS)

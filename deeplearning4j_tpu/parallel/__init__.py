@@ -7,7 +7,10 @@ parallelism (tensor.py), pipeline parallelism (pipeline.py), expert
 parallelism / MoE (expert.py), and sequence parallelism via ring attention
 (sequence.py)."""
 
-from .mesh import make_mesh, replicated, batch_sharded
+from .mesh import (make_mesh, replicated, batch_sharded, generation_mesh,
+                   mesh_tag, parse_mesh_shape, validate_decode_mesh)
+from .spec_layout import (SpecLayout, decoder_param_specs,
+                          validate_param_specs)
 from .wrapper import ParallelWrapper
 from .graph_wrapper import GraphDataParallelTrainer
 from .tensor import ShardedTrainer, TensorParallelTrainer, tp_param_specs
@@ -26,7 +29,10 @@ from .failures import (EngineSupervisor, HeartbeatMonitor,
 from .faults import (Cancelled, DeadlineExceeded, FaultInjector,
                      RejectedError)
 
-__all__ = ["make_mesh", "replicated", "batch_sharded", "ParallelWrapper",
+__all__ = ["make_mesh", "replicated", "batch_sharded", "generation_mesh",
+           "mesh_tag", "parse_mesh_shape", "validate_decode_mesh",
+           "SpecLayout", "decoder_param_specs", "validate_param_specs",
+           "ParallelWrapper",
            "GraphDataParallelTrainer", "ShardedTrainer",
            "TensorParallelTrainer", "tp_param_specs",
            "PipelineParallelTrainer", "pipeline_apply",

@@ -234,7 +234,8 @@ class EngineSupervisor(HeartbeatMonitor):
         old = self._engine
         recoverable, dead = old.quarantine()
         for k, v in old.stats().items():
-            if k not in ("queue_depth", "active_slots"):   # gauges
+            # gauges and topology labels don't accumulate across engines
+            if k not in ("queue_depth", "active_slots", "mesh_shape"):
                 self._prior_stats[k] = self._prior_stats.get(k, 0) + v
         cause = dead or cause or RuntimeError("engine restarted")
         if self.restarts >= self.max_restarts:
@@ -249,6 +250,9 @@ class EngineSupervisor(HeartbeatMonitor):
             return
         self.restarts += 1
         self._m_restarts.inc()
+        # the shared decoder carries its mesh/SpecLayout too, so a
+        # takeover of a SHARDED engine rebuilds the same tensor/FSDP-
+        # parallel decode path with zero new steady-state compiles
         new = SlotGenerationEngine(
             old.decoder.net, num_slots=old.num_slots, refill=old.refill,
             seed=old.seed, decoder=old.decoder,      # SAME jit programs

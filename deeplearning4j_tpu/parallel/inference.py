@@ -41,7 +41,9 @@ class ParallelInference:
                  generation_block_size: int = 1,
                  generation_registry=None,
                  generation_trace_store=None,
-                 generation_tracing: bool = True):
+                 generation_tracing: bool = True,
+                 generation_mesh=None,
+                 generation_spec_layout=None):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
@@ -68,6 +70,10 @@ class ParallelInference:
         self.generation_registry = generation_registry
         self.generation_trace_store = generation_trace_store
         self.generation_tracing = bool(generation_tracing)
+        # mesh-sharded generation (r12): a named (data, tp) mesh shards
+        # the decode path tensor/FSDP-parallel; None = single device
+        self.generation_mesh = generation_mesh
+        self.generation_spec_layout = generation_spec_layout
         self._telemetry = None
         self._jit_fwd = None
         self._lock = threading.Lock()
@@ -203,7 +209,9 @@ class ParallelInference:
                     block_size=self.generation_block_size,
                     registry=self.generation_registry,
                     trace_store=self.generation_trace_store,
-                    tracing=self.generation_tracing)
+                    tracing=self.generation_tracing,
+                    mesh=self.generation_mesh,
+                    spec_layout=self.generation_spec_layout)
                 if self.generation_supervised:
                     from .failures import EngineSupervisor
                     self._gen_supervisor = EngineSupervisor(

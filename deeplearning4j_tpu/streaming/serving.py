@@ -240,10 +240,22 @@ class GenerationServingRoute(_RoutePublishMixin):
                  max_inflight: int = 64, deadline: Optional[float] = None,
                  publish_retries: int = 3, retry_backoff: float = 0.05,
                  fault_injector=None, block_size: int = 1, registry=None,
-                 trace_store=None, tracing: bool = True):
+                 trace_store=None, tracing: bool = True, mesh=None,
+                 spec_layout=None):
         self._owns_engine = engine is None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
+        if engine is not None and mesh is not None:
+            # a prebuilt engine (or supervisor) carries its own mesh —
+            # silently ignoring mesh= here would let a caller believe
+            # decode is sharded when it is not (mirror of the engine's
+            # shared-decoder mesh-conflict guard)
+            inner = getattr(engine, "_engine", engine)
+            if getattr(inner, "mesh", None) is not mesh:
+                raise ValueError(
+                    "prebuilt engine was built for a different mesh; "
+                    "pass mesh= only when the route owns its engine "
+                    "(give the engine/supervisor its mesh instead)")
         if engine is None:
             from ..models.generation import SlotGenerationEngine
             # block_size > 1: requests complete (and publish) at decode-
@@ -251,13 +263,17 @@ class GenerationServingRoute(_RoutePublishMixin):
             # per block, admission batched at the boundary. The
             # observability sinks thread through whole: an isolated
             # registry/trace ring isolates the route-owned engine too.
+            # mesh= (r12): the route-owned engine decodes tensor/FSDP-
+            # parallel over a named (data, tp) mesh; a supervisor-
+            # wrapped or prebuilt engine carries its own mesh
             engine = SlotGenerationEngine(net, num_slots=num_slots,
                                           t_max=t_max,
                                           fault_injector=self._faults,
                                           block_size=block_size,
                                           registry=registry,
                                           trace_store=trace_store,
-                                          tracing=tracing)
+                                          tracing=tracing, mesh=mesh,
+                                          spec_layout=spec_layout)
         self.engine = engine
         self.broker = broker
         self.input_topic = input_topic

@@ -28,6 +28,12 @@ asserts the invariants the resilience + telemetry layers promise:
    static lock-order graph — zero cycles and zero unexplained
    inversions among package locks, takeover-built engines included;
 
+6. with ``--mesh DATAxTP`` (r12): the whole soak runs on a
+   mesh-SHARDED decoder over a forced-host-device CPU mesh — same
+   bars (zero stranded, zero steady-state compiles post-takeover, one
+   finished trace per request, token-identical completions), proving
+   supervised recovery composes with tensor/FSDP-parallel decode;
+
 plus the correctness bar: every COMPLETED request's tokens equal the
 uninterrupted clean-engine run, token for token (greedy). The summary
 also reports per-request latency p50/p99 (through the shared
@@ -63,7 +69,7 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
              vocab: int = 12, supervisor_timeout: float = 2.0,
              hang_seconds: float = None, wait_s: float = 180.0,
              steady_wave: int = 4, overhead_ab: bool = True,
-             lock_audit: bool = False) -> dict:
+             lock_audit: bool = False, mesh_shape: str = None) -> dict:
     """One soak iteration; returns a summary dict (see keys below).
 
     Prompt lengths and generation budgets are drawn so every prefill —
@@ -90,7 +96,17 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
     net = ComputationGraph(transformer_lm_conf(
         vocab, d_model=32, num_heads=2, num_layers=2, max_length=32,
         learning_rate=1e-2, seed=5)).init()
-    dec = TransformerDecoder(net)
+    # --mesh (r12): the WHOLE soak — clean reference, chaos run,
+    # takeovers, steady wave, overhead A/B — on a mesh-sharded decoder
+    # (forced-host-device CPU mesh; main() set XLA_FLAGS before jax
+    # loaded). The shared decoder carries the mesh through every
+    # supervisor-rebuilt engine.
+    mesh = None
+    if mesh_shape:
+        from deeplearning4j_tpu.parallel.mesh import (generation_mesh,
+                                                      parse_mesh_shape)
+        mesh = generation_mesh(*parse_mesh_shape(mesh_shape))
+    dec = TransformerDecoder(net, mesh=mesh)
 
     # prompt len 2..4, gens 2..max_new, max_new <= 11: prompt + generated
     # <= 15 < 16 keeps every (re-)prefill in the same tp=16 bucket
@@ -100,7 +116,8 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
     gens = [int(rng.integers(2, max_new + 1)) for _ in range(n_requests)]
 
     summary = {"seed": seed, "requests": n_requests, "crashes": crashes,
-               "hangs": hangs}
+               "hangs": hangs,
+               "mesh": mesh_shape if mesh_shape else None}
     # --lock-audit: every lock constructed during the soak (all three
     # engines, the supervisor, replacement engines built by takeovers)
     # is instrumented; observed acquisition orders are cross-checked
@@ -308,6 +325,11 @@ def main(argv=None) -> int:
                          "registry snapshot")
     ap.add_argument("--no-overhead-ab", action="store_true",
                     help="skip the telemetry-on/off throughput A/B")
+    ap.add_argument("--mesh", default=None, metavar="DATAxTP",
+                    help="run the soak on a mesh-sharded decoder "
+                         "('2x1', '1x2', '2x2', or a bare device "
+                         "count); forces a virtual host-device CPU "
+                         "mesh, so no hardware is needed")
     ap.add_argument("--lock-audit", action="store_true",
                     help="instrument every lock (LockAudit patch mode), "
                          "cross-check observed acquisition orders "
@@ -320,6 +342,27 @@ def main(argv=None) -> int:
                          "shape is host-bound and scheduler-noisy)")
     args = ap.parse_args(argv)
 
+    if args.mesh:
+        # XLA_FLAGS must land before jax initializes (run_soak performs
+        # the first jax import, so no framework import is allowed
+        # here); a light inline parse sizes the virtual device pool —
+        # parse_mesh_shape re-validates the grammar inside run_soak
+        txt = str(args.mesh).strip().lower()
+        parts = txt.split("x") if "x" in txt else [txt, "1"]
+        if len(parts) != 2:
+            ap.error(f"--mesh '{args.mesh}': expected DATAxTP, e.g. 2x1")
+        try:
+            need = 1
+            for p in parts:
+                need *= int(p)
+        except ValueError:
+            ap.error(f"--mesh '{args.mesh}': expected DATAxTP, e.g. 2x1")
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count="
+                     f"{max(need, 1)}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+
     ok = True
     for i in range(args.iterations):
         s = run_soak(seed=args.seed + i, n_requests=args.requests,
@@ -327,7 +370,7 @@ def main(argv=None) -> int:
                      crashes=args.crashes, hangs=args.hangs,
                      supervisor_timeout=args.supervisor_timeout,
                      overhead_ab=not args.no_overhead_ab,
-                     lock_audit=args.lock_audit)
+                     lock_audit=args.lock_audit, mesh_shape=args.mesh)
         over_budget = (s.get("telemetry_overhead_pct") or 0.0) > 5.0
         lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
                         s.get("lock_audit", {}).get("cycles"))
@@ -349,7 +392,9 @@ def main(argv=None) -> int:
                       f"{d['explained']}explained/"
                       f"{len(d['novel'])}novel/"
                       f"{len(d['inversions'])}inversions")
-            print(f"round {i}: seed={s['seed']} restarts={s['restarts']} "
+            mz = "" if not s.get("mesh") else f" mesh={s['mesh']}"
+            print(f"round {i}:{mz} seed={s['seed']} "
+                  f"restarts={s['restarts']} "
                   f"recovered={s['recovered_requests']} "
                   f"completed={s['completed']}/{s['requests']} "
                   f"stranded={s['stranded']} mismatches={s['mismatches']} "

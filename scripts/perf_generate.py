@@ -25,7 +25,22 @@ per-token latency, and readbacks/step. Exits NON-ZERO if no K>1 beats
 the K=1 baseline: the pipelined path must never ship slower than the
 loop it replaces.
 
-Run: [JAX_PLATFORMS=...] python scripts/perf_generate.py [--block-sweep]
+--mesh-sweep (r12) runs the mesh-sharded serving A/B instead: for each
+named (data, tp) mesh shape in GEN_MESH_SHAPES (default
+"1x1,2x1,1x2,4x1"), the serving-pattern loop at the best fused-block
+size (best of GEN_BLOCKS measured on the unsharded decoder;
+GEN_MESH_BLOCK overrides) — one JSON object with per-shape steady
+decode tok/s, p50/p99 per-token latency, readbacks/block, and the
+token-parity verdict vs the 1x1 run (greedy AND fixed-seed sampled).
+Exits NON-ZERO if any sharded shape breaks token parity: sharding may
+move compute, never tokens. Shapes that don't fit jax.device_count()
+(or fail the heads/batch divisibility contract) are reported skipped.
+On CPU the script forces XLA_FLAGS=--xla_force_host_platform_device_
+count=8 (GEN_MESH_DEVICES overrides) so the sweep runs without TPU
+hardware.
+
+Run: [JAX_PLATFORMS=...] python scripts/perf_generate.py \
+         [--block-sweep | --mesh-sweep]
 """
 
 from __future__ import annotations
@@ -38,6 +53,15 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--mesh-sweep" in sys.argv[1:]:
+    # must land BEFORE jax initializes; a no-op on real TPU/GPU backends
+    # (the flag only affects the host cpu platform)
+    _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+              if "xla_force_host_platform_device_count" not in f]
+    _flags.append("--xla_force_host_platform_device_count=" +
+                  os.environ.get("GEN_MESH_DEVICES", "8"))
+    os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 VOCAB = int(os.environ.get("GEN_VOCAB", "32000"))
 DMODEL = int(os.environ.get("GEN_DMODEL", "768"))
@@ -57,13 +81,22 @@ def _median(fn, runs=RUNS):
     return med, round(spread, 2)
 
 
+def _serving_run(dec, k, b, tokens, lengths, gen_t):
+    """The canonical serving-pattern timing loop, shared with the bench
+    driver (ONE definition repo-wide: a timing fix cannot land in one
+    table and miss another). Returns (tok/s, per-token latencies,
+    decode blocks, readbacks)."""
+    from bench import serving_run    # repo root is on sys.path (above)
+    return serving_run(dec, k, b, tokens, lengths, gen_t,
+                       tag="perf.decode")
+
+
 def block_sweep() -> int:
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models import (TransformerDecoder,
                                            transformer_lm_conf)
     from deeplearning4j_tpu.nn.graph import ComputationGraph
-    from deeplearning4j_tpu.ops.transfer import device_fetch, fetch_counts
 
     from deeplearning4j_tpu.observability.metrics import percentiles
 
@@ -85,40 +118,10 @@ def block_sweep() -> int:
     lengths = np.full(b, tp, np.int32)
 
     def run_once(k):
-        """One serving-pattern run at block size k: (tok/s, per-token
-        latencies, readbacks per step)."""
-        reads0 = fetch_counts().get("perf.decode", 0)
-        nx, _, cs = dec.prefill(dec.init_cache(b), tokens, lengths)
-        marks = []
-        if k == 1:                           # legacy baseline loop
-            ids, pos = np.asarray(nx), lengths.copy()
-            nb = gen_t
-            t0 = time.perf_counter()
-            for _ in range(gen_t):
-                nx2, _, cs = dec.decode_step(cs, ids, pos)
-                ids = device_fetch(nx2, tag="perf.decode")
-                marks.append(time.perf_counter())
-                pos = pos + 1
-        else:                                # pipelined block loop
-            ids, pos = nx, jnp.asarray(lengths)
-            stop = np.zeros(b, bool)
-            pending = None
-            nb = max(1, gen_t // k)
-            t0 = time.perf_counter()
-            for blk in range(nb):
-                toks, ids, pos, stop, cs = dec.decode_block(
-                    cs, ids, pos, block_size=k, stopped=stop,
-                    step0=blk * k)
-                if pending is not None:
-                    device_fetch(pending, tag="perf.decode")
-                    marks.append(time.perf_counter())
-                pending = toks
-            device_fetch(pending, tag="perf.decode")
-            marks.append(time.perf_counter())
-        total = time.perf_counter() - t0
-        lats = np.diff([t0] + marks) / k
-        reads = fetch_counts().get("perf.decode", 0) - reads0
-        return b * nb * k / total, lats, reads / (nb * k)
+        """(tok/s, per-token latencies, readbacks per STEP) at block k."""
+        tps, lats, nb, reads = _serving_run(dec, k, b, tokens, lengths,
+                                            gen_t)
+        return tps, lats, reads / (nb * k)
 
     table = {}
     for k in ks:
@@ -154,6 +157,129 @@ def block_sweep() -> int:
         "ok": ok,
     }, indent=1), flush=True)
     return 0 if ok else 1
+
+
+def mesh_sweep() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import (TransformerDecoder,
+                                           transformer_lm_conf)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.metrics import percentiles
+    from deeplearning4j_tpu.parallel.mesh import (generation_mesh,
+                                                  parse_mesh_shape)
+
+    b = int(os.environ.get("GEN_SWEEP_BATCH", str(max(BATCHES))))
+    tp = int(os.environ.get("GEN_SWEEP_PROMPT", str(max(PROMPTS))))
+    gen_t = int(os.environ.get("GEN_SWEEP_TOKENS", str(max(TOKENS))))
+    conf = transformer_lm_conf(vocab_size=VOCAB, d_model=DMODEL,
+                               num_heads=HEADS, num_layers=LAYERS,
+                               max_length=tp + gen_t + 1)
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
+    # parity twin at f32: cross-mesh token identity is a property of the
+    # PARTITIONING discipline, and it is gated where reduction-order
+    # noise sits far below any decision threshold. At bf16 compute the
+    # GSPMD reduction reorder lands AT the quantum, so an untrained
+    # flat-logit model can drift tokens across meshes — a dtype
+    # property, not a sharding bug; the bf16 net above still carries
+    # every timed number. Same conf + seed → identical master params.
+    net_parity = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, VOCAB, (b, tp)).astype(np.int32)
+    lengths = np.full(b, tp, np.int32)
+    parity_prompts = [tokens[i, :tp] for i in range(min(b, 4))]
+
+    def run_once(dec, k):
+        """(tok/s, per-token latencies, readbacks per BLOCK) at block
+        k — the shared --block-sweep timing loop on ``dec``."""
+        tps, lats, nb, reads = _serving_run(dec, k, b, tokens, lengths,
+                                            gen_t)
+        return tps, lats, reads / nb
+
+    # best K measured on the unsharded decoder (GEN_MESH_BLOCK pins it)
+    dec0 = TransformerDecoder(net)
+    blk_env = os.environ.get("GEN_MESH_BLOCK", "")
+    if blk_env:
+        best_k = int(blk_env)
+    else:
+        ks = sorted({int(t) for t in
+                     os.environ.get("GEN_BLOCKS", "1,4,8").split(",")
+                     if int(t) >= 1})
+        by_k = {}
+        for k in ks:
+            run_once(dec0, k)                    # warm
+            by_k[k] = float(np.median(
+                [run_once(dec0, k)[0] for _ in range(RUNS)]))
+        best_k = max(by_k, key=by_k.get)
+
+    # parity references off the unsharded f32 twin
+    pdec0 = TransformerDecoder(net_parity)
+    ref_greedy = pdec0.generate(parity_prompts, 12, temperature=0.0,
+                                block_size=best_k)
+    ref_sampled = pdec0.generate(parity_prompts, 12, temperature=1.0,
+                                 seed=11, block_size=best_k)
+
+    shapes = [s.strip() for s in
+              os.environ.get("GEN_MESH_SHAPES",
+                             "1x1,2x1,1x2,4x1").split(",") if s.strip()]
+    table = {}
+    parity_ok = True
+    for shp in shapes:
+        try:
+            data, tpx = parse_mesh_shape(shp)
+        except ValueError as e:
+            table[shp] = {"skipped": str(e)[:160]}
+            continue
+        if data * tpx > jax.device_count():
+            table[shp] = {"skipped": f"needs {data * tpx} devices, "
+                                     f"jax.device_count()="
+                                     f"{jax.device_count()}"}
+            continue
+        try:
+            mesh = generation_mesh(data, tpx)
+            dec = TransformerDecoder(net, mesh=mesh)
+            pdec = TransformerDecoder(net_parity, mesh=mesh)
+            got_g = pdec.generate(parity_prompts, 12, temperature=0.0,
+                                  block_size=best_k)
+            got_s = pdec.generate(parity_prompts, 12, temperature=1.0,
+                                  seed=11, block_size=best_k)
+        except ValueError as e:
+            table[shp] = {"skipped": str(e)[:160]}
+            continue
+        parity = (all(np.array_equal(a, g)
+                      for a, g in zip(ref_greedy, got_g)) and
+                  all(np.array_equal(a, g)
+                      for a, g in zip(ref_sampled, got_s)))
+        parity_ok = parity_ok and parity
+        run_once(dec, best_k)                    # warm this mesh
+        vals, lats, rpb = [], [], []
+        for _ in range(RUNS):
+            tps, ls, rp = run_once(dec, best_k)
+            vals.append(tps)
+            lats.extend(ls)
+            rpb.append(rp)
+        med = float(np.median(vals))
+        pct = percentiles(lats, (50, 99))
+        table[shp] = {
+            "decode_tok_s": round(med, 1),
+            "spread_pct": round(
+                100.0 * (max(vals) - min(vals)) / med, 2) if med else 0.0,
+            "p50_ms": round(pct["p50"] * 1e3, 3),
+            "p99_ms": round(pct["p99"] * 1e3, 3),
+            "readbacks_per_block": round(float(np.mean(rpb)), 4),
+            "token_parity_vs_1x1": parity,
+        }
+    print(json.dumps({
+        "mesh_sweep": table,
+        "block_size": best_k,
+        "shape": {"batch": b, "prompt_t": tp, "gen_t": gen_t,
+                  "vocab": VOCAB, "d_model": DMODEL, "heads": HEADS,
+                  "layers": LAYERS},
+        "devices": jax.device_count(),
+        "ok": parity_ok,
+    }, indent=1), flush=True)
+    return 0 if parity_ok else 1
 
 
 def main() -> int:
@@ -276,4 +402,6 @@ def main() -> int:
 if __name__ == "__main__":
     if "--block-sweep" in sys.argv[1:]:
         sys.exit(block_sweep())
+    if "--mesh-sweep" in sys.argv[1:]:
+        sys.exit(mesh_sweep())
     sys.exit(main())

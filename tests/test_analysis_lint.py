@@ -1016,6 +1016,47 @@ class TestShardingRules:
         """, rules=["GL013"])
         assert len(out) == 1 and "rank-1" in out[0].message
 
+    def test_dataclass_axis_vocab_catches_typo(self, tmp_path):
+        """r12: a module declaring its axes as dataclass fields (the
+        SpecLayout idiom — AnnAssign, not Assign) still contributes to
+        the axis vocabulary, so a typo'd literal axis in its spec
+        tables is caught instead of being vocabulary-blind."""
+        out = _lint_src(tmp_path, """
+            import dataclasses
+            from jax.sharding import PartitionSpec as P
+
+            @dataclasses.dataclass(frozen=True)
+            class Layout:
+                data_axis: str = "data"
+                tp_axis: str = "tp"
+
+            SPECS = {"Wq": P(None, "tpp")}
+        """, rules=["GL013"])
+        assert len(out) == 1 and "'tpp'" in out[0].message
+
+    def test_dataclass_axis_vocab_accepts_declared(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import dataclasses
+            from jax.sharding import PartitionSpec as P
+
+            @dataclasses.dataclass(frozen=True)
+            class Layout:
+                data_axis: str = "data"
+                tp_axis: str = "tp"
+
+            SPECS = {"Wq": P(None, "tp"), "Wo": P("tp", None)}
+        """, rules=["GL013"])
+        assert out == []
+
+    def test_annotated_module_axis_constant_counts(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            from jax.sharding import PartitionSpec as P
+
+            TP_AXIS: str = "tp"
+            TABLE = {"W1": P(None, "tp"), "W2": P("model", None)}
+        """, rules=["GL013"])
+        assert len(out) == 1 and "'model'" in out[0].message
+
     def test_consistent_specs_are_fine(self, tmp_path):
         out = _lint_src(tmp_path, """
             from jax.sharding import Mesh, PartitionSpec as P
@@ -1067,8 +1108,9 @@ class TestShardingRules:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         pkg = os.path.join(root, "deeplearning4j_tpu")
         paths = [os.path.join(pkg, "parallel", f) for f in
-                 ("mesh.py", "tensor.py", "wrapper.py", "sequence.py",
-                  "pipeline.py", "inference.py")]
+                 ("mesh.py", "spec_layout.py", "tensor.py", "wrapper.py",
+                  "sequence.py", "pipeline.py", "inference.py")]
+        paths.append(os.path.join(pkg, "models", "generation.py"))
         found = lint_paths(paths, repo_root=root,
                            rules=["GL013", "GL014"])
         assert found == [], "\n".join(str(f) for f in found)
