@@ -729,6 +729,11 @@ class GenerationRequest:
         self._running = False              # holds a cache slot right now
         self._cancel_requested = False
         self._engine = None                # set at submit; woken on cancel
+        # completion hooks (fleet tier): fired exactly once per callback
+        # when the request reaches a terminal state, outside every engine
+        # lock — the fleet router's dedup ledger hangs off this seam
+        self._cb_lock = threading.Lock()
+        self._callbacks: List = []
         # observability: one Trace per request for its WHOLE life — it
         # rides on the request through supervisor quarantine/requeue, so
         # a recovered request keeps its original timeline (plus a
@@ -743,6 +748,7 @@ class GenerationRequest:
         if self.trace is not None:
             self.trace.finish("ok", tokens=len(self.generated))
         self._done.set()
+        self._fire_callbacks()
 
     def _fail(self, exc: BaseException):
         self._error = exc
@@ -751,6 +757,35 @@ class GenerationRequest:
             self.trace.finish(f"failed:{type(exc).__name__}",
                               tokens=len(self.generated))
         self._done.set()
+        self._fire_callbacks()
+
+    def _fire_callbacks(self):
+        # drain-under-lock then fire outside it: a callback that submits
+        # or requeues (the fleet migration path) must never run inside
+        # _cb_lock, and each registered callback fires exactly once even
+        # when racing add_done_callback
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:   # noqa: BLE001 — a bad hook can't strand
+                pass            # the engine thread that completed us
+
+    def add_done_callback(self, fn) -> None:
+        """Register ``fn(request)`` to fire when the request reaches a
+        terminal state (DONE / FAILED / CANCELLED). Fires from whichever
+        thread completes the request — or immediately, in the calling
+        thread, if the request is already done. Exactly once per
+        registered callback."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:   # noqa: BLE001 — same contract as the
+            pass            # completion-path fire: a bad hook is swallowed
 
     def _expired(self, now: Optional[float] = None) -> bool:
         return self._deadline_t is not None and \

@@ -275,15 +275,47 @@ class EngineSupervisor(HeartbeatMonitor):
         takeovers, so a request is never dropped into a dead engine that
         no one will ever restart."""
         with self._sup_lock:
-            eng = self._engine
-            with eng._lock:
-                dead = eng._dead
-            if dead is not None and self.given_up is None:
-                # crashed but the crash callback lost the race — restart
-                # now, then submit to the replacement
-                self._restart(cause=dead)
-                eng = self._engine
+            eng = self._current_engine()
             return eng.submit(*args, **kwargs)
+
+    def requeue(self, req) -> None:
+        """Re-queue a recovered request through the CURRENT engine — the
+        cross-replica migration entry point (streaming/fleet.py): a fleet
+        router moving work off a dead replica must land it in whatever
+        engine this supervisor is running NOW, never in a quarantined one
+        a takeover already retired. Serialized against takeovers like
+        ``submit()``; recovery bypasses admission control."""
+        with self._sup_lock:
+            eng = self._current_engine()
+            eng.requeue(req)
+
+    def _current_engine(self):
+        # callers hold _sup_lock. If the engine crashed but the crash
+        # callback lost the race, restart now and hand back the
+        # replacement.
+        eng = self._engine
+        with eng._lock:
+            dead = eng._dead
+        if dead is not None and self.given_up is None:
+            self._restart(cause=dead)
+            eng = self._engine
+        return eng
+
+    def quarantine(self):
+        """Retire this supervised replica for fleet-level migration: stop
+        supervising (a crash/wedge callback arriving later is a no-op),
+        then quarantine the current engine and hand back its recoverable
+        requests exactly once — the same harvest contract
+        ``SlotGenerationEngine.quarantine`` gives, lifted over takeovers.
+        Returns ``(recoverable_requests, death_cause)``."""
+        with self._sup_lock:
+            self._stopped = True
+            eng = self._engine
+        HeartbeatMonitor.stop(self)
+        # quarantine OUTSIDE _sup_lock (it takes the engine lock; the
+        # crash callback path takes _sup_lock from the engine thread —
+        # same discipline as stop())
+        return eng.quarantine()
 
     def stats(self) -> dict:
         """Current engine's counters PLUS everything quarantined engines

@@ -59,9 +59,19 @@ class RejectedError(RuntimeError):
         self.queue_depth = int(queue_depth)
 
 
-#: documented injection points — components fire these names
+#: documented injection points — components fire these names.
+#: The fleet tier (streaming/fleet.py) fires ``fleet.dispatch`` per
+#: router dispatch attempt (raise = transport failure → retry on the
+#: next-best replica; drop = lost dispatch frame), ``fleet.heartbeat``
+#: per replica heartbeat (hang = momentarily-slow replica → SUSPECT;
+#: drop = silent replica → SUSPECT → DEAD zombie), and ``replica.kill``
+#: per heartbeat iteration (raise = hard replica crash, detected and
+#: migrated immediately). Fleet chaos schedules stay deterministic by
+#: arming ONE injector per replica — concurrent replicas never interleave
+#: on a shared hit counter.
 POINTS = ("engine.step", "engine.prefill", "broker.send", "broker.recv",
-          "route.publish", "route.consume")
+          "route.publish", "route.consume", "fleet.dispatch",
+          "fleet.heartbeat", "replica.kill")
 
 
 class _NullInjector:

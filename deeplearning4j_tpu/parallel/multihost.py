@@ -90,8 +90,33 @@ def host_allreduce_mean(tree, tag: str, timeout_ms: int = 60_000):
     flat = np.concatenate([a.astype(np.float64).ravel() for a in arrs]) \
         if arrs else np.zeros(0, np.float64)
     key = f"dl4j/hostavg/{tag}"
-    client.key_value_set(f"{key}/{jax.process_index()}",
-                         base64.b64encode(flat.tobytes()).decode("ascii"))
+    payload = base64.b64encode(flat.tobytes()).decode("ascii")
+    my_key = f"{key}/{jax.process_index()}"
+    try:
+        client.key_value_set(my_key, payload)
+    except Exception as exc:   # noqa: BLE001 — store raises on overwrite
+        # keys are WRITE-ONCE in the coordinator store: a reused tag
+        # would silently hand every peer the PREVIOUS reduction's buffers
+        # (same keys, stale values). Distinguish an idempotent retry
+        # (same payload already published — benign) from a genuine tag
+        # collision, and name the tag so the bug is findable. Caveat:
+        # a REUSED tag whose local payload happens to be byte-identical
+        # to the previous reduction (converged metric, zeroed grads) is
+        # indistinguishable from a retry HERE and would still read stale
+        # peers — tag-per-logical-reduction uniqueness remains the
+        # caller's contract; only the differing-payload case is locally
+        # detectable.
+        try:
+            existing = client.blocking_key_value_get(my_key, 1_000)
+        except Exception:
+            raise exc   # can't read it back: surface the original error
+        if existing != payload:
+            raise ValueError(
+                f"host_allreduce_mean tag '{tag}' was already used with "
+                f"a different payload: coordinator keys are write-once, "
+                f"so reusing a tag returns every peer's STALE buffers. "
+                f"Use a unique tag per logical reduction (e.g. suffix a "
+                f"step counter).") from exc
     acc = np.zeros_like(flat)
     for p in range(n):
         blob = client.blocking_key_value_get(f"{key}/{p}", timeout_ms)

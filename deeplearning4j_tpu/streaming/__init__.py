@@ -2,6 +2,8 @@
 NDArray pub/sub and model serving — NDArrayKafkaClient, DL4jServeRouteBuilder;
 SURVEY.md §2.4)."""
 
+from .fleet import (EngineFleetRouter, EngineReplica, FleetLedger,
+                    FleetMembership, FleetRequest, KVFleetMembership)
 from .pubsub import (MessageBroker, NDArrayPublisher, NDArraySubscriber,
                      NDArrayStreamClient)
 from .serving import ModelServingRoute
@@ -9,4 +11,6 @@ from .tcp_broker import TcpBrokerServer, TcpMessageBroker  # registers tcp://
 
 __all__ = ["MessageBroker", "NDArrayPublisher", "NDArraySubscriber",
            "NDArrayStreamClient", "ModelServingRoute", "TcpBrokerServer",
-           "TcpMessageBroker"]
+           "TcpMessageBroker", "EngineFleetRouter", "EngineReplica",
+           "FleetLedger", "FleetMembership", "FleetRequest",
+           "KVFleetMembership"]

@@ -1,0 +1,1189 @@
+"""Replicated engine fleet: least-loaded routing with cross-replica
+exactly-once migration (ROADMAP item 5).
+
+One ``SlotGenerationEngine`` is reliable, observable, and mesh-sharded
+(PRs 3-7); millions of users need N of them. This module is the fleet
+tier over the existing broker + serving-route machinery — the TPU-native
+analogue of the reference's Spark executors behind a driver (SURVEY
+§2.4), with the hard part being *surviving replica death without losing
+or duplicating a single request*:
+
+- :class:`EngineFleetRouter` — dispatches prompts to N engine replicas
+  (bare engines or :class:`..parallel.failures.EngineSupervisor`-wrapped)
+  by LEAST-LOADED policy, driven by each replica's live queue-depth /
+  active-slot gauges (the ``stats()`` data the PR 5 ``/snapshot``
+  endpoint serves). Per-replica health rides a heartbeat protocol:
+  ``ALIVE`` → ``SUSPECT`` after ``suspect_after`` without a beat →
+  ``DEAD`` after ``dead_after``; recovery from SUSPECT needs
+  ``recover_beats`` consecutive fresh scans (hysteresis — a momentarily
+  slow replica is sidelined, not flapped dead and back). The router
+  duck-types the engine surface (``submit/start/shutdown/stats``), so
+  ``GenerationServingRoute(engine=router)`` serves a whole fleet from a
+  topic with in-order publishing unchanged.
+
+- Cross-replica migration — :class:`EngineSupervisor`'s exactly-once
+  requeue generalized across process boundaries. A replica declared dead
+  has its non-terminal requests re-dispatched to survivors exactly once:
+  a *reachable* corpse (crash callback, explicit kill) is quarantined
+  and its harvested requests requeued object-for-object (the same
+  takeover contract as supervised restart — resume by re-prefilling
+  prompt + generated-so-far, token-identical greedy); an *unreachable*
+  one (heartbeat death: in a real fleet you cannot quarantine a
+  partitioned process) gets CLONE-based re-dispatch from the router's
+  own request record. Either way the :class:`FleetLedger` — request id →
+  assigned replica, completion fencing — guarantees fleet-wide
+  exactly-once: a zombie replica's late completion is rejected because
+  migration *reassigned* the request, and a double completion is
+  rejected because the ledger records the first. The in-process
+  ``_admitting`` parking trick does not cross processes; the ledger is
+  what replaces it.
+
+- Graceful degradation — the router sheds with
+  :class:`..parallel.faults.RejectedError` (carrying the observed fleet
+  queue depth) only when EVERY live replica is saturated; SUSPECT
+  replicas are dispatched to only when no ALIVE one can take the
+  request. A sticky-routing seam (consistent hash over a prompt-prefix
+  key, overridable per request) keeps same-prefix prompts on one
+  replica — the cooperation hook the prefix cache (ROADMAP item 2)
+  needs — and spills to the ring successor on saturation or death.
+
+Fault points (``parallel/faults.py``): ``fleet.dispatch`` per dispatch
+attempt, ``fleet.heartbeat`` per replica beat, ``replica.kill`` per
+heartbeat iteration. Arm ONE injector per replica so N concurrent
+replicas never interleave on a shared hit counter — fleet chaos stays
+deterministic (``scripts/chaos_soak.py --replicas N``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.metrics import default_registry
+from ..observability.tracing import default_trace_ring
+from ..parallel.faults import NULL_INJECTOR, RejectedError
+
+#: replica health states (the membership protocol's vocabulary)
+REPLICA_ALIVE = "ALIVE"
+REPLICA_SUSPECT = "SUSPECT"
+REPLICA_DEAD = "DEAD"
+
+_FLEET_SEQ = itertools.count()
+_FLEET_REQ_SEQ = itertools.count(1)
+
+#: fleet counters: metric suffix → help text (one labeled child per
+#: router instance, label ``fleet=<id>`` — same registry discipline as
+#: the engine/route counters)
+_FLEET_COUNTERS = {
+    "requests": "requests submitted through the fleet router",
+    "migrations": "requests migrated off a dead replica",
+    "fenced_completions": "completions rejected by fencing (stale "
+                          "replica after migration)",
+    "duplicate_completions": "completions rejected as duplicates "
+                             "(request already completed)",
+    "shed": "requests shed by router-level admission control "
+            "(all replicas saturated or dead)",
+    "dispatch_errors": "dispatch attempts that failed in transport "
+                       "(retried on the next-best replica)",
+}
+
+
+def _ring_hash(s: str) -> int:
+    """Deterministic 64-bit hash (stable across processes — ``hash()``
+    is salted per interpreter and would break sticky routing)."""
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8], "big")
+
+
+# --------------------------------------------------------------- ledger
+class FleetLedger:
+    """Fleet-wide exactly-once dedup ledger: request id → assigned
+    replica, with completion fencing.
+
+    The single-engine supervisor gets exactly-once from in-process lock
+    discipline (``_admitting`` parking + quarantine). Across replicas —
+    where in a real deployment the router cannot reach into a dead
+    process — the ledger is the authority instead:
+
+    - ``assign``/``try_reassign`` record which replica OWNS a request;
+      reassignment (migration) refuses if the request already completed,
+      so migration and completion are mutually exclusive;
+    - ``try_complete(req, replica)`` accepts a completion only from the
+      CURRENT assignee and only ONCE — a slow-to-die replica's late
+      publish for a migrated request is ``fenced``, a second completion
+      is a ``duplicate``; both are counted, never served.
+
+    Completed entries are retained in a bounded LRU window
+    (``completed_window``) so late duplicates are still classified after
+    the router forgot the live request; beyond the window a stale
+    completion still fails the assignee check (fenced).
+    """
+
+    def __init__(self, completed_window: int = 4096):
+        self._lock = threading.Lock()
+        self._assignee: Dict[str, str] = {}
+        self._completed: "OrderedDict[str, str]" = OrderedDict()
+        self._window = int(completed_window)
+        self.duplicates = 0
+        self.fenced = 0
+        self.reassignments = 0
+        self.completed_total = 0
+
+    def assign(self, req_id: str, replica_id: str) -> None:
+        with self._lock:
+            self._assignee[req_id] = replica_id
+
+    def try_reassign(self, req_id: str, replica_id: str) -> bool:
+        """Move ownership (migration). False iff the request already
+        completed — the migration must then be abandoned, or a finished
+        request would decode (and publish) a second time."""
+        with self._lock:
+            if req_id in self._completed:
+                return False
+            self._assignee[req_id] = replica_id
+            self.reassignments += 1
+            return True
+
+    def try_complete(self, req_id: str, replica_id: str) -> str:
+        """Record a completion attempt; returns ``"ok"`` (first
+        completion by the current assignee), ``"duplicate"`` (already
+        completed) or ``"fenced"`` (stale replica: the request was
+        reassigned away, or was never assigned here)."""
+        with self._lock:
+            if req_id in self._completed:
+                self.duplicates += 1
+                return "duplicate"
+            if self._assignee.get(req_id) != replica_id:
+                self.fenced += 1
+                return "fenced"
+            self._assignee.pop(req_id, None)
+            self._completed[req_id] = replica_id
+            self.completed_total += 1
+            while len(self._completed) > self._window:
+                self._completed.popitem(last=False)
+            return "ok"
+
+    def reject_stale(self, req_id: str) -> None:
+        """Count a completion from an inner handle migration already
+        replaced (identity fencing caught it before the ledger had to)."""
+        with self._lock:
+            self.fenced += 1
+
+    def assignee(self, req_id: str) -> Optional[str]:
+        with self._lock:
+            return self._assignee.get(req_id)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {"open": len(self._assignee),
+                    "completed": self.completed_total,
+                    "reassignments": self.reassignments,
+                    "duplicates": self.duplicates,
+                    "fenced": self.fenced}
+
+
+# ----------------------------------------------------------- membership
+class FleetMembership:
+    """In-process membership table: replicas ``beat(rid, load)``, the
+    router reads ``ages()`` — seconds since each member's last beat,
+    plus the load the beat carried. The transport-crossing variant is
+    :class:`KVFleetMembership`; both expose the same surface, so the
+    router is membership-agnostic."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beats: Dict[str, Tuple[float, int]] = {}
+
+    def register(self, replica_id: str) -> None:
+        self.beat(replica_id, 0)
+
+    def beat(self, replica_id: str, load: int) -> None:
+        with self._lock:
+            self._beats[replica_id] = (time.monotonic(), int(load))
+
+    def leave(self, replica_id: str) -> None:
+        with self._lock:
+            self._beats.pop(replica_id, None)
+
+    def ages(self) -> Dict[str, Tuple[float, int]]:
+        now = time.monotonic()
+        with self._lock:
+            return {rid: (now - t, load)
+                    for rid, (t, load) in self._beats.items()}
+
+
+class KVFleetMembership:
+    """Membership over the jax.distributed coordinator key-value store
+    (``parallel/multihost.distributed_client()``) — the cross-process
+    seam: replicas in separate processes beat through the coordinator
+    the way ``host_allreduce_mean`` stages buffers through it.
+
+    The store is WRITE-ONCE, so beats are sequence-numbered keys
+    (``dl4j/fleet/<fleet>/<rid>/<seq>``) rather than overwrites, and
+    liveness is *sequence advancement observed locally*: ``ages()``
+    reports seconds since this process last saw a member's seq move —
+    no cross-host clock is ever compared. A member leaves by writing a
+    ``<rid>/left`` tombstone (once, naturally write-once-safe).
+
+    Because the store is write-once, old beat keys ACCUMULATE — the
+    coordinator footprint and per-scan directory size grow with total
+    beats written (the store has no delete; compaction would need an
+    epoch-prefixed directory rotation, future work). ``ages()`` keeps
+    the scan cheap — one int parse per key and at most one json parse
+    per member per scan — but long-lived fleets should beat coarsely
+    through this seam (``heartbeat_interval`` ≥ 0.5s) rather than at
+    the in-process default."""
+
+    def __init__(self, client, fleet_id: str = "fleet0"):
+        self._client = client
+        self.fleet_id = str(fleet_id)
+        self._prefix = f"dl4j/fleet/{self.fleet_id}/"
+        self._lock = threading.Lock()
+        self._seq: Dict[str, int] = {}
+        # rid -> [last seq seen, local time it changed, load it carried]
+        self._seen: Dict[str, List] = {}
+
+    def register(self, replica_id: str) -> None:
+        self.beat(replica_id, 0)
+
+    def beat(self, replica_id: str, load: int) -> None:
+        with self._lock:
+            self._seq[replica_id] = self._seq.get(replica_id, 0) + 1
+            seq = self._seq[replica_id]
+        payload = json.dumps({"load": int(load)})
+        try:
+            self._client.key_value_set(
+                f"{self._prefix}{replica_id}/{seq:08d}", payload)
+        except Exception:   # noqa: BLE001 — a dup key (restarted beater
+            pass            # replaying a seq) is a missed beat, not fatal
+
+    def leave(self, replica_id: str) -> None:
+        try:
+            self._client.key_value_set(
+                f"{self._prefix}{replica_id}/left", "1")
+        except Exception:   # noqa: BLE001 — second leave: already gone
+            pass
+
+    def ages(self) -> Dict[str, Tuple[float, int]]:
+        try:
+            entries = self._client.key_value_dir_get(self._prefix)
+        except Exception:   # noqa: BLE001 — coordinator hiccup: ages
+            entries = None  # keep growing from the local cache
+        now = time.monotonic()
+        with self._lock:
+            if entries is not None:
+                latest: Dict[str, Tuple[int, str]] = {}
+                left = set()
+                for key, val in entries:
+                    rest = str(key)[len(self._prefix):] \
+                        if str(key).startswith(self._prefix) else str(key)
+                    rid, _, tail = rest.partition("/")
+                    if tail == "left":
+                        left.add(rid)
+                        continue
+                    try:
+                        seq = int(tail)
+                    except ValueError:
+                        continue
+                    if seq > latest.get(rid, (-1, ""))[0]:
+                        latest[rid] = (seq, val)
+                for rid in left:
+                    self._seen.pop(rid, None)
+                    latest.pop(rid, None)
+                for rid, (seq, val) in latest.items():
+                    rec = self._seen.get(rid)
+                    if rec is None or rec[0] != seq:
+                        # payload parsed only on seq ADVANCEMENT — an
+                        # unchanged seq is the same beat (same load)
+                        try:
+                            load = int(json.loads(val).get("load", 0))
+                        except (ValueError, TypeError):
+                            continue
+                        self._seen[rid] = [seq, now, load]
+            return {rid: (now - t, load)
+                    for rid, (_, t, load) in self._seen.items()}
+
+
+# -------------------------------------------------------------- replica
+class EngineReplica:
+    """One fleet member: a ``SlotGenerationEngine`` (bare) or an
+    ``EngineSupervisor`` wrapping one (restart-in-place is then the
+    first line of defense; the fleet only migrates when the whole
+    replica dies), plus the heartbeat thread that publishes this
+    replica's liveness + load into the membership table.
+
+    ``reachable`` models the transport: a crash the router OBSERVES
+    (crash callback, explicit kill) leaves a reachable corpse that can
+    be quarantined and harvested; a heartbeat death is treated as a
+    partition — the engine may still be running (zombie), so migration
+    re-dispatches clones and relies on ledger fencing instead."""
+
+    def __init__(self, replica_id: str, engine, membership,
+                 fault_injector=None, heartbeat_interval: float = 0.05):
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        self.supervised = hasattr(engine, "_sup_lock")
+        inner = engine.engine if self.supervised else engine
+        self.capacity = int(inner.max_pending) + int(inner.num_slots)
+        self.reachable = True
+        self._membership = membership
+        self._faults = fault_injector if fault_injector is not None \
+            else NULL_INJECTOR
+        self.heartbeat_interval = float(heartbeat_interval)
+        self._stop_hb = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._on_kill = None        # callable(replica_id, exc) — router
+
+    # ----------------------------------------------------------- engine
+    def submit(self, *args, **kwargs):
+        return self.engine.submit(*args, **kwargs)
+
+    def requeue(self, req) -> None:
+        self.engine.requeue(req)
+
+    def quarantine(self):
+        return self.engine.quarantine()
+
+    def shutdown(self) -> None:
+        self.stop_heartbeat()
+        try:
+            if self.supervised:
+                self.engine.stop()
+            else:
+                self.engine.shutdown()
+        except Exception:   # noqa: BLE001 — a dead replica's teardown
+            pass            # must not abort the fleet's
+
+    def given_up(self) -> Optional[BaseException]:
+        return self.engine.given_up if self.supervised else None
+
+    def dead(self) -> bool:
+        """True when the engine cannot accept work RIGHT NOW (worker
+        crashed, shut down, or a supervisor out of restart budget).
+        ``submit`` on such an engine fast-fails the request with the
+        replica-local death cause; the router must not deliver that to
+        the caller while healthy replicas exist — it spills instead."""
+        if self.supervised and self.engine.given_up is not None:
+            return True
+        eng = self.engine.engine if self.supervised else self.engine
+        try:
+            with eng._lock:
+                return bool(eng._shutdown) or eng._dead is not None
+        except Exception:   # noqa: BLE001 — unreadable == not taking work
+            return True
+
+    def load(self) -> Optional[int]:
+        """Live load (queued + decoding) from the replica's own gauges —
+        the number the ``/snapshot`` endpoint serves. ``None`` means the
+        replica could not be read (unreachable): callers fall back to
+        the membership table's last beat-carried load."""
+        try:
+            s = self.engine.stats()
+            return int(s.get("queue_depth", 0)) + \
+                int(s.get("active_slots", 0))
+        except Exception:   # noqa: BLE001
+            return None
+
+    # -------------------------------------------------------- heartbeat
+    def start(self) -> "EngineReplica":
+        self.engine.start()
+        self._membership.register(self.replica_id)
+        if self._hb_thread is None or not self._hb_thread.is_alive():
+            self._stop_hb.clear()
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True,
+                name=f"fleet-hb-{self.replica_id}")
+            self._hb_thread.start()
+        return self
+
+    def stop_heartbeat(self) -> None:
+        self._stop_hb.set()
+
+    def _hb_loop(self) -> None:
+        while not self._stop_hb.wait(self.heartbeat_interval):
+            try:
+                # scripted hard kill: a raise here is the replica dying
+                # between beats; the router is told and migrates NOW
+                self._faults.fire("replica.kill")
+            except BaseException as exc:   # noqa: BLE001 — scripted
+                cb = self._on_kill
+                if cb is not None:
+                    cb(self.replica_id, exc)
+                return
+            try:
+                # hang → a momentarily-slow replica (SUSPECT then
+                # recovery); drop → a silent one (SUSPECT then DEAD)
+                drop = self._faults.fire("fleet.heartbeat")
+            except Exception:   # noqa: BLE001 — an injected raise is a
+                drop = True     # missed beat, never a dead hb thread
+            if drop:
+                continue
+            load = self.load()
+            if load is not None:
+                self._membership.beat(self.replica_id, load)
+
+
+# -------------------------------------------------------- fleet request
+class FleetRequest:
+    """Fleet-level request handle: survives cross-replica migration.
+
+    Wraps the current replica-local ``GenerationRequest`` (``_inner``);
+    migration may swap the inner handle (clone-based re-dispatch), but
+    THIS object is what the caller — and the in-order route publisher —
+    holds, so ordering and ``result()`` semantics are untouched by
+    replica death. The trace rides the inner request(s): migration
+    shares one trace object across inners, keeping the
+    one-trace-per-request contract (with ``migrate`` spans at the
+    seams)."""
+
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+    def __init__(self, prompt, max_new_tokens: int, temperature: float,
+                 eos_id: Optional[int], deadline: Optional[float] = None,
+                 sticky_key=None):
+        self.request_id = f"flt{next(_FLEET_REQ_SEQ)}"
+        self.prompt = np.asarray(prompt, np.int64).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.deadline = None if deadline is None else float(deadline)
+        self._deadline_t = None if deadline is None \
+            else time.monotonic() + float(deadline)
+        self.sticky_key = sticky_key
+        self.migrations = 0
+        self.replica_id: Optional[str] = None
+        self._inner = None
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._result: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+        self._cancel_requested = False
+
+    # ------------------------------------------------------------ views
+    @property
+    def trace(self):
+        with self._lock:
+            inner = self._inner
+        return None if inner is None else inner.trace
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def state(self) -> str:
+        from ..parallel.faults import Cancelled
+        if self._done.is_set():
+            if self._error is None:
+                return self.DONE
+            if isinstance(self._error, Cancelled):
+                return self.CANCELLED
+            return self.FAILED
+        with self._lock:
+            inner = self._inner
+        if inner is not None and inner._running:
+            return self.RUNNING
+        return self.PENDING
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation not finished")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def cancel(self) -> bool:
+        if self._done.is_set():
+            return False
+        with self._lock:
+            self._cancel_requested = True
+            inner = self._inner
+        if inner is not None:
+            inner.cancel()
+        return True
+
+    # -------------------------------------------------------- internals
+    def _complete(self, result: np.ndarray) -> None:
+        self._result = result
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+
+    def __repr__(self) -> str:
+        mig = "" if not self.migrations else f" migrations={self.migrations}"
+        return (f"<FleetRequest {self.request_id} {self.state} "
+                f"replica={self.replica_id}{mig}>")
+
+
+# --------------------------------------------------------------- router
+class EngineFleetRouter:
+    """Least-loaded router over N engine replicas with health-tracked
+    membership, cross-replica exactly-once migration, and router-level
+    admission control. Duck-types the engine surface
+    (``submit``/``start``/``shutdown``/``stats``), so it drops into
+    ``GenerationServingRoute(engine=router)`` unchanged — the fleet
+    serves a topic with in-order publishing across migrations.
+
+    Build it from a net (N engines sharing ONE ``TransformerDecoder``,
+    so every replica runs the same jitted programs — migration re-serves
+    token-identical greedy outputs and steady state compiles nothing
+    new) or hand it prebuilt ``replicas=[engine_or_supervisor, ...]``.
+
+    ``supervised=True`` wraps each replica in an ``EngineSupervisor``:
+    crash/wedge restarts stay replica-local and the fleet only migrates
+    when a whole replica is lost. ``sticky_prefix=k`` enables sticky
+    routing on the first k prompt tokens (consistent hash; overridable
+    per ``submit(sticky_key=...)``); saturation or death spills a key to
+    its ring successor, deterministically."""
+
+    def __init__(self, net=None, num_replicas: int = 2, *,
+                 replicas: Optional[List] = None, decoder=None,
+                 num_slots: int = 8, t_max: Optional[int] = None,
+                 block_size: int = 1, max_pending: int = 256,
+                 refill: bool = True, seed: int = 0,
+                 supervised: bool = False,
+                 supervisor_timeout: float = 10.0,
+                 max_restarts: int = 3,
+                 membership=None, fleet_id: Optional[str] = None,
+                 fault_injector=None,
+                 replica_injectors: Optional[List] = None,
+                 heartbeat_interval: float = 0.05,
+                 monitor_interval: float = 0.05,
+                 suspect_after: float = 0.25, dead_after: float = 1.0,
+                 recover_beats: int = 3,
+                 sticky_prefix: Optional[int] = None,
+                 completed_window: int = 4096,
+                 registry=None, trace_store=None, tracing: bool = True):
+        self.fleet_id = fleet_id if fleet_id is not None \
+            else f"fleet{next(_FLEET_SEQ)}"
+        self._registry = registry if registry is not None \
+            else default_registry()
+        self._trace_store = trace_store if trace_store is not None \
+            else default_trace_ring()
+        self._tracing = bool(tracing)
+        self._faults = fault_injector if fault_injector is not None \
+            else NULL_INJECTOR
+        self._membership = membership if membership is not None \
+            else FleetMembership()
+        self._ledger = FleetLedger(completed_window=completed_window)
+        self.monitor_interval = float(monitor_interval)
+        self.suspect_after = float(suspect_after)
+        self.dead_after = float(dead_after)
+        self.recover_beats = int(recover_beats)
+        self.sticky_prefix = sticky_prefix if sticky_prefix is None \
+            else int(sticky_prefix)
+
+        # ---------------------------------------------------- replicas
+        engines = replicas
+        if engines is None:
+            if net is None:
+                raise ValueError("EngineFleetRouter needs a net (to build "
+                                 "replicas) or prebuilt replicas=[...]")
+            from ..models.generation import (SlotGenerationEngine,
+                                             TransformerDecoder)
+            if decoder is None:
+                decoder = TransformerDecoder(net, t_max=t_max)
+            engines = []
+            for i in range(int(num_replicas)):
+                inj = None if replica_injectors is None \
+                    else replica_injectors[i]
+                eng = SlotGenerationEngine(
+                    net, num_slots=num_slots, refill=refill, seed=seed,
+                    decoder=decoder, max_pending=max_pending,
+                    fault_injector=inj, block_size=block_size,
+                    registry=self._registry,
+                    trace_store=self._trace_store, tracing=self._tracing)
+                if supervised:
+                    from ..parallel.failures import EngineSupervisor
+                    eng = EngineSupervisor(
+                        eng, timeout=supervisor_timeout,
+                        max_restarts=max_restarts,
+                        name=f"{self.fleet_id}:r{i}")
+                engines.append(eng)
+        self._replicas: Dict[str, EngineReplica] = {}
+        for i, eng in enumerate(engines):
+            # prebuilt replicas get the injector too: the heartbeat/kill
+            # points live on the EngineReplica, not the engine
+            inj = None if replica_injectors is None \
+                else replica_injectors[i]
+            rep = EngineReplica(f"r{i}", eng, self._membership,
+                                fault_injector=inj,
+                                heartbeat_interval=heartbeat_interval)
+            rep._on_kill = self._on_replica_kill
+            self._replicas[rep.replica_id] = rep
+
+        # ------------------------------------------------ health state
+        self._lock = threading.Lock()
+        self._health: Dict[str, dict] = {
+            rid: {"state": REPLICA_ALIVE, "fresh": 0, "load": 0,
+                  "age": 0.0} for rid in self._replicas}
+        self._dead_handled: set = set()
+        # rid -> death cause; written only under _migrate_lock, read by
+        # _bind's retired-replica re-check (also under _migrate_lock)
+        self._death_cause: Dict[str, BaseException] = {}
+        self._live: Dict[str, FleetRequest] = {}
+        # serializes migrations; REENTRANT because a requeue inside
+        # _redispatch can fast-fail synchronously (destination died in
+        # the dispatch window) and re-enter migration through the
+        # done-callback completion gate in this same thread
+        self._migrate_lock = threading.RLock()
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_monitor = threading.Event()
+        self._started = False
+        self._shutdown_flag = False
+
+        # ------------------------------------------------- sticky ring
+        self._ring: List[Tuple[int, str]] = sorted(
+            (_ring_hash(f"{rid}#{v}"), rid)
+            for rid in self._replicas for v in range(32))
+
+        # ------------------------------------------------------ metrics
+        reg = self._registry
+        self._m = {key: reg.counter(f"fleet_{key}_total", desc,
+                                    ("fleet",)).labels(self.fleet_id)
+                   for key, desc in _FLEET_COUNTERS.items()}
+        self._g_replicas = reg.gauge(
+            "fleet_replicas", "fleet replicas by health state",
+            ("fleet", "state"))
+        self._update_gauges_locked_init()
+
+    def _update_gauges_locked_init(self) -> None:
+        with self._lock:
+            self._update_gauges_locked()
+
+    def _update_gauges_locked(self) -> None:
+        # caller holds self._lock
+        counts = {REPLICA_ALIVE: 0, REPLICA_SUSPECT: 0, REPLICA_DEAD: 0}
+        for h in self._health.values():
+            counts[h["state"]] += 1
+        for state, n in counts.items():
+            self._g_replicas.labels(self.fleet_id, state).set(n)
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               deadline: Optional[float] = None, *,
+               sticky_key=None, replica_id: Optional[str] = None
+               ) -> FleetRequest:
+        """Dispatch to the best replica; returns a :class:`FleetRequest`
+        (already failed with :class:`RejectedError` when the whole fleet
+        is saturated — mirror of the engine's shed contract, so the
+        serving route's publisher counts it as shed, not an error).
+
+        ``sticky_key`` overrides the prompt-prefix sticky key;
+        ``replica_id`` pins the request to one replica (falls back to
+        least-loaded only if that replica cannot take it)."""
+        fr = FleetRequest(prompt, max_new_tokens, temperature, eos_id,
+                          deadline=deadline, sticky_key=sticky_key)
+        self._m["requests"].inc()
+        with self._lock:
+            stopped = self._shutdown_flag
+        if stopped:
+            fr._fail(RuntimeError("EngineFleetRouter shut down"))
+            return fr
+        key = sticky_key
+        if key is None and self.sticky_prefix:
+            key = ",".join(str(int(t))
+                           for t in fr.prompt[:self.sticky_prefix])
+        order, loads = self._dispatch_order(prefer=replica_id,
+                                            sticky_key=key)
+        total_depth = 0
+        for rep in order:
+            ld = loads.get(rep.replica_id)
+            if ld is None:
+                continue                      # unreadable: skip
+            if ld >= rep.capacity:
+                total_depth += ld             # saturated: spill onward
+                continue
+            try:
+                if self._faults.fire("fleet.dispatch"):
+                    self._m["dispatch_errors"].inc()
+                    continue                  # injected lost frame
+            except Exception:   # noqa: BLE001 — injected transport error
+                self._m["dispatch_errors"].inc()
+                continue
+            inner = rep.submit(fr.prompt, fr.max_new_tokens,
+                               temperature=fr.temperature,
+                               eos_id=fr.eos_id, deadline=fr.deadline)
+            err = inner._error if inner.done() else None
+            if isinstance(err, RejectedError):
+                total_depth += rep.capacity   # raced to saturation
+                continue
+            if err is not None and rep.dead():
+                # the replica died between the health read and this
+                # dispatch: its fast-fail carries the crash cause, which
+                # must not reach the caller while another replica can
+                # serve — spill onward (a genuine synchronous failure,
+                # e.g. validation, still binds and propagates below)
+                self._m["dispatch_errors"].inc()
+                continue
+            self._bind(fr, inner, rep)
+            return fr
+        # every replica saturated, dead, or unreadable: router-level shed
+        self._m["shed"].inc()
+        fr._fail(RejectedError(
+            f"fleet {self.fleet_id}: all {len(self._replicas)} replicas "
+            f"saturated or dead — request shed",
+            queue_depth=total_depth))
+        return fr
+
+    def _bind(self, fr: FleetRequest, inner, rep: EngineReplica) -> None:
+        with fr._lock:
+            fr._inner = inner
+            fr.replica_id = rep.replica_id
+        self._ledger.assign(fr.request_id, rep.replica_id)
+        with self._lock:
+            self._live[fr.request_id] = fr
+            retired = rep.replica_id in self._dead_handled
+        tr = inner.trace
+        if tr is not None:
+            tr.event("dispatch", fleet=self.fleet_id,
+                     replica=rep.replica_id)
+        inner.add_done_callback(
+            lambda r, _fr=fr: self._on_inner_done(_fr, r))
+        if retired:
+            # the replica was retired between rep.submit() and this
+            # bind, so _migrate's victim snapshot could not include fr —
+            # a request the engine accepted (and quarantine may already
+            # have harvested) would otherwise be stranded forever.
+            # Migrate it here; _redispatch's src-assignee re-check under
+            # _migrate_lock makes this and a racing victim-loop pass
+            # mutually exclusive, so the inner is requeued exactly once.
+            with self._migrate_lock:
+                cause = self._death_cause.get(rep.replica_id) \
+                    or RuntimeError(f"replica {rep.replica_id} retired")
+                if self._redispatch(fr, rep, cause):
+                    self._m["migrations"].inc()
+
+    def _dispatch_order(self, prefer: Optional[str] = None,
+                        sticky_key=None
+                        ) -> Tuple[List[EngineReplica], Dict[str, int]]:
+        """Candidate replicas in dispatch-preference order, plus their
+        observed loads. Base policy: ALIVE by ascending load, then
+        SUSPECT by ascending load (a slow replica takes traffic only
+        when no healthy one can), DEAD never. A sticky key reorders the
+        live set to its consistent-hash ring walk; an explicit pin goes
+        first."""
+        with self._lock:
+            states = {rid: h["state"] for rid, h in self._health.items()}
+            beat_loads = {rid: h["load"] for rid, h in
+                          self._health.items()}
+            reps = dict(self._replicas)
+        loads: Dict[str, int] = {}
+        for rid, rep in reps.items():
+            if states[rid] == REPLICA_DEAD:
+                continue
+            ld = rep.load()
+            if ld is None:
+                ld = beat_loads.get(rid)      # fall back to last beat
+            if ld is not None:
+                loads[rid] = int(ld)
+        alive = sorted((rid for rid in loads
+                        if states[rid] == REPLICA_ALIVE),
+                       key=lambda r: (loads[r], r))
+        suspect = sorted((rid for rid in loads
+                          if states[rid] == REPLICA_SUSPECT),
+                         key=lambda r: (loads[r], r))
+        if sticky_key is not None:
+            # ring preference applies WITHIN each health class: a
+            # SUSPECT ring-owner must not hold its sticky traffic while
+            # an ALIVE replica can take it (degradation-ladder contract)
+            rank = {rid: i for i, rid in
+                    enumerate(self._ring_walk(str(sticky_key)))}
+            alive.sort(key=lambda r: rank[r])
+            suspect.sort(key=lambda r: rank[r])
+        order = alive + suspect
+        if prefer is not None and prefer in loads:
+            order = [prefer] + [r for r in order if r != prefer]
+        return [reps[rid] for rid in order], loads
+
+    def _ring_walk(self, key: str) -> List[str]:
+        """All replica ids in consistent-hash preference order for
+        ``key`` (first = owner, rest = successors — the spill order on
+        saturation or death)."""
+        h = _ring_hash(key)
+        idx = bisect.bisect(self._ring, (h, ""))
+        seen: List[str] = []
+        for i in range(len(self._ring)):
+            _, rid = self._ring[(idx + i) % len(self._ring)]
+            if rid not in seen:
+                seen.append(rid)
+        return seen
+
+    # -------------------------------------------------------- completion
+    def _on_inner_done(self, fr: FleetRequest, inner) -> None:
+        """Done-callback from a replica engine: the fleet's completion
+        gate. The inner-identity check fences handles migration already
+        replaced; the ledger fences replica-level staleness and
+        duplicates. A failure delivered by a replica that is itself dead
+        (the destination died inside the dispatch window and fast-failed
+        the requeue) is re-migrated instead of accepted — survivors must
+        mask a dead replica's cause here exactly as submit() does.
+        Accept exactly once, then finish the fleet request."""
+        with fr._lock:
+            if inner is not fr._inner:
+                # a clone superseded this handle (zombie's late publish)
+                self._ledger.reject_stale(fr.request_id)
+                self._m["fenced_completions"].inc()
+                return
+            err = inner._error
+            rid = fr.replica_id
+            cancelled = fr._cancel_requested
+        if err is not None and not cancelled \
+                and not isinstance(err, RejectedError) \
+                and fr.migrations < len(self._replicas):
+            with self._lock:
+                stopping = self._shutdown_flag
+            rep = self._replicas.get(rid)
+            if not stopping and rep is not None and rep.dead():
+                with self._migrate_lock:
+                    if self._redispatch(fr, rep, err):
+                        self._m["migrations"].inc()
+                        return
+                if fr.done():
+                    return      # settled while deciding (the
+                                # no-survivor path completes the ledger)
+        with fr._lock:
+            if inner is not fr._inner:
+                # migration replaced the handle while we were deciding
+                self._ledger.reject_stale(fr.request_id)
+                self._m["fenced_completions"].inc()
+                return
+            verdict = self._ledger.try_complete(fr.request_id,
+                                                fr.replica_id)
+            if verdict != "ok":
+                self._m["duplicate_completions" if verdict == "duplicate"
+                        else "fenced_completions"].inc()
+                return
+            err = inner._error
+            if err is not None:
+                fr._fail(err)
+            else:
+                fr._complete(inner._result)
+        with self._lock:
+            self._live.pop(fr.request_id, None)
+
+    # --------------------------------------------------------- migration
+    def _on_replica_kill(self, rid: str, exc: BaseException) -> None:
+        # scripted replica.kill from the heartbeat thread
+        self._migrate(rid, exc)
+
+    def _on_replica_crash(self, rid: str, engine, exc: BaseException
+                          ) -> None:
+        # bare-engine crash hook: called from the dying worker thread
+        # itself (no engine locks held) — migrate immediately instead of
+        # waiting out the heartbeat
+        rep = self._replicas.get(rid)
+        if rep is None:
+            return
+        current = rep.engine if not rep.supervised else None
+        if current is not engine:
+            return          # a stale engine's death: already migrated
+        self._migrate(rid, exc)
+
+    def kill_replica(self, rid: str, mode: str = "crash",
+                     cause: Optional[BaseException] = None) -> None:
+        """Chaos/ops entry point. ``crash``: the replica is observed
+        dead — harvested and migrated NOW (reachable corpse).
+        ``zombie``: the replica stops heartbeating and becomes
+        unreachable to the router while its engine keeps running (a
+        network partition); the monitor declares it DEAD after
+        ``dead_after`` and migration re-dispatches clones — the zombie's
+        late completions are fenced by the ledger."""
+        rep = self._replicas[rid]
+        if mode == "zombie":
+            rep.reachable = False
+            rep.stop_heartbeat()
+            return
+        self._migrate(rid, cause or RuntimeError(f"replica {rid} killed"))
+
+    def _migrate(self, rid: str, cause: BaseException) -> None:
+        """Retire ``rid`` and re-dispatch its non-terminal requests to
+        survivors exactly once. Serialized globally: concurrent death
+        reports (crash callback vs monitor scan vs chaos kill) collapse
+        to one migration per replica."""
+        with self._migrate_lock:
+            with self._lock:
+                if rid in self._dead_handled:
+                    return
+                self._dead_handled.add(rid)
+                self._death_cause[rid] = cause
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    return
+                h = self._health[rid]
+                h["state"] = REPLICA_DEAD
+                self._update_gauges_locked()
+            rep.stop_heartbeat()
+            self._membership.leave(rid)
+            if rep.reachable:
+                try:
+                    _, dead_cause = rep.quarantine()
+                    cause = dead_cause or cause
+                    self._death_cause[rid] = cause
+                except Exception:   # noqa: BLE001 — treat as unreachable
+                    rep.reachable = False
+            with self._lock:
+                victims = [fr for fr in self._live.values()
+                           if fr.replica_id == rid and not fr.done()]
+            moved = 0
+            for fr in victims:
+                if self._redispatch(fr, rep, cause):
+                    moved += 1
+            if moved:
+                self._m["migrations"].inc(moved)
+
+    def _redispatch(self, fr: FleetRequest, src: EngineReplica,
+                    cause: BaseException) -> bool:
+        """Move one fleet request off a dead replica. Reachable source:
+        requeue the SAME harvested request object (supervisor-takeover
+        contract — resume by re-prefilling prompt + generated-so-far).
+        Unreachable source: requeue a CLONE built from the router's own
+        record; the zombie's handle is fenced by identity + ledger."""
+        order, loads = self._dispatch_order(sticky_key=fr.sticky_key)
+        dst = None
+        for rep in order:
+            if rep.replica_id != src.replica_id and \
+                    loads.get(rep.replica_id) is not None and \
+                    not rep.dead():
+                dst = rep       # migration bypasses admission control:
+                break           # inherited work is never shed
+        with fr._lock:
+            if fr.done():
+                return False
+            if fr.replica_id != src.replica_id:
+                return False    # already migrated off src (the bind-time
+                                # re-check and the victim loop race here)
+            if dst is None:
+                # no survivors: fail with the death cause chained, the
+                # way a supervisor out of restart budget fails requests
+                exc = RuntimeError(
+                    f"fleet {self.fleet_id}: replica {src.replica_id} "
+                    f"died with no surviving replica to migrate to")
+                exc.__cause__ = cause
+                fr._fail(exc)
+                self._ledger.try_complete(fr.request_id, fr.replica_id)
+                return False
+            if not self._ledger.try_reassign(fr.request_id,
+                                             dst.replica_id):
+                return False    # completed while we were deciding
+            old_inner = fr._inner
+            if src.reachable and old_inner is not None \
+                    and not old_inner.done():
+                inner = old_inner       # quarantined corpse: same object
+            else:
+                inner = self._clone_inner(fr, old_inner)
+                inner.add_done_callback(
+                    lambda r, _fr=fr: self._on_inner_done(_fr, r))
+                fr._inner = inner
+            fr.replica_id = dst.replica_id
+            fr.migrations += 1
+        tr = inner.trace
+        if tr is not None:
+            tr.event("migrate", src=src.replica_id, dst=dst.replica_id,
+                     generated=len(inner.generated))
+        dst.requeue(inner)
+        return True
+
+    def _clone_inner(self, fr: FleetRequest, old_inner):
+        """Fresh replica-local request resuming the fleet request: the
+        unreachable-source migration path. Resumes from a snapshot of
+        generated-so-far when the old handle is readable in-process
+        (greedy decoding makes ANY resume prefix token-identical); the
+        trace object is shared, so the request keeps one timeline."""
+        from ..models.generation import GenerationRequest
+        clone = GenerationRequest(fr.prompt, fr.max_new_tokens,
+                                  fr.temperature, fr.eos_id)
+        clone.deadline = fr.deadline
+        clone._deadline_t = fr._deadline_t      # original ABSOLUTE deadline
+        clone._cancel_requested = fr._cancel_requested
+        if old_inner is not None:
+            clone.generated = list(old_inner.generated)
+            clone.trace = old_inner.trace
+            # the zombie must not keep spanning the timeline its
+            # replacement now owns (if it already finish()ed the shared
+            # trace first-wins, the object still accumulates the clone's
+            # spans — one ring entry, early status: rare-race tradeoff)
+            old_inner.trace = None
+        return clone
+
+    # --------------------------------------------------------- monitoring
+    def _monitor_loop(self) -> None:
+        while not self._stop_monitor.wait(self.monitor_interval):
+            self._scan_once()
+
+    def _scan_once(self) -> None:
+        """One membership scan: age beats into health transitions.
+        SUSPECT → ALIVE needs ``recover_beats`` consecutive fresh scans
+        (hysteresis); ``dead_after`` without a beat — or a supervisor
+        that gave up — is DEAD and triggers migration."""
+        ages = self._membership.ages()
+        to_kill: List[Tuple[str, BaseException]] = []
+        with self._lock:
+            for rid, rep in self._replicas.items():
+                h = self._health[rid]
+                if h["state"] == REPLICA_DEAD:
+                    continue
+                gave_up = rep.given_up()
+                if gave_up is not None:
+                    to_kill.append((rid, gave_up))
+                    continue
+                age, load = ages.get(rid, (None, None))
+                if age is None or age > self.dead_after:
+                    rep.reachable = False   # heartbeat death == partition
+                    to_kill.append((rid, RuntimeError(
+                        f"replica {rid}: no heartbeat for "
+                        f"{self.dead_after}s")))
+                    continue
+                h["age"] = age
+                h["load"] = load
+                if age > self.suspect_after:
+                    if h["state"] == REPLICA_ALIVE:
+                        h["state"] = REPLICA_SUSPECT
+                    h["fresh"] = 0
+                elif h["state"] == REPLICA_SUSPECT:
+                    h["fresh"] += 1
+                    if h["fresh"] >= self.recover_beats:
+                        h["state"] = REPLICA_ALIVE
+                        h["fresh"] = 0
+            self._update_gauges_locked()
+        for rid, cause in to_kill:
+            self._migrate(rid, cause)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "EngineFleetRouter":
+        if self._started:
+            return self
+        self._started = True
+        for rid, rep in self._replicas.items():
+            if not rep.supervised:
+                # the fleet IS the supervisor, one level up: a crashing
+                # bare engine reports here instead of failing its
+                # requests, and migration re-runs them exactly once
+                eng = rep.engine
+                eng._supervised = True
+                eng._on_crash = (lambda engine, exc, _rid=rid:
+                                 self._on_replica_crash(_rid, engine, exc))
+            rep.start()
+        self._stop_monitor.clear()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name=f"{self.fleet_id}-monitor")
+        self._monitor.start()
+        return self
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._shutdown_flag:
+                return
+            self._shutdown_flag = True
+            reps = list(self._replicas.values())
+        self._stop_monitor.set()
+        mon = self._monitor
+        if mon is not None and mon is not threading.current_thread():
+            mon.join(timeout=2)
+        for rep in reps:
+            rep.stop_heartbeat()
+        for rep in reps:
+            rep.shutdown()      # fails outstanding inners → callbacks
+        #                         finish their fleet requests
+        with self._lock:
+            leftovers = [fr for fr in self._live.values()
+                         if not fr.done()]
+            self._live.clear()
+        for fr in leftovers:
+            with fr._lock:
+                if not fr.done():
+                    fr._fail(RuntimeError("EngineFleetRouter shut down"))
+
+    stop = shutdown             # route/supervisor-style alias
+
+    # --------------------------------------------------------------- views
+    def replica_ids(self) -> List[str]:
+        return sorted(self._replicas)
+
+    def replica_state(self, rid: str) -> str:
+        with self._lock:
+            return self._health[rid]["state"]
+
+    def stats(self) -> dict:
+        """Supervisor-style aggregate: every replica's engine counters
+        summed (numeric keys only), plus the fleet-level counters — the
+        telemetry-source shape dashboards already consume."""
+        out: Dict[str, int] = {}
+        for rep in self._replicas.values():
+            try:
+                s = rep.engine.stats()
+            except Exception:   # noqa: BLE001 — a dead replica degrades
+                continue        # the aggregate, not the endpoint
+            for k, v in s.items():
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    out[k] = out.get(k, 0) + v
+        with self._lock:
+            counts = {REPLICA_ALIVE: 0, REPLICA_SUSPECT: 0,
+                      REPLICA_DEAD: 0}
+            for h in self._health.values():
+                counts[h["state"]] += 1
+        out["replicas"] = len(self._replicas)
+        out["replicas_alive"] = counts[REPLICA_ALIVE]
+        out["replicas_suspect"] = counts[REPLICA_SUSPECT]
+        out["replicas_dead"] = counts[REPLICA_DEAD]
+        for key in _FLEET_COUNTERS:
+            out[key] = int(self._m[key].value)
+        return out
+
+    def fleet_stats(self) -> dict:
+        """The router's replica table + ledger summary — the
+        ``/snapshot`` source ``scripts/telemetry_dump.py --fleet``
+        pretty-prints."""
+        ages = self._membership.ages()
+        with self._lock:
+            health = {rid: dict(h) for rid, h in self._health.items()}
+        table = {}
+        for rid, rep in sorted(self._replicas.items()):
+            h = health[rid]
+            age, beat_load = ages.get(rid, (None, None))
+            row = {"state": h["state"],
+                   "heartbeat_age_s": None if age is None
+                   else round(age, 3),
+                   "load": beat_load if beat_load is not None
+                   else h.get("load"),
+                   "capacity": rep.capacity,
+                   "supervised": rep.supervised,
+                   "reachable": rep.reachable}
+            try:
+                s = rep.engine.stats()
+                row["queue_depth"] = s.get("queue_depth")
+                row["active_slots"] = s.get("active_slots")
+            except Exception:   # noqa: BLE001
+                pass
+            table[rid] = row
+        return {"fleet": self.fleet_id,
+                "replicas": table,
+                "ledger": self._ledger.to_dict(),
+                "counters": {key: int(self._m[key].value)
+                             for key in _FLEET_COUNTERS}}
+
+
+# Legacy-style counter attributes (``router.migrations`` etc.) as
+# read-only registry views, matching the engine/route idiom.
+for _counter_name in _FLEET_COUNTERS:
+    setattr(EngineFleetRouter, _counter_name,
+            property(lambda self, _k=_counter_name:
+                     int(self._m[_k].value),
+                     doc=f"registry view: fleet_{_counter_name}_total"
+                         f"{{fleet=<id>}}"))
+del _counter_name

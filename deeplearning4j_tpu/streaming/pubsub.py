@@ -77,9 +77,20 @@ class NDArrayPublisher:
     def __init__(self, broker: MessageBroker, topic: str):
         self.broker = broker
         self.topic = topic
+        self._closed = False
 
     def publish(self, arr: np.ndarray) -> None:
+        if self._closed:
+            # a closed publisher fails loudly instead of silently feeding
+            # a topic its route already tore down; _publish_safe callers
+            # degrade this to a counted drop
+            raise RuntimeError(f"publisher for '{self.topic}' is closed")
         self.broker.publish(self.topic, serialize_ndarray(arr))
+
+    def close(self) -> None:
+        """Release the publishing end (route ``stop()`` closes BOTH ends;
+        transports with per-publisher state hook their teardown here)."""
+        self._closed = True
 
 
 class NDArraySubscriber:

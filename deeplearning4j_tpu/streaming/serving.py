@@ -10,6 +10,7 @@ route level."""
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 import time
@@ -124,6 +125,7 @@ class ModelServingRoute(_RoutePublishMixin):
             else NULL_INJECTOR
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._stopped = False
         # serving counters: registry children (thread-safe by
         # construction — the route thread writes, dashboards/tests read)
         self.route_id = f"serve{next(_ROUTE_SEQ)}:{input_topic}"
@@ -206,10 +208,14 @@ class ModelServingRoute(_RoutePublishMixin):
         return self
 
     def stop(self) -> None:
+        if self._stopped:                    # idempotent double-stop
+            return
+        self._stopped = True
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2)
         self.sub.close()
+        self.pub.close()
 
 
 class GenerationServingRoute(_RoutePublishMixin):
@@ -286,9 +292,13 @@ class GenerationServingRoute(_RoutePublishMixin):
         self.publish_retries = int(publish_retries)
         self.retry_backoff = float(retry_backoff)
         self._stop = threading.Event()
+        self._stopped = False
         self._consumer: Optional[threading.Thread] = None
         self._publisher: Optional[threading.Thread] = None
-        self._inflight: "List" = []          # submission-ordered handles
+        # submission-ordered handles: deque, not list — the publisher
+        # retires strictly from the head, and at fleet fan-in depths
+        # (max_inflight 64+) a list's pop(0) is O(n) per publish
+        self._inflight: "collections.deque" = collections.deque()
         self._inflight_lock = threading.Lock()
         self.max_inflight = max(1, int(max_inflight))
         # counters: registry children shared-safe between the consumer
@@ -353,7 +363,7 @@ class GenerationServingRoute(_RoutePublishMixin):
                 self._m["errors"].inc()
                 out = None
             with self._inflight_lock:
-                self._inflight.pop(0)
+                self._inflight.popleft()
             if out is not None:
                 t_p0 = time.monotonic()
                 if self._publish_safe(np.asarray(out, np.int32)):
@@ -377,6 +387,9 @@ class GenerationServingRoute(_RoutePublishMixin):
         return self
 
     def stop(self) -> None:
+        if self._stopped:                    # idempotent: a double-stop
+            return                           # must not re-join dead
+        self._stopped = True                 # threads or re-close topics
         self._stop.set()
         for t in (self._consumer, self._publisher):
             if t is not None:
@@ -384,6 +397,7 @@ class GenerationServingRoute(_RoutePublishMixin):
         if self._owns_engine:                # an injected engine is shared;
             self.engine.shutdown()           # its owner stops it
         self.sub.close()
+        self.pub.close()
 
 
 # Legacy counter attributes (``route.served``, ``route.publish_drops``,

@@ -34,6 +34,21 @@ asserts the invariants the resilience + telemetry layers promise:
    finished trace per request, token-identical completions), proving
    supervised recovery composes with tensor/FSDP-parallel decode;
 
+7. with ``--replicas N`` (r13): the soak runs against an
+   ``EngineFleetRouter`` fleet instead of a single supervised engine —
+   one replica is hard-crashed mid-stream (bare-engine crash hook →
+   reachable-corpse harvest + exactly-once requeue on survivors) and,
+   at N ≥ 3, a second is turned into a slow ZOMBIE (heartbeat drop via
+   ``fleet.heartbeat`` + ``engine.step`` hangs → SUSPECT → DEAD →
+   clone-based migration, with the zombie's late completions fenced by
+   the FleetLedger) — the bars are zero stranded fleet requests, zero
+   duplicate publishes (ledger-verified: every request id completes
+   exactly once; fenced/duplicate rejections are counted, never
+   served), token-identical greedy outputs on every completed request,
+   zero steady-state compiles in a post-migration wave PINNED to each
+   surviving replica, and (unless ``--no-fleet-scale``) near-linear
+   1 → N aggregate decode tok/s on a compute-bound shape;
+
 plus the correctness bar: every COMPLETED request's tokens equal the
 uninterrupted clean-engine run, token for token (greedy). The summary
 also reports per-request latency p50/p99 (through the shared
@@ -43,6 +58,8 @@ metrics-registry snapshot.
 
     python scripts/chaos_soak.py --seed 7 --requests 24 --crashes 3
     python scripts/chaos_soak.py --seed 7 --json
+    python scripts/chaos_soak.py --replicas 3 --json
+    python scripts/chaos_soak.py --replicas 3 --lock-audit
 
 The same seed reproduces the same schedule bit-for-bit (the injector is
 hit-count keyed, the engine's decode loop deterministic). A short seeded
@@ -250,25 +267,229 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
     if ab is not None:
         summary.update(ab)
     if la is not None:
-        from deeplearning4j_tpu.analysis.concurrency import \
-            lock_order_edges
-        from deeplearning4j_tpu.analysis.lint import (LintCache,
-                                                      collect_package_facts)
-        facts = collect_package_facts(
-            [os.path.join(REPO_ROOT, "deeplearning4j_tpu")], REPO_ROOT,
-            cache=LintCache(os.environ.get(
-                "GRAFTLINT_CACHE",
-                os.path.join(REPO_ROOT, ".graftlint_cache.json"))))
-        static = lock_order_edges(facts)
-        cc = la.cross_check(static.keys())
-        summary["lock_audit"] = {
-            "dynamic_edges": len(la.edges()),
-            "explained": len(cc["explained"]),
-            "novel": cc["novel"],
-            "inversions": cc["inversions"],
-            "cycles": la.cycles(),
-        }
+        summary["lock_audit"] = _lock_audit_summary(la)
     return summary
+
+
+def _lock_audit_summary(la) -> dict:
+    """Cross-check the LockAudit's observed acquisition orders against
+    graftlint's static lock-order graph (shared by the single-engine and
+    fleet soak profiles)."""
+    from deeplearning4j_tpu.analysis.concurrency import lock_order_edges
+    from deeplearning4j_tpu.analysis.lint import (LintCache,
+                                                  collect_package_facts)
+    facts = collect_package_facts(
+        [os.path.join(REPO_ROOT, "deeplearning4j_tpu")], REPO_ROOT,
+        cache=LintCache(os.environ.get(
+            "GRAFTLINT_CACHE",
+            os.path.join(REPO_ROOT, ".graftlint_cache.json"))))
+    static = lock_order_edges(facts)
+    cc = la.cross_check(static.keys())
+    return {
+        "dynamic_edges": len(la.edges()),
+        "explained": len(cc["explained"]),
+        "novel": cc["novel"],
+        "inversions": cc["inversions"],
+        "cycles": la.cycles(),
+    }
+
+
+def run_fleet_soak(seed: int = 0, replicas: int = 3,
+                   n_requests: int = 24, num_slots: int = 2,
+                   max_new: int = 6, vocab: int = 12,
+                   wait_s: float = 120.0, steady_wave: int = 2,
+                   fleet_scale: bool = True,
+                   lock_audit: bool = False) -> dict:
+    """One fleet soak round (``--replicas N``): N replicas behind an
+    ``EngineFleetRouter`` under load, one hard-crashed mid-stream and
+    (N ≥ 3) one zombied, with the exactly-once / token-parity /
+    steady-compile bars checked per surviving replica.
+
+    Same padding-bucket discipline as :func:`run_soak`: prompt(≤4) +
+    generated(≤11) < 16 keeps every re-prefill — crash-harvest resumes
+    AND zombie-migration clones — inside the tp=16 bucket the clean
+    warmup already compiled."""
+    import contextlib
+
+    import numpy as np
+
+    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.analysis.lock_audit import LockAudit
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                      TransformerDecoder)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.metrics import default_registry
+    from deeplearning4j_tpu.parallel.faults import FaultInjector
+    from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                    REPLICA_ALIVE)
+
+    assert max_new <= 11, "max_new > 11 would leave the tp=16 bucket"
+    rng = np.random.default_rng(seed)
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=32, num_heads=2, num_layers=2, max_length=32,
+        learning_rate=1e-2, seed=5)).init()
+    dec = TransformerDecoder(net)
+    prompts = [rng.integers(0, vocab, int(rng.integers(2, 5)))
+               for _ in range(n_requests)]
+    gens = [int(rng.integers(2, max_new + 1)) for _ in range(n_requests)]
+
+    summary = {"seed": seed, "replicas": replicas, "requests": n_requests}
+    la = LockAudit(patch=True) if lock_audit else None
+    with CompileAudit() as audit, \
+            (la if la is not None else contextlib.nullcontext()):
+        # --- clean single-engine reference: ground truth + compile warmup
+        clean = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec)
+        clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+        clean.run_until_drained()
+        expected = [r.result(1) for r in clean_reqs]
+
+        # --- seeded per-replica fault schedule: ONE injector per replica
+        # (replicas never interleave on a shared hit counter, so the same
+        # seed reproduces the same deaths). r0 hard-crashes mid-stream;
+        # at N >= 3, r1 turns zombie: its engine.step slows to a crawl
+        # (keeps work in flight) while its heartbeat goes silent — the
+        # monitor declares it DEAD and migration re-dispatches clones,
+        # then its late completions must be fenced, never served.
+        per_rep = max(1, (sum(gens) // max(1, num_slots)) // replicas)
+        crash_hit = int(rng.integers(2, max(3, per_rep)))
+        injs = [FaultInjector() for _ in range(replicas)]
+        injs[0].raise_once(
+            "engine.step",
+            RuntimeError(f"fleet soak: r0 crash at step hit {crash_hit}"),
+            at=crash_hit)
+        zombie = replicas >= 3
+        if zombie:
+            injs[1].hang_for("engine.step", seconds=0.15, at=1,
+                             times=8 * max(1, per_rep))
+            injs[1].drop("fleet.heartbeat", n=1_000_000, at=2)
+        summary["crash_hit"] = crash_hit
+        summary["zombie"] = "r1" if zombie else None
+
+        router = EngineFleetRouter(
+            net, num_replicas=replicas, decoder=dec, num_slots=num_slots,
+            replica_injectors=injs, heartbeat_interval=0.03,
+            monitor_interval=0.03, suspect_after=0.15, dead_after=0.4,
+            recover_beats=3).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + wait_s
+        for fr in frs:
+            fr._done.wait(max(0.0, deadline - time.monotonic()))
+        stranded = [fr for fr in frs if not fr.done()]
+
+        # --- post-migration steady state: a wave PINNED to each
+        # surviving replica must complete without one new lowering
+        for inj in injs:
+            inj.clear()
+        survivors = [rid for rid in router.replica_ids()
+                     if router.replica_state(rid) == REPLICA_ALIVE]
+        snap = audit.snapshot()
+        wave = [router.submit(prompts[i % n_requests],
+                              gens[i % n_requests], replica_id=rid)
+                for rid in survivors for i in range(steady_wave)]
+        wave_deadline = time.monotonic() + 60.0
+        for fr in wave:
+            fr._done.wait(max(0.0, wave_deadline - time.monotonic()))
+        steady_delta = audit.delta(snap)
+        stranded += [fr for fr in wave if not fr.done()]
+
+        fleet_table = router.fleet_stats()
+        router.shutdown()       # fails the zombie's leftover inners →
+        #                         their late publishes land in the ledger
+        ledger = router._ledger.to_dict()
+        # ledger-verified exactly-once: every non-shed request id was
+        # accepted by the ledger EXACTLY once (duplicates/fenced are
+        # rejections — counted, never served)
+        ledger_consistent = (
+            ledger["completed"] ==
+            n_requests + len(wave) - int(router.shed))
+
+    completed = failed = mismatches = 0
+    for fr, want in zip(frs, expected):
+        if fr.state == fr.DONE:
+            completed += 1
+            if not np.array_equal(fr.result(0), want):
+                mismatches += 1
+        else:
+            failed += 1
+    migrated = sum(fr.migrations > 0 for fr in frs)
+
+    summary.update({
+        "stranded": len(stranded),
+        "mismatches": mismatches,
+        "completed": completed,
+        "failed": failed,
+        "shed": int(router.shed),
+        "migrations": int(router.migrations),
+        "migrated_requests": migrated,
+        "survivors": survivors,
+        "dead": [rid for rid in router.replica_ids()
+                 if rid not in survivors],
+        "ledger": ledger,
+        "ledger_consistent": ledger_consistent,
+        "steady_new_compiles": steady_delta,
+        "injector": {f"r{i}": inj.counters()
+                     for i, inj in enumerate(injs)},
+        "fleet": fleet_table,
+        "metrics": default_registry().snapshot(),
+    })
+    if fleet_scale:
+        summary["fleet_scale"] = _fleet_scale_ab(replicas)
+    if la is not None:
+        summary["lock_audit"] = _lock_audit_summary(la)
+    return summary
+
+
+def _fleet_scale_ab(replicas: int, n_requests: int = 24,
+                    prompt_len: int = 8, gen: int = 16,
+                    num_slots: int = 8) -> dict:
+    """Aggregate decode tok/s, 1 replica vs N, no faults. The soak's
+    tiny model is dispatch-bound (one engine already saturates the
+    Python dispatch path), so scaling is measured on a compute-bound
+    shape — d512 4-layer, 4k vocab — where replica worker threads
+    release the GIL into real XLA compute and near-linear scaling is
+    physically available. Every router shares ONE decoder: the N-replica
+    fleet compiles nothing the 1-replica fleet didn't."""
+    import time as _t
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import TransformerDecoder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.streaming.fleet import EngineFleetRouter
+
+    vocab = 4096
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=512, num_heads=8, num_layers=4, max_length=64,
+        learning_rate=1e-2, seed=5)).init()
+    dec = TransformerDecoder(net)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, prompt_len)
+               for _ in range(n_requests)]
+
+    def drain(n: int) -> float:
+        router = EngineFleetRouter(net, num_replicas=n, decoder=dec,
+                                   num_slots=num_slots,
+                                   tracing=False).start()
+        try:
+            frs = [router.submit(p, gen) for p in prompts]
+            for fr in frs:                         # warm (all compiled)
+                fr.result(300)
+            t0 = _t.perf_counter()
+            frs = [router.submit(p, gen) for p in prompts]
+            toks = sum(len(fr.result(300)) - len(p)
+                       for fr, p in zip(frs, prompts))
+            return toks / (_t.perf_counter() - t0)
+        finally:
+            router.shutdown()
+
+    one = drain(1)
+    n_way = drain(replicas)
+    return {"replicas": replicas,
+            "tok_s_1": round(one, 1),
+            "tok_s_n": round(n_way, 1),
+            "speedup": round(n_way / one, 2) if one else None}
 
 
 def _overhead_ab(SlotGenerationEngine, net, dec, prompts, gens,
@@ -325,6 +546,17 @@ def main(argv=None) -> int:
                          "registry snapshot")
     ap.add_argument("--no-overhead-ab", action="store_true",
                     help="skip the telemetry-on/off throughput A/B")
+    ap.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="fleet soak: N engine replicas behind an "
+                         "EngineFleetRouter; one is crash-killed "
+                         "mid-stream (and at N>=3 a second zombied) — "
+                         "bars: zero stranded, zero duplicate publishes "
+                         "(ledger-verified), token-identical outputs, "
+                         "zero steady compiles per surviving replica, "
+                         "near-linear 1->N aggregate tok/s")
+    ap.add_argument("--no-fleet-scale", action="store_true",
+                    help="skip the 1->N aggregate-throughput A/B "
+                         "(the slowest part of the fleet soak)")
     ap.add_argument("--mesh", default=None, metavar="DATAxTP",
                     help="run the soak on a mesh-sharded decoder "
                          "('2x1', '1x2', '2x2', or a bare device "
@@ -362,6 +594,57 @@ def main(argv=None) -> int:
         flags.append(f"--xla_force_host_platform_device_count="
                      f"{max(need, 1)}")
         os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    if args.replicas:
+        if args.mesh:
+            # the fleet soak builds unsharded replicas — silently
+            # accepting --mesh would print '-> ok' for a sharded-fleet
+            # configuration that never executed
+            ap.error("--replicas and --mesh cannot be combined yet: "
+                     "the fleet soak runs unsharded replicas "
+                     "(sharded-fleet support is future work)")
+        ok = True
+        for i in range(args.iterations):
+            s = run_fleet_soak(seed=args.seed + i, replicas=args.replicas,
+                               n_requests=args.requests,
+                               num_slots=args.slots, max_new=args.max_new,
+                               fleet_scale=not args.no_fleet_scale,
+                               lock_audit=args.lock_audit)
+            scale = s.get("fleet_scale") or {}
+            # near-linear bar: >= 0.8x per replica (2.4x at N=3)
+            scale_bad = bool(scale) and \
+                (scale["speedup"] or 0.0) < 0.8 * args.replicas
+            lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
+                            s.get("lock_audit", {}).get("cycles"))
+            bad = s["stranded"] or s["mismatches"] or s["failed"] or \
+                s["steady_new_compiles"] or s["migrations"] == 0 or \
+                not s["ledger_consistent"] or scale_bad or lock_bad
+            ok = ok and not bad
+            if args.json:
+                print(json.dumps(s, default=str))
+            else:
+                sc = "" if not scale else \
+                    (f" scale={scale['tok_s_1']}->{scale['tok_s_n']}tok/s"
+                     f"({scale['speedup']}x"
+                     f"{' UNDER BAR' if scale_bad else ''})")
+                lk = ""
+                if "lock_audit" in s:
+                    d = s["lock_audit"]
+                    lk = (f" locks={d['dynamic_edges']}edges/"
+                          f"{len(d['inversions'])}inversions")
+                led = s["ledger"]
+                print(f"round {i}: replicas={args.replicas} "
+                      f"seed={s['seed']} dead={','.join(s['dead']) or '-'} "
+                      f"migrations={s['migrations']} "
+                      f"completed={s['completed']}/{s['requests']} "
+                      f"stranded={s['stranded']} "
+                      f"mismatches={s['mismatches']} "
+                      f"ledger[ok={led['completed']} "
+                      f"fenced={led['fenced']} dup={led['duplicates']}] "
+                      f"steady_new_compiles="
+                      f"{s['steady_new_compiles'] or '{}'}"
+                      f"{sc}{lk} -> {'FAIL' if bad else 'ok'}")
+        return 0 if ok else 1
 
     ok = True
     for i in range(args.iterations):

@@ -3,13 +3,22 @@
 
 Talks to an observability TelemetryServer (``/snapshot`` by default;
 ``--metrics`` for the raw Prometheus text, ``--traces [N]`` for recent
-request timelines) over plain HTTP — no in-process imports, so it works
-against any serving process on any host:
+request timelines, ``--fleet`` for an EngineFleetRouter's replica
+table) over plain HTTP — no in-process imports, so it works against
+any serving process on any host:
 
     python scripts/telemetry_dump.py http://127.0.0.1:9100
     python scripts/telemetry_dump.py http://127.0.0.1:9100 --json
     python scripts/telemetry_dump.py http://host:9100 --traces 5
     python scripts/telemetry_dump.py http://host:9100 --metrics
+    python scripts/telemetry_dump.py http://host:9100 --fleet
+
+``--fleet`` expects the serving process to have registered the
+router's ``fleet_stats`` as a snapshot source
+(``TelemetryServer.add_source("fleet", router.fleet_stats)``); it
+pretty-prints every fleet-shaped source it finds — per-replica health
+state, heartbeat age, live load vs capacity, plus the exactly-once
+ledger and fleet counters.
 
 The pretty printer groups the nested registry snapshot by family:
 counters/gauges one line per labeled child, histograms as
@@ -83,6 +92,51 @@ def pretty(snapshot: dict, out=sys.stdout) -> None:
             w(f"  {src}\n")
 
 
+def _fleet_sources(snapshot: dict) -> dict:
+    """Every snapshot source with the ``fleet_stats()`` shape (a
+    ``replicas`` table plus a ``ledger``) — the router's registration
+    name is the caller's choice, so match on shape, not name."""
+    return {name: src
+            for name, src in (snapshot.get("sources") or {}).items()
+            if isinstance(src, dict)
+            and isinstance(src.get("replicas"), dict)
+            and isinstance(src.get("ledger"), dict)}
+
+
+def pretty_fleet(snapshot: dict, out=sys.stdout) -> int:
+    w = out.write
+    fleets = _fleet_sources(snapshot)
+    if not fleets:
+        w("no fleet sources in /snapshot (register one with "
+          "TelemetryServer.add_source('fleet', router.fleet_stats))\n")
+        return 2
+    for name, src in sorted(fleets.items()):
+        w(f"fleet {src.get('fleet', '?')}  (source '{name}')\n")
+        hdr = (f"  {'replica':<9} {'state':<8} {'hb-age':>7} "
+               f"{'load':>5} {'cap':>4} {'queue':>6} {'active':>7} "
+               f"{'sup':>4} {'reach':>6}\n")
+        w(hdr)
+        for rid, row in sorted(src["replicas"].items()):
+            age = row.get("heartbeat_age_s")
+            fmt = (lambda v: "-" if v is None else str(v))
+            w(f"  {rid:<9} {row.get('state', '?'):<8} "
+              f"{'-' if age is None else f'{age:.3f}s':>7} "
+              f"{fmt(row.get('load')):>5} {fmt(row.get('capacity')):>4} "
+              f"{fmt(row.get('queue_depth')):>6} "
+              f"{fmt(row.get('active_slots')):>7} "
+              f"{'y' if row.get('supervised') else 'n':>4} "
+              f"{'y' if row.get('reachable') else 'n':>6}\n")
+        led = src["ledger"]
+        w("  ledger: " + " ".join(f"{k}={led[k]}" for k in sorted(led))
+          + "\n")
+        counters = src.get("counters") or {}
+        if counters:
+            w("  counters: " + " ".join(f"{k}={counters[k]}"
+                                        for k in sorted(counters)) + "\n")
+        w("\n")
+    return 0
+
+
 def pretty_traces(doc: dict, out=sys.stdout) -> None:
     w = out.write
     w(f"{doc.get('count', 0)} trace(s) "
@@ -109,6 +163,10 @@ def main(argv=None) -> int:
                     help="print the raw Prometheus /metrics text")
     ap.add_argument("--traces", type=int, nargs="?", const=10, default=None,
                     metavar="N", help="print the last N request traces")
+    ap.add_argument("--fleet", action="store_true",
+                    help="print fleet router replica tables (state, "
+                         "heartbeat age, load/capacity, exactly-once "
+                         "ledger) from the snapshot's fleet sources")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
     base = args.url.rstrip("/")
@@ -129,6 +187,15 @@ def main(argv=None) -> int:
     except (urllib.error.URLError, OSError, TimeoutError) as e:
         print(f"error: cannot reach {base}: {e}", file=sys.stderr)
         return 2
+    if args.fleet:
+        if args.json:
+            fleets = _fleet_sources(snap)
+            print(json.dumps(fleets, indent=1, default=str))
+            # an absent fleet source is a misconfiguration either way:
+            # match the pretty path's exit code so automation keyed on
+            # it doesn't read '{}' as healthy
+            return 0 if fleets else 2
+        return pretty_fleet(snap)
     if args.json:
         print(json.dumps(snap, indent=1, default=str))
     else:

@@ -217,3 +217,597 @@ class TestGcloudFailureSemantics:
             argv, 1, b"", b"quota exceeded"))
         with pytest.raises(RuntimeError, match="quota exceeded"):
             drv.launch(1, {}, False)
+
+
+# ======================================================================
+# Replicated ENGINE fleet (ISSUE 8 / ROADMAP item 5): least-loaded
+# routing, membership health states, and cross-replica exactly-once
+# migration over streaming/fleet.py — the serving-side fleet, distinct
+# from the cloud-provisioning lifecycle above.
+# ======================================================================
+
+import threading
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.models import transformer_lm_conf
+from deeplearning4j_tpu.models.generation import (GenerationRequest,
+                                                  SlotGenerationEngine,
+                                                  TransformerDecoder)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+from deeplearning4j_tpu.parallel.faults import FaultInjector, RejectedError
+from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                FleetLedger,
+                                                FleetMembership,
+                                                FleetRequest,
+                                                KVFleetMembership,
+                                                REPLICA_ALIVE,
+                                                REPLICA_DEAD,
+                                                REPLICA_SUSPECT)
+from deeplearning4j_tpu.streaming.pubsub import (MessageBroker,
+                                                 NDArrayPublisher,
+                                                 NDArraySubscriber)
+from deeplearning4j_tpu.streaming.serving import GenerationServingRoute
+
+VOCAB = 12
+
+
+def _wait(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def fleet_net():
+    """One net + decoder for every fleet below: replicas share the jitted
+    programs (the production layout — migration re-serves token-identical
+    outputs and steady state compiles nothing new), and the module warms
+    the prefill/decode programs so health timeouts never race a first
+    lowering."""
+    net = ComputationGraph(transformer_lm_conf(
+        VOCAB, d_model=32, num_heads=2, num_layers=2, max_length=32,
+        learning_rate=1e-2, seed=5)).init()
+    dec = TransformerDecoder(net)
+    for slots in (1, 2):
+        warm = SlotGenerationEngine(net, num_slots=slots, decoder=dec)
+        warm.submit([1, 2], 3)
+        warm.submit([2, 1, 3], 3)
+        warm.run_until_drained()
+    return net, dec
+
+
+def _expected(fleet_net, prompts, gens):
+    """Uninterrupted clean-engine ground truth (same decoder + seed)."""
+    net, dec = fleet_net
+    clean = SlotGenerationEngine(net, num_slots=2, decoder=dec)
+    reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+    clean.run_until_drained()
+    return [r.result(1) for r in reqs]
+
+
+class TestFleetLedger:
+    def test_exactly_once_accept(self):
+        led = FleetLedger()
+        led.assign("q1", "r0")
+        assert led.try_complete("q1", "r0") == "ok"
+        assert led.try_complete("q1", "r0") == "duplicate"
+        assert led.duplicates == 1 and led.completed_total == 1
+
+    def test_fencing_after_reassign(self):
+        led = FleetLedger()
+        led.assign("q1", "r0")
+        assert led.try_reassign("q1", "r1")
+        # the zombie's late completion carries the OLD assignee
+        assert led.try_complete("q1", "r0") == "fenced"
+        assert led.try_complete("q1", "r1") == "ok"
+        assert led.fenced == 1
+
+    def test_reassign_refused_after_completion(self):
+        led = FleetLedger()
+        led.assign("q1", "r0")
+        assert led.try_complete("q1", "r0") == "ok"
+        # migration racing a completion must lose: a completed request
+        # re-dispatched would decode (and publish) twice
+        assert not led.try_reassign("q1", "r1")
+
+    def test_unknown_request_is_fenced(self):
+        led = FleetLedger()
+        assert led.try_complete("ghost", "r0") == "fenced"
+
+    def test_completed_window_bounds_memory(self):
+        led = FleetLedger(completed_window=4)
+        for i in range(10):
+            led.assign(f"q{i}", "r0")
+            assert led.try_complete(f"q{i}", "r0") == "ok"
+        assert len(led._completed) == 4
+        # beyond the window a late duplicate degrades to fenced (the
+        # assignment is gone too) — still rejected, never served
+        assert led.try_complete("q0", "r0") == "fenced"
+
+
+class _FakeKVClient:
+    """Write-once key-value store with the coordinator client's surface
+    (the multihost.distributed_client contract)."""
+
+    def __init__(self):
+        self._kv = {}
+        self.lock = threading.Lock()
+
+    def key_value_set(self, key, value):
+        with self.lock:
+            if key in self._kv:
+                raise RuntimeError(f"ALREADY_EXISTS: {key}")
+            self._kv[key] = value
+
+    def key_value_dir_get(self, prefix):
+        with self.lock:
+            return [(k, v) for k, v in self._kv.items()
+                    if k.startswith(prefix)]
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        with self.lock:
+            if key in self._kv:
+                return self._kv[key]
+        raise TimeoutError(key)
+
+
+class TestFleetMembership:
+    def test_in_process_ages_and_loads(self):
+        m = FleetMembership()
+        m.register("r0")
+        m.beat("r1", 7)
+        ages = m.ages()
+        assert set(ages) == {"r0", "r1"}
+        assert ages["r1"][1] == 7 and ages["r1"][0] < 1.0
+        m.leave("r1")
+        assert "r1" not in m.ages()
+
+    def test_kv_membership_seq_advancement_is_liveness(self):
+        kv = _FakeKVClient()
+        m = KVFleetMembership(kv, fleet_id="t1")
+        m.register("r0")
+        a0 = m.ages()["r0"][0]
+        assert a0 < 0.5
+        time.sleep(0.05)
+        # no new beat: age grows (seq unchanged)
+        assert m.ages()["r0"][0] >= 0.05
+        m.beat("r0", 3)
+        age, load = m.ages()["r0"]
+        assert age < 0.05 and load == 3   # seq advanced: fresh again
+
+    def test_kv_membership_leave_tombstone_and_dup_beat(self):
+        kv = _FakeKVClient()
+        m = KVFleetMembership(kv, fleet_id="t2")
+        m.beat("r0", 1)
+        # a replayed seq (restarted beater) hits the write-once wall:
+        # swallowed as a missed beat, never fatal
+        m._seq["r0"] = 0
+        m.beat("r0", 9)
+        assert "r0" in m.ages()
+        m.leave("r0")
+        m.leave("r0")                      # second leave: already gone
+        assert "r0" not in m.ages()
+
+    def test_kv_membership_drives_a_router(self, fleet_net):
+        """The cross-process seam end-to-end in-process: replicas beat
+        through the (fake) coordinator store; the monitor ages them from
+        seq advancement; silencing one gets it declared DEAD."""
+        net, dec = fleet_net
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=2,
+            membership=KVFleetMembership(_FakeKVClient(), fleet_id="kv"),
+            heartbeat_interval=0.03, monitor_interval=0.03,
+            suspect_after=0.2, dead_after=0.6).start()
+        try:
+            frs = [router.submit([1, 2, 3], 3) for _ in range(4)]
+            for fr in frs:
+                fr.result(30)
+            router.kill_replica("r0", mode="zombie")   # beats stop
+            assert _wait(lambda:
+                         router.replica_state("r0") == REPLICA_DEAD,
+                         timeout=10)
+            assert router.replica_state("r1") == REPLICA_ALIVE
+            # the fleet still serves on the survivor
+            router.submit([2, 3], 3).result(30)
+        finally:
+            router.shutdown()
+
+
+class TestDoneCallback:
+    def test_fires_once_on_completion_and_immediately_if_done(self):
+        req = GenerationRequest([1, 2], 3, 0.0, None)
+        hits = []
+        req.add_done_callback(lambda r: hits.append("a"))
+        req.generated.extend([4, 5])
+        req._complete()
+        assert hits == ["a"]
+        req.add_done_callback(lambda r: hits.append("b"))  # already done
+        assert hits == ["a", "b"]
+
+    def test_callback_exception_does_not_strand_completion(self):
+        req = GenerationRequest([1], 2, 0.0, None)
+
+        def boom(r):
+            raise RuntimeError("bad hook")
+
+        req.add_done_callback(boom)
+        req._fail(RuntimeError("x"))
+        assert req.done()
+
+
+class TestFleetRouting:
+    def test_least_loaded_under_skewed_load(self, fleet_net):
+        """Pin long jobs to r0 (the explicit-pin seam); unpinned
+        traffic must spread to the idle replica."""
+        net, dec = fleet_net
+        inj0 = FaultInjector()
+        inj0.hang_for("engine.step", seconds=0.5, at=1, times=3)
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=2,
+            replica_injectors=[inj0, None]).start()
+        try:
+            pinned = [router.submit([1, 2, 3], 8, replica_id="r0")
+                      for _ in range(3)]
+            assert all(fr.replica_id == "r0" for fr in pinned)
+            _wait(lambda: router._replicas["r0"].load() >= 3, timeout=5)
+            free = [router.submit([2, 3, 1], 2) for _ in range(3)]
+            assert all(fr.replica_id == "r1" for fr in free)
+            for fr in pinned + free:
+                fr.result(30)
+        finally:
+            router.shutdown()
+
+    def test_all_saturated_sheds_with_queue_depth(self, fleet_net):
+        net, dec = fleet_net
+        injs = [FaultInjector(), FaultInjector()]
+        for inj in injs:
+            inj.hang_for("engine.step", seconds=0.8, at=1)
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=1,
+            max_pending=1, replica_injectors=injs).start()
+        try:
+            frs = [router.submit([1, 2, 3], 8) for _ in range(12)]
+            shed = [fr for fr in frs if fr.done()
+                    and isinstance(fr._error, RejectedError)]
+            assert shed, "flooding 2x(1 slot + 1 pending) must shed"
+            assert shed[0]._error.queue_depth > 0
+            assert router.shed == len(shed)
+            for fr in frs:
+                try:
+                    fr.result(30)
+                except RejectedError:
+                    pass
+        finally:
+            router.shutdown()
+
+    def test_sticky_key_consistent_and_overridable(self, fleet_net):
+        net, dec = fleet_net
+        router = EngineFleetRouter(net, num_replicas=3, decoder=dec,
+                                   num_slots=2, sticky_prefix=2).start()
+        try:
+            same = [router.submit([5, 7, i], 2) for i in range(5)]
+            for fr in same:
+                fr.result(30)
+            assert len({fr.replica_id for fr in same}) == 1
+            # explicit sticky_key overrides the prompt-prefix key
+            explicit = [router.submit([i, i, i], 2, sticky_key="tenant-a")
+                        for i in range(4)]
+            for fr in explicit:
+                fr.result(30)
+            assert len({fr.replica_id for fr in explicit}) == 1
+        finally:
+            router.shutdown()
+
+    def test_sticky_key_honored_across_migration(self, fleet_net):
+        """When the key's owner dies, the key moves to its ring
+        successor — deterministically, for every later submit."""
+        net, dec = fleet_net
+        router = EngineFleetRouter(net, num_replicas=3, decoder=dec,
+                                   num_slots=2, sticky_prefix=2).start()
+        try:
+            first = router.submit([5, 7, 1], 2)
+            first.result(30)
+            owner = first.replica_id
+            ring = router._ring_walk("5,7")
+            assert ring[0] == owner
+            successor = next(r for r in ring if r != owner)
+            router.kill_replica(owner, mode="crash")
+            after = [router.submit([5, 7, i], 2) for i in range(4)]
+            for fr in after:
+                fr.result(30)
+            assert {fr.replica_id for fr in after} == {successor}
+        finally:
+            router.shutdown()
+
+
+class TestFleetMigration:
+    def test_kill_mid_decode_exactly_once_token_identical(self, fleet_net):
+        net, dec = fleet_net
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, VOCAB, int(rng.integers(2, 5)))
+                   for _ in range(10)]
+        gens = [int(rng.integers(3, 8)) for _ in range(10)]
+        want = _expected(fleet_net, prompts, gens)
+        router = EngineFleetRouter(net, num_replicas=2, decoder=dec,
+                                   num_slots=2).start()
+        try:
+            frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+            _wait(lambda: any(fr.replica_id == "r0" and
+                              len(fr._inner.generated) > 0
+                              for fr in frs), timeout=10)
+            router.kill_replica("r0", mode="crash")   # mid-decode
+            outs = [fr.result(60) for fr in frs]
+            for out, w in zip(outs, want):
+                np.testing.assert_array_equal(out, w)
+            assert router.migrations > 0
+            led = router.fleet_stats()["ledger"]
+            assert led["duplicates"] == 0
+            migrated = [fr for fr in frs if fr.migrations]
+            assert migrated
+            for fr in migrated:
+                names = fr.trace.span_names()
+                assert "migrate" in names
+                assert fr.trace.finished
+        finally:
+            router.shutdown()
+
+    def test_dead_engine_fast_fail_spills_to_survivor(self, fleet_net):
+        """An engine that died between the health scan and dispatch
+        fast-fails ``submit`` with its crash cause; the router must mask
+        that and spill to a healthy replica (regression: the failed
+        inner was bound and r0's crash delivered to the caller while r1
+        sat idle)."""
+        net, dec = fleet_net
+        want = _expected(fleet_net, [[1, 2, 3]], [5])[0]
+        router = EngineFleetRouter(net, num_replicas=2, decoder=dec,
+                                   num_slots=2).start()
+        try:
+            eng = router._replicas["r0"].engine
+            with eng._lock:     # dead to submit, ALIVE to the monitor —
+                eng._dead = RuntimeError(   # exactly the race window
+                    "crashed between scan and dispatch")
+            assert router.replica_state("r0") == REPLICA_ALIVE
+            fr = router.submit([1, 2, 3], 5, replica_id="r0")
+            np.testing.assert_array_equal(fr.result(30), want)
+            assert fr.replica_id == "r1"
+            assert fr.migrations == 0
+            assert router.dispatch_errors >= 1
+        finally:
+            router.shutdown()
+
+    def test_bind_after_migrate_is_not_stranded(self, fleet_net):
+        """A request the engine ACCEPTED but the router had not yet
+        _bind-registered when the replica died sits in the quarantine
+        harvest but outside _migrate's victim snapshot — the bind-time
+        retired re-check must migrate it (regression: stranded forever,
+        ``result()`` timing out, in the module whose bar is zero
+        stranded)."""
+        net, dec = fleet_net
+        want = _expected(fleet_net, [[2, 3]], [4])[0]
+        inj0 = FaultInjector()
+        # park r0's admission so the inner cannot finish before the kill
+        inj0.hang_for("engine.prefill", seconds=1.0, at=1)
+        router = EngineFleetRouter(net, num_replicas=2, decoder=dec,
+                                   num_slots=2,
+                                   replica_injectors=[inj0, None]).start()
+        try:
+            rep = router._replicas["r0"]
+            fr = FleetRequest([2, 3], 4, 0.0, None)
+            inner = rep.submit(fr.prompt, fr.max_new_tokens)
+            # the replica dies between rep.submit() and _bind: the
+            # victim snapshot cannot include fr
+            router.kill_replica("r0", mode="crash")
+            router._bind(fr, inner, rep)
+            np.testing.assert_array_equal(fr.result(30), want)
+            assert fr.replica_id == "r1"
+            assert fr.migrations == 1
+            assert router.fleet_stats()["ledger"]["duplicates"] == 0
+        finally:
+            router.shutdown()
+
+    def test_replica_kill_injection_point(self, fleet_net):
+        """`replica.kill` raise in the heartbeat loop = scripted hard
+        crash, detected and migrated immediately (no heartbeat wait)."""
+        net, dec = fleet_net
+        inj0 = FaultInjector()
+        inj0.raise_once("replica.kill", RuntimeError("scripted kill"),
+                        at=4)
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=2,
+            replica_injectors=[inj0, None],
+            heartbeat_interval=0.03).start()
+        try:
+            frs = [router.submit([1, 2, 3], 6, replica_id="r0")
+                   for _ in range(3)]
+            assert _wait(lambda:
+                         router.replica_state("r0") == REPLICA_DEAD,
+                         timeout=10)
+            for fr in frs:
+                fr.result(30)
+            assert router.replica_state("r1") == REPLICA_ALIVE
+        finally:
+            router.shutdown()
+
+    def test_suspect_flap_hysteresis(self, fleet_net):
+        """A momentarily-slow replica (one heartbeat hang shorter than
+        dead_after) goes SUSPECT, then needs recover_beats consecutive
+        fresh scans to return ALIVE — and is never migrated."""
+        net, dec = fleet_net
+        inj0 = FaultInjector()
+        inj0.hang_for("fleet.heartbeat", seconds=0.4, at=3)
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=2,
+            replica_injectors=[inj0, None],
+            heartbeat_interval=0.03, monitor_interval=0.03,
+            suspect_after=0.2, dead_after=3.0, recover_beats=2).start()
+        try:
+            assert _wait(lambda:
+                         router.replica_state("r0") == REPLICA_SUSPECT,
+                         timeout=10), "hang must trip SUSPECT"
+            # dispatch while SUSPECT prefers the healthy replica
+            fr = router.submit([1, 2], 3)
+            assert fr.replica_id == "r1"
+            fr.result(30)
+            assert _wait(lambda:
+                         router.replica_state("r0") == REPLICA_ALIVE,
+                         timeout=10), "fresh beats must recover it"
+            assert router.migrations == 0
+            assert router.replica_state("r0") == REPLICA_ALIVE
+        finally:
+            router.shutdown()
+
+    def test_zombie_late_publish_is_fenced(self, fleet_net):
+        """Heartbeat death with the engine still running (partition):
+        migration re-dispatches a CLONE; when the zombie wakes and
+        completes its stale handle, the completion is fenced — exactly
+        one result, token-identical, one finished trace."""
+        net, dec = fleet_net
+        want = _expected(fleet_net, [[3, 1, 4]], [6])[0]
+        inj0 = FaultInjector()
+        inj0.hang_for("engine.step", seconds=1.2, at=2)
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=2,
+            replica_injectors=[inj0, None],
+            heartbeat_interval=0.03, monitor_interval=0.03,
+            suspect_after=0.15, dead_after=0.4).start()
+        try:
+            fr = router.submit([3, 1, 4], 6, replica_id="r0")
+            time.sleep(0.08)                  # let it enter the hang
+            router.kill_replica("r0", mode="zombie")
+            out = fr.result(30)               # served by the clone on r1
+            np.testing.assert_array_equal(out, want)
+            assert fr.replica_id == "r1" and fr.migrations == 1
+            # the zombie wakes, finishes its stale handle, and is fenced
+            assert _wait(lambda: router.fenced_completions >= 1,
+                         timeout=15), "late publish must be fenced"
+            led = router.fleet_stats()["ledger"]
+            assert led["duplicates"] == 0
+            tr = fr.trace
+            assert tr.finished and "migrate" in tr.span_names()
+        finally:
+            router.shutdown()
+
+    def test_no_survivors_fails_with_cause(self, fleet_net):
+        net, dec = fleet_net
+        inj0 = FaultInjector()
+        inj0.hang_for("engine.step", seconds=0.6, at=1)
+        router = EngineFleetRouter(net, num_replicas=1, decoder=dec,
+                                   num_slots=2,
+                                   replica_injectors=[inj0]).start()
+        try:
+            fr = router.submit([1, 2, 3], 8)
+            time.sleep(0.05)
+            router.kill_replica("r0", mode="crash",
+                                cause=RuntimeError("the only one died"))
+            with pytest.raises(RuntimeError, match="no surviving"):
+                fr.result(30)
+            assert fr._error.__cause__ is not None
+        finally:
+            router.shutdown()
+
+    def test_supervised_replicas_restart_in_place(self, fleet_net):
+        """supervised=True: an engine crash is absorbed by the replica's
+        own EngineSupervisor (restart-in-place, exactly-once requeue);
+        the FLEET sees nothing — no migration, no state change."""
+        net, dec = fleet_net
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(0, VOCAB, 3) for _ in range(6)]
+        gens = [5] * 6
+        want = _expected(fleet_net, prompts, gens)
+        inj0 = FaultInjector()
+        inj0.raise_once("engine.step", RuntimeError("replica-local crash"),
+                        at=2)
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=2,
+            supervised=True, supervisor_timeout=5.0,
+            replica_injectors=[inj0, None],
+            dead_after=20.0).start()
+        try:
+            frs = [router.submit(p, g, replica_id="r0")
+                   for p, g in zip(prompts, gens)]
+            outs = [fr.result(60) for fr in frs]
+            for out, w in zip(outs, want):
+                np.testing.assert_array_equal(out, w)
+            assert router.migrations == 0
+            assert router.replica_state("r0") == REPLICA_ALIVE
+            assert router._replicas["r0"].engine.restarts >= 1
+        finally:
+            router.shutdown()
+
+
+class TestSupervisorRequeueFacade:
+    def test_requeue_lands_in_current_engine(self, fleet_net):
+        net, dec = fleet_net
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec)
+        sup = EngineSupervisor(eng, timeout=5.0).start()
+        try:
+            req = GenerationRequest([2, 3, 1], 4, 0.0, None)
+            sup.requeue(req)
+            out = req.result(30)
+            np.testing.assert_array_equal(
+                out, _expected(fleet_net, [[2, 3, 1]], [4])[0])
+            assert sup.engine.requeued >= 1
+        finally:
+            sup.stop()
+
+
+class TestFleetServingRoute:
+    def test_in_order_publishing_across_migration(self, fleet_net):
+        """GenerationServingRoute(engine=router): the fleet serves a
+        topic; a replica killed mid-stream migrates its requests and the
+        publisher's submission-order contract holds across the seam."""
+        net, dec = fleet_net
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(0, VOCAB, 3) for _ in range(10)]
+        gens = [5] * 10
+        want = _expected(fleet_net, prompts, gens)
+        router = EngineFleetRouter(net, num_replicas=2, decoder=dec,
+                                   num_slots=2).start()
+        broker = MessageBroker()
+        out_sub = NDArraySubscriber(broker, "fleet-out")
+        route = GenerationServingRoute(
+            None, broker, engine=router, max_new_tokens=5,
+            input_topic="fleet-in", output_topic="fleet-out").start()
+        try:
+            pub = NDArrayPublisher(broker, "fleet-in")
+            for i, p in enumerate(prompts):
+                pub.publish(np.asarray(p, np.int32))
+                if i == 4:
+                    router.kill_replica("r0", mode="crash")
+            got = []
+            deadline = time.monotonic() + 60
+            while len(got) < len(prompts) and time.monotonic() < deadline:
+                m = out_sub.poll(timeout=0.2)
+                if m is not None:
+                    got.append(m)
+            assert len(got) == len(prompts)
+            for g, w in zip(got, want):       # submission order preserved
+                np.testing.assert_array_equal(np.asarray(g, np.int64), w)
+            assert route.served == len(prompts)
+        finally:
+            route.stop()
+            router.shutdown()
+            out_sub.close()
+
+    def test_fleet_stats_replica_table(self, fleet_net):
+        net, dec = fleet_net
+        router = EngineFleetRouter(net, num_replicas=2, decoder=dec,
+                                   num_slots=2).start()
+        try:
+            router.submit([1, 2], 3).result(30)
+            fs = router.fleet_stats()
+            assert set(fs["replicas"]) == {"r0", "r1"}
+            row = fs["replicas"]["r0"]
+            assert {"state", "heartbeat_age_s", "load", "capacity",
+                    "queue_depth", "active_slots"} <= set(row)
+            assert fs["ledger"]["duplicates"] == 0
+            agg = router.stats()
+            assert agg["replicas"] == 2 and agg["completed"] >= 1
+        finally:
+            router.shutdown()

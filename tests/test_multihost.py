@@ -88,6 +88,80 @@ def _run_phase(port, ckdir, phase):
     return outs
 
 
+class _WriteOnceKV:
+    """Fake coordinator key-value client with the store's WRITE-ONCE
+    semantics: a second set on the same key raises, gets block (here:
+    raise) until the key exists."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, val):
+        if key in self.store:
+            raise RuntimeError(f"key already exists: {key}")
+        self.store[key] = val
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.store:
+            raise TimeoutError(f"no value for {key}")
+        return self.store[key]
+
+
+class TestHostAllreduceTagReuse:
+    """host_allreduce_mean keys are write-once: a reused tag used to
+    silently return every peer's STALE buffers. It must now raise a
+    clear error naming the tag — and still tolerate an idempotent
+    retry (same payload re-published)."""
+
+    @staticmethod
+    def _encode(arr):
+        import base64
+
+        import numpy as np
+        return base64.b64encode(
+            np.asarray(arr, np.float64).ravel().tobytes()).decode("ascii")
+
+    def _patched(self, monkeypatch, kv, n=2, pid=0):
+        import jax
+
+        from deeplearning4j_tpu.parallel import multihost
+        monkeypatch.setattr(multihost, "distributed_client", lambda: kv)
+        monkeypatch.setattr(jax, "process_count", lambda: n)
+        monkeypatch.setattr(jax, "process_index", lambda: pid)
+        return multihost
+
+    def test_mean_across_fake_peers(self, monkeypatch):
+        import numpy as np
+        kv = _WriteOnceKV()
+        kv.store["dl4j/hostavg/step1/1"] = self._encode([4.0, 8.0])
+        mh = self._patched(monkeypatch, kv)
+        out = mh.host_allreduce_mean(np.array([2.0, 4.0], np.float32),
+                                     tag="step1")
+        np.testing.assert_allclose(np.asarray(out), [3.0, 6.0])
+
+    def test_reused_tag_with_different_payload_raises_naming_tag(
+            self, monkeypatch):
+        import numpy as np
+        import pytest
+        kv = _WriteOnceKV()
+        # a PREVIOUS reduction already used this tag with other data
+        kv.store["dl4j/hostavg/epoch/0"] = self._encode([9.0, 9.0])
+        kv.store["dl4j/hostavg/epoch/1"] = self._encode([9.0, 9.0])
+        mh = self._patched(monkeypatch, kv)
+        with pytest.raises(ValueError, match="tag 'epoch'"):
+            mh.host_allreduce_mean(np.array([1.0, 2.0]), tag="epoch")
+
+    def test_idempotent_retry_same_payload_is_benign(self, monkeypatch):
+        import numpy as np
+        kv = _WriteOnceKV()
+        mine = self._encode([1.0, 2.0])
+        kv.store["dl4j/hostavg/retry/0"] = mine   # my earlier attempt
+        kv.store["dl4j/hostavg/retry/1"] = self._encode([3.0, 4.0])
+        mh = self._patched(monkeypatch, kv)
+        out = mh.host_allreduce_mean(np.array([1.0, 2.0]), tag="retry")
+        np.testing.assert_allclose(np.asarray(out), [2.0, 3.0])
+
+
 def test_two_process_train_checkpoint_resume(tmp_path):
     ckdir = tmp_path / "ckpts"
     # phase 1: fresh two-process cluster trains 3 steps, proc 0 checkpoints

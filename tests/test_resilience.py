@@ -479,7 +479,7 @@ class TestRouteDegradation:
             pub.publish(np.asarray(rng_np.integers(0, VOCAB, 3), np.int32))
             assert _wait(lambda: route.deadline_errors >= 1, timeout=30)
             with route._inflight_lock:
-                assert route._inflight == []   # popped, not wedged
+                assert not route._inflight     # popped, not wedged
             assert out.poll(timeout=0.2) is None
         finally:
             route.stop()
@@ -544,6 +544,45 @@ class TestChaosAcceptance:
                 route_broker.close()
                 feed.broker.close()
                 srv.close()
+
+
+class TestRouteStopContract:
+    """stop() must close BOTH broker ends and be idempotent — a
+    double-stop used to re-join dead threads and leave the publisher
+    open, silently feeding a topic whose route was torn down."""
+
+    def test_generation_route_stop_closes_both_ends_idempotent(
+            self, shared_decoder, rng_np):
+        net, _ = shared_decoder
+        broker = MessageBroker()
+        eng = _engine(shared_decoder)
+        route = GenerationServingRoute(net, broker, engine=eng,
+                                       max_new_tokens=3).start()
+        route.stop()
+        assert route.pub._closed and route.sub._stop.is_set()
+        with pytest.raises(RuntimeError, match="closed"):
+            route.pub.publish(np.zeros(2, np.int32))
+        t0 = time.monotonic()
+        route.stop()                           # second stop: no re-join,
+        assert time.monotonic() - t0 < 0.5     # no re-close, returns fast
+
+    def test_model_route_stop_closes_both_ends_idempotent(self):
+        from deeplearning4j_tpu.nn import (InputType,
+                                           NeuralNetConfiguration,
+                                           MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.streaming.serving import ModelServingRoute
+        conf = (NeuralNetConfiguration.Builder().seed(5).list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        route = ModelServingRoute(net, MessageBroker()).start()
+        route.stop()
+        assert route.pub._closed and route.sub._stop.is_set()
+        route.stop()                           # idempotent
 
 
 class TestChaosSoakProfile:
