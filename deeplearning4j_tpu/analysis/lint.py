@@ -61,16 +61,20 @@ RULES: Dict[str, str] = {
              "spec rank vs known parameter rank)",
     "GL014": "host sync or metric/trace recording inside a "
              "shard_map/pjit region",
+    "GL015": "metric-family naming violation (counters must end _total, "
+             "histograms _seconds/_bytes) or flight-recorder/devstats/"
+             "SLO recording inside jitted/traced code",
 }
 
 #: rules decided per module (cacheable per file); the rest (GL009-GL012)
 #: need the whole-package call graph
 PER_FILE_RULES = frozenset({"GL001", "GL002", "GL003", "GL004", "GL005",
-                            "GL006", "GL007", "GL008", "GL013", "GL014"})
+                            "GL006", "GL007", "GL008", "GL013", "GL014",
+                            "GL015"})
 PACKAGE_RULES = frozenset({"GL009", "GL010", "GL011", "GL012"})
 
 #: bump to invalidate cached per-file results when any pass changes
-LINT_VERSION = 11
+LINT_VERSION = 13
 
 #: wrappers whose function arguments are traced when called
 _TRACE_WRAPPERS = {
@@ -104,6 +108,23 @@ _OBS_HINTED_METHODS = {"set", "dec", "event", "finish", "labels",
                        "annotate"}
 _OBS_NAME_HINTS = ("metric", "gauge", "counter", "hist", "trace", "span",
                    "registry", "telemetry")
+#: GL015 — the ISSUE 9 sinks: flight-recorder / devstats / SLO recording
+#: must stay host-side exactly like GL008's metric/trace calls (same
+#: receiver-hint machinery, its own rule id so the new subsystems get
+#: their own baseline rows)
+_GL015_NAME_HINTS = ("flight", "recorder", "flightrec", "devstats",
+                     "slo")
+_GL015_RECORD_METHODS = {"record", "dump", "write_postmortem",
+                         "observe_request", "snapshot", "sample",
+                         "record_request"}
+#: GL015 — metric-family naming: registry declaration method → the
+#: suffixes a family name must carry (Prometheus conventions; gauges are
+#: unconstrained). Checked at any ``<registry-ish>.counter/histogram``
+#: call site with a statically visible name (string literal, or an
+#: f-string whose final fragment is literal).
+_GL015_NAME_SUFFIXES = {"counter": ("_total",),
+                        "histogram": ("_seconds", "_bytes")}
+_GL015_REGISTRY_HINTS = ("registry", "reg")
 #: callees whose results are NOT "just-dispatched device work" for GL007:
 #: python builtins and host-side helpers a loop legitimately materializes
 _GL007_SAFE_CALLEES = {"range", "len", "list", "tuple", "dict", "set",
@@ -402,6 +423,19 @@ class ModuleLint:
                                    "(once per compile, never per step) "
                                    "and host-syncs any traced value; "
                                    "record outside the jitted region")
+            if isinstance(node, ast.Call) and "GL015" in enabled:
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    recv = _dotted_name(f.value).lower()
+                    if f.attr in _GL015_RECORD_METHODS and any(
+                            w in recv for w in _GL015_NAME_HINTS):
+                        self._emit(out, "GL015", node, qual,
+                                   f".{f.attr}() on an SLO/flight-"
+                                   "recorder/devstats sink under trace "
+                                   "— it would record at TRACE time "
+                                   "(once per compile, never per "
+                                   "event); record outside the jitted "
+                                   "region")
             if isinstance(node, ast.Call) and "GL004" in enabled:
                 np_fn = _is_np_call(node.func)
                 if np_fn and np_fn not in _NP_SAFE and \
@@ -636,6 +670,61 @@ class ModuleLint:
                                    "fetch the previous dispatch via "
                                    "ops.transfer.device_fetch")
 
+    # -------------------------------------------------------------- GL015
+    @staticmethod
+    def _static_metric_name(node: ast.AST) -> Optional[str]:
+        """The statically visible (suffix of the) metric name at a
+        declaration site: a string literal whole, an f-string's trailing
+        literal fragment (the repo's ``f"route_{key}_total"`` idiom), or
+        None when the name is fully dynamic (skipped — the gate only
+        judges what it can read)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.JoinedStr) and node.values:
+            last = node.values[-1]
+            if isinstance(last, ast.Constant) and \
+                    isinstance(last.value, str):
+                return last.value
+        return None
+
+    def _check_metric_naming(self, out: List[Finding],
+                             enabled: Set[str]) -> None:
+        """Metric-family naming at registry declaration sites: counters
+        must end ``_total``, histograms ``_seconds``/``_bytes`` (the
+        Prometheus unit conventions every dashboard and the fleet-scrape
+        aggregator key on). Applies to ``<registry>.counter(...)`` /
+        ``<registry>.histogram(...)`` calls whose receiver names a
+        registry; standalone perf-script Histogram instances never reach
+        exposition and stay unconstrained."""
+        if "GL015" not in enabled:
+            return
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            suffixes = _GL015_NAME_SUFFIXES.get(node.func.attr)
+            if suffixes is None:
+                continue
+            recv = _dotted_name(node.func.value).lower()
+            last = recv.rsplit(".", 1)[-1]
+            if not ("registry" in last or last == "reg" or
+                    last.endswith("_reg")):
+                continue
+            name_node = node.args[0] if node.args else None
+            if name_node is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_node = kw.value
+            name = None if name_node is None \
+                else self._static_metric_name(name_node)
+            if name is None or name.endswith(tuple(suffixes)):
+                continue
+            want = "/".join(suffixes)
+            self._emit(out, "GL015", node, self._qualname(node),
+                       f"{node.func.attr} family {name!r} must end "
+                       f"{want} (Prometheus unit conventions; the "
+                       "fleet-scrape aggregator sums by suffix)")
+
     @staticmethod
     def _gl007_safe_call(call: ast.Call) -> bool:
         """Callees whose results are host values, not dispatched device
@@ -686,6 +775,7 @@ class ModuleLint:
         self._check_jit_sites(out, enabled)
         self._check_lock_discipline(out, enabled)
         self._check_host_loop_syncs(out, enabled, jit_ids)
+        self._check_metric_naming(out, enabled)
         if enabled & {"GL013", "GL014"}:
             from .sharding import run_sharding_pass
             run_sharding_pass(

@@ -51,7 +51,9 @@ from jax.sharding import NamedSharding
 from ..nn.conf.layers import (RnnOutputLayer, SelfAttentionLayer,
                               TokenAndPositionEmbedding)
 from ..nn.graph.vertices import LayerVertex
+from ..observability.flightrec import default_flight_recorder
 from ..observability.metrics import default_registry
+from ..observability.slo import default_slo_tracker
 from ..observability.tracing import Trace, default_trace_ring
 from ..ops.platform import train_donate_argnums
 from ..ops.transfer import device_fetch
@@ -93,6 +95,14 @@ def _round_up_pow2(n: int, floor: int = 16) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def _abstract_spec(x):
+    """Array leaf → ShapeDtypeStruct (the cost seam's signature record);
+    scalar leaves keep their numpy-inferred dtype."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return jax.ShapeDtypeStruct((), np.asarray(x).dtype)
 
 
 class TransformerDecoder:
@@ -160,6 +170,9 @@ class TransformerDecoder:
         self.t_max = int(t_max)
         self.vocab_size = out_v.layer.n_out
         self._jit: Dict = {}
+        # cost seam (observability/devstats.py): impl audit name →
+        # [jitted fn, first-dispatch abstract arg specs, memoized cost]
+        self._cost_seam: Dict[str, List] = {}
         self._cast_src = None
         self._cast_params = None
         # ---- mesh sharding (r12) ----
@@ -519,8 +532,34 @@ class TransformerDecoder:
                 out_specs=(mat, row, row, row, csh))
         else:                                 # pragma: no cover
             raise KeyError(name)
+        fn = self._with_cost_seam(name, fn)
         self._jit[name] = fn
         return fn
+
+    def _impl_audit_name(self, name) -> str:
+        """The wrapped impl's __name__ as the compile auditor sees it
+        (per-K, per-mesh) — devstats keys its cost table the same way,
+        so the two views line up row for row."""
+        base = {"prefill": "prefill_impl", "step": "decode_step_impl",
+                "prefill_slots": "prefill_slots_impl"}.get(name)
+        if base is None and isinstance(name, tuple) and name[0] == "block":
+            base = f"decode_block{int(name[1])}_impl"
+        return (base or str(name)) + self._impl_suffix
+
+    def _with_cost_seam(self, name, jitted):
+        """Wrap a jitted impl so its FIRST dispatch captures the
+        abstract arg signature (ShapeDtypeStructs — host-side, no device
+        work) into ``_cost_seam``; devstats lowers from those specs on
+        demand for the per-impl cost_analysis table. Steady-state cost:
+        one dict-entry check per dispatch."""
+        entry = [jitted, None, None]
+        self._cost_seam[self._impl_audit_name(name)] = entry
+
+        def dispatch(*args):
+            if entry[1] is None:
+                entry[1] = jax.tree_util.tree_map(_abstract_spec, args)
+            return jitted(*args)
+        return dispatch
 
     def prefill(self, caches, tokens, lengths, temps=None, seed: int = 0):
         """Fill ``caches`` from padded prompts [B, Tp] (+ true lengths
@@ -740,6 +779,16 @@ class GenerationRequest:
         # `takeover` span per restart) instead of starting a second one
         self.trace: Optional[Trace] = None
         self._submit_t = time.monotonic()
+        # SLO clocks (observability/slo.py): anchored at the ORIGINAL
+        # submission and written once — requeue resets _submit_t (the
+        # per-engine queued-span clock) but never these, so deadline
+        # headroom / TTFT / queue-wait survive takeovers and migrations
+        self._created_t = self._submit_t
+        self._admitted_t: Optional[float] = None
+        self._first_token_t: Optional[float] = None
+        self._slo = None                   # SLOTracker, set at submit
+        self._slo_done = False             # an observe_request happened
+        self._slo_labels: Dict = {}
 
     def _complete(self):
         self._result = np.concatenate(
@@ -748,6 +797,7 @@ class GenerationRequest:
         if self.trace is not None:
             self.trace.finish("ok", tokens=len(self.generated))
         self._done.set()
+        self._notify_slo("ok")
         self._fire_callbacks()
 
     def _fail(self, exc: BaseException):
@@ -757,7 +807,37 @@ class GenerationRequest:
             self.trace.finish(f"failed:{type(exc).__name__}",
                               tokens=len(self.generated))
         self._done.set()
+        self._notify_slo(self._slo_status(exc))
         self._fire_callbacks()
+
+    @staticmethod
+    def _slo_status(exc: BaseException) -> str:
+        """Map a terminal exception to its SLO outcome class (the fleet
+        completion gate reuses this for sync-failed inner handles)."""
+        if isinstance(exc, DeadlineExceeded):
+            return "deadline"
+        if isinstance(exc, Cancelled):
+            return "cancelled"
+        if isinstance(exc, RejectedError):
+            return "shed"
+        return "failed"
+
+    def _notify_slo(self, status: str) -> None:
+        # exactly once per request (racing completion paths included):
+        # the tracker handle is consumed by the first notifier, UNDER
+        # _cb_lock — the fleet clone path clears a zombie's handle from
+        # the router thread, and without the lock the zombie's engine
+        # thread could load a still-armed reference concurrently and
+        # double-count the request its clone now owns.
+        with self._cb_lock:
+            slo, self._slo = self._slo, None
+        if slo is None:
+            return
+        self._slo_done = True
+        try:
+            slo.observe_request(self, status)
+        except Exception:   # noqa: BLE001 — accounting must not strand
+            pass            # the engine thread that completed us
 
     def _fire_callbacks(self):
         # drain-under-lock then fire outside it: a callback that submits
@@ -877,7 +957,8 @@ class SlotGenerationEngine:
                  seed: int = 0, decoder: Optional[TransformerDecoder] = None,
                  max_pending: int = 256, fault_injector=None,
                  block_size: int = 1, registry=None, trace_store=None,
-                 tracing: bool = True, mesh=None, spec_layout=None):
+                 tracing: bool = True, mesh=None, spec_layout=None,
+                 slo=None, slo_label=None, flight_recorder=None):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -953,6 +1034,18 @@ class SlotGenerationEngine:
             else default_trace_ring()
         self._tracing = bool(tracing)
         self.engine_id = f"e{next(_ENGINE_SEQ)}"
+        # SLO + flight-recorder sinks (ISSUE 9): the tracker accounts
+        # deadline headroom / TTFT / queue-wait per request at its
+        # exactly-once completion; slo_label keeps one STABLE replica
+        # label across supervisor-rebuilt engines (the supervisor passes
+        # the old label through), so attainment never fragments across
+        # takeovers. The flight recorder gets lifecycle events
+        # (admission waves, block retires, sheds) for post-mortems.
+        self._slo = slo if slo is not None else default_slo_tracker()
+        self.slo_label = str(slo_label) if slo_label is not None \
+            else self.engine_id
+        self._flightrec = flight_recorder if flight_recorder is not None \
+            else default_flight_recorder()
         reg = self._registry
         self._m = {key: reg.counter(f"generation_{key}_total", desc,
                                     ("engine",)).labels(self.engine_id)
@@ -989,7 +1082,9 @@ class SlotGenerationEngine:
     # ------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                eos_id: Optional[int] = None,
-               deadline: Optional[float] = None) -> GenerationRequest:
+               deadline: Optional[float] = None,
+               route: Optional[str] = None,
+               _slo_sync_fail: bool = True) -> GenerationRequest:
         req = GenerationRequest(prompt, max_new_tokens, temperature, eos_id,
                                 deadline=deadline)
         req._engine = self
@@ -1000,6 +1095,17 @@ class SlotGenerationEngine:
             req.trace = Trace(store=self._trace_store)
             req.trace.event("submit", engine=self.engine_id,
                             prompt_len=len(req.prompt))
+        # SLO accounting rides every request (completion is once per
+        # request, not per token — outside the ≤5% A/B's hot loop).
+        # _slo_sync_fail=False is the FLEET seam: the router spills past
+        # this engine's synchronous fast-fails (queue-full shed, dead
+        # engine) and retries another replica, so those outcomes must
+        # not be accounted as misses here — the tracker is armed only
+        # once the request is actually accepted (the fleet completion
+        # gate accounts any sync failure it ends up propagating).
+        req._slo_labels = {"replica": self.slo_label, "route": route}
+        if _slo_sync_fail:
+            req._slo = self._slo
         with self._lock:
             dead = self._dead
             stopped = self._shutdown or dead is not None
@@ -1039,8 +1145,14 @@ class SlotGenerationEngine:
                     shed_depth = depth
                     queued = False
                 else:
+                    # past every synchronous fast-fail: arm the SLO sink
+                    # BEFORE the append (the worker may complete the
+                    # request the instant it is visible in the queue)
+                    req._slo = self._slo
                     self._pending.append(req)
         if shed_depth is not None:
+            self._flightrec.record("shed", engine=self.engine_id,
+                                   queue_depth=shed_depth)
             req._fail(RejectedError(
                 f"pending queue full ({shed_depth} queued, "
                 f"max_pending={self.max_pending}) — request shed",
@@ -1064,6 +1176,16 @@ class SlotGenerationEngine:
             # a restarted request shows in its timeline
             req.trace.event("takeover", engine=self.engine_id,
                             generated=len(req.generated))
+        # SLO continuity: re-point the sink at THIS engine's tracker and
+        # replica label, but never touch the created/admitted/first-token
+        # clocks — the takeover must not reset any SLO clock. A clone
+        # whose zombie already accounted the request (_slo_done inherited
+        # in the fleet's _clone_inner) is NOT re-armed: one record per
+        # request even across the migrate-vs-complete race.
+        if not req._slo_done:
+            req._slo = self._slo
+        req._slo_labels = dict(req._slo_labels or {},
+                               replica=self.slo_label)
         req._submit_t = time.monotonic()
         with self._lock:
             dead = self._dead
@@ -1275,6 +1397,13 @@ class SlotGenerationEngine:
                     tok = int(toks[i])
                     req._running = True
                     req.generated.append(tok)
+                    # SLO clocks: admitted/first-token stamped ONCE — a
+                    # recovered request re-admitting after takeover keeps
+                    # its original queue-wait and TTFT
+                    if req._admitted_t is None:
+                        req._admitted_t = t_pre0
+                    if req._first_token_t is None:
+                        req._first_token_t = t_pre1
                     self._m["emitted_tokens"].inc()
                     if req.trace is not None:
                         req.trace.add_span("queued", req._submit_t, t_pre0)
@@ -1294,6 +1423,11 @@ class SlotGenerationEngine:
                 # slot contents changed: the block-decode pipeline must
                 # resync its device carry from host state
                 self._carry = None
+            if self._tracing:       # outside the engine lock (flightrec
+                self._flightrec.record(   # owns its own lock)
+                    "admission", engine=self.engine_id, batch=m,
+                    bucket=mb, tp=tp,
+                    wait_ms=round((t_pre1 - t_pre0) * 1e3, 3))
             for req in finishers:
                 req._complete()
             if drained:
@@ -1327,6 +1461,9 @@ class SlotGenerationEngine:
         t_ret = time.monotonic()
         if self._tracing:
             self._h_block.observe(t_ret - t_disp)
+            self._flightrec.record("block_retire", engine=self.engine_id,
+                                   k=1, ms=round((t_ret - t_disp) * 1e3,
+                                                 3))
         finished: List[GenerationRequest] = []
         # token appends and slot frees are one critical section: a
         # concurrent quarantine() either runs before (we see empty slots
@@ -1429,6 +1566,9 @@ class SlotGenerationEngine:
         t_ret = time.monotonic()
         if self._tracing:
             self._h_block.observe(t_ret - t_disp)
+            self._flightrec.record("block_retire", engine=self.engine_id,
+                                   k=k, lanes=len(snapshot),
+                                   ms=round((t_ret - t_disp) * 1e3, 3))
         finished: List[GenerationRequest] = []
         with self._lock:
             if self._quarantined or self._shutdown:

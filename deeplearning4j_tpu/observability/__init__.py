@@ -17,18 +17,38 @@ hot path and everything around it:
   publish, carried ACROSS EngineSupervisor takeovers (one trace per
   request, a ``takeover`` span marking each restart), with a fixed
   :class:`TraceRing` of completed traces.
+- :mod:`.slo` — :class:`SLOTracker`: per-request deadline headroom,
+  queue-wait, and TTFT accounting with rolling short/long-window
+  attainment and burn rate, per-route and per-replica, riding on the
+  request clocks the engine stamps (which survive takeovers and
+  migrations — the clock never resets).
+- :mod:`.devstats` — device-side cost accounting sampled off the hot
+  path: device memory / live-array census, exact per-engine KV-cache
+  bytes from the live cache leaves, and per-impl XLA cost analysis
+  (flops/bytes per ``prefill``/``decode_block{K}``/``prefill_slots``,
+  per mesh tag).
+- :mod:`.flightrec` — :class:`FlightRecorder`: a bounded structured
+  event ring (admission, block retire, shed, takeover, migration,
+  reconnect, fault) with post-mortem JSON artifacts bundling events +
+  traces + registry snapshot + transfer/compile-audit state, written by
+  the supervisor and fleet router on crash/wedge/replica death.
 - :mod:`.telemetry` — :class:`TelemetryServer`, a background HTTP
-  endpoint (``/metrics``, ``/snapshot``, ``/traces/recent``) reusing
-  the training UI's HTTP plumbing.
+  endpoint (``/metrics``, ``/snapshot``, ``/slo``, ``/traces/recent``)
+  reusing the training UI's HTTP plumbing.
 
 Instrumentation is host-side only (wall clocks, counter bumps): it
 compiles nothing, adds no device syncs beyond the existing
-``device_fetch`` seam, and graftlint GL008 statically rejects any
-metric/trace record call that drifts into jit-traced code.
+``device_fetch`` seam, and graftlint GL008/GL015 statically reject any
+metric/trace/SLO/flight-recorder record call that drifts into
+jit-traced code.
 """
 
+from .devstats import (DeviceStats, device_memory_snapshot,
+                       impl_cost_analysis, kv_cache_stats)
+from .flightrec import FlightRecorder, default_flight_recorder
 from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, default_registry, percentiles)
+from .slo import SLORecord, SLOTracker, default_slo_tracker
 from .telemetry import TelemetryServer
 from .tracing import Span, Trace, TraceRing, default_trace_ring
 
@@ -36,5 +56,9 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS", "default_registry", "percentiles",
     "Span", "Trace", "TraceRing", "default_trace_ring",
+    "SLORecord", "SLOTracker", "default_slo_tracker",
+    "DeviceStats", "device_memory_snapshot", "impl_cost_analysis",
+    "kv_cache_stats",
+    "FlightRecorder", "default_flight_recorder",
     "TelemetryServer",
 ]

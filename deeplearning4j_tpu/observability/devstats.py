@@ -1,0 +1,245 @@
+"""Device-side cost accounting, sampled OFF the serving hot path.
+
+Three accounts the adaptive policies (ROADMAP items 2-3) need before
+they can size anything:
+
+- **Device memory** — per-device allocator stats from
+  ``Device.memory_stats()`` (TPU/GPU backends; ``None`` on CPU, where
+  the view degrades to the live-array census) plus a
+  ``jax.live_arrays()`` census (count + bytes). Both are read at
+  COLLECTION time (a ``/snapshot`` or ``/metrics`` render), never from
+  the decode loop — reading allocator counters syncs nothing, but it is
+  still work the hot path must not pay.
+
+- **KV-cache bytes** — exact per-engine accounting from the decoder's
+  ACTUAL cache leaves (slots × heads × T_max × Dh × itemsize summed
+  over attention layers and k/v), not a formula that can drift from the
+  allocation. Sharded caches report global bytes, per-host
+  (addressable) bytes, and the shard count, so a (data, tp) mesh's
+  dominant allocation is attributable per chip — the number the paged
+  KV cache (ROADMAP item 2) must fit under.
+
+- **Per-impl static cost** — flops / bytes-accessed from XLA's cost
+  analysis for every compiled decode impl (``prefill`` /
+  ``decode_block{K}`` / ``prefill_slots`` / ``decode_step``, per mesh
+  tag): the measured-cost table μ-cuDNN-style block-size policies read
+  instead of guessing. The decoder captures each impl's abstract arg
+  signature at its FIRST dispatch (one dict lookup per call, host-side);
+  cost extraction then lowers from those specs on demand. Lowering logs
+  one compile record per impl the first time (cached after), so cost
+  capture belongs OUTSIDE compile-audited steady-state windows — call
+  it once after warmup, as the telemetry server does.
+
+Everything here is host-side observation: nothing dispatches device
+work, nothing runs under jit (graftlint GL015 rejects devstats calls in
+traced code), and every probe degrades to a partial snapshot instead of
+failing the endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+from .metrics import MetricsRegistry, default_registry
+
+
+def _leaf_arrays(tree) -> List:
+    import jax
+    return [x for x in jax.tree_util.tree_leaves(tree)
+            if hasattr(x, "dtype") and hasattr(x, "shape")]
+
+
+def device_memory_snapshot() -> dict:
+    """Per-device allocator stats + the live-array census. Guarded
+    end-to-end: a backend without ``memory_stats`` (CPU) reports
+    ``memory_stats: None`` per device and the census still stands."""
+    import jax
+    devices = []
+    for d in jax.local_devices():
+        row = {"id": int(d.id), "platform": str(d.platform),
+               "kind": str(getattr(d, "device_kind", "?"))}
+        try:
+            ms = d.memory_stats()
+        except Exception:   # noqa: BLE001 — a probe must not 500 the view
+            ms = None
+        if ms:
+            row["memory_stats"] = {
+                k: int(v) for k, v in ms.items()
+                if isinstance(v, (int, float)) and k in (
+                    "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                    "largest_alloc_size", "pool_bytes")}
+        else:
+            row["memory_stats"] = None
+        devices.append(row)
+    try:
+        live = jax.live_arrays()
+        census = {"count": len(live),
+                  "bytes": int(sum(int(a.nbytes) for a in live))}
+    except Exception:   # noqa: BLE001
+        census = {"count": None, "bytes": None}
+    return {"devices": devices, "live_arrays": census}
+
+
+def kv_cache_stats(engine) -> dict:
+    """Exact KV-cache byte accounting from the engine's live cache
+    leaves. ``bytes`` is the global logical allocation; on a sharded
+    cache ``addressable_bytes`` is this host's share and ``shards`` the
+    device count one layer's k tensor spans."""
+    caches = getattr(engine, "_caches", None)
+    if not caches:
+        return {"bytes": 0, "layers": 0}
+    leaves = _leaf_arrays(caches)
+    total = sum(int(x.size) * int(x.dtype.itemsize) for x in leaves)
+    addressable = 0
+    shards = 1
+    for x in leaves:
+        try:
+            sh = x.addressable_shards
+            addressable += sum(int(s.data.size) * int(x.dtype.itemsize)
+                               for s in sh)
+            shards = max(shards, len(x.sharding.device_set))
+        except Exception:   # noqa: BLE001 — plain arrays: fully local
+            addressable += int(x.size) * int(x.dtype.itemsize)
+    first = leaves[0]
+    out = {
+        "bytes": total,
+        "addressable_bytes": addressable,
+        "shards": shards,
+        "layers": len(caches),
+        "slot_shape": list(first.shape),          # [S, H, T_max, Dh]
+        "dtype": str(first.dtype),
+        "bytes_per_slot": total // max(1, int(first.shape[0])),
+    }
+    mesh = getattr(engine, "mesh", None)
+    if mesh is not None:
+        from ..parallel.mesh import mesh_tag
+        out["mesh"] = mesh_tag(mesh)
+    return out
+
+
+def impl_cost_analysis(decoder, refresh: bool = False) -> Dict[str, dict]:
+    """flops / bytes-accessed per compiled impl, from XLA cost analysis
+    over each impl's first-dispatch signature (the decoder's
+    ``_cost_seam``). Memoized on the seam: the lowering (one logged
+    compile record per impl, cached by jax afterwards) happens at most
+    once per impl per process — run this after warmup, outside any
+    steady-state compile-audit window."""
+    seam = getattr(decoder, "_cost_seam", None)
+    if not seam:
+        return {}
+    out: Dict[str, dict] = {}
+    for name, entry in sorted(seam.items()):
+        jitted, specs, cost = entry
+        if specs is None:
+            continue                      # never dispatched: nothing real
+        if cost is None or refresh:
+            cost = _cost_from_specs(jitted, specs)
+            entry[2] = cost
+        out[name] = cost
+    return out
+
+
+def _cost_from_specs(jitted, specs) -> dict:
+    try:
+        lowered = jitted.lower(*specs)
+    except Exception as e:   # noqa: BLE001 — cost is best-effort telemetry
+        return {"error": f"lower: {type(e).__name__}: {e}"[:200]}
+    ca = None
+    try:
+        ca = lowered.compile().cost_analysis()
+    except Exception:   # noqa: BLE001 — fall back to the pre-compile view
+        try:
+            ca = lowered.cost_analysis()
+        except Exception as e:   # noqa: BLE001
+            return {"error": f"cost_analysis: {type(e).__name__}"[:200]}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return {"error": "cost_analysis unavailable on this backend"}
+    out = {}
+    for key, label in (("flops", "flops"),
+                       ("bytes accessed", "bytes_accessed"),
+                       ("transcendentals", "transcendentals")):
+        v = ca.get(key)
+        if v is not None:
+            out[label] = int(v)
+    return out
+
+
+class DeviceStats:
+    """Aggregating view: engines attach once; ``snapshot()`` assembles
+    device memory + per-engine KV bytes + per-impl cost on demand.
+
+    Registry integration: ``devstats_live_array_bytes`` /
+    ``devstats_live_arrays`` gauges (collection-time callbacks) and a
+    ``devstats_kv_cache_bytes{engine=...}`` gauge per attached engine —
+    all weakref'd, so a retired engine reads 0 instead of being pinned
+    (with its device caches) by the registry."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._registry = registry if registry is not None \
+            else default_registry()
+        self._lock = threading.Lock()
+        self._engines: Dict[str, weakref.ref] = {}
+        reg = self._registry
+        self._g_kv = reg.gauge("devstats_kv_cache_bytes",
+                               "KV-cache bytes allocated (global)",
+                               ("engine",))
+        reg.gauge("devstats_live_arrays",
+                  "jax.live_arrays() count").set_function(
+            _live_count)
+        reg.gauge("devstats_live_array_bytes",
+                  "jax.live_arrays() total bytes").set_function(
+            _live_bytes)
+
+    def attach_engine(self, name: str, engine) -> "DeviceStats":
+        wref = weakref.ref(engine)
+        with self._lock:
+            self._engines[str(name)] = wref
+        self._g_kv.labels(str(name)).set_function(
+            lambda: (lambda e: 0 if e is None else
+                     kv_cache_stats(e).get("bytes", 0))(wref()))
+        return self
+
+    def snapshot(self) -> dict:
+        out = device_memory_snapshot()
+        kv = {}
+        costs = {}
+        with self._lock:
+            engines = dict(self._engines)
+        for name, wref in sorted(engines.items()):
+            eng = wref()
+            if eng is None:
+                continue
+            try:
+                kv[name] = kv_cache_stats(eng)
+            except Exception as e:   # noqa: BLE001 — degrade per engine
+                kv[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            dec = getattr(eng, "decoder", None)
+            if dec is not None:
+                try:
+                    costs.update(impl_cost_analysis(dec))
+                except Exception as e:   # noqa: BLE001
+                    costs[name] = {"error":
+                                   f"{type(e).__name__}: {e}"[:200]}
+        out["kv_cache"] = kv
+        out["impl_cost"] = costs
+        return out
+
+
+def _live_count() -> int:
+    import jax
+    try:
+        return len(jax.live_arrays())
+    except Exception:   # noqa: BLE001
+        return 0
+
+
+def _live_bytes() -> int:
+    import jax
+    try:
+        return int(sum(int(a.nbytes) for a in jax.live_arrays()))
+    except Exception:   # noqa: BLE001
+        return 0

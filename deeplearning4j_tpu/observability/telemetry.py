@@ -10,10 +10,17 @@ serving process:
   snapshot, per-tag device→host readback DELTAS since server start (the
   TransferAudit view over ``ops.transfer.device_fetch``), the
   CompileAudit report (per-function XLA compiles + delta since start,
-  when ``audit_compiles=True``), and every registered source
-  (engine/supervisor ``stats()`` dicts, broker counters, ...);
+  when ``audit_compiles=True``), the device-cost stats (device memory,
+  per-engine KV-cache bytes, per-impl XLA cost analysis — next to the
+  compile audit), the flight-recorder summary, the SLO summary, and
+  every registered source (engine/supervisor ``stats()`` dicts, broker
+  counters, ...);
+- ``GET /slo``            — the SLO tracker's full document: rolling
+  short/long-window attainment + burn rate, deadline-headroom /
+  TTFT / queue-wait quantiles, per-route and per-replica splits;
 - ``GET /traces/recent``  — the completed-trace ring as JSON timelines
-  (``?n=`` limits the count);
+  (``?n=`` limits the count, ``?status=`` filters — ``failed`` matches
+  every ``failed:*`` status, any exact status works);
 - ``GET /healthz``        — liveness probe.
 
 Reading is free for the serving hot path: every endpoint renders from
@@ -29,12 +36,16 @@ dying engine must degrade the snapshot, not the endpoint.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..ui.server import BackgroundHTTPServer, JsonHTTPHandler
+from .devstats import DeviceStats, impl_cost_analysis
+from .flightrec import FlightRecorder, default_flight_recorder
 from .metrics import MetricsRegistry, default_registry
+from .slo import SLOTracker, default_slo_tracker
 from .tracing import TraceRing, default_trace_ring
 
 
@@ -56,13 +67,26 @@ class _TelemetryHandler(JsonHTTPHandler):
                        "text/plain; version=0.0.4")
         elif url.path == "/snapshot":
             self._json(srv.snapshot())
+        elif url.path == "/slo":
+            self._json(srv.slo_tracker.snapshot())
         elif url.path == "/traces/recent":
             q = parse_qs(url.query)
             try:
                 n = int(q.get("n", ["0"])[0]) or None
             except ValueError:
                 n = None
-            traces = srv.trace_store.recent(n)
+            status = (q.get("status", [None])[0] or None)
+            if status is None:
+                traces = srv.trace_store.recent(n)
+            else:
+                # filter BEFORE the count cut, so ?n=5&status=failed is
+                # "the last 5 failures", not "failures among the last 5";
+                # bare "failed" covers every failed:<ExcType> status
+                traces = [t for t in srv.trace_store.recent(None)
+                          if t.status == status or
+                          (t.status or "").startswith(status + ":")]
+                if n is not None:
+                    traces = traces[-n:]
             self._json({"count": len(traces),
                         "total_completed": srv.trace_store.total_added,
                         "traces": [t.to_dict() for t in traces]})
@@ -70,8 +94,8 @@ class _TelemetryHandler(JsonHTTPHandler):
             self._json({"ok": True, "uptime_s": round(srv.uptime, 3)})
         else:
             self._json({"error": "not found", "endpoints": [
-                "/metrics", "/snapshot", "/traces/recent", "/healthz"]},
-                code=404)
+                "/metrics", "/snapshot", "/slo", "/traces/recent",
+                "/healthz"]}, code=404)
 
 
 class TelemetryServer:
@@ -86,7 +110,10 @@ class TelemetryServer:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  trace_store: Optional[TraceRing] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 audit_compiles: bool = False):
+                 audit_compiles: bool = False,
+                 slo_tracker: Optional[SLOTracker] = None,
+                 devstats: Optional[DeviceStats] = None,
+                 flight_recorder: Optional[FlightRecorder] = None):
         # loopback by default: the endpoint is unauthenticated and
         # /snapshot+/traces expose serving internals — exposing it
         # beyond the host is an explicit host="0.0.0.0" decision
@@ -94,6 +121,12 @@ class TelemetryServer:
             else default_registry()
         self.trace_store = trace_store if trace_store is not None \
             else default_trace_ring()
+        self.slo_tracker = slo_tracker if slo_tracker is not None \
+            else default_slo_tracker()
+        self.devstats = devstats if devstats is not None \
+            else DeviceStats(registry=self.registry)
+        self.flight_recorder = flight_recorder \
+            if flight_recorder is not None else default_flight_recorder()
         self._http = BackgroundHTTPServer(None, host=host, port=port)
         self._sources: Dict[str, Callable[[], dict]] = {}
         self._audit = None
@@ -109,6 +142,26 @@ class TelemetryServer:
         a broker's counters, an injector's ``counters`` — any zero-arg
         callable returning JSON-serializable data)."""
         self._sources[str(name)] = fn
+        return self
+
+    def add_engine(self, name: str, engine) -> "TelemetryServer":
+        """One-call engine wiring: ``stats()`` as a snapshot source plus
+        device-stats attachment (KV-cache bytes gauge, per-impl cost in
+        ``/snapshot``). Per-impl cost extraction lowers each impl once
+        (sub-second when XLA's caches hit, but seconds cold on an
+        accelerator) — warm it here, off the HTTP thread, so the first
+        scrape reads memoized numbers instead of paying the lowering."""
+        self.add_source(name, engine.stats)
+        self.devstats.attach_engine(name, engine)
+        dec = getattr(engine, "decoder", None)
+        if dec is not None:
+            def _warm():
+                try:
+                    impl_cost_analysis(dec)
+                except Exception:   # noqa: BLE001 — best-effort warmup;
+                    pass            # /snapshot degrades per entry anyway
+            threading.Thread(target=_warm, daemon=True,
+                             name=f"telemetry-cost-warm-{name}").start()
         return self
 
     def start(self) -> "TelemetryServer":
@@ -173,6 +226,21 @@ class TelemetryServer:
             rep = self._audit.report()
             rep["new_since_start"] = self._audit.delta(self._audit_snap)
             out["compile_audit"] = rep
+        # device-cost stats live NEXT TO the compile audit: both answer
+        # "what did the device side actually cost", one at compile
+        # granularity, one at memory/flops granularity
+        try:
+            out["devstats"] = self.devstats.snapshot()
+        except Exception as e:   # noqa: BLE001 — degrade, don't 500
+            out["devstats"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        try:
+            out["slo"] = self.slo_tracker.snapshot()
+        except Exception as e:   # noqa: BLE001
+            out["slo"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        try:
+            out["flightrec"] = self.flight_recorder.stats()
+        except Exception as e:   # noqa: BLE001
+            out["flightrec"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         sources = {}
         for name, fn in self._sources.items():
             try:

@@ -126,11 +126,22 @@ class EngineSupervisor(HeartbeatMonitor):
 
     def __init__(self, engine, timeout: float = 10.0,
                  interval: float = 0.25, max_restarts: int = 3,
-                 warmup_grace: float = 300.0, name: str = "slot-engine"):
+                 warmup_grace: float = 300.0, name: str = "slot-engine",
+                 flight_recorder=None, postmortem_dir: str = None):
         super().__init__(timeout=timeout, interval=interval,
                          on_failure=self._on_wedge)
         self._engine = engine
         self._name = name
+        # crash flight recorder (ISSUE 9): takeovers append to the
+        # engine's event ring, and — when a post-mortem directory is
+        # configured — every crash/wedge writes a JSON artifact bundling
+        # the last-N events, the harvested requests' traces, and the
+        # registry snapshot at death. Defaults to the ENGINE's recorder
+        # so engine-side events and supervisor-side takeovers land in
+        # one timeline.
+        self._flightrec = flight_recorder if flight_recorder is not None \
+            else engine._flightrec
+        self._postmortem_dir = postmortem_dir
         # observability (ISSUE 5): takeovers are first-class telemetry —
         # the supervisor publishes restart/recovery counters on the same
         # registry its engine uses, labeled by supervisor name
@@ -238,6 +249,30 @@ class EngineSupervisor(HeartbeatMonitor):
             if k not in ("queue_depth", "active_slots", "mesh_shape"):
                 self._prior_stats[k] = self._prior_stats.get(k, 0) + v
         cause = dead or cause or RuntimeError("engine restarted")
+        self._flightrec.record(
+            "takeover", supervisor=self._name, engine=old.engine_id,
+            cause=f"{type(cause).__name__}: {cause}"[:200],
+            recovered=len(recoverable), restarts=self.restarts + 1)
+        if self._postmortem_dir:
+            # the artifact is the black box a dead 3am replica leaves
+            # behind: written BEFORE the requeue so it captures the
+            # harvested traces exactly as the dying engine left them
+            self._flightrec.write_postmortem(
+                self._postmortem_dir, self._name,
+                reason=f"engine takeover (restart {self.restarts + 1})",
+                cause=cause,
+                traces=[r.trace for r in recoverable
+                        if r.trace is not None],
+                registry=old._registry,
+                extra={"supervisor": self._name,
+                       "engine": old.engine_id,
+                       "recovered_request_ids":
+                           [r.trace.request_id for r in recoverable
+                            if r.trace is not None],
+                       "generated_so_far":
+                           {r.trace.request_id: len(r.generated)
+                            for r in recoverable
+                            if r.trace is not None}})
         if self.restarts >= self.max_restarts:
             self.given_up = cause
             self.deregister(self._name)
@@ -259,8 +294,10 @@ class EngineSupervisor(HeartbeatMonitor):
             max_pending=old.max_pending, fault_injector=old._faults,
             block_size=old.block_size,   # same decode_block{K} program too
             registry=old._registry, trace_store=old._trace_store,
-            tracing=old._tracing)    # same telemetry sinks too: requeued
-        #                              requests CONTINUE their traces
+            tracing=old._tracing,    # same telemetry sinks too: requeued
+            #                          requests CONTINUE their traces
+            slo=old._slo, slo_label=old.slo_label,   # one stable SLO
+            flight_recorder=old._flightrec)          # label per replica
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)

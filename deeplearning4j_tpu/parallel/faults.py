@@ -106,7 +106,7 @@ class FaultInjector:
     (``hits``/``fired``) make schedules auditable after a run.
     """
 
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, flight_recorder=None):
         self._lock = threading.Lock()
         self._plans: Dict[str, List[dict]] = defaultdict(list)
         self._hits: Dict[str, int] = defaultdict(int)
@@ -115,12 +115,18 @@ class FaultInjector:
         # as fault_injections_total{point=...} — a soak's schedule is
         # auditable from the telemetry endpoint, not just the injector.
         # Lazy import: observability must stay importable without us.
+        from ..observability.flightrec import default_flight_recorder
         from ..observability.metrics import default_registry
         reg = registry if registry is not None else default_registry()
         self._m_fired = reg.counter(
             "fault_injections_total",
             "injected faults that actually fired, by injection point",
             ("point",))
+        # ... and land on the flight recorder's timeline (ISSUE 9): a
+        # post-mortem must show the injected fault RIGHT BEFORE the
+        # crash events it caused
+        self._flightrec = flight_recorder if flight_recorder is not None \
+            else default_flight_recorder()
 
     # ------------------------------------------------------------- arming
     def raise_once(self, point: str, exc, at: int = 1) -> "FaultInjector":
@@ -188,6 +194,10 @@ class FaultInjector:
                     raise_exc = plan["exc"]
         if fired:
             self._m_fired.labels(point).inc(fired)
+            self._flightrec.record("fault", point=point, hit=hit,
+                                   mode="drop" if drop else
+                                   ("raise" if raise_exc is not None
+                                    else "hang"))
         if hang_s > 0.0:
             time.sleep(hang_s)          # outside the lock: a hung point
         if raise_exc is not None:       # must not block arming/counters

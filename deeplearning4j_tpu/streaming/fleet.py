@@ -67,7 +67,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability.flightrec import default_flight_recorder
 from ..observability.metrics import default_registry
+from ..observability.slo import default_slo_tracker
 from ..observability.tracing import default_trace_ring
 from ..parallel.faults import NULL_INJECTOR, RejectedError
 
@@ -461,6 +463,7 @@ class FleetRequest:
         self._deadline_t = None if deadline is None \
             else time.monotonic() + float(deadline)
         self.sticky_key = sticky_key
+        self._created_t = time.monotonic()   # original submission clock
         self.migrations = 0
         self.replica_id: Optional[str] = None
         self._inner = None
@@ -565,7 +568,9 @@ class EngineFleetRouter:
                  recover_beats: int = 3,
                  sticky_prefix: Optional[int] = None,
                  completed_window: int = 4096,
-                 registry=None, trace_store=None, tracing: bool = True):
+                 registry=None, trace_store=None, tracing: bool = True,
+                 slo_tracker=None, flight_recorder=None,
+                 postmortem_dir: Optional[str] = None):
         self.fleet_id = fleet_id if fleet_id is not None \
             else f"fleet{next(_FLEET_SEQ)}"
         self._registry = registry if registry is not None \
@@ -573,6 +578,16 @@ class EngineFleetRouter:
         self._trace_store = trace_store if trace_store is not None \
             else default_trace_ring()
         self._tracing = bool(tracing)
+        # SLO + flight-recorder sinks (ISSUE 9): one shared tracker with
+        # per-replica labels (fleet_stats() reads attainment per replica
+        # from it — routing data and SLO data in ONE document), one
+        # shared event ring, and — with a post-mortem dir — a JSON
+        # artifact per replica death bundling the victims' traces
+        self._slo_tracker = slo_tracker if slo_tracker is not None \
+            else default_slo_tracker()
+        self._flightrec = flight_recorder if flight_recorder is not None \
+            else default_flight_recorder()
+        self._postmortem_dir = postmortem_dir
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
         self._membership = membership if membership is not None \
@@ -604,13 +619,16 @@ class EngineFleetRouter:
                     decoder=decoder, max_pending=max_pending,
                     fault_injector=inj, block_size=block_size,
                     registry=self._registry,
-                    trace_store=self._trace_store, tracing=self._tracing)
+                    trace_store=self._trace_store, tracing=self._tracing,
+                    slo=self._slo_tracker, slo_label=f"r{i}",
+                    flight_recorder=self._flightrec)
                 if supervised:
                     from ..parallel.failures import EngineSupervisor
                     eng = EngineSupervisor(
                         eng, timeout=supervisor_timeout,
                         max_restarts=max_restarts,
-                        name=f"{self.fleet_id}:r{i}")
+                        name=f"{self.fleet_id}:r{i}",
+                        postmortem_dir=postmortem_dir)
                 engines.append(eng)
         self._replicas: Dict[str, EngineReplica] = {}
         for i, eng in enumerate(engines):
@@ -675,8 +693,8 @@ class EngineFleetRouter:
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                deadline: Optional[float] = None, *,
-               sticky_key=None, replica_id: Optional[str] = None
-               ) -> FleetRequest:
+               sticky_key=None, replica_id: Optional[str] = None,
+               route: Optional[str] = None) -> FleetRequest:
         """Dispatch to the best replica; returns a :class:`FleetRequest`
         (already failed with :class:`RejectedError` when the whole fleet
         is saturated — mirror of the engine's shed contract, so the
@@ -714,9 +732,15 @@ class EngineFleetRouter:
             except Exception:   # noqa: BLE001 — injected transport error
                 self._m["dispatch_errors"].inc()
                 continue
+            # _slo_sync_fail=False: a spilled-past synchronous fast-fail
+            # (queue-full race, dead engine) must not SLO-account a
+            # request the fleet goes on to serve elsewhere — sync
+            # outcomes the fleet DOES propagate are accounted by the
+            # completion gate (_on_inner_done) instead
             inner = rep.submit(fr.prompt, fr.max_new_tokens,
                                temperature=fr.temperature,
-                               eos_id=fr.eos_id, deadline=fr.deadline)
+                               eos_id=fr.eos_id, deadline=fr.deadline,
+                               route=route, _slo_sync_fail=False)
             err = inner._error if inner.done() else None
             if isinstance(err, RejectedError):
                 total_depth += rep.capacity   # raced to saturation
@@ -733,6 +757,15 @@ class EngineFleetRouter:
             return fr
         # every replica saturated, dead, or unreadable: router-level shed
         self._m["shed"].inc()
+        self._flightrec.record("shed", fleet=self.fleet_id,
+                               queue_depth=total_depth)
+        # a router-shed request was never accepted by an engine (inner
+        # sync-fails run unarmed, _slo_sync_fail=False, so the spilled
+        # handles recorded nothing) — the fleet records the ONE miss
+        self._slo_tracker.record(
+            "shed", latency=time.monotonic() - fr._created_t,
+            headroom=None if fr._deadline_t is None
+            else fr._deadline_t - time.monotonic(), route=route)
         fr._fail(RejectedError(
             f"fleet {self.fleet_id}: all {len(self._replicas)} replicas "
             f"saturated or dead — request shed",
@@ -872,6 +905,15 @@ class EngineFleetRouter:
                 fr._fail(err)
             else:
                 fr._complete(inner._result)
+        if not inner._slo_done:
+            # the inner settled synchronously before its tracker was
+            # armed (_slo_sync_fail=False: validation error, instant
+            # zero-token complete) and the fleet is propagating that
+            # outcome — account it exactly once here
+            from ..models.generation import GenerationRequest
+            inner._slo = self._slo_tracker
+            inner._notify_slo("ok" if err is None
+                              else GenerationRequest._slo_status(err))
         with self._lock:
             self._live.pop(fr.request_id, None)
 
@@ -935,15 +977,37 @@ class EngineFleetRouter:
                     self._death_cause[rid] = cause
                 except Exception:   # noqa: BLE001 — treat as unreachable
                     rep.reachable = False
+            self._flightrec.record(
+                "replica_dead", fleet=self.fleet_id, replica=rid,
+                reachable=rep.reachable,
+                cause=f"{type(cause).__name__}: {cause}"[:200])
             with self._lock:
                 victims = [fr for fr in self._live.values()
                            if fr.replica_id == rid and not fr.done()]
+            if self._postmortem_dir:
+                # artifact BEFORE re-dispatch: it must capture the
+                # victims' traces as the dead replica left them, and the
+                # fleet request ids migration is about to move
+                self._flightrec.write_postmortem(
+                    self._postmortem_dir, f"{self.fleet_id}-{rid}",
+                    reason=f"replica {rid} dead "
+                           f"({'reachable' if rep.reachable else 'partitioned'})",
+                    cause=cause,
+                    traces=[fr.trace for fr in victims
+                            if fr.trace is not None],
+                    registry=self._registry,
+                    extra={"fleet": self.fleet_id, "replica": rid,
+                           "reachable": rep.reachable,
+                           "fleet_request_ids":
+                               [fr.request_id for fr in victims]})
             moved = 0
             for fr in victims:
                 if self._redispatch(fr, rep, cause):
                     moved += 1
             if moved:
                 self._m["migrations"].inc(moved)
+                self._flightrec.record("migration", fleet=self.fleet_id,
+                                       src=rid, moved=moved)
 
     def _redispatch(self, fr: FleetRequest, src: EngineReplica,
                     cause: BaseException) -> bool:
@@ -1009,14 +1073,35 @@ class EngineFleetRouter:
         clone.deadline = fr.deadline
         clone._deadline_t = fr._deadline_t      # original ABSOLUTE deadline
         clone._cancel_requested = fr._cancel_requested
+        # SLO clock continuity: the clone inherits the ORIGINAL
+        # created/admitted/first-token stamps, so headroom and TTFT are
+        # measured from the real submission — migration resets nothing
+        clone._created_t = fr._created_t
         if old_inner is not None:
             clone.generated = list(old_inner.generated)
             clone.trace = old_inner.trace
+            clone._created_t = getattr(old_inner, "_created_t",
+                                       fr._created_t)
+            clone._admitted_t = getattr(old_inner, "_admitted_t", None)
+            clone._first_token_t = getattr(old_inner, "_first_token_t",
+                                           None)
+            clone._slo_labels = dict(getattr(old_inner, "_slo_labels",
+                                             None) or {})
             # the zombie must not keep spanning the timeline its
             # replacement now owns (if it already finish()ed the shared
             # trace first-wins, the object still accumulates the clone's
             # spans — one ring entry, early status: rare-race tradeoff)
             old_inner.trace = None
+            # ... and its late failure must not SLO-account the request
+            # the clone now owns (requeue re-arms the clone's tracker).
+            # Cleared under the zombie's _cb_lock — _notify_slo consumes
+            # under the same lock, so a completion racing this clear
+            # either records BEFORE the clone exists or never records.
+            # If it DID record first, the clone inherits _slo_done and
+            # requeue skips re-arming: one record per request, always.
+            with old_inner._cb_lock:
+                old_inner._slo = None
+            clone._slo_done = old_inner._slo_done
         return clone
 
     # --------------------------------------------------------- monitoring
@@ -1148,7 +1233,10 @@ class EngineFleetRouter:
     def fleet_stats(self) -> dict:
         """The router's replica table + ledger summary — the
         ``/snapshot`` source ``scripts/telemetry_dump.py --fleet``
-        pretty-prints."""
+        pretty-prints. Each replica row carries its SLO account
+        (rolling-window attainment, headroom/TTFT quantiles) from the
+        shared tracker, so least-loaded routing data and SLO data live
+        in ONE document (ISSUE 9)."""
         ages = self._membership.ages()
         with self._lock:
             health = {rid: dict(h) for rid, h in self._health.items()}
@@ -1170,10 +1258,32 @@ class EngineFleetRouter:
                 row["active_slots"] = s.get("active_slots")
             except Exception:   # noqa: BLE001
                 pass
+            try:
+                inner = rep.engine.engine if rep.supervised \
+                    else rep.engine
+                label = getattr(inner, "slo_label", rid)
+                agg = self._slo_tracker.label_snapshot(
+                    "replica", label, window=self._slo_tracker.long_window)
+                row["slo"] = {
+                    "attainment": agg["attainment"], "n": agg["n"],
+                    "headroom_p50_s": agg["headroom_s"]["p50"],
+                    "headroom_min_s": agg["headroom_s"]["min"],
+                    "ttft_p99_s": agg["ttft_s"]["p99"]}
+            except Exception:   # noqa: BLE001 — a dead replica degrades
+                row["slo"] = None             # its row, not the table
             table[rid] = row
         return {"fleet": self.fleet_id,
                 "replicas": table,
                 "ledger": self._ledger.to_dict(),
+                "slo": {"attainment_short":
+                        round(self._slo_tracker.attainment(
+                            self._slo_tracker.short_window), 6),
+                        "attainment_long":
+                        round(self._slo_tracker.attainment(
+                            self._slo_tracker.long_window), 6),
+                        "burn_rate_short":
+                        round(self._slo_tracker.burn_rate(
+                            self._slo_tracker.short_window), 6)},
                 "counters": {key: int(self._m[key].value)
                              for key in _FLEET_COUNTERS}}
 

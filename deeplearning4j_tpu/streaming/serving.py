@@ -322,10 +322,14 @@ class GenerationServingRoute(_RoutePublishMixin):
             t_c0 = time.monotonic()
             try:
                 prompt = np.asarray(arr).astype(np.int64).reshape(-1)
+                # route= labels the request's SLO record (attainment per
+                # route in /slo); engine, supervisor, and fleet router
+                # all accept it through the same submit surface
                 req = self.engine.submit(prompt, self.max_new_tokens,
                                          temperature=self.temperature,
                                          eos_id=self.eos_id,
-                                         deadline=self.deadline)
+                                         deadline=self.deadline,
+                                         route=self.route_id)
                 # the engine opened the request's trace at submit; the
                 # consume span closes over the route-side intake work
                 # (message arrival → request queued)

@@ -308,7 +308,7 @@ class TcpMessageBroker(MessageBroker):
                  reconnect: bool = True, max_reconnect_attempts: int = 20,
                  backoff_base: float = 0.05, backoff_cap: float = 2.0,
                  publish_max_retries: int = 8, fault_injector=None,
-                 registry=None):
+                 registry=None, flight_recorder=None):
         super().__init__(capacity)
         self.host, self.port = host, int(port)
         self.reconnect = bool(reconnect)
@@ -318,6 +318,11 @@ class TcpMessageBroker(MessageBroker):
         self.publish_max_retries = int(publish_max_retries)
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
+        # reconnect breadcrumbs land on the flight recorder (ISSUE 9) —
+        # injectable like every other sink, so a round-private recorder
+        # sees broker flaps on the same timeline as the crash they often
+        # precede; lazily defaulted so construction stays import-light
+        self._flightrec = flight_recorder
         self._sock = socket.create_connection((host, port), timeout=10)
         self._sock.settimeout(None)
         self._send_lock = threading.Lock()
@@ -489,6 +494,14 @@ class TcpMessageBroker(MessageBroker):
                 delay *= 2
                 continue
             self._m_reconnects.inc()
+            # flight-recorder breadcrumb (ISSUE 9): broker flaps right
+            # before a crash are exactly what a post-mortem needs to see
+            fr = self._flightrec
+            if fr is None:
+                from ..observability.flightrec import \
+                    default_flight_recorder
+                fr = default_flight_recorder()
+            fr.record("reconnect", host=self.host, port=self.port)
             self._conn_ok.set()
             return True
         return False

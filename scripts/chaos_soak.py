@@ -49,6 +49,15 @@ asserts the invariants the resilience + telemetry layers promise:
    surviving replica, and (unless ``--no-fleet-scale``) near-linear
    1 → N aggregate decode tok/s on a compute-bound shape;
 
+8. with ``--postmortem-dir DIR`` (ISSUE 9): every injected crash /
+   replica kill must leave a flight-recorder post-mortem artifact in
+   DIR whose embedded traces are id-matched to the requests the
+   recovery path harvested (supervisor takeovers: trace ids ==
+   ``recovered_request_ids``; fleet deaths: every migrated request
+   appears in some artifact's ``fleet_request_ids``) and whose event
+   timeline shows the injected fault that caused the death — the
+   verification table is archived in ``--json`` output;
+
 plus the correctness bar: every COMPLETED request's tokens equal the
 uninterrupted clean-engine run, token for token (greedy). The summary
 also reports per-request latency p50/p99 (through the shared
@@ -86,7 +95,8 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
              vocab: int = 12, supervisor_timeout: float = 2.0,
              hang_seconds: float = None, wait_s: float = 180.0,
              steady_wave: int = 4, overhead_ab: bool = True,
-             lock_audit: bool = False, mesh_shape: str = None) -> dict:
+             lock_audit: bool = False, mesh_shape: str = None,
+             postmortem_dir: str = None) -> dict:
     """One soak iteration; returns a summary dict (see keys below).
 
     Prompt lengths and generation budgets are drawn so every prefill —
@@ -159,7 +169,13 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
         # Total clean steps ~= sum(gens)/num_slots; crashes land in the
         # first half so they actually fire, the wedge right after.
         est_steps = max(4, sum(gens) // max(1, num_slots))
-        inj = FaultInjector()
+        # --postmortem-dir (ISSUE 9): one PRIVATE flight recorder per
+        # round, shared by the injector, the engine, and the supervisor,
+        # so each round's artifacts (and the fault events they embed)
+        # are attributable to THIS round's schedule
+        from deeplearning4j_tpu.observability.flightrec import FlightRecorder
+        flightrec = FlightRecorder() if postmortem_dir else None
+        inj = FaultInjector(flight_recorder=flightrec)
         crash_hits = sorted(
             {int(h) for h in rng.integers(2, max(3, est_steps), crashes)})
         for h in crash_hits:
@@ -176,10 +192,12 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
 
         # --- chaos run under supervision
         eng = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec,
-                                   fault_injector=inj)
+                                   fault_injector=inj,
+                                   flight_recorder=flightrec)
         sup = EngineSupervisor(eng, timeout=supervisor_timeout,
                                interval=0.1,
-                               max_restarts=crashes + hangs + 2).start()
+                               max_restarts=crashes + hangs + 2,
+                               postmortem_dir=postmortem_dir).start()
         reqs = [sup.submit(p, g) for p, g in zip(prompts, gens)]
         deadline = time.monotonic() + wait_s
         for r in reqs:
@@ -268,7 +286,67 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
         summary.update(ab)
     if la is not None:
         summary["lock_audit"] = _lock_audit_summary(la)
+    if postmortem_dir:
+        # flight-recorder acceptance (ISSUE 9): every takeover left a
+        # post-mortem artifact whose embedded traces ARE the recovered
+        # requests' timelines (id-matched), with the injected fault on
+        # the event timeline right before the takeover it caused
+        known_ids = {r.trace.request_id
+                     for r in list(reqs) + list(wave) + list(clean_reqs)
+                     if r.trace is not None}
+        summary["postmortems"], summary["postmortem_ok"] = \
+            _verify_postmortems(flightrec.dumps, known_ids,
+                                expected=stats["restarts"],
+                                id_key="recovered_request_ids")
     return summary
+
+
+def _verify_postmortems(paths, known_trace_ids, expected: int,
+                        id_key: str, known_harvest_ids=None,
+                        exact: bool = True) -> tuple:
+    """Load each artifact and cross-check it against the run: the
+    embedded traces' request ids must match the ids the recovery path
+    said it harvested (``extra[id_key]``) and belong to requests this
+    round actually served; the event timeline must show the injected
+    fault and the death/takeover that followed. ``exact=True``
+    (supervisor artifacts) demands trace ids == harvested ids — both
+    name engine traces; fleet artifacts carry fleet ids in ``extra``
+    (``known_harvest_ids``) next to the engine-trace ids. Returns
+    (archive, ok) — the archive rides ``--json`` so a failed soak
+    carries its own post-mortems."""
+    archive = []
+    # exactly as many artifacts as deaths: a clean round (zero injected
+    # crashes/kills, expected == 0) must pass with an empty directory
+    ok = len(paths) >= expected
+    if known_harvest_ids is None:
+        known_harvest_ids = known_trace_ids
+    for path in paths:
+        row = {"path": path}
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            kinds = {e.get("kind") for e in doc.get("events", ())}
+            trace_ids = set(doc.get("request_ids", ()))
+            harvested = set((doc.get("extra") or {}).get(id_key, ()))
+            row.update({
+                "reason": doc.get("reason"),
+                "events": len(doc.get("events", ())),
+                "request_ids": sorted(trace_ids),
+                "harvested": sorted(harvested),
+                "fault_on_timeline": "fault" in kinds,
+                "trace_match":
+                    (trace_ids == harvested or not exact)
+                    and trace_ids <= known_trace_ids
+                    and harvested <= set(known_harvest_ids),
+            })
+            row["ok"] = bool(row["trace_match"] and
+                             row["fault_on_timeline"] and
+                             doc.get("metrics") is not None)
+        except (OSError, ValueError) as e:
+            row.update({"ok": False, "error": f"{type(e).__name__}: {e}"})
+        ok = ok and row["ok"]
+        archive.append(row)
+    return archive, ok
 
 
 def _lock_audit_summary(la) -> dict:
@@ -299,7 +377,8 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
                    max_new: int = 6, vocab: int = 12,
                    wait_s: float = 120.0, steady_wave: int = 2,
                    fleet_scale: bool = True,
-                   lock_audit: bool = False) -> dict:
+                   lock_audit: bool = False,
+                   postmortem_dir: str = None) -> dict:
     """One fleet soak round (``--replicas N``): N replicas behind an
     ``EngineFleetRouter`` under load, one hard-crashed mid-stream and
     (N ≥ 3) one zombied, with the exactly-once / token-parity /
@@ -353,7 +432,14 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
         # then its late completions must be fenced, never served.
         per_rep = max(1, (sum(gens) // max(1, num_slots)) // replicas)
         crash_hit = int(rng.integers(2, max(3, per_rep)))
-        injs = [FaultInjector() for _ in range(replicas)]
+        # --postmortem-dir (ISSUE 9): one round-private recorder shared
+        # by every injector and the router, so each replica-death
+        # artifact's event timeline shows the injected fault that
+        # killed it
+        from deeplearning4j_tpu.observability.flightrec import FlightRecorder
+        flightrec = FlightRecorder() if postmortem_dir else None
+        injs = [FaultInjector(flight_recorder=flightrec)
+                for _ in range(replicas)]
         injs[0].raise_once(
             "engine.step",
             RuntimeError(f"fleet soak: r0 crash at step hit {crash_hit}"),
@@ -370,7 +456,8 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
             net, num_replicas=replicas, decoder=dec, num_slots=num_slots,
             replica_injectors=injs, heartbeat_interval=0.03,
             monitor_interval=0.03, suspect_after=0.15, dead_after=0.4,
-            recover_beats=3).start()
+            recover_beats=3, flight_recorder=flightrec,
+            postmortem_dir=postmortem_dir).start()
         frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
         deadline = time.monotonic() + wait_s
         for fr in frs:
@@ -433,6 +520,27 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
         "fleet": fleet_table,
         "metrics": default_registry().snapshot(),
     })
+    if postmortem_dir:
+        # one artifact per replica kill, trace-id-matched to the round:
+        # every migrated request must appear in some artifact's harvest
+        # list (the artifact is written BEFORE its re-dispatch)
+        known_traces = {fr.trace.request_id
+                        for fr in list(frs) + list(wave) + list(clean_reqs)
+                        if fr.trace is not None}
+        fleet_ids = {fr.request_id for fr in list(frs) + list(wave)}
+        archive, pm_ok = _verify_postmortems(
+            flightrec.dumps, known_traces,
+            expected=len(summary["dead"]),
+            id_key="fleet_request_ids", known_harvest_ids=fleet_ids,
+            exact=False)
+        harvested_union = set()
+        for row in archive:
+            harvested_union |= set(row.get("harvested", ()))
+        migrated_ids = {fr.request_id for fr in frs if fr.migrations > 0}
+        summary["postmortems"] = archive
+        summary["postmortem_ok"] = bool(
+            pm_ok and len(flightrec.dumps) >= len(summary["dead"]) and
+            migrated_ids <= harvested_union)
     if fleet_scale:
         summary["fleet_scale"] = _fleet_scale_ab(replicas)
     if la is not None:
@@ -568,6 +676,13 @@ def main(argv=None) -> int:
                          "against graftlint's static lock-order graph, "
                          "and fail on any cycle or unexplained "
                          "inversion")
+    ap.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                    help="write a flight-recorder post-mortem artifact "
+                         "per injected crash / replica kill into DIR, "
+                         "assert one exists for every death with its "
+                         "embedded traces id-matched to the recovered "
+                         "requests, and archive the verification table "
+                         "in --json output")
     ap.add_argument("--strict-overhead", action="store_true",
                     help="fail the round if telemetry overhead exceeds "
                          "5%% (advisory by default: the tiny-model soak "
@@ -609,16 +724,19 @@ def main(argv=None) -> int:
                                n_requests=args.requests,
                                num_slots=args.slots, max_new=args.max_new,
                                fleet_scale=not args.no_fleet_scale,
-                               lock_audit=args.lock_audit)
+                               lock_audit=args.lock_audit,
+                               postmortem_dir=args.postmortem_dir)
             scale = s.get("fleet_scale") or {}
             # near-linear bar: >= 0.8x per replica (2.4x at N=3)
             scale_bad = bool(scale) and \
                 (scale["speedup"] or 0.0) < 0.8 * args.replicas
             lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
                             s.get("lock_audit", {}).get("cycles"))
+            pm_bad = args.postmortem_dir and not s.get("postmortem_ok")
             bad = s["stranded"] or s["mismatches"] or s["failed"] or \
                 s["steady_new_compiles"] or s["migrations"] == 0 or \
-                not s["ledger_consistent"] or scale_bad or lock_bad
+                not s["ledger_consistent"] or scale_bad or lock_bad or \
+                pm_bad
             ok = ok and not bad
             if args.json:
                 print(json.dumps(s, default=str))
@@ -633,6 +751,9 @@ def main(argv=None) -> int:
                     lk = (f" locks={d['dynamic_edges']}edges/"
                           f"{len(d['inversions'])}inversions")
                 led = s["ledger"]
+                pm = "" if "postmortem_ok" not in s else \
+                    (f" postmortems={len(s['postmortems'])}"
+                     f"{'' if s['postmortem_ok'] else ' MISMATCH'}")
                 print(f"round {i}: replicas={args.replicas} "
                       f"seed={s['seed']} dead={','.join(s['dead']) or '-'} "
                       f"migrations={s['migrations']} "
@@ -643,7 +764,7 @@ def main(argv=None) -> int:
                       f"fenced={led['fenced']} dup={led['duplicates']}] "
                       f"steady_new_compiles="
                       f"{s['steady_new_compiles'] or '{}'}"
-                      f"{sc}{lk} -> {'FAIL' if bad else 'ok'}")
+                      f"{sc}{lk}{pm} -> {'FAIL' if bad else 'ok'}")
         return 0 if ok else 1
 
     ok = True
@@ -653,14 +774,16 @@ def main(argv=None) -> int:
                      crashes=args.crashes, hangs=args.hangs,
                      supervisor_timeout=args.supervisor_timeout,
                      overhead_ab=not args.no_overhead_ab,
-                     lock_audit=args.lock_audit, mesh_shape=args.mesh)
+                     lock_audit=args.lock_audit, mesh_shape=args.mesh,
+                     postmortem_dir=args.postmortem_dir)
         over_budget = (s.get("telemetry_overhead_pct") or 0.0) > 5.0
         lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
                         s.get("lock_audit", {}).get("cycles"))
+        pm_bad = args.postmortem_dir and not s.get("postmortem_ok")
         bad = s["stranded"] or s["mismatches"] or s["failed"] or \
             s["steady_new_compiles"] or s["trace_problems"] or \
             (s["readbacks_per_block"] or 0.0) > 1.0 or lock_bad or \
-            (args.strict_overhead and over_budget)
+            (args.strict_overhead and over_budget) or pm_bad
         ok = ok and not bad
         if args.json:
             print(json.dumps(s, default=str))
@@ -676,7 +799,10 @@ def main(argv=None) -> int:
                       f"{len(d['novel'])}novel/"
                       f"{len(d['inversions'])}inversions")
             mz = "" if not s.get("mesh") else f" mesh={s['mesh']}"
-            print(f"round {i}:{mz} seed={s['seed']} "
+            pm = "" if "postmortem_ok" not in s else \
+                (f" postmortems={len(s['postmortems'])}"
+                 f"{'' if s['postmortem_ok'] else ' MISMATCH'}")
+            print(f"round {i}:{mz}{pm} seed={s['seed']} "
                   f"restarts={s['restarts']} "
                   f"recovered={s['recovered_requests']} "
                   f"completed={s['completed']}/{s['requests']} "

@@ -3,15 +3,20 @@
 
 Talks to an observability TelemetryServer (``/snapshot`` by default;
 ``--metrics`` for the raw Prometheus text, ``--traces [N]`` for recent
-request timelines, ``--fleet`` for an EngineFleetRouter's replica
-table) over plain HTTP — no in-process imports, so it works against
-any serving process on any host:
+request timelines, ``--slo`` for the SLO tracker document, ``--fleet``
+for an EngineFleetRouter's replica table, ``--scrape`` to merge N
+replicas' snapshots into one fleet summary, ``--watch`` to re-scrape
+periodically and print deltas) over plain HTTP — no in-process
+imports, so it works against any serving process on any host:
 
     python scripts/telemetry_dump.py http://127.0.0.1:9100
     python scripts/telemetry_dump.py http://127.0.0.1:9100 --json
     python scripts/telemetry_dump.py http://host:9100 --traces 5
     python scripts/telemetry_dump.py http://host:9100 --metrics
+    python scripts/telemetry_dump.py http://host:9100 --slo
     python scripts/telemetry_dump.py http://host:9100 --fleet
+    python scripts/telemetry_dump.py --scrape http://h1:9100,http://h2:9100,http://h3:9100
+    python scripts/telemetry_dump.py http://host:9100 --watch 5
 
 ``--fleet`` expects the serving process to have registered the
 router's ``fleet_stats`` as a snapshot source
@@ -19,6 +24,21 @@ router's ``fleet_stats`` as a snapshot source
 pretty-prints every fleet-shaped source it finds — per-replica health
 state, heartbeat age, live load vs capacity, plus the exactly-once
 ledger and fleet counters.
+
+``--scrape URL,URL,...`` (ISSUE 9) is the fleet-wide view for
+SEPARATE-PROCESS replicas, each running its own TelemetryServer: it
+fetches every replica's ``/snapshot`` and merges them into one
+document — aggregate SLO attainment/burn (windows pooled by summing
+met/n across replicas), a per-replica health table (reachability,
+uptime, attainment, deadline-headroom quantiles, KV-cache bytes), and
+fleet-wide summed counters. An unreachable replica degrades to a
+``down`` row; the merge never fails the scrape.
+
+``--watch SECS`` re-samples the target (single URL or ``--scrape``
+set) every SECS seconds and prints DELTAS between samples — counter
+rates (/s), gauge changes, replica up/down transitions and attainment
+moves — the live view for babysitting a soak. ``--count N`` bounds the
+number of samples (default: until interrupted).
 
 The pretty printer groups the nested registry snapshot by family:
 counters/gauges one line per labeled child, histograms as
@@ -31,6 +51,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 import urllib.error
 import urllib.request
 
@@ -152,6 +173,187 @@ def pretty_traces(doc: dict, out=sys.stdout) -> None:
               f"{s['duration_ms']:>9.3f}ms{attrs}\n")
 
 
+def scrape_fleet(urls, timeout: float = 5.0) -> dict:
+    """Fetch every replica's ``/snapshot`` and merge (ISSUE 9): one
+    fleet document with aggregate SLO attainment (windows pooled by
+    summing met/n — exact, unlike averaging ratios), a per-replica
+    health/headroom table, and fleet-wide summed counters. Unreachable
+    replicas degrade to ``up: False`` rows."""
+    per_url = {}
+    for url in urls:
+        base = url.rstrip("/")
+        try:
+            per_url[base] = fetch(f"{base}/snapshot", timeout)
+        except (urllib.error.URLError, OSError, ValueError,
+                TimeoutError) as e:
+            per_url[base] = {"__error__": f"{type(e).__name__}: {e}"}
+    return merge_snapshots(per_url)
+
+
+def _kv_bytes(snap: dict):
+    kv = ((snap.get("devstats") or {}).get("kv_cache") or {})
+    vals = [v.get("bytes") for v in kv.values()
+            if isinstance(v, dict) and isinstance(v.get("bytes"), int)]
+    return sum(vals) if vals else None
+
+
+def merge_snapshots(per_url: dict) -> dict:
+    """Merge N ``/snapshot`` documents (keyed by replica URL) into the
+    fleet summary — pure dict math, reused by the one-shot scrape, the
+    watch loop, and the tests."""
+    replicas = {}
+    win_pool = {"short": {"n": 0, "met": 0}, "long": {"n": 0, "met": 0}}
+    counters: dict = {}
+    requests = missed = 0
+    target = None
+    for base, snap in sorted(per_url.items()):
+        err = snap.get("__error__")
+        if err:
+            replicas[base] = {"up": False, "error": err}
+            continue
+        slo = snap.get("slo") or {}
+        row = {"up": True,
+               "uptime_s": snap.get("uptime_s"),
+               "requests": slo.get("requests"),
+               "missed": slo.get("missed"),
+               "kv_cache_bytes": _kv_bytes(snap)}
+        for win, agg in (slo.get("windows") or {}).items():
+            if win in win_pool:
+                win_pool[win]["n"] += int(agg.get("n") or 0)
+                win_pool[win]["met"] += int(agg.get("met") or 0)
+                row[f"attainment_{win}"] = agg.get("attainment")
+        overall = slo.get("overall") or {}
+        head = overall.get("headroom_s") or {}
+        row["headroom_p50_s"] = head.get("p50")
+        row["headroom_min_s"] = head.get("min")
+        row["ttft_p99_s"] = (overall.get("ttft_s") or {}).get("p99")
+        if target is None and slo.get("target") is not None:
+            target = float(slo["target"])
+        requests += int(slo.get("requests") or 0)
+        missed += int(slo.get("missed") or 0)
+        for fam, doc in (snap.get("metrics") or {}).items():
+            if doc.get("type") != "counter":
+                continue
+            vals = [v for v in (doc.get("values") or {}).values()
+                    if isinstance(v, (int, float))]
+            if vals:
+                counters[fam] = counters.get(fam, 0) + sum(vals)
+        replicas[base] = row
+    target = 0.99 if target is None else target
+    slo_agg = {"target": target, "requests": requests, "missed": missed}
+    for win, pool in win_pool.items():
+        att = 1.0 if not pool["n"] else pool["met"] / pool["n"]
+        slo_agg[f"attainment_{win}"] = round(att, 6)
+        slo_agg[f"burn_rate_{win}"] = round(
+            (1.0 - att) / (1.0 - target), 6)
+    up = [b for b, r in replicas.items() if r.get("up")]
+    return {"replicas": replicas,
+            "up": len(up), "scraped": len(replicas),
+            "slo": slo_agg,
+            "counters": {k: counters[k] for k in sorted(counters)}}
+
+
+def pretty_scrape(doc: dict, out=sys.stdout) -> None:
+    w = out.write
+    w(f"fleet scrape: {doc['up']}/{doc['scraped']} replicas up\n")
+    w(f"  {'replica':<36} {'up':>2} {'uptime':>8} {'att-short':>9} "
+      f"{'att-long':>8} {'reqs':>6} {'miss':>5} {'hd-p50':>8} "
+      f"{'hd-min':>8} {'kv-bytes':>10}\n")
+    fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
+    for base, row in sorted(doc["replicas"].items()):
+        if not row.get("up"):
+            w(f"  {base:<36}  n  DOWN ({row.get('error', '?')})\n")
+            continue
+        w(f"  {base:<36} {'y':>2} {fmt(row.get('uptime_s')):>8} "
+          f"{fmt(row.get('attainment_short')):>9} "
+          f"{fmt(row.get('attainment_long')):>8} "
+          f"{fmt(row.get('requests')):>6} {fmt(row.get('missed')):>5} "
+          f"{fmt(row.get('headroom_p50_s')):>8} "
+          f"{fmt(row.get('headroom_min_s')):>8} "
+          f"{fmt(row.get('kv_cache_bytes')):>10}\n")
+    agg = doc["slo"]
+    w(f"  fleet SLO (target {agg['target']}): "
+      f"attainment short={agg['attainment_short']} "
+      f"long={agg['attainment_long']} "
+      f"burn short={agg['burn_rate_short']} "
+      f"long={agg['burn_rate_long']} "
+      f"requests={agg['requests']} missed={agg['missed']}\n")
+    if doc["counters"]:
+        w("  summed counters:\n")
+        for fam, v in doc["counters"].items():
+            w(f"    {fam:<44} {v}\n")
+
+
+def _flat_sample(snap: dict) -> dict:
+    """One watch sample: monotonically increasing series (counters +
+    histogram counts) and instantaneous series (gauges) flattened to
+    ``name{labels}`` keys."""
+    rates, gauges = {}, {}
+    for fam, doc in (snap.get("metrics") or {}).items():
+        typ = doc.get("type")
+        for label, value in (doc.get("values") or {}).items():
+            key = f"{fam}{{{label}}}" if label else fam
+            if typ == "counter" and isinstance(value, (int, float)):
+                rates[key] = value
+            elif typ == "histogram" and isinstance(value, dict):
+                rates[key + ":count"] = value.get("count") or 0
+            elif typ == "gauge" and isinstance(value, (int, float)):
+                gauges[key] = value
+    return {"rates": rates, "gauges": gauges}
+
+
+def _fleet_sample(doc: dict) -> dict:
+    """Watch sample over a merged scrape: summed counters are the rate
+    series; per-replica attainment/up are the gauge series."""
+    gauges = {}
+    for base, row in doc["replicas"].items():
+        gauges[f"up{{{base}}}"] = 1.0 if row.get("up") else 0.0
+        if row.get("attainment_short") is not None:
+            gauges[f"attainment_short{{{base}}}"] = \
+                row["attainment_short"]
+    gauges["fleet_attainment_short"] = doc["slo"]["attainment_short"]
+    return {"rates": dict(doc["counters"]), "gauges": gauges}
+
+
+def print_deltas(prev: dict, cur: dict, dt: float,
+                 out=sys.stdout) -> None:
+    """Counter rates and gauge changes between two watch samples; flat
+    lines (no per-sample headers) so a terminal tail stays greppable."""
+    w = out.write
+    for key in sorted(cur["rates"]):
+        d = cur["rates"][key] - prev["rates"].get(key, 0)
+        if d:
+            w(f"  {key:<56} +{d:g}  ({d / dt:.2f}/s)\n")
+    for key in sorted(cur["gauges"]):
+        old = prev["gauges"].get(key)
+        new = cur["gauges"][key]
+        if old is None or new != old:
+            w(f"  {key:<56} "
+              f"{'-' if old is None else f'{old:g}'} -> {new:g}\n")
+
+
+def watch(sample_fn, period: float, count=None, out=sys.stdout,
+          clock=time.monotonic, sleep=time.sleep) -> int:
+    """The ``--watch`` loop: sample, sleep, re-sample, print deltas.
+    ``count`` bounds the number of RE-samples (None: until ^C);
+    ``clock``/``sleep`` are injectable for deterministic tests."""
+    prev = sample_fn()
+    prev_t = clock()
+    done = 0
+    try:
+        while count is None or done < count:
+            sleep(period)
+            cur = sample_fn()
+            t = clock()
+            out.write(f"-- watch sample +{t - prev_t:.2f}s --\n")
+            print_deltas(prev, cur, max(t - prev_t, 1e-9), out)
+            prev, prev_t = cur, t
+            done += 1
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("url", nargs="?", default="http://127.0.0.1:9100",
@@ -167,11 +369,55 @@ def main(argv=None) -> int:
                     help="print fleet router replica tables (state, "
                          "heartbeat age, load/capacity, exactly-once "
                          "ledger) from the snapshot's fleet sources")
+    ap.add_argument("--slo", action="store_true",
+                    help="print the /slo document (rolling-window "
+                         "attainment + burn rate, headroom/TTFT/queue "
+                         "quantiles, per-route and per-replica splits)")
+    ap.add_argument("--scrape", default=None, metavar="URL,URL,...",
+                    help="fleet-wide scrape: fetch every listed "
+                         "replica's /snapshot and merge into one "
+                         "summary (aggregate SLO attainment, "
+                         "per-replica health/headroom, summed "
+                         "counters); exit 2 if NO replica answered")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECS",
+                    help="re-sample every SECS seconds and print "
+                         "deltas (counter rates, gauge changes) "
+                         "between samples; combine with --scrape for "
+                         "the fleet-wide live view")
+    ap.add_argument("--count", type=int, default=None, metavar="N",
+                    help="with --watch: stop after N delta samples "
+                         "(default: run until interrupted)")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
     base = args.url.rstrip("/")
 
+    if args.scrape:
+        urls = [u for u in args.scrape.split(",") if u.strip()]
+        if args.watch is not None:
+            return watch(lambda: _fleet_sample(
+                scrape_fleet(urls, args.timeout)),
+                args.watch, args.count)
+        doc = scrape_fleet(urls, args.timeout)
+        if args.json:
+            print(json.dumps(doc, indent=1, default=str))
+        else:
+            pretty_scrape(doc)
+        return 0 if doc["up"] else 2
+
+    if args.watch is not None:
+        def sample():
+            return _flat_sample(fetch(f"{base}/snapshot", args.timeout))
+        try:
+            return watch(sample, args.watch, args.count)
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            print(f"error: cannot reach {base}: {e}", file=sys.stderr)
+            return 2
+
     try:
+        if args.slo:
+            doc = fetch(f"{base}/slo", args.timeout)
+            print(json.dumps(doc, indent=1, default=str))
+            return 0
         if args.metrics:
             sys.stdout.write(fetch(f"{base}/metrics", args.timeout))
             return 0

@@ -1116,6 +1116,129 @@ class TestShardingRules:
         assert found == [], "\n".join(str(f) for f in found)
 
 
+class TestMetricNamingAndSinkRule:
+    """GL015 (ISSUE 9): metric-family naming conventions at registry
+    declaration sites (counters end ``_total``, histograms ``_seconds``/
+    ``_bytes``), plus SLO/flight-recorder/devstats recording banned from
+    jit-traced contexts (GL008's machinery, new sinks)."""
+
+    def test_counter_without_total_suffix_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            def wire(registry):
+                return registry.counter("requests_served", "served")
+        """, rules=["GL015"])
+        assert _rules(out) == ["GL015"]
+        assert "'requests_served'" in out[0].message
+        assert "_total" in out[0].message
+
+    def test_histogram_without_unit_suffix_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            def wire(reg):
+                return reg.histogram("decode_latency_ms", "latency")
+        """, rules=["GL015"])
+        assert len(out) == 1 and "_seconds/_bytes" in out[0].message
+
+    def test_conventional_names_and_gauges_are_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            def wire(registry):
+                registry.counter("requests_total", "served")
+                registry.histogram("decode_seconds", "latency")
+                registry.histogram("kv_cache_bytes", "cache size")
+                registry.gauge("queue_depth", "gauges unconstrained")
+        """, rules=["GL015"])
+        assert out == []
+
+    def test_fstring_trailing_literal_is_judged(self, tmp_path):
+        """The repo's f-string idiom: the statically visible trailing
+        fragment carries the unit suffix, so it IS checkable."""
+        out = _lint_src(tmp_path, """
+            def wire(registry, key):
+                registry.counter(f"route_{key}_total", "ok")
+                registry.counter(f"route_{key}_count", "bad")
+        """, rules=["GL015"])
+        assert len(out) == 1 and "_count'" in out[0].message
+
+    def test_dynamic_name_and_non_registry_receiver_skip(self, tmp_path):
+        """The gate judges only what it can read: fully dynamic names
+        pass, and standalone perf-script Histogram instances (no
+        registry receiver) never reach exposition."""
+        out = _lint_src(tmp_path, """
+            from deeplearning4j_tpu.observability import Histogram
+
+            def wire(registry, name, broker):
+                registry.counter(name, "dynamic: unjudgeable")
+                h = Histogram("soak_latency_ms")
+                broker.counter("not_a_registry")
+                return h
+        """, rules=["GL015"])
+        assert out == []
+
+    def test_flightrec_record_inside_jit_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x, flightrec):
+                flightrec.record("block_retire", k=4)
+                return x + 1
+        """, rules=["GL015"])
+        assert _rules(out) == ["GL015"]
+        assert ".record()" in out[0].message
+
+    def test_slo_observe_in_scan_body_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+
+            def body(carry, t, slo_tracker):
+                slo_tracker.observe_request(t)
+                return carry, t
+
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+        """, rules=["GL015"])
+        assert _rules(out) == ["GL015"]
+
+    def test_devstats_snapshot_under_trace_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x, devstats):
+                devstats.snapshot()
+                return x
+        """, rules=["GL015"])
+        assert _rules(out) == ["GL015"]
+
+    def test_recording_outside_jit_is_fine(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            def serve(flightrec, slo_tracker, req):
+                flightrec.record("admission", batch=2)
+                slo_tracker.observe_request(req)
+        """, rules=["GL015"])
+        assert out == []
+
+    def test_unhinted_receiver_in_jit_is_not_gl015(self, tmp_path):
+        """.record() on a receiver that does not name one of the ISSUE 9
+        sinks is someone else's problem (same discipline as GL008's
+        receiver hints)."""
+        out = _lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x, session):
+                session.record("frame")
+                return x
+        """, rules=["GL015"])
+        assert out == []
+
+    def test_inline_disable_suppresses_gl015(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            def wire(registry):
+                return registry.counter("legacy_count", "grandfathered")  # graftlint: disable=GL015
+        """, rules=["GL015"])
+        assert out == []
+
+
 class TestLintCacheAndCLI:
     _SRC = textwrap.dedent("""
         import jax

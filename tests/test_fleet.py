@@ -811,3 +811,135 @@ class TestFleetServingRoute:
             assert agg["replicas"] == 2 and agg["completed"] >= 1
         finally:
             router.shutdown()
+
+
+class TestFleetSLOAndPostmortem:
+    """ISSUE 9: routing data and SLO data in ONE fleet_stats() document,
+    and replica death leaving a trace-matched post-mortem artifact."""
+
+    def test_fleet_stats_carries_per_replica_slo(self, fleet_net):
+        import json as _json
+
+        from deeplearning4j_tpu.observability import (FlightRecorder,
+                                                      MetricsRegistry,
+                                                      SLOTracker)
+        net, dec = fleet_net
+        reg = MetricsRegistry()
+        trk = SLOTracker(registry=reg, name="fleet-slo")
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=2,
+            registry=reg, slo_tracker=trk,
+            flight_recorder=FlightRecorder(registry=reg)).start()
+        try:
+            frs = [router.submit([1, 2, i % 3], 3, deadline=60.0,
+                                 route="api") for i in range(6)]
+            for fr in frs:
+                fr.result(30)
+            fs = router.fleet_stats()
+            # top-level fleet SLO summary next to the replica table
+            assert fs["slo"]["attainment_short"] == 1.0
+            assert fs["slo"]["burn_rate_short"] == 0.0
+            served = {fr.replica_id for fr in frs}
+            for rid in served:
+                row = fs["replicas"][rid]["slo"]
+                assert row["attainment"] == 1.0 and row["n"] >= 1
+                assert row["headroom_min_s"] > 0
+            # each request accounted once, labeled by its serving replica
+            snap = trk.snapshot()
+            assert snap["requests"] == 6 and snap["missed"] == 0
+            assert set(snap["replicas"]) == served
+            assert set(snap["routes"]) == {"api"}
+            _json.dumps(fs)              # the /snapshot contract: JSON-safe
+        finally:
+            router.shutdown()
+
+    def test_spillover_and_shed_account_each_request_exactly_once(
+            self, fleet_net):
+        """An engine-level fast-fail the router spills past (queue-full
+        race, dead engine) must not SLO-account a request the fleet goes
+        on to serve or shed elsewhere: exactly ONE record per
+        FleetRequest, whatever path it took (regression: raced inner
+        sheds ran armed and each recorded a phantom miss, so one flooded
+        request could count as N+1 requests and tank attainment)."""
+        from deeplearning4j_tpu.observability import (MetricsRegistry,
+                                                      SLOTracker)
+        net, dec = fleet_net
+        injs = [FaultInjector(), FaultInjector()]
+        for inj in injs:
+            inj.hang_for("engine.step", seconds=0.8, at=1)
+        reg = MetricsRegistry()
+        trk = SLOTracker(registry=reg, name="spill")
+        router = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec, num_slots=1,
+            max_pending=1, registry=reg, slo_tracker=trk,
+            replica_injectors=injs).start()
+        try:
+            frs = [router.submit([1, 2, 3], 8) for _ in range(12)]
+            # sync-settled propagations are accounted by the completion
+            # gate even though their inner handles ran unarmed
+            frs.append(router.submit([2, 1], 0))          # instant ok
+            frs.append(router.submit([], 3))              # validation
+            for fr in frs:
+                try:
+                    fr.result(30)
+                except (RejectedError, ValueError):
+                    pass
+            snap = trk.snapshot()
+            n_shed = sum(1 for fr in frs
+                         if isinstance(fr._error, RejectedError))
+            n_failed = sum(1 for fr in frs
+                           if isinstance(fr._error, ValueError))
+            assert snap["requests"] == len(frs), snap["by_status"]
+            assert snap["by_status"].get("shed", 0) == n_shed
+            assert snap["by_status"].get("failed", 0) == n_failed
+            assert sum(snap["by_status"].values()) == len(frs)
+        finally:
+            router.shutdown()
+
+    def test_replica_death_writes_trace_matched_postmortem(
+            self, fleet_net, tmp_path):
+        import json as _json
+
+        from deeplearning4j_tpu.observability import (FlightRecorder,
+                                                      MetricsRegistry)
+        net, dec = fleet_net
+        rng = np.random.default_rng(23)
+        prompts = [rng.integers(0, VOCAB, int(rng.integers(2, 5)))
+                   for _ in range(8)]
+        gens = [int(rng.integers(3, 8)) for _ in range(8)]
+        want = _expected(fleet_net, prompts, gens)
+        reg = MetricsRegistry()
+        rec = FlightRecorder(registry=reg)
+        router = EngineFleetRouter(net, num_replicas=2, decoder=dec,
+                                   num_slots=2, registry=reg,
+                                   flight_recorder=rec,
+                                   postmortem_dir=str(tmp_path)).start()
+        try:
+            frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+            _wait(lambda: any(fr.replica_id == "r0" and
+                              len(fr._inner.generated) > 0
+                              for fr in frs), timeout=10)
+            router.kill_replica("r0", mode="crash")
+            outs = [fr.result(60) for fr in frs]
+            for out, w in zip(outs, want):
+                np.testing.assert_array_equal(out, w)
+            assert len(rec.dumps) == 1
+            with open(rec.dumps[0], encoding="utf-8") as f:
+                doc = _json.load(f)
+            assert doc["reason"].startswith("replica r0 dead")
+            # the artifact was written BEFORE re-dispatch: its embedded
+            # traces are the victims' — the requests migration re-served
+            migrated = {fr.request_id for fr in frs if fr.migrations}
+            assert migrated
+            assert set(doc["extra"]["fleet_request_ids"]) == migrated
+            trace_ids = {fr.trace.request_id for fr in frs
+                         if fr.migrations}
+            assert set(doc["request_ids"]) == trace_ids
+            kinds = [e["kind"] for e in doc["events"]]
+            assert "replica_dead" in kinds
+            assert doc["metrics"]["fleet_migrations_total"] is not None
+            # the migration event lands back on the recorder's ring
+            # after the artifact (artifact first, then re-dispatch)
+            assert any(e["kind"] == "migration" for e in rec.events())
+        finally:
+            router.shutdown()

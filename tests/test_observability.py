@@ -18,9 +18,13 @@ from deeplearning4j_tpu.models import (SlotGenerationEngine,
                                        TransformerDecoder,
                                        transformer_lm_conf)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
-from deeplearning4j_tpu.observability import (Histogram, MetricsRegistry,
-                                              TelemetryServer, Trace,
-                                              TraceRing, percentiles)
+from deeplearning4j_tpu.observability import (DeviceStats, FlightRecorder,
+                                              Histogram, MetricsRegistry,
+                                              SLOTracker, TelemetryServer,
+                                              Trace, TraceRing,
+                                              device_memory_snapshot,
+                                              impl_cost_analysis,
+                                              kv_cache_stats, percentiles)
 from deeplearning4j_tpu.parallel.failures import EngineSupervisor
 from deeplearning4j_tpu.parallel.faults import FaultInjector
 from deeplearning4j_tpu.streaming.pubsub import (MessageBroker,
@@ -499,3 +503,591 @@ class TestTelemetryOverhead:
             f"{len(results)} consecutive best-of-5 measurements: " \
             f"{[f'{r[0]:.1%}' for r in results]} (last: on " \
             f"{on_best:.0f} vs off {off_best:.0f} tok/s)"
+
+
+class TestSLOTracker:
+    """SLO math (ISSUE 9): window exactness under thread storms,
+    attainment/burn against a numpy oracle, and deadline-headroom
+    continuity across a supervisor takeover."""
+
+    def test_attainment_and_burn_match_numpy_oracle(self):
+        rng = np.random.default_rng(3)
+        trk = SLOTracker(registry=MetricsRegistry(), name="oracle",
+                         target=0.95, capacity=2048)
+        times = np.sort(rng.uniform(0.0, 100.0, 600))
+        status = rng.choice(["ok", "deadline", "cancelled", "shed"],
+                            600, p=[0.7, 0.15, 0.05, 0.1])
+        headroom = rng.uniform(-2.0, 5.0, 600)
+        for t, st, h in zip(times, status, headroom):
+            # ok records carry non-negative headroom (the engine raises
+            # DeadlineExceeded otherwise, which lands as status=deadline)
+            trk.record(st, headroom=abs(h) if st == "ok" else -abs(h),
+                       latency=0.1, now=float(t))
+        now = 100.0
+        for window in (10.0, 37.5, 80.0, None):
+            counted = status != "cancelled"
+            if window is not None:
+                counted &= times >= now - window
+            met = counted & (status == "ok")
+            want = 1.0 if not counted.sum() else \
+                met.sum() / counted.sum()
+            got = trk.attainment(window, now=now)
+            assert got == pytest.approx(want, abs=1e-12)
+            assert trk.burn_rate(window, now=now) == pytest.approx(
+                (1.0 - want) / (1.0 - 0.95), abs=1e-9)
+
+    def test_sixteen_thread_recording_storm_window_exact(self):
+        """16 threads × 250 records with deterministic injected clocks:
+        every record lands exactly once, and the short/long windows
+        count exactly the records whose stamps fall inside them."""
+        trk = SLOTracker(registry=MetricsRegistry(), name="storm",
+                         short_window=60.0, long_window=600.0,
+                         capacity=8192)
+        n_threads, per = 16, 250
+        barrier = threading.Barrier(n_threads)
+
+        def worker(tid):
+            barrier.wait()
+            for k in range(per):
+                j = tid * per + k                 # global 0..3999
+                trk.record("ok" if j % 5 else "deadline",
+                           headroom=1.0 if j % 5 else -0.5,
+                           latency=0.01, now=j * 0.025)  # t in [0, 100)
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = trk.snapshot(now=100.0)
+        assert snap["requests"] == n_threads * per
+        assert snap["missed"] == n_threads * per // 5
+        # short window [40, 100): j*0.025 >= 40  ->  j >= 1600
+        short = snap["windows"]["short"]
+        assert short["n"] == 2400
+        assert short["met"] == 2400 - sum(
+            1 for j in range(1600, 4000) if j % 5 == 0)
+        long_w = snap["windows"]["long"]
+        assert long_w["n"] == 4000
+        assert trk._m_requests.labels("storm", "ok").value == \
+            sum(1 for j in range(4000) if j % 5)
+
+    def test_cancelled_excluded_sheds_count_as_miss(self):
+        trk = SLOTracker(registry=MetricsRegistry(), name="mix",
+                         target=0.5)
+        trk.record("ok", headroom=1.0, now=1.0)
+        trk.record("cancelled", now=2.0)
+        trk.record("shed", now=3.0)
+        trk.record("failed", now=4.0)
+        snap = trk.snapshot(now=5.0)
+        assert snap["requests"] == 3          # cancelled not counted
+        assert snap["missed"] == 2
+        assert trk.attainment(None, now=5.0) == pytest.approx(1 / 3)
+        assert snap["by_status"] == {"ok": 1, "cancelled": 1,
+                                     "shed": 1, "failed": 1}
+
+    def test_registry_gauges_follow_tracker(self):
+        reg = MetricsRegistry()
+        trk = SLOTracker(registry=reg, name="g", target=0.9)
+        trk.record("ok", headroom=1.0)
+        trk.record("deadline", headroom=-1.0)
+        vals = reg.snapshot()["slo_attainment_ratio"]["values"]
+        assert vals["tracker=g,window=short"] == pytest.approx(0.5)
+        burn = reg.snapshot()["slo_burn_rate"]["values"]
+        assert burn["tracker=g,window=long"] == pytest.approx(5.0)
+        hist = reg.get("slo_deadline_headroom_seconds")
+        assert hist.labels("g").count == 2
+
+    def test_engine_records_one_slo_account_per_request(
+            self, shared_decoder, rng_np):
+        reg = MetricsRegistry()
+        trk = SLOTracker(registry=reg, name="eng")
+        eng = _engine(shared_decoder, registry=reg, slo=trk,
+                      slo_label="rA")
+        reqs = [eng.submit(rng_np.integers(0, VOCAB, 3), 4,
+                           deadline=60.0, route="unit")
+                for _ in range(4)]
+        eng.run_until_drained()
+        assert all(r.done() for r in reqs)
+        snap = trk.snapshot()
+        assert snap["requests"] == 4 and snap["missed"] == 0
+        assert set(snap["replicas"]) == {"rA"}
+        assert set(snap["routes"]) == {"unit"}
+        for rec in trk.recent(10):
+            assert rec["status"] == "ok"
+            assert rec["queue_wait_s"] is not None
+            assert 0.0 <= rec["ttft_s"] <= rec["latency_s"]
+            # headroom + latency == deadline (both anchored at submit)
+            assert rec["headroom_s"] == pytest.approx(
+                60.0 - rec["latency_s"], abs=0.05)
+            assert rec["tokens"] == 4
+
+    def test_slo_sync_fail_seam_suppresses_spillable_fast_fails(
+            self, shared_decoder, rng_np):
+        """The fleet dispatch seam: with ``_slo_sync_fail=False`` an
+        engine-level synchronous fast-fail (queue-full shed, dead
+        engine) records NOTHING — the router spills onward and the
+        serving replica (or the router's own shed) accounts the request
+        exactly once. Default submits keep accounting sync fails."""
+        reg = MetricsRegistry()
+        trk = SLOTracker(registry=reg, name="seam")
+        eng = _engine(shared_decoder, registry=reg, slo=trk,
+                      slo_label="rS", max_pending=1)
+        prompt = rng_np.integers(0, VOCAB, 3)
+        held = eng.submit(prompt, 4)             # fills the 1-deep queue
+        shed_armed = eng.submit(prompt, 4)       # default: accounted
+        shed_unarmed = eng.submit(prompt, 4, _slo_sync_fail=False)
+        assert shed_armed.done() and shed_unarmed.done()
+        snap = trk.snapshot()
+        assert snap["by_status"] == {"shed": 1}
+        assert shed_unarmed._slo_done is False   # the fleet gate's cue
+        eng.run_until_drained()
+        assert held.done() and trk.snapshot()["by_status"] == {
+            "ok": 1, "shed": 1}
+        eng.shutdown()
+        dead_unarmed = eng.submit(prompt, 4, _slo_sync_fail=False)
+        assert dead_unarmed.done()
+        assert trk.snapshot()["by_status"] == {"ok": 1, "shed": 1}
+
+    def test_deadline_headroom_survives_takeover(self, shared_decoder,
+                                                 rng_np):
+        """The takeover span must not reset the clock: a recovered
+        request's headroom/latency are measured from the ORIGINAL
+        submission, and it is SLO-accounted exactly once."""
+        reg, ring = MetricsRegistry(), TraceRing(64)
+        trk = SLOTracker(registry=reg, name="tk")
+        inj = FaultInjector(registry=reg,
+                            flight_recorder=FlightRecorder(registry=reg))
+        inj.raise_once("engine.step", RuntimeError("chaos"), at=3)
+        eng = _engine(shared_decoder, registry=reg, trace_store=ring,
+                      fault_injector=inj, slo=trk, slo_label="rT")
+        sup = EngineSupervisor(eng, timeout=10.0, interval=0.1,
+                               max_restarts=2,
+                               flight_recorder=eng._flightrec).start()
+        try:
+            t0 = time.monotonic()
+            reqs = [sup.submit(rng_np.integers(0, VOCAB, 3), 6,
+                               deadline=120.0) for _ in range(5)]
+            created = [r._created_t for r in reqs]
+            for r in reqs:
+                assert r.result(60) is not None
+            wall = time.monotonic() - t0
+            assert sup.restarts == 1
+            # creation stamps never reset, label re-pointed post-takeover
+            assert [r._created_t for r in reqs] == created
+            assert all(r._slo_labels["replica"] == "rT" for r in reqs)
+            snap = trk.snapshot()
+            assert snap["requests"] == 5          # exactly once each
+            assert snap["missed"] == 0
+            for rec in trk.recent(10):
+                assert rec["headroom_s"] == pytest.approx(
+                    120.0 - rec["latency_s"], abs=0.05)
+                assert rec["latency_s"] <= wall + 0.05
+            # the crash really harvested in-flight work: at least one
+            # request carries a takeover span — and ITS latency is
+            # still deadline-consistent (checked above for all)
+            assert any("takeover" in r.trace.span_names()
+                       for r in reqs)
+        finally:
+            sup.stop()
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_sequenced_and_counted(self):
+        reg = MetricsRegistry()
+        rec = FlightRecorder(capacity=8, registry=reg)
+        for i in range(20):
+            rec.record("admission", batch=i)
+        assert len(rec) == 8
+        assert rec.total_events == 20
+        evs = rec.events()
+        assert [e["seq"] for e in evs] == list(range(13, 21))
+        assert reg.get("flightrec_events_total") \
+            .labels("admission").value == 20
+        st = rec.stats()
+        assert st["ring"] == 8 and st["by_kind"] == {"admission": 8}
+
+    def test_events_filter_by_kind_and_count(self):
+        rec = FlightRecorder(capacity=32)
+        for i in range(4):
+            rec.record("shed", depth=i)
+            rec.record("takeover", n=i)
+        assert len(rec.events(kind="shed")) == 4
+        assert [e["n"] for e in rec.events(2, kind="takeover")] == [2, 3]
+
+    def test_postmortem_artifact_roundtrip(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("pm_total", "x").inc(3)
+        rec = FlightRecorder(capacity=16, registry=reg)
+        rec.record("fault", point="engine.step")
+        rec.record("crash", engine="e1")
+        ring = TraceRing(4)
+        tr = Trace(store=ring)
+        tr.event("submit")
+        tr.finish("failed:RuntimeError")
+        path = rec.write_postmortem(
+            str(tmp_path), "unit", reason="unit crash",
+            cause=RuntimeError("boom"), traces=[tr, None],
+            registry=reg, extra={"k": "v"})
+        assert path is not None
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["reason"] == "unit crash"
+        assert doc["cause"] == "RuntimeError: boom"
+        assert [e["kind"] for e in doc["events"]] == ["fault", "crash"]
+        assert doc["request_ids"] == [tr.request_id]
+        assert doc["traces"][0]["status"] == "failed:RuntimeError"
+        assert doc["metrics"]["pm_total"]["values"][""] == 3
+        assert doc["extra"] == {"k": "v"}
+        assert rec.dumps == [path]
+        assert rec.events()[-1]["kind"] == "postmortem"
+
+    def test_postmortem_artifacts_never_clobber_across_recorders(
+            self, tmp_path):
+        """seq is per-recorder: a second soak round (fresh recorder,
+        same directory, same tag) must land NEXT TO round 1's artifact,
+        not os.replace it away (regression: identical filenames)."""
+        paths = []
+        for _ in range(3):
+            rec = FlightRecorder(capacity=8, registry=MetricsRegistry())
+            rec.record("crash", engine="e1")
+            paths.append(rec.write_postmortem(
+                str(tmp_path), "slot-engine", reason="round crash"))
+        assert all(p is not None for p in paths)
+        assert len(set(paths)) == 3
+        for p in paths:
+            with open(p, encoding="utf-8") as f:
+                assert json.load(f)["reason"] == "round crash"
+
+    def test_postmortem_write_failure_degrades(self, tmp_path):
+        rec = FlightRecorder(capacity=8, registry=MetricsRegistry())
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file, not dir")
+        path = rec.write_postmortem(str(blocker), "x", reason="r")
+        assert path is None and rec.dumps == []
+        assert rec.events()[-1] == {
+            "seq": 1, "t": rec.events()[-1]["t"], "kind": "postmortem",
+            "tag": "x", "error": "write failed"}
+
+    def test_engine_lifecycle_events_gated_on_tracing(
+            self, shared_decoder, rng_np):
+        reg = MetricsRegistry()
+        rec = FlightRecorder(registry=reg)
+        eng = _engine(shared_decoder, registry=reg, flight_recorder=rec)
+        for _ in range(3):
+            eng.submit(rng_np.integers(0, VOCAB, 3), 4)
+        eng.run_until_drained()
+        kinds = {e["kind"] for e in rec.events()}
+        assert {"admission", "block_retire"} <= kinds
+        # telemetry-off arm: lifecycle events skipped (the ≤5% A/B)
+        rec2 = FlightRecorder(registry=MetricsRegistry())
+        eng2 = _engine(shared_decoder, registry=MetricsRegistry(),
+                       tracing=False, flight_recorder=rec2)
+        eng2.submit(rng_np.integers(0, VOCAB, 3), 4)
+        eng2.run_until_drained()
+        assert rec2.events() == []
+
+    def test_supervisor_writes_postmortem_on_crash(self, shared_decoder,
+                                                   rng_np, tmp_path):
+        reg, ring = MetricsRegistry(), TraceRing(64)
+        rec = FlightRecorder(registry=reg)
+        inj = FaultInjector(registry=reg, flight_recorder=rec)
+        inj.raise_once("engine.step", RuntimeError("chaos"), at=3)
+        eng = _engine(shared_decoder, registry=reg, trace_store=ring,
+                      fault_injector=inj, flight_recorder=rec)
+        sup = EngineSupervisor(eng, timeout=10.0, interval=0.1,
+                               max_restarts=2,
+                               postmortem_dir=str(tmp_path)).start()
+        try:
+            reqs = [sup.submit(rng_np.integers(0, VOCAB, 3), 6)
+                    for _ in range(5)]
+            for r in reqs:
+                assert r.result(60) is not None
+            assert sup.restarts == 1
+            paths = rec.dumps
+            assert len(paths) == 1
+            with open(paths[0], encoding="utf-8") as f:
+                doc = json.load(f)
+            kinds = [e["kind"] for e in doc["events"]]
+            assert "fault" in kinds and "takeover" in kinds
+            # embedded traces ARE the harvested requests' timelines
+            known = {r.trace.request_id for r in reqs}
+            assert set(doc["request_ids"]) \
+                == set(doc["extra"]["recovered_request_ids"])
+            assert set(doc["request_ids"]) <= known
+            assert doc["request_ids"]          # the crash harvested work
+        finally:
+            sup.stop()
+
+
+class TestDeviceStats:
+    def test_kv_cache_bytes_exact_from_live_leaves(self, shared_decoder):
+        """The accounting reads the ACTUAL cache leaves: layers × k/v ×
+        slots × heads × T_max × Dh × itemsize, no formula drift."""
+        eng = _engine(shared_decoder, registry=MetricsRegistry())
+        st = kv_cache_stats(eng)
+        # shared decoder: 2 attention layers, 2 heads, T_max 32, Dh 16
+        want = 2 * 2 * (2 * 2 * 32 * 16) * 4
+        assert st["bytes"] == want
+        assert st["addressable_bytes"] == want     # unsharded: all local
+        assert st["shards"] == 1 and st["layers"] == 2
+        assert st["slot_shape"] == [2, 2, 32, 16]
+        assert st["dtype"] == "float32"
+        assert st["bytes_per_slot"] == want // 2
+
+    def test_device_memory_snapshot_degrades_on_cpu(self):
+        snap = device_memory_snapshot()
+        assert snap["devices"], "at least one jax device"
+        for d in snap["devices"]:
+            assert {"id", "platform", "kind", "memory_stats"} <= set(d)
+        census = snap["live_arrays"]
+        assert census["count"] is None or census["count"] >= 0
+        assert census["bytes"] is None or census["bytes"] >= 0
+
+    def test_impl_cost_analysis_covers_dispatched_impls(
+            self, shared_decoder, rng_np):
+        net, dec = shared_decoder
+        eng = _engine(shared_decoder, registry=MetricsRegistry())
+        eng.submit(rng_np.integers(0, VOCAB, 3), 4)
+        eng.run_until_drained()
+        costs = impl_cost_analysis(dec)
+        dispatched = {name for name, entry in dec._cost_seam.items()
+                      if entry[1] is not None}
+        assert "prefill_slots_impl" in dispatched
+        assert set(costs) == dispatched
+        for name, cost in costs.items():
+            assert "error" not in cost, (name, cost)
+            assert cost["flops"] > 0
+            assert cost["bytes_accessed"] > 0
+        # memoized: the second call returns the cached analyses
+        again = impl_cost_analysis(dec)
+        assert all(again[k] is costs[k] for k in costs)
+
+    def test_devstats_snapshot_and_registry_gauge(self, shared_decoder,
+                                                  rng_np):
+        reg = MetricsRegistry()
+        eng = _engine(shared_decoder, registry=reg)
+        eng.submit(rng_np.integers(0, VOCAB, 3), 3)
+        eng.run_until_drained()
+        ds = DeviceStats(registry=reg).attach_engine("gen", eng)
+        snap = ds.snapshot()
+        want = kv_cache_stats(eng)["bytes"]
+        assert snap["kv_cache"]["gen"]["bytes"] == want
+        assert snap["impl_cost"]          # decoder attached via engine
+        assert snap["devices"]
+        vals = reg.snapshot()["devstats_kv_cache_bytes"]["values"]
+        assert vals["engine=gen"] == want
+        assert reg.snapshot()["devstats_live_arrays"]["values"][""] > 0
+
+
+class TestSLOAndDevstatsEndpoints:
+    def test_slo_endpoint_and_snapshot_sections(self, shared_decoder,
+                                                rng_np):
+        reg, ring = MetricsRegistry(), TraceRing(64)
+        trk = SLOTracker(registry=reg, name="srv")
+        rec = FlightRecorder(registry=reg)
+        eng = _engine(shared_decoder, registry=reg, trace_store=ring,
+                      slo=trk, slo_label="r0", flight_recorder=rec)
+        reqs = [eng.submit(rng_np.integers(0, VOCAB, 3), 4,
+                           deadline=60.0, route="lm")
+                for _ in range(3)]
+        eng.run_until_drained()
+        assert all(r.done() for r in reqs)
+        srv = TelemetryServer(registry=reg, trace_store=ring,
+                              slo_tracker=trk, flight_recorder=rec)
+        srv.add_engine("gen", eng).start()
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                srv.url + "/slo").read())
+            assert doc["tracker"] == "srv"
+            assert doc["requests"] == 3 and doc["missed"] == 0
+            assert set(doc["windows"]) == {"short", "long"}
+            assert doc["windows"]["long"]["attainment"] == 1.0
+            assert set(doc["replicas"]) == {"r0"}
+            assert set(doc["routes"]) == {"lm"}
+            assert doc["overall"]["headroom_s"]["min"] > 0
+            snap = json.loads(urllib.request.urlopen(
+                srv.url + "/snapshot").read())
+            # the acceptance bar: KV bytes + per-impl cost_analysis for
+            # every compiled decode impl live in /snapshot
+            kv = snap["devstats"]["kv_cache"]["gen"]
+            assert kv["bytes"] == kv_cache_stats(eng)["bytes"]
+            net, dec = shared_decoder
+            dispatched = {n for n, e in dec._cost_seam.items()
+                          if e[1] is not None}
+            assert set(snap["devstats"]["impl_cost"]) == dispatched
+            assert snap["slo"]["requests"] == 3
+            assert snap["flightrec"]["events_total"] == \
+                rec.total_events
+            # engine source rides the same add_engine() call
+            assert snap["sources"]["gen"]["completed"] == 3
+            # SLO gauges render on /metrics too
+            text = urllib.request.urlopen(
+                srv.url + "/metrics").read().decode()
+            assert 'slo_attainment_ratio{tracker="srv",window="long"} 1' \
+                in text
+        finally:
+            srv.stop()
+
+    def test_traces_recent_query_params_over_http(self):
+        """?n= and ?status= (ISSUE 9 satellite): filter BEFORE the count
+        cut — ?n=2&status=failed is 'the last 2 failures'."""
+        ring = TraceRing(32)
+        statuses = ["ok", "failed:RuntimeError", "ok",
+                    "failed:ValueError", "failed:RuntimeError", "ok"]
+        ids = []
+        for st in statuses:
+            tr = Trace(store=ring)
+            tr.event("submit")
+            tr.finish(st)
+            ids.append(tr.request_id)
+        srv = TelemetryServer(registry=MetricsRegistry(),
+                              trace_store=ring).start()
+        try:
+            def get(query):
+                return json.loads(urllib.request.urlopen(
+                    srv.url + "/traces/recent" + query).read())
+            assert get("")["count"] == 6
+            assert get("?n=2")["count"] == 2
+            doc = get("?status=failed")
+            assert doc["count"] == 3
+            assert [t["request_id"] for t in doc["traces"]] == \
+                [ids[1], ids[3], ids[4]]
+            assert all(t["status"].startswith("failed:")
+                       for t in doc["traces"])
+            doc = get("?n=2&status=failed")      # the last 2 FAILURES
+            assert [t["request_id"] for t in doc["traces"]] == \
+                [ids[3], ids[4]]
+            doc = get("?status=failed:ValueError")
+            assert [t["request_id"] for t in doc["traces"]] == [ids[3]]
+            assert get("?status=ok")["count"] == 3
+            assert get("?status=nope")["count"] == 0
+            assert get("?n=bogus")["count"] == 6     # bad n: ignored
+        finally:
+            srv.stop()
+
+
+def _load_telemetry_dump():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_dump", os.path.join(os.path.dirname(__file__),
+                                       "..", "scripts",
+                                       "telemetry_dump.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFleetScrape:
+    """``telemetry_dump --scrape`` (ISSUE 9): merge N replicas' live
+    ``/snapshot`` documents into one fleet summary, over real HTTP."""
+
+    @staticmethod
+    def _three_replicas():
+        servers, urls, trackers = [], [], []
+        for i in range(3):
+            reg = MetricsRegistry()
+            trk = SLOTracker(registry=reg, name=f"r{i}", target=0.9)
+            # r0: 10/10 met; r1: 8/10; r2: 9/10 -> fleet 27/30
+            misses = {0: 0, 1: 2, 2: 1}[i]
+            for j in range(10):
+                ok = j >= misses
+                trk.record("ok" if ok else "deadline",
+                           ttft=0.01, queue_wait=0.001, latency=0.05,
+                           headroom=1.0 if ok else -0.5,
+                           replica=f"r{i}")
+            reg.counter("served_total", "s").inc(10 + i)
+            srv = TelemetryServer(registry=reg, trace_store=TraceRing(4),
+                                  slo_tracker=trk).start()
+            servers.append(srv)
+            urls.append(srv.url)
+            trackers.append(trk)
+        return servers, urls, trackers
+
+    def test_scrape_merges_three_live_replicas(self):
+        td = _load_telemetry_dump()
+        servers, urls, _ = self._three_replicas()
+        try:
+            doc = td.scrape_fleet(urls + ["http://127.0.0.1:9"],
+                                  timeout=5.0)
+            assert doc["scraped"] == 4 and doc["up"] == 3
+            down = doc["replicas"]["http://127.0.0.1:9"]
+            assert down["up"] is False and "error" in down
+            # pooled attainment is met/n summed across replicas — the
+            # numpy-oracle identity, not an average of ratios
+            agg = doc["slo"]
+            assert agg["requests"] == 30 and agg["missed"] == 3
+            assert agg["attainment_long"] == pytest.approx(27 / 30)
+            assert agg["burn_rate_long"] == pytest.approx(
+                (3 / 30) / (1 - 0.9))
+            for i, url in enumerate(urls):
+                row = doc["replicas"][url]
+                assert row["up"] is True
+                assert row["attainment_long"] == pytest.approx(
+                    (10 - {0: 0, 1: 2, 2: 1}[i]) / 10)
+                assert row["headroom_min_s"] is not None
+            # counters summed fleet-wide
+            assert doc["counters"]["served_total"] == 10 + 11 + 12
+            assert doc["counters"]["slo_requests_total"] == 30
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_scrape_cli_json_and_exit_codes(self, capsys):
+        td = _load_telemetry_dump()
+        servers, urls, _ = self._three_replicas()
+        try:
+            rc = td.main(["--scrape", ",".join(urls), "--json"])
+            assert rc == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["up"] == 3
+            rc = td.main(["--scrape", ",".join(urls)])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "fleet scrape: 3/3 replicas up" in out
+            assert "fleet SLO (target 0.9)" in out
+        finally:
+            for s in servers:
+                s.stop()
+        # every replica down: exit 2 (automation must not read an
+        # empty merge as healthy)
+        assert td.main(["--scrape", "http://127.0.0.1:9", "--json"]) == 2
+        capsys.readouterr()
+
+    def test_watch_prints_counter_rates_and_gauge_moves(self):
+        import io
+        td = _load_telemetry_dump()
+        samples = [
+            {"rates": {"a_total": 10}, "gauges": {"depth": 3.0}},
+            {"rates": {"a_total": 30}, "gauges": {"depth": 5.0}},
+            {"rates": {"a_total": 30}, "gauges": {"depth": 5.0}},
+        ]
+        it = iter(samples)
+        out = io.StringIO()
+        clock_vals = iter([0.0, 2.0, 4.0])
+        rc = td.watch(lambda: next(it), period=0.0, count=2, out=out,
+                      clock=lambda: next(clock_vals),
+                      sleep=lambda s: None)
+        assert rc == 0
+        text = out.getvalue()
+        assert "a_total" in text and "+20" in text and "10.00/s" in text
+        assert "depth" in text and "3 -> 5" in text
+        # the steady sample prints no spurious delta lines
+        assert text.count("a_total") == 1
+
+    def test_watch_cli_against_live_server(self, shared_decoder, rng_np,
+                                           capsys):
+        td = _load_telemetry_dump()
+        reg = MetricsRegistry()
+        eng = _engine(shared_decoder, registry=reg)
+        srv = TelemetryServer(registry=reg,
+                              trace_store=TraceRing(8)).start()
+        try:
+            eng.submit(rng_np.integers(0, VOCAB, 3), 3)
+            eng.run_until_drained()
+            rc = td.main([srv.url, "--watch", "0.05", "--count", "1"])
+            assert rc == 0
+            assert "watch sample" in capsys.readouterr().out
+        finally:
+            srv.stop()
