@@ -40,6 +40,7 @@ import collections
 import itertools
 import threading
 import time
+import uuid
 import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -789,6 +790,13 @@ class GenerationRequest:
         self._slo = None                   # SLOTracker, set at submit
         self._slo_done = False             # an observe_request happened
         self._slo_labels: Dict = {}
+        # durability (ISSUE 10): the id this request journals under —
+        # stable across requeues, takeovers, and migrations (a fleet
+        # clone inherits it; the zombie's is detached). None = not
+        # journaled. _journal_hooked latches the terminal-state journal
+        # callback so engine hops never double-attach it.
+        self.journal_id: Optional[str] = None
+        self._journal_hooked = False
 
     def _complete(self):
         self._result = np.concatenate(
@@ -958,7 +966,8 @@ class SlotGenerationEngine:
                  max_pending: int = 256, fault_injector=None,
                  block_size: int = 1, registry=None, trace_store=None,
                  tracing: bool = True, mesh=None, spec_layout=None,
-                 slo=None, slo_label=None, flight_recorder=None):
+                 slo=None, slo_label=None, flight_recorder=None,
+                 journal=None):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -1018,6 +1027,17 @@ class SlotGenerationEngine:
         self._worker: Optional[threading.Thread] = None
         self._shutdown = False
         self._dead: Optional[BaseException] = None   # worker crash cause
+        # durable request journal (ISSUE 10): lifecycle records append
+        # OUTSIDE the engine lock, on the readback thread, batched per
+        # decode block — GL010-clean by construction, and journal I/O
+        # failures degrade durability without ever failing serving
+        self._journal = journal
+        # preemption drain (parallel/preemption.py): _draining sheds new
+        # submissions, _drain_stop parks the serve loop at the next
+        # block boundary so the in-flight block can be retired before
+        # the quarantine harvest
+        self._draining = False
+        self._drain_stop = False
         # supervision hooks (EngineSupervisor._attach)
         self._supervised = False
         self._quarantined = False
@@ -1084,10 +1104,18 @@ class SlotGenerationEngine:
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
                route: Optional[str] = None,
+               journal_id: Optional[str] = None,
                _slo_sync_fail: bool = True) -> GenerationRequest:
         req = GenerationRequest(prompt, max_new_tokens, temperature, eos_id,
                                 deadline=deadline)
         req._engine = self
+        # durable id (ISSUE 10): callers may pin one (the fleet router
+        # reuses its request id so ledger fencing arbitrates recovery);
+        # otherwise a journaled engine mints a process-unique id
+        if journal_id is not None:
+            req.journal_id = str(journal_id)
+        elif self._journal is not None:
+            req.journal_id = uuid.uuid4().hex[:16]
         # the engine opens the request's trace; route-side spans
         # (consume/publish) are appended onto it afterwards. The
         # early-failure paths below finish it through req._fail.
@@ -1135,9 +1163,17 @@ class SlotGenerationEngine:
         # Admission control shares the section: the observed depth and
         # the append/shed decision are atomic.
         shed_depth = None
+        draining = False
         with self._lock:
             dead = self._dead
             queued = not (self._shutdown or dead is not None)
+            if queued and self._draining:
+                # preemption drain (ISSUE 10): admission is CLOSED — new
+                # work is shed (the caller retries another replica);
+                # inherited/queued work keeps decoding until harvest
+                self._m["rejected"].inc()
+                draining = True
+                queued = False
             if queued:
                 depth = len(self._pending)
                 if depth >= self.max_pending:
@@ -1150,6 +1186,12 @@ class SlotGenerationEngine:
                     # request the instant it is visible in the queue)
                     req._slo = self._slo
                     self._pending.append(req)
+        if draining:
+            self._flightrec.record("shed", engine=self.engine_id,
+                                   reason="draining")
+            req._fail(RejectedError(
+                "engine draining for preemption — request shed"))
+            return req
         if shed_depth is not None:
             self._flightrec.record("shed", engine=self.engine_id,
                                    queue_depth=shed_depth)
@@ -1162,6 +1204,12 @@ class SlotGenerationEngine:
             req._fail(dead or RuntimeError(
                 "SlotGenerationEngine shut down"))
             return req
+        jr = self._journal
+        if jr is not None and req.journal_id is not None:
+            # write-ahead: the sub record lands before the caller can
+            # observe acceptance; a SIGKILL from here on recovers it
+            jr.submitted(req, route=route)
+            self._hook_journal(req)
         self._work.set()
         return req
 
@@ -1199,7 +1247,41 @@ class SlotGenerationEngine:
             req._fail(dead or RuntimeError(
                 "SlotGenerationEngine shut down"))
             return
+        jr = self._journal
+        if jr is not None and req.journal_id is not None:
+            # takeover/migration/recovery marker: replay-inert (the sub
+            # + ret records already carry the durable state), but the
+            # forensic timeline shows where each resume happened
+            jr.requeued(req)
+            self._hook_journal(req)
         self._work.set()
+
+    def _hook_journal(self, req: GenerationRequest) -> None:
+        """Attach the terminal-state journal callback exactly once per
+        request — the latch rides the request, so supervisor takeovers
+        and fleet migrations through other journaled engines never
+        double-attach. Fires outside every engine lock (the
+        done-callback contract); a zombie whose ``journal_id`` was
+        detached by migration journals nothing."""
+        with req._cb_lock:
+            hooked, req._journal_hooked = req._journal_hooked, True
+        if hooked:
+            return
+        jr = self._journal
+
+        def _fin(r, _jr=jr):
+            rid = r.journal_id
+            if rid is None:
+                return
+            err = r._error
+            if err is None:
+                _jr.finished(rid, "done")
+            elif isinstance(err, Cancelled):
+                _jr.finished(rid, "cancelled")
+            else:
+                _jr.finished(rid, "failed",
+                             error=f"{type(err).__name__}: {err}")
+        req.add_done_callback(_fin)
 
     # -------------------------------------------------------------- slots
     def _pop_for_admit(self) -> Optional[GenerationRequest]:
@@ -1383,6 +1465,8 @@ class SlotGenerationEngine:
             toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
             t_pre1 = time.monotonic()
             finishers: List[GenerationRequest] = []
+            jlog: List[Tuple] = []       # journal appends, written
+            #                              OUTSIDE the engine lock below
             with self._lock:
                 if self._shutdown or self._quarantined:
                     # a drain harvested the batch while we were in the
@@ -1396,6 +1480,10 @@ class SlotGenerationEngine:
                     self._admitting.remove(req)
                     tok = int(toks[i])
                     req._running = True
+                    if self._journal is not None and \
+                            req.journal_id is not None:
+                        jlog.append((req.journal_id, len(req.generated),
+                                     (tok,)))
                     req.generated.append(tok)
                     # SLO clocks: admitted/first-token stamped ONCE — a
                     # recovered request re-admitting after takeover keeps
@@ -1428,6 +1516,11 @@ class SlotGenerationEngine:
                     "admission", engine=self.engine_id, batch=m,
                     bucket=mb, tp=tp,
                     wait_ms=round((t_pre1 - t_pre0) * 1e3, 3))
+            if jlog:
+                # first tokens journaled BEFORE the finishers complete,
+                # outside the engine lock (GL010) — a done record never
+                # races ahead of the tokens it summarizes
+                self._journal.retired(jlog)
             for req in finishers:
                 req._complete()
             if drained:
@@ -1465,6 +1558,7 @@ class SlotGenerationEngine:
                                    k=1, ms=round((t_ret - t_disp) * 1e3,
                                                  3))
         finished: List[GenerationRequest] = []
+        jlog: List[Tuple] = []
         # token appends and slot frees are one critical section: a
         # concurrent quarantine() either runs before (we see empty slots
         # and append nothing) or after (it harvests the post-append
@@ -1477,6 +1571,10 @@ class SlotGenerationEngine:
                 if req is None:
                     continue
                 tok = int(nxt_host[s])
+                if self._journal is not None and \
+                        req.journal_id is not None:
+                    jlog.append((req.journal_id, len(req.generated),
+                                 (tok,)))
                 req.generated.append(tok)
                 emitted += 1
                 self._positions[s] += 1
@@ -1489,6 +1587,8 @@ class SlotGenerationEngine:
                     finished.append(req)
             self._m["emitted_tokens"].inc(emitted)
             self._first_step_done = True
+        if jlog:
+            self._journal.retired(jlog)   # one batched append, no locks
         for req in finished:
             req._complete()
 
@@ -1570,6 +1670,7 @@ class SlotGenerationEngine:
                                    k=k, lanes=len(snapshot),
                                    ms=round((t_ret - t_disp) * 1e3, 3))
         finished: List[GenerationRequest] = []
+        jlog: List[Tuple] = []
         with self._lock:
             if self._quarantined or self._shutdown:
                 return   # the drain owns the requests; recovery
@@ -1582,6 +1683,7 @@ class SlotGenerationEngine:
                                # the lane's tokens are overshoot
                 closed = False
                 took = 0
+                base = len(req.generated)
                 for c in range(k):
                     tok = int(host[s, c])
                     req.generated.append(tok)
@@ -1593,6 +1695,10 @@ class SlotGenerationEngine:
                         finished.append(req)
                         closed = True
                         break
+                if self._journal is not None and \
+                        req.journal_id is not None and took:
+                    jlog.append((req.journal_id, base,
+                                 req.generated[base:base + took]))
                 if req.trace is not None:
                     req.trace.add_span("decode_block", t_disp, t_ret,
                                        k=k, tokens=took)
@@ -1605,8 +1711,54 @@ class SlotGenerationEngine:
                 # freed lanes must not keep decoding from the device
                 # carry: resync (and let _admit refill) next dispatch
                 self._carry = None
+        if jlog:
+            # batched per block on the readback thread, OUTSIDE the
+            # engine lock (GL010-clean): one buffer write (and at most
+            # one fsync per the journal's policy) per decode block
+            self._journal.retired(jlog)
         for req in finished:
             req._complete()
+
+    # -------------------------------------------------------- preemption
+    def begin_drain(self) -> None:
+        """Close admission (new submissions shed with RejectedError)
+        while queued/decoding work continues — phase 1 of a preemption
+        drain (parallel/preemption.py)."""
+        with self._lock:
+            self._draining = True
+
+    def preempt_drain(self, budget: float = 10.0
+                      ) -> Tuple[List[GenerationRequest],
+                                 Optional[BaseException]]:
+        """Drain-or-die stop for preemption: close admission, park the
+        serve loop at the next block boundary (waiting at most
+        ``budget`` seconds — a loop wedged in a device call is
+        abandoned, not waited out), retire the in-flight decode block if
+        the loop stopped cleanly (its tokens are journaled and its
+        finished requests complete — work the re-prefill would otherwise
+        redo), then quarantine-harvest everything still live. Harvested
+        requests are NOT failed: their journal records stay open, and
+        post-restart recovery resumes them token-identically."""
+        t_end = time.monotonic() + max(0.0, float(budget))
+        with self._lock:
+            self._draining = True
+            self._drain_stop = True
+        self._work.set()
+        w = self._worker
+        if w is not None and w is not threading.current_thread():
+            w.join(timeout=max(0.0, t_end - time.monotonic()))
+        stale = None
+        with self._lock:
+            loop_stopped = w is None or not w.is_alive()
+            if loop_stopped and not (self._quarantined or self._shutdown):
+                stale, self._inflight = self._inflight, None
+        if stale is not None:
+            # budget-gated: retiring fetches the block (a device sync);
+            # with no budget left the tokens are abandoned instead —
+            # recovery regenerates them deterministically
+            if time.monotonic() < t_end:
+                self._retire_block(stale)
+        return self.quarantine()
 
     # ------------------------------------------------------- supervision
     def quarantine(self) -> Tuple[List[GenerationRequest],
@@ -1674,6 +1826,10 @@ class SlotGenerationEngine:
     def _serve_loop(self):
         try:
             while not self._shutdown:
+                if self._drain_stop:
+                    # preemption drain: park at a block boundary — the
+                    # handler retires the in-flight block and harvests
+                    return
                 beat = self._beat
                 if beat is not None:
                     beat()                    # supervisor liveness signal

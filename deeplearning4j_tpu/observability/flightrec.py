@@ -44,7 +44,8 @@ from .metrics import MetricsRegistry, default_registry
 #: the serving stack emits)
 EVENT_KINDS = ("admission", "block_retire", "shed", "takeover",
                "migration", "reconnect", "fault", "crash",
-               "replica_dead", "postmortem")
+               "replica_dead", "postmortem", "journal", "recovered",
+               "preempt")
 
 
 class FlightRecorder:

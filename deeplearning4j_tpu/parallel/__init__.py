@@ -28,6 +28,11 @@ from .failures import (EngineSupervisor, HeartbeatMonitor,
                        PreemptionHandler, run_elastic)
 from .faults import (Cancelled, DeadlineExceeded, FaultInjector,
                      RejectedError)
+# the SERVING drain handler (ISSUE 10) — exported under a distinct name
+# because failures.PreemptionHandler (training checkpoint-on-SIGTERM)
+# predates it and keeps its API
+from .preemption import DrainReport
+from .preemption import PreemptionHandler as ServingPreemptionHandler
 
 __all__ = ["make_mesh", "replicated", "batch_sharded", "generation_mesh",
            "mesh_tag", "parse_mesh_shape", "validate_decode_mesh",
@@ -43,5 +48,6 @@ __all__ = ["make_mesh", "replicated", "batch_sharded", "generation_mesh",
            "ParameterServerTrainer", "ParameterServerParallelWrapper",
            "EarlyStoppingParallelTrainer", "MagicQueue",
            "EngineSupervisor", "HeartbeatMonitor", "PreemptionHandler",
+           "ServingPreemptionHandler", "DrainReport",
            "run_elastic", "FaultInjector", "Cancelled", "DeadlineExceeded",
            "RejectedError"]

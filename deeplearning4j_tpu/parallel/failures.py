@@ -297,7 +297,10 @@ class EngineSupervisor(HeartbeatMonitor):
             tracing=old._tracing,    # same telemetry sinks too: requeued
             #                          requests CONTINUE their traces
             slo=old._slo, slo_label=old.slo_label,   # one stable SLO
-            flight_recorder=old._flightrec)          # label per replica
+            flight_recorder=old._flightrec,          # label per replica
+            journal=old._journal)   # restarts keep the durable journal:
+        #                             requeued requests keep appending
+        #                             under their original ids
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)
@@ -336,6 +339,19 @@ class EngineSupervisor(HeartbeatMonitor):
         if dead is not None and self.given_up is None:
             self._restart(cause=dead)
             eng = self._engine
+        return eng
+
+    def detach(self):
+        """Stop supervising WITHOUT shutting the engine down and return
+        the current engine — the preemption-drain seam
+        (parallel/preemption.py): the handler must drain the live
+        engine itself (retire the in-flight block, then harvest), and a
+        crash/wedge callback arriving mid-drain must not spin up a
+        replacement that would race the handoff."""
+        with self._sup_lock:
+            self._stopped = True
+            eng = self._engine
+        HeartbeatMonitor.stop(self)
         return eng
 
     def quarantine(self):

@@ -43,7 +43,10 @@ class ParallelInference:
                  generation_trace_store=None,
                  generation_tracing: bool = True,
                  generation_mesh=None,
-                 generation_spec_layout=None):
+                 generation_spec_layout=None,
+                 generation_journal_dir: Optional[str] = None,
+                 generation_journal_fsync: str = "every_n",
+                 generation_recover: bool = True):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
@@ -74,6 +77,15 @@ class ParallelInference:
         # the decode path tensor/FSDP-parallel; None = single device
         self.generation_mesh = generation_mesh
         self.generation_spec_layout = generation_spec_layout
+        # durable request journal (ISSUE 10): a directory turns on the
+        # write-ahead log; on the first generate() after a restart the
+        # facade recovers every unfinished journaled request (prompt +
+        # retired tokens, original SLO clocks) before serving new work
+        self.generation_journal_dir = generation_journal_dir
+        self.generation_journal_fsync = str(generation_journal_fsync)
+        self.generation_recover = bool(generation_recover)
+        self._gen_journal = None
+        self.last_recovery = None          # RecoveryReport of this boot
         self._telemetry = None
         self._jit_fwd = None
         self._lock = threading.Lock()
@@ -201,6 +213,13 @@ class ParallelInference:
                 raise RuntimeError("ParallelInference is shut down")
             if self._gen_engine is None:
                 from ..models.generation import SlotGenerationEngine
+                if self.generation_journal_dir and \
+                        self._gen_journal is None:
+                    from ..streaming.journal import RequestJournal
+                    self._gen_journal = RequestJournal(
+                        self.generation_journal_dir,
+                        fsync=self.generation_journal_fsync,
+                        registry=self.generation_registry)
                 engine = SlotGenerationEngine(
                     self.net, num_slots=self.generation_slots,
                     t_max=self.generation_t_max,
@@ -211,7 +230,8 @@ class ParallelInference:
                     trace_store=self.generation_trace_store,
                     tracing=self.generation_tracing,
                     mesh=self.generation_mesh,
-                    spec_layout=self.generation_spec_layout)
+                    spec_layout=self.generation_spec_layout,
+                    journal=self._gen_journal)
                 if self.generation_supervised:
                     from .failures import EngineSupervisor
                     self._gen_supervisor = EngineSupervisor(
@@ -221,6 +241,17 @@ class ParallelInference:
                 else:
                     engine.start()
                 self._gen_engine = engine
+                if self._gen_journal is not None and \
+                        self.generation_recover:
+                    # resume whatever a previous incarnation left
+                    # unfinished BEFORE new work is admitted — recovery
+                    # bypasses admission control like a takeover
+                    from ..streaming.journal import recover_from_journal
+                    self.last_recovery = recover_from_journal(
+                        self._gen_journal,
+                        self._gen_supervisor or self._gen_engine,
+                        trace_store=self.generation_trace_store,
+                        tracing=self.generation_tracing)
             return self._gen_supervisor or self._gen_engine
 
     def generate(self, prompt_ids, max_new_tokens: int,
@@ -292,3 +323,7 @@ class ParallelInference:
             sup.stop()
         elif eng is not None:
             eng.shutdown()
+        jr = self._gen_journal
+        self._gen_journal = None
+        if jr is not None:
+            jr.close()

@@ -4,6 +4,8 @@ SURVEY.md §2.4)."""
 
 from .fleet import (EngineFleetRouter, EngineReplica, FleetLedger,
                     FleetMembership, FleetRequest, KVFleetMembership)
+from .journal import (RecoveryReport, RequestJournal, recover_from_journal,
+                      replay_journal)
 from .pubsub import (MessageBroker, NDArrayPublisher, NDArraySubscriber,
                      NDArrayStreamClient)
 from .serving import ModelServingRoute
@@ -13,4 +15,5 @@ __all__ = ["MessageBroker", "NDArrayPublisher", "NDArraySubscriber",
            "NDArrayStreamClient", "ModelServingRoute", "TcpBrokerServer",
            "TcpMessageBroker", "EngineFleetRouter", "EngineReplica",
            "FleetLedger", "FleetMembership", "FleetRequest",
-           "KVFleetMembership"]
+           "KVFleetMembership", "RequestJournal", "RecoveryReport",
+           "recover_from_journal", "replay_journal"]

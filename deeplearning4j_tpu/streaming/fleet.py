@@ -228,11 +228,25 @@ class KVFleetMembership:
     the way ``host_allreduce_mean`` stages buffers through it.
 
     The store is WRITE-ONCE, so beats are sequence-numbered keys
-    (``dl4j/fleet/<fleet>/<rid>/<seq>``) rather than overwrites, and
-    liveness is *sequence advancement observed locally*: ``ages()``
-    reports seconds since this process last saw a member's seq move —
-    no cross-host clock is ever compared. A member leaves by writing a
-    ``<rid>/left`` tombstone (once, naturally write-once-safe).
+    (``dl4j/fleet/<fleet>/<rid>/<epoch>-<seq>``) rather than
+    overwrites, and liveness is *sequence advancement observed
+    locally*: ``ages()`` reports seconds since this process last saw a
+    member's (epoch, seq) move — no cross-host clock is ever compared.
+    A member leaves by writing a ``<rid>/left`` tombstone (once,
+    naturally write-once-safe).
+
+    ``epoch`` is a per-BOOT id (wall-clock milliseconds by default,
+    r15): a replica restarted after a whole-process kill starts its seq
+    back at 1, and without the epoch its first beats would (a) collide
+    with the dead incarnation's write-once keys and be silently
+    dropped, and (b) lose the ``latest`` scan to the old incarnation's
+    higher seq — the rejoin would look permanently dead. Epoch-seq
+    ordering is lexicographic on the (epoch, seq) pair, so a new boot's
+    first beat always supersedes every beat of an older boot; legacy
+    plain-``<seq>`` keys parse as epoch 0. (One-way compatibility:
+    r15 readers understand pre-r15 keys, but a pre-r15 reader skips
+    epoch keys as unparseable — in a mixed-version fleet, upgrade the
+    ROUTER/observer side first.)
 
     Because the store is write-once, old beat keys ACCUMULATE — the
     coordinator footprint and per-scan directory size grow with total
@@ -243,28 +257,68 @@ class KVFleetMembership:
     through this seam (``heartbeat_interval`` ≥ 0.5s) rather than at
     the in-process default."""
 
-    def __init__(self, client, fleet_id: str = "fleet0"):
+    def __init__(self, client, fleet_id: str = "fleet0",
+                 epoch: Optional[int] = None):
         self._client = client
         self.fleet_id = str(fleet_id)
         self._prefix = f"dl4j/fleet/{self.fleet_id}/"
         self._lock = threading.Lock()
+        # boot id: unique per incarnation (ms wall clock — collisions
+        # would need two boots of the SAME replica id within 1ms). A
+        # host whose clock stepped BACKWARD across the restart (pre-NTP
+        # boot window) would mint a lower epoch and lose every (epoch,
+        # seq) comparison to the dead incarnation — the first beat
+        # scans the store once and bumps past any observed epoch.
+        self.epoch = int(time.time() * 1000) if epoch is None \
+            else int(epoch)
+        self._epoch_ready = False
         self._seq: Dict[str, int] = {}
-        # rid -> [last seq seen, local time it changed, load it carried]
+        # rid -> [last (epoch, seq) seen, local time it changed, load]
         self._seen: Dict[str, List] = {}
 
     def register(self, replica_id: str) -> None:
         self.beat(replica_id, 0)
 
+    def _max_observed_epoch(self) -> int:
+        try:
+            entries = self._client.key_value_dir_get(self._prefix)
+        except Exception:   # noqa: BLE001 — no scan, trust wall clock
+            return -1
+        mx = -1
+        for key, _ in entries:
+            rest = str(key)[len(self._prefix):] \
+                if str(key).startswith(self._prefix) else str(key)
+            _, _, tail = rest.partition("/")
+            ep_s, dash, _ = tail.partition("-")
+            if dash:
+                try:
+                    mx = max(mx, int(ep_s))
+                except ValueError:
+                    continue
+        return mx
+
     def beat(self, replica_id: str, load: int) -> None:
+        with self._lock:
+            ready = self._epoch_ready
+            self._epoch_ready = True
+        if not ready:
+            # one-time monotonicity guard: our epoch must exceed every
+            # epoch already in the store, or a backward-stepped clock
+            # recreates the permanently-dead-rejoin bug epochs fix
+            mx = self._max_observed_epoch()
+            with self._lock:
+                if mx >= self.epoch:
+                    self.epoch = mx + 1
         with self._lock:
             self._seq[replica_id] = self._seq.get(replica_id, 0) + 1
             seq = self._seq[replica_id]
-        payload = json.dumps({"load": int(load)})
+        payload = json.dumps({"load": int(load), "epoch": self.epoch})
         try:
             self._client.key_value_set(
-                f"{self._prefix}{replica_id}/{seq:08d}", payload)
-        except Exception:   # noqa: BLE001 — a dup key (restarted beater
-            pass            # replaying a seq) is a missed beat, not fatal
+                f"{self._prefix}{replica_id}/{self.epoch:016d}-{seq:08d}",
+                payload)
+        except Exception:   # noqa: BLE001 — a dup key (two beaters
+            pass            # sharing an epoch) is a missed beat, not fatal
 
     def leave(self, replica_id: str) -> None:
         try:
@@ -281,7 +335,7 @@ class KVFleetMembership:
         now = time.monotonic()
         with self._lock:
             if entries is not None:
-                latest: Dict[str, Tuple[int, str]] = {}
+                latest: Dict[str, Tuple[Tuple[int, int], str]] = {}
                 left = set()
                 for key, val in entries:
                     rest = str(key)[len(self._prefix):] \
@@ -290,25 +344,33 @@ class KVFleetMembership:
                     if tail == "left":
                         left.add(rid)
                         continue
+                    # epoch-seq beat key; a legacy plain-seq key (or a
+                    # pre-r15 writer) parses as epoch 0, so a rejoining
+                    # boot's first beat always supersedes it
+                    ep_s, dash, seq_s = tail.partition("-")
                     try:
-                        seq = int(tail)
+                        stamp = (int(ep_s), int(seq_s)) if dash \
+                            else (0, int(tail))
                     except ValueError:
                         continue
-                    if seq > latest.get(rid, (-1, ""))[0]:
-                        latest[rid] = (seq, val)
+                    if stamp > latest.get(rid, ((-1, -1), ""))[0]:
+                        latest[rid] = (stamp, val)
                 for rid in left:
                     self._seen.pop(rid, None)
                     latest.pop(rid, None)
-                for rid, (seq, val) in latest.items():
+                for rid, (stamp, val) in latest.items():
                     rec = self._seen.get(rid)
-                    if rec is None or rec[0] != seq:
-                        # payload parsed only on seq ADVANCEMENT — an
-                        # unchanged seq is the same beat (same load)
+                    if rec is None or rec[0] != stamp:
+                        # payload parsed only on (epoch, seq)
+                        # ADVANCEMENT — an unchanged stamp is the same
+                        # beat (same load); a NEW epoch with a lower seq
+                        # (process restart) advances like any fresh beat
+                        # instead of being discarded as a regression
                         try:
                             load = int(json.loads(val).get("load", 0))
                         except (ValueError, TypeError):
                             continue
-                        self._seen[rid] = [seq, now, load]
+                        self._seen[rid] = [stamp, now, load]
             return {rid: (now - t, load)
                     for rid, (_, t, load) in self._seen.items()}
 
@@ -570,7 +632,8 @@ class EngineFleetRouter:
                  completed_window: int = 4096,
                  registry=None, trace_store=None, tracing: bool = True,
                  slo_tracker=None, flight_recorder=None,
-                 postmortem_dir: Optional[str] = None):
+                 postmortem_dir: Optional[str] = None,
+                 journal=None):
         self.fleet_id = fleet_id if fleet_id is not None \
             else f"fleet{next(_FLEET_SEQ)}"
         self._registry = registry if registry is not None \
@@ -588,6 +651,12 @@ class EngineFleetRouter:
         self._flightrec = flight_recorder if flight_recorder is not None \
             else default_flight_recorder()
         self._postmortem_dir = postmortem_dir
+        # durable request journal (ISSUE 10): ONE shared WAL for the
+        # whole fleet (appends are journal-lock serialized); dispatches
+        # journal under the FLEET request id, so a restarted process's
+        # recovery and a surviving router's clone re-dispatch are
+        # arbitrated by the same ledger fence over the same ids
+        self._journal = journal
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
         self._membership = membership if membership is not None \
@@ -621,7 +690,8 @@ class EngineFleetRouter:
                     registry=self._registry,
                     trace_store=self._trace_store, tracing=self._tracing,
                     slo=self._slo_tracker, slo_label=f"r{i}",
-                    flight_recorder=self._flightrec)
+                    flight_recorder=self._flightrec,
+                    journal=journal)
                 if supervised:
                     from ..parallel.failures import EngineSupervisor
                     eng = EngineSupervisor(
@@ -737,10 +807,14 @@ class EngineFleetRouter:
             # request the fleet goes on to serve elsewhere — sync
             # outcomes the fleet DOES propagate are accounted by the
             # completion gate (_on_inner_done) instead
+            # journal_id=fleet id: the WAL and the exactly-once ledger
+            # speak the same id space, so post-restart recovery is
+            # fenced against clone re-dispatch by the same arbiter
             inner = rep.submit(fr.prompt, fr.max_new_tokens,
                                temperature=fr.temperature,
                                eos_id=fr.eos_id, deadline=fr.deadline,
-                               route=route, _slo_sync_fail=False)
+                               route=route, journal_id=fr.request_id,
+                               _slo_sync_fail=False)
             err = inner._error if inner.done() else None
             if isinstance(err, RejectedError):
                 total_depth += rep.capacity   # raced to saturation
@@ -1073,6 +1147,16 @@ class EngineFleetRouter:
         clone.deadline = fr.deadline
         clone._deadline_t = fr._deadline_t      # original ABSOLUTE deadline
         clone._cancel_requested = fr._cancel_requested
+        # the clone inherits the durable id; the zombie's is DETACHED so
+        # its engine stops journaling retires (and its terminal callback
+        # journals nothing) for the id the clone now owns. Straggler
+        # ``ret`` records that raced the detach are harmless (replay
+        # places tokens by absolute offset); a straggler ``fin`` is
+        # neutralized at recovery by the ledger: an id terminal-on-disk
+        # but still ASSIGNED in the ledger is resurrected
+        # (recover_from_journal — the completion fence is the arbiter,
+        # not the zombie's last write)
+        clone.journal_id = fr.request_id
         # SLO clock continuity: the clone inherits the ORIGINAL
         # created/admitted/first-token stamps, so headroom and TTFT are
         # measured from the real submission — migration resets nothing
@@ -1102,6 +1186,7 @@ class EngineFleetRouter:
             with old_inner._cb_lock:
                 old_inner._slo = None
             clone._slo_done = old_inner._slo_done
+            old_inner.journal_id = None
         return clone
 
     # --------------------------------------------------------- monitoring
@@ -1196,6 +1281,13 @@ class EngineFleetRouter:
     stop = shutdown             # route/supervisor-style alias
 
     # --------------------------------------------------------------- views
+    @property
+    def ledger(self) -> FleetLedger:
+        """The exactly-once arbiter — ``recover_from_journal(...,
+        ledger=router.ledger, replica_id=...)`` fences a restarted
+        replica's recovery against clone re-dispatch through it."""
+        return self._ledger
+
     def replica_ids(self) -> List[str]:
         return sorted(self._replicas)
 
@@ -1275,6 +1367,8 @@ class EngineFleetRouter:
         return {"fleet": self.fleet_id,
                 "replicas": table,
                 "ledger": self._ledger.to_dict(),
+                "journal": None if self._journal is None
+                else self._journal.stats(),
                 "slo": {"attainment_short":
                         round(self._slo_tracker.attainment(
                             self._slo_tracker.short_window), 6),

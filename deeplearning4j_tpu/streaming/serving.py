@@ -247,7 +247,7 @@ class GenerationServingRoute(_RoutePublishMixin):
                  publish_retries: int = 3, retry_backoff: float = 0.05,
                  fault_injector=None, block_size: int = 1, registry=None,
                  trace_store=None, tracing: bool = True, mesh=None,
-                 spec_layout=None):
+                 spec_layout=None, journal=None):
         self._owns_engine = engine is None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
@@ -272,6 +272,9 @@ class GenerationServingRoute(_RoutePublishMixin):
             # mesh= (r12): the route-owned engine decodes tensor/FSDP-
             # parallel over a named (data, tp) mesh; a supervisor-
             # wrapped or prebuilt engine carries its own mesh
+            # journal= (ISSUE 10): the route-owned engine write-ahead
+            # logs its requests; a prebuilt engine/supervisor carries
+            # its own journal the same way it carries its mesh
             engine = SlotGenerationEngine(net, num_slots=num_slots,
                                           t_max=t_max,
                                           fault_injector=self._faults,
@@ -279,7 +282,8 @@ class GenerationServingRoute(_RoutePublishMixin):
                                           registry=registry,
                                           trace_store=trace_store,
                                           tracing=tracing, mesh=mesh,
-                                          spec_layout=spec_layout)
+                                          spec_layout=spec_layout,
+                                          journal=journal)
         self.engine = engine
         self.broker = broker
         self.input_topic = input_topic

@@ -30,9 +30,11 @@ SEPARATE-PROCESS replicas, each running its own TelemetryServer: it
 fetches every replica's ``/snapshot`` and merges them into one
 document — aggregate SLO attainment/burn (windows pooled by summing
 met/n across replicas), a per-replica health table (reachability,
-uptime, attainment, deadline-headroom quantiles, KV-cache bytes), and
-fleet-wide summed counters. An unreachable replica degrades to a
-``down`` row; the merge never fails the scrape.
+uptime, attainment, deadline-headroom quantiles, KV-cache bytes,
+durable-journal backlog/degraded state), and fleet-wide summed
+counters. An unreachable replica degrades to a ``down`` row; the
+merge never fails the scrape. ``--fleet`` likewise prints each fleet
+source's journal health line when the router carries a RequestJournal.
 
 ``--watch SECS`` re-samples the target (single URL or ``--scrape``
 set) every SECS seconds and prints DELTAS between samples — counter
@@ -150,6 +152,15 @@ def pretty_fleet(snapshot: dict, out=sys.stdout) -> int:
         led = src["ledger"]
         w("  ledger: " + " ".join(f"{k}={led[k]}" for k in sorted(led))
           + "\n")
+        jr = src.get("journal")
+        if isinstance(jr, dict):
+            w(f"  journal: pending={jr.get('pending')} "
+              f"degraded={'Y' if jr.get('degraded') else 'n'} "
+              f"bytes={jr.get('bytes')} "
+              f"segments={jr.get('segments')} "
+              f"fsync={jr.get('fsync_policy')} "
+              f"dropped={jr.get('dropped_records')} "
+              f"recovered={jr.get('recovered_requests')}\n")
         counters = src.get("counters") or {}
         if counters:
             w("  counters: " + " ".join(f"{k}={counters[k]}"
@@ -197,6 +208,18 @@ def _kv_bytes(snap: dict):
     return sum(vals) if vals else None
 
 
+def _gauge_sum(snap: dict, family: str):
+    """Sum a gauge family's children from a snapshot's metrics (e.g.
+    ``journal_pending`` across a replica's journals); None when the
+    family is absent."""
+    doc = (snap.get("metrics") or {}).get(family) or {}
+    if doc.get("type") != "gauge":
+        return None
+    vals = [v for v in (doc.get("values") or {}).values()
+            if isinstance(v, (int, float))]
+    return sum(vals) if vals else None
+
+
 def merge_snapshots(per_url: dict) -> dict:
     """Merge N ``/snapshot`` documents (keyed by replica URL) into the
     fleet summary — pure dict math, reused by the one-shot scrape, the
@@ -227,6 +250,12 @@ def merge_snapshots(per_url: dict) -> dict:
         row["headroom_p50_s"] = head.get("p50")
         row["headroom_min_s"] = head.get("min")
         row["ttft_p99_s"] = (overall.get("ttft_s") or {}).get("p99")
+        # journal health (ISSUE 10): durable-WAL backlog + degraded flag
+        # per replica — a degraded journal means the replica serves with
+        # no durability and deserves the same attention as a missed SLO
+        row["journal_pending"] = _gauge_sum(snap, "journal_pending")
+        deg = _gauge_sum(snap, "journal_degraded")
+        row["journal_degraded"] = None if deg is None else bool(deg)
         if target is None and slo.get("target") is not None:
             target = float(slo["target"])
         requests += int(slo.get("requests") or 0)
@@ -258,19 +287,22 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
     w(f"fleet scrape: {doc['up']}/{doc['scraped']} replicas up\n")
     w(f"  {'replica':<36} {'up':>2} {'uptime':>8} {'att-short':>9} "
       f"{'att-long':>8} {'reqs':>6} {'miss':>5} {'hd-p50':>8} "
-      f"{'hd-min':>8} {'kv-bytes':>10}\n")
+      f"{'hd-min':>8} {'kv-bytes':>10} {'j-pend':>6} {'j-deg':>5}\n")
     fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
     for base, row in sorted(doc["replicas"].items()):
         if not row.get("up"):
             w(f"  {base:<36}  n  DOWN ({row.get('error', '?')})\n")
             continue
+        jd = row.get("journal_degraded")
         w(f"  {base:<36} {'y':>2} {fmt(row.get('uptime_s')):>8} "
           f"{fmt(row.get('attainment_short')):>9} "
           f"{fmt(row.get('attainment_long')):>8} "
           f"{fmt(row.get('requests')):>6} {fmt(row.get('missed')):>5} "
           f"{fmt(row.get('headroom_p50_s')):>8} "
           f"{fmt(row.get('headroom_min_s')):>8} "
-          f"{fmt(row.get('kv_cache_bytes')):>10}\n")
+          f"{fmt(row.get('kv_cache_bytes')):>10} "
+          f"{fmt(row.get('journal_pending')):>6} "
+          f"{'-' if jd is None else ('Y' if jd else 'n'):>5}\n")
     agg = doc["slo"]
     w(f"  fleet SLO (target {agg['target']}): "
       f"attainment short={agg['attainment_short']} "

@@ -226,6 +226,7 @@ class TestGcloudFailureSemantics:
 # from the cloud-provisioning lifecycle above.
 # ======================================================================
 
+import json
 import threading
 import time
 
@@ -393,6 +394,64 @@ class TestFleetMembership:
         m.leave("r0")
         m.leave("r0")                      # second leave: already gone
         assert "r0" not in m.ages()
+
+    def test_kv_membership_rejoin_after_process_restart(self):
+        """Satellite (r15): a replica that dies and restarts starts its
+        seq back at 1. Pre-epoch, its first beats (a) collided with the
+        dead incarnation's write-once keys and were silently swallowed
+        and (b) lost the latest-beat scan to the old incarnation's
+        higher seq — the rejoined replica aged into DEAD forever. The
+        per-boot epoch in the key (and payload) fixes both: (epoch,
+        seq) ordering makes a new boot's first beat supersede every
+        old-boot beat."""
+        kv = _FakeKVClient()
+        boot1 = KVFleetMembership(kv, fleet_id="t3", epoch=1000)
+        for i in range(5):
+            boot1.beat("r0", i)            # old incarnation: seq → 5
+        obs = KVFleetMembership(kv, fleet_id="t3", epoch=7)  # router view
+        assert obs.ages()["r0"][1] == 4
+        time.sleep(0.08)
+        assert obs.ages()["r0"][0] >= 0.08   # boot1 silent: aging out
+        # whole-process restart: fresh instance, seq resets, NEW epoch
+        boot2 = KVFleetMembership(kv, fleet_id="t3", epoch=2000)
+        boot2.beat("r0", 9)                  # seq 1 < dead boot's 5
+        age, load = obs.ages()["r0"]
+        assert age < 0.05, "rejoin beat discarded as a seq regression"
+        assert load == 9
+        # the beat actually landed (epoch key ≠ old write-once keys)
+        keys = [k for k, _ in kv.key_value_dir_get("dl4j/fleet/t3/")]
+        assert any(f"{2000:016d}-" in k for k in keys), keys
+
+    def test_kv_membership_backward_clock_bumps_past_observed_epoch(
+            self):
+        """Second-round review fix: a replacement VM whose clock
+        stepped BACKWARD (pre-NTP boot) would mint a lower epoch and
+        lose every (epoch, seq) comparison to the dead incarnation —
+        the first beat scans the store and bumps past any observed
+        epoch."""
+        kv = _FakeKVClient()
+        boot1 = KVFleetMembership(kv, fleet_id="t5", epoch=5000)
+        boot1.beat("r0", 1)
+        obs = KVFleetMembership(kv, fleet_id="t5", epoch=7)
+        time.sleep(0.06)
+        # restarted replica, clock behind: naive epoch 100 < dead 5000
+        boot2 = KVFleetMembership(kv, fleet_id="t5", epoch=100)
+        boot2.beat("r0", 8)
+        assert boot2.epoch == 5001          # bumped past the store
+        age, load = obs.ages()["r0"]
+        assert age < 0.05 and load == 8     # rejoin observed as fresh
+
+    def test_kv_membership_legacy_plain_seq_keys_parse_as_epoch0(self):
+        """Pre-r15 writers beat with plain-seq keys; they read as epoch
+        0, so any epoch-carrying boot supersedes them."""
+        kv = _FakeKVClient()
+        kv.key_value_set("dl4j/fleet/t4/r0/00000042",
+                         json.dumps({"load": 5}))
+        obs = KVFleetMembership(kv, fleet_id="t4", epoch=3)
+        assert obs.ages()["r0"][1] == 5
+        boot = KVFleetMembership(kv, fleet_id="t4", epoch=9000)
+        boot.beat("r0", 2)
+        assert obs.ages()["r0"][1] == 2      # epoch beat wins
 
     def test_kv_membership_drives_a_router(self, fleet_net):
         """The cross-process seam end-to-end in-process: replicas beat

