@@ -66,6 +66,12 @@ from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
 #: or with batched-admission prefill keys
 ENGINE_KEY_SALT = 1 << 20
 PREFILL_BATCH_SALT = 1 << 21
+CHUNK_SALT = 1 << 22
+
+#: submission order for the EDF tie-break: two requests with the same
+#: deadline (or none) pop FIFO — rides the request across requeues and
+#: migrations, so recovered work keeps its place in the tie order
+_REQ_SEQ = itertools.count()
 
 #: registry-backed serving counters (ISSUE 5): stats() keys → help text.
 #: The source of truth is the metrics registry (one labeled child per
@@ -81,7 +87,11 @@ _ENGINE_COUNTERS = {
     "host_readbacks": "deliberate device→host syncs in the serve loop",
     "prefills": "requests admitted (prefilled into a cache slot)",
     "prefill_batches": "coalesced batched-admission prefill calls",
-    "rejected": "admission-control sheds (bounded pending queue)",
+    "prefill_chunks": "chunked-prefill device dispatches (long prompts)",
+    "rejected": "admission-control sheds (queue bound or projected "
+                "deadline miss)",
+    "headroom_shed": "admission sheds on projected deadline miss "
+                     "(headroom policy; subset of rejected)",
     "deadline_exceeded": "requests failed by per-request deadline",
     "cancelled": "requests cancelled by their caller",
     "requeued": "requests recovered into this engine after a takeover",
@@ -336,6 +346,38 @@ class TransformerDecoder:
         return logits.astype(jnp.float32), new_caches
 
     # graftlint: traced
+    def _walk_chunk(self, params, state, caches, tokens, pos0, valid):
+        """One chunked-prefill window: tokens [B, C] at absolute start
+        positions ``pos0`` [B] → (logits at each row's LAST real window
+        position [B, V] f32, new caches). The chunk attends earlier
+        chunks' context through the cache (chunk_forward), so a long
+        prompt prefills in bounded windows interleaved with decode
+        blocks instead of one monopolizing device program."""
+        conf = self.net.conf
+        acts = {self.input_name: tokens}
+        new_caches = {}
+        logits = None
+        for name in conf.topological_order:
+            v = conf.vertices[name]
+            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            if isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, TokenAndPositionEmbedding):
+                acts[name] = v.layer.embed_chunk(params[name], xs[0], pos0)
+            elif isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, SelfAttentionLayer):
+                acts[name], new_caches[name] = v.layer.chunk_forward(
+                    params[name], xs[0], caches[name], pos0)
+            elif name == self.output_name:
+                idx = jnp.clip(valid - 1, 0)[:, None, None]
+                h_last = jnp.take_along_axis(xs[0], idx, axis=1)
+                logits = v.layer.preoutput(params[name], h_last)[:, 0]
+            else:
+                y, _ = v.forward(params[name], state[name], xs, train=False,
+                                 rng=None, masks=[None] * len(xs))
+                acts[name] = y
+        return logits.astype(jnp.float32), new_caches
+
+    # graftlint: traced
     def _walk_recompute(self, params, state, tokens, lengths):
         """Full teacher-forced forward over the padded context + gather of
         the last real position's logits — the per-token program of the
@@ -489,6 +531,43 @@ class TransformerDecoder:
                 prefill_slots_impl, donate,
                 in_specs=(psh, None, csh, None, None, None, None, None),
                 out_specs=(None, None, csh))
+        elif isinstance(name, tuple) and name[0] == "chunk":
+            c_len = int(name[1])
+
+            def prefill_chunk_impl(params, state, caches, tokens, pos0,
+                                   valid, slot, temps, key):
+                # one slot's [1, C] prompt window prefilled into the
+                # SHARED cache at [pos0, pos0+C): slice the slot row,
+                # run the chunk walk (embed at absolute positions,
+                # chunk attention over the already-filled cells),
+                # scatter the row back. Bounded device work per
+                # dispatch — decode blocks interleave between chunks,
+                # so one 10k-token prompt cannot stall every stream.
+                z = jnp.zeros((), jnp.int32)
+                c1 = {n: {kk: jax.lax.dynamic_slice_in_dim(
+                              caches[n][kk], slot[0], 1, axis=0)
+                          for kk in ("k", "v")}
+                      for n in self.attn_names}
+                logits, c1 = self._walk_chunk(params, state, c1, tokens,
+                                              pos0, valid)
+                merged = {n: {kk: jax.lax.dynamic_update_slice(
+                                  caches[n][kk], c1[n][kk],
+                                  (slot[0], z, z, z))
+                              for kk in ("k", "v")}
+                          for n in self.attn_names}
+                return self._select(logits, temps, key), merged
+            # per-chunk-size name, like the per-K decode blocks: two
+            # chunk sizes share every input rank and a bare shared name
+            # would read as a blown jit cache in the compile audit
+            prefill_chunk_impl.__name__ = f"prefill_chunk{c_len}_impl"
+            # the batch-1 slice/scatter crosses the data axis on a
+            # sharded cache; like prefill_slots, only the SHARED cache
+            # keeps its pinned layout through the scatter
+            fn = self._jit_sharded(
+                prefill_chunk_impl, donate,
+                in_specs=(psh, None, csh, None, None, None, None, None,
+                          None),
+                out_specs=(None, csh))
         elif isinstance(name, tuple) and name[0] == "block":
             k_steps = int(name[1])
 
@@ -545,6 +624,8 @@ class TransformerDecoder:
                 "prefill_slots": "prefill_slots_impl"}.get(name)
         if base is None and isinstance(name, tuple) and name[0] == "block":
             base = f"decode_block{int(name[1])}_impl"
+        if base is None and isinstance(name, tuple) and name[0] == "chunk":
+            base = f"prefill_chunk{int(name[1])}_impl"
         return (base or str(name)) + self._impl_suffix
 
     def _with_cost_seam(self, name, jitted):
@@ -763,6 +844,7 @@ class GenerationRequest:
         self._deadline_t = None if deadline is None \
             else time.monotonic() + float(deadline)
         self.generated: List[int] = []
+        self._seq = next(_REQ_SEQ)       # EDF tie-break: FIFO by creation
         self._done = threading.Event()
         self._result: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -956,6 +1038,20 @@ class SlotGenerationEngine:
     so far. ``fault_injector`` arms the ``engine.step`` /
     ``engine.prefill`` injection points (parallel/faults.py).
 
+    Scheduling tier (ISSUE 11) — all off by default, legacy behaviour
+    bit-preserved: ``scheduling="edf"`` pops the earliest absolute
+    deadline first (FIFO tie-break, no-deadline last);
+    ``shed_headroom=True`` rejects a request at admission when the
+    measured prefill/per-step EWMAs project it cannot make its
+    deadline (``RejectedError.projected_miss_s``, exactly one SLO miss
+    per shed); ``prefill_chunk=C`` fills long prompts' caches in
+    C-token windows interleaved with decode blocks (one window per
+    serve-loop cycle — a 10k-token prompt cannot stall every stream);
+    ``adaptive_block=True`` chooses K live per wave from queue depth,
+    capped by the measured block latency, over ``block_ladder`` rungs
+    that are all warmed at construction (a burst's first escalation to
+    a bigger K must never stall the loop on a compile).
+
     Synchronous use: ``submit(...)`` then ``run_until_drained()``.
     Serving use: ``start()`` spins a worker thread that blocks on the
     queue (ParallelInference.generate / GenerationServingRoute)."""
@@ -967,7 +1063,13 @@ class SlotGenerationEngine:
                  block_size: int = 1, registry=None, trace_store=None,
                  tracing: bool = True, mesh=None, spec_layout=None,
                  slo=None, slo_label=None, flight_recorder=None,
-                 journal=None):
+                 journal=None, scheduling: str = "fifo",
+                 shed_headroom: bool = False,
+                 headroom_margin: float = 1.0,
+                 prefill_chunk: Optional[int] = None,
+                 adaptive_block: bool = False,
+                 block_ladder: Optional[Sequence[int]] = None,
+                 block_latency_target: float = 0.25):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -997,8 +1099,47 @@ class SlotGenerationEngine:
         self.refill = bool(refill)
         self.seed = int(seed)
         self.max_pending = int(max_pending)
-        self.block_size = max(1, int(block_size))
         self.t_max = self.decoder.t_max
+        # ---- scheduling policy tier (ISSUE 11) ----
+        # queue order: "fifo" (legacy) or "edf" — earliest absolute
+        # deadline pops first, FIFO tie-break on equal deadlines,
+        # no-deadline requests order FIFO after every deadlined one
+        if scheduling not in ("fifo", "edf"):
+            raise ValueError(f"scheduling must be 'fifo' or 'edf', "
+                             f"got {scheduling!r}")
+        self.scheduling = scheduling
+        # shed-by-headroom: a request whose projected service time
+        # (measured prefill + per-step EWMAs) exceeds its remaining
+        # deadline headroom is REJECTED at admission with the projected
+        # miss, instead of decoded into a guaranteed DeadlineExceeded
+        self.shed_headroom = bool(shed_headroom)
+        self.headroom_margin = float(headroom_margin)
+        # chunked prefill: prompts longer than this prefill in bounded
+        # windows interleaved with decode blocks (None = whole-prompt
+        # batched admission, the legacy path)
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if not 1 <= prefill_chunk <= self.t_max:
+                raise ValueError(f"prefill_chunk {prefill_chunk} must be "
+                                 f"in [1, t_max={self.t_max}]")
+        self.prefill_chunk = prefill_chunk
+        # adaptive decode block size: K chosen live per wave from queue
+        # depth and the measured per-step latency, over a ladder of
+        # already-compiled decode_block{K}_impl rungs
+        self.adaptive_block = bool(adaptive_block)
+        ladder = tuple(sorted({int(k) for k in
+                               (block_ladder or (1, 2, 4, 8))}))
+        if any(k < 1 for k in ladder):
+            raise ValueError(f"block_ladder rungs must be >= 1: {ladder}")
+        self.block_ladder = ladder if self.adaptive_block \
+            else (max(1, int(block_size)),)
+        self.block_size = max(self.block_ladder) if self.adaptive_block \
+            else max(1, int(block_size))
+        self.block_latency_target = float(block_latency_target)
+        # latency account the policies read: EWMA seconds per decode
+        # step and per prefill dispatch, written under the engine lock
+        self._est_step: Optional[float] = None
+        self._est_prefill: Optional[float] = None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
         self._caches = self.decoder.init_cache(self.num_slots)
@@ -1015,6 +1156,13 @@ class SlotGenerationEngine:
         # fetched one cycle later (double buffering)
         self._carry = None
         self._inflight = None
+        # chunked-prefill state: slot → [request, full context array,
+        # tokens filled so far]. A chunking slot is OCCUPIED (the free
+        # list skips it) but not decoding yet — its lanes launch frozen
+        # until the final chunk lands the first token. Round-robin
+        # pointer interleaves multiple long prompts fairly.
+        self._chunking: Dict[int, List] = {}
+        self._chunk_rr = 0
         self._pending: collections.deque = collections.deque()
         # requests popped from the queue but not yet landed in a slot:
         # parked here so a concurrent quarantine()/shutdown() drain can
@@ -1077,6 +1225,12 @@ class SlotGenerationEngine:
             "generation_decode_block_seconds",
             "host wall time per decode block, dispatch to retire",
             ("engine",)).labels(self.engine_id)
+        # adaptive-K visibility (ISSUE 11): blocks dispatched per chosen
+        # rung — the policy's live distribution on /metrics
+        self._m_k = reg.counter(
+            "generation_adaptive_k_total",
+            "decode blocks dispatched, by adaptively chosen K",
+            ("engine", "k"))
         # depth gauges evaluate lazily at collection time through a WEAK
         # reference: the process-default registry must never keep a dead
         # engine (and its device caches) alive
@@ -1084,10 +1238,28 @@ class SlotGenerationEngine:
         reg.gauge("generation_queue_depth", "pending requests queued",
                   ("engine",)).labels(self.engine_id).set_function(
             lambda: (lambda s: 0 if s is None else len(s._pending))(wself()))
-        reg.gauge("generation_active_slots", "cache slots decoding",
+        reg.gauge("generation_active_slots",
+                  "cache slots decoding or chunk-prefilling",
                   ("engine",)).labels(self.engine_id).set_function(
             lambda: (lambda s: 0 if s is None else
-                     sum(r is not None for r in s._slots))(wself()))
+                     sum(r is not None for r in s._slots) +
+                     len(s._chunking))(wself()))
+        # adaptive-K rungs warm at CONSTRUCTION: the first escalation
+        # to a bigger K under a traffic burst must not block the serve
+        # loop on a jit compile — that stall would land exactly when
+        # the queue is deepest, blowing the deadlines EDF/headroom
+        # protect. All lanes dispatch frozen at the parking cell
+        # (t_max-1), so the warmup writes only cells the decode
+        # write-head overwrites before they are ever attended; caches
+        # are donated per dispatch, so the returned ones thread through.
+        if self.adaptive_block:
+            w_ids = np.zeros(self.num_slots, np.int32)
+            w_pos = np.full(self.num_slots, self.t_max - 1, np.int32)
+            w_stop = np.ones(self.num_slots, bool)
+            for k in self.block_ladder:
+                _, _, _, _, self._caches = self.decoder.decode_block(
+                    self._caches, w_ids, w_pos, stopped=w_stop,
+                    block_size=k)
         # mesh topology gauges (r12): one child per mesh axis so the
         # telemetry endpoint can chart per-axis sizes; set once — the
         # mesh never changes for an engine's lifetime
@@ -1164,6 +1336,15 @@ class SlotGenerationEngine:
         # the append/shed decision are atomic.
         shed_depth = None
         draining = False
+        headroom_shed = False
+        # headroom policy (ISSUE 11): projected service time vs the
+        # request's remaining deadline headroom, from the measured
+        # per-step / prefill EWMAs — a request that cannot make its
+        # deadline is shed NOW with the projected miss, not decoded
+        # into a guaranteed DeadlineExceeded. Cold estimates admit.
+        headroom_exc = None
+        if self.shed_headroom and req._deadline_t is not None:
+            headroom_exc = self._headroom_check(req)
         with self._lock:
             dead = self._dead
             queued = not (self._shutdown or dead is not None)
@@ -1173,6 +1354,11 @@ class SlotGenerationEngine:
                 # inherited/queued work keeps decoding until harvest
                 self._m["rejected"].inc()
                 draining = True
+                queued = False
+            if queued and headroom_exc is not None:
+                self._m["rejected"].inc()
+                self._m["headroom_shed"].inc()
+                headroom_shed = True
                 queued = False
             if queued:
                 depth = len(self._pending)
@@ -1186,6 +1372,13 @@ class SlotGenerationEngine:
                     # request the instant it is visible in the queue)
                     req._slo = self._slo
                     self._pending.append(req)
+        if headroom_shed:
+            self._flightrec.record("shed", engine=self.engine_id,
+                                   reason="headroom",
+                                   projected_miss_s=round(
+                                       headroom_exc.projected_miss_s, 4))
+            req._fail(headroom_exc)
+            return req
         if draining:
             self._flightrec.record("shed", engine=self.engine_id,
                                    reason="draining")
@@ -1283,14 +1476,98 @@ class SlotGenerationEngine:
                              error=f"{type(err).__name__}: {err}")
         req.add_done_callback(_fin)
 
+    # --------------------------------------------------------- scheduling
+    def _headroom_check(self, req: GenerationRequest,
+                        remaining: Optional[int] = None
+                        ) -> Optional[RejectedError]:
+        """Projected-miss shed decision: RejectedError iff the measured
+        account (prefill + per-step EWMAs) projects the request cannot
+        finish inside its deadline; None while the estimates are cold (a
+        fresh engine admits everything rather than shed on no data) or
+        while headroom suffices. ``remaining`` overrides the token
+        budget (a recovered request re-checks with what is left)."""
+        with self._lock:
+            est, pre = self._est_step, self._est_prefill
+        if est is None or req._deadline_t is None:
+            return None
+        tokens = req.max_new_tokens if remaining is None else remaining
+        # a chunked long prompt pays ONE prefill dispatch per window,
+        # not one total — charge every window, or a 10k-token prompt
+        # would pass the check and still die mid-chunking
+        ctx = len(req.prompt) + len(req.generated)
+        dispatches = 1
+        if self.prefill_chunk is not None and ctx > self.prefill_chunk:
+            dispatches = -(-ctx // self.prefill_chunk)      # ceil
+        need = ((pre or 0.0) * dispatches +
+                max(0, tokens) * est) * self.headroom_margin
+        headroom = req._deadline_t - time.monotonic()
+        if need <= headroom:
+            return None
+        return RejectedError(
+            f"projected deadline miss: needs ~{need:.3f}s (margin "
+            f"{self.headroom_margin:g}) against {headroom:.3f}s headroom "
+            f"— shed at admission", projected_miss_s=need - headroom)
+
+    def _ewma_locked(self, attr: str, value: float) -> None:
+        """Fold one observation into a latency EWMA (caller holds the
+        engine lock) — the measured account the headroom shed and the
+        adaptive-K policy read."""
+        old = getattr(self, attr)
+        setattr(self, attr, value if old is None
+                else 0.8 * old + 0.2 * value)
+
+    def _choose_block_size(self) -> int:
+        """Adaptive K, chosen live per wave (ISSUE 11): deep queue →
+        the largest compiled rung (throughput: dispatch overhead
+        amortizes over K steps), idle queue → K=1 (latency: tokens
+        retire every step). The measured per-step EWMA then caps K so
+        one block's wall time stays under ``block_latency_target`` —
+        a deep queue of slow steps must not turn into multi-second
+        blocks that blow every deadline the EDF order protects. Every
+        rung reuses an already-compiled ``decode_block{K}_impl``, so
+        steady-state switching compiles nothing."""
+        with self._lock:
+            depth = len(self._pending)
+            est = self._est_step
+        ladder = self.block_ladder
+        k = ladder[0]
+        for rung in ladder:
+            if rung <= max(1, depth):
+                k = rung
+        if est is not None and est > 0:
+            while k > ladder[0] and k * est > self.block_latency_target:
+                k = max(r for r in ladder if r < k)
+        return k
+
+    def _edf_key(self, req: GenerationRequest):
+        # earliest absolute deadline first; no deadline sorts after
+        # every deadlined request; FIFO (creation order) breaks ties —
+        # equal-headroom requests can never starve each other
+        return (req._deadline_t if req._deadline_t is not None
+                else float("inf"), req._seq)
+
     # -------------------------------------------------------------- slots
     def _pop_for_admit(self) -> Optional[GenerationRequest]:
         """Pop the next queued request AND park it in ``_admitting`` in
         one critical section: from this moment until it lands in a slot
         (or is failed), a concurrent quarantine()/shutdown() drain can
-        always see it — a request is never invisible to takeover."""
+        always see it — a request is never invisible to takeover.
+        ``scheduling="edf"`` pops the earliest deadline instead of the
+        queue head (FIFO tie-break via the request's creation seq) —
+        a linear scan per pop, O(depth²) per drain: fine at the default
+        max_pending=256; revisit with a lazy-deletion heap if queues
+        grow to many thousands."""
         with self._lock:
-            req = self._pending.popleft() if self._pending else None
+            req = None
+            if self._pending:
+                if self.scheduling == "edf":
+                    best = min(range(len(self._pending)),
+                               key=lambda i: self._edf_key(
+                                   self._pending[i]))
+                    req = self._pending[best]
+                    del self._pending[best]
+                else:
+                    req = self._pending.popleft()
             if req is not None:
                 self._admitting.append(req)
             return req
@@ -1383,7 +1660,8 @@ class SlotGenerationEngine:
         while True:
             with self._lock:
                 free = [s for s in range(self.num_slots)
-                        if self._slots[s] is None]
+                        if self._slots[s] is None and
+                        s not in self._chunking]
             if not free:
                 return
             batch: List[Tuple[GenerationRequest, int, np.ndarray]] = []
@@ -1397,7 +1675,10 @@ class SlotGenerationEngine:
                         break
                     # lifecycle beats admission: never spend prefill
                     # compute on a request that is already cancelled /
-                    # out of deadline / (recovered) already finished
+                    # out of deadline / (recovered) already finished —
+                    # and the headroom policy re-projects with what the
+                    # queue wait left (a request that can no longer make
+                    # its deadline sheds here, not after decoding)
                     exc = None
                     if req._cancel_requested:
                         exc = Cancelled("cancelled while queued")
@@ -1405,12 +1686,19 @@ class SlotGenerationEngine:
                         exc = DeadlineExceeded(
                             f"deadline of {req.deadline}s passed while "
                             "queued")
+                    elif self.shed_headroom:
+                        exc = self._headroom_check(
+                            req, remaining=req.max_new_tokens -
+                            len(req.generated))
                     if exc is not None:
                         with self._lock:
                             if not self._unpark(req):
                                 return   # a takeover drain owns it now
                             if isinstance(exc, Cancelled):
                                 self._m["cancelled"].inc()
+                            elif isinstance(exc, RejectedError):
+                                self._m["rejected"].inc()
+                                self._m["headroom_shed"].inc()
                             else:
                                 self._m["deadline_exceeded"].inc()
                         req._fail(exc)
@@ -1428,6 +1716,38 @@ class SlotGenerationEngine:
                         req._complete()
                         req = None
                         continue
+                    if self.prefill_chunk is not None and \
+                            len(ctx) > self.prefill_chunk:
+                        # long prompt: the slot is taken but prefill
+                        # proceeds in bounded windows interleaved with
+                        # decode blocks (_advance_chunks) — one burst of
+                        # 10k-token prompts degrades throughput
+                        # gracefully instead of stalling every stream
+                        with self._lock:
+                            if not self._unpark(req):
+                                return
+                            self._chunking[s] = [req, ctx, 0]
+                            # park the lane's decode write-head at the
+                            # LAST cache cell: a frozen lane re-writes
+                            # its own cell every block, and a stale
+                            # position would clobber chunk-prefilled
+                            # cells mid-fill. Cell t_max-1 is attended
+                            # only at position t_max-1, which the decode
+                            # write-head overwrites first.
+                            self._positions[s] = self.t_max - 1
+                            self._last_ids[s] = 0
+                            # and resync the block pipeline: the device
+                            # carry may still hold this lane frozen at
+                            # its PREVIOUS occupant's position, whose
+                            # per-block rewrite would clobber the cells
+                            # the chunks are about to fill
+                            self._carry = None
+                            req._running = True
+                            self._m["prefills"].inc()
+                        if req.trace is not None:
+                            req.trace.add_span("queued", req._submit_t,
+                                               time.monotonic())
+                        break          # this slot is occupied; next one
                     batch.append((req, s, ctx))
                 if drained:
                     break
@@ -1474,6 +1794,7 @@ class SlotGenerationEngine:
                     # tokens (re-prefill regenerates them)
                     return
                 self._m["host_readbacks"].inc()
+                self._ewma_locked("_est_prefill", t_pre1 - t_pre0)
                 for i, (req, s, ctx) in enumerate(batch):
                     if req not in self._admitting:
                         continue          # pragma: no cover — defensive
@@ -1526,12 +1847,134 @@ class SlotGenerationEngine:
             if drained:
                 return
 
+    def _advance_chunks(self):
+        """One chunked-prefill dispatch (round-robin over chunking
+        slots), interleaved with decode blocks by the serve loop: long
+        prompts fill their cache window by window, each window a bounded
+        device program, so a burst of 10k-token prompts degrades
+        throughput gracefully instead of spiking every stream's p99.
+        Non-final windows never read back (no host sync); the final
+        window's single readback lands the first token and activates
+        the slot for decode."""
+        doomed: List[Tuple[GenerationRequest, BaseException]] = []
+        entry = None
+        with self._lock:
+            if self._quarantined or self._shutdown:
+                return
+            # lifecycle first: a cancelled / expired chunking request
+            # frees its slot without spending another window
+            for s in sorted(self._chunking):
+                req = self._chunking[s][0]
+                if req._cancel_requested:
+                    self._m["cancelled"].inc()
+                    doomed.append((req, Cancelled(
+                        "cancelled during chunked prefill")))
+                    del self._chunking[s]
+                elif req._expired():
+                    self._m["deadline_exceeded"].inc()
+                    doomed.append((req, DeadlineExceeded(
+                        f"deadline of {req.deadline}s passed during "
+                        "chunked prefill")))
+                    del self._chunking[s]
+            if self._chunking:
+                slots = sorted(self._chunking)
+                s = slots[self._chunk_rr % len(slots)]
+                self._chunk_rr += 1
+                entry = (s, *self._chunking[s])
+        for req, exc in doomed:
+            req._fail(exc)
+        if entry is None:
+            return
+        s, req, ctx, filled = entry
+        c = self.prefill_chunk
+        # the final window may slide LEFT so it always fits the cache
+        # depth (rewriting a cell from the same tokens is idempotent up
+        # to float reassociation); earlier windows are aligned at
+        # multiples of c by construction
+        pos0 = filled if filled + c <= self.t_max else self.t_max - c
+        window = ctx[pos0:pos0 + c]
+        valid = len(window)
+        final = pos0 + valid >= len(ctx)
+        tokens = np.zeros((1, c), np.int32)
+        tokens[0, :valid] = window
+        chunk_no = self._m["prefill_chunks"].inc()
+        t0 = time.monotonic()
+        if req._admitted_t is None:
+            req._admitted_t = t0          # SLO queue-wait ends at the
+        #                                   FIRST window's dispatch
+        self._faults.fire("engine.prefill")
+        nxt, self._caches = self.decoder._fn(("chunk", c))(
+            self.decoder._device_params(),
+            self.decoder.net._inference_state(), self._caches,
+            jnp.asarray(tokens), jnp.asarray([pos0], jnp.int32),
+            jnp.asarray([valid], jnp.int32), jnp.asarray([s], jnp.int32),
+            jnp.asarray([req.temperature], jnp.float32),
+            jax.random.fold_in(self._key, CHUNK_SALT | chunk_no))
+        tok = None
+        if final:
+            tok = int(device_fetch(nxt, tag="engine.prefill")[0])
+        t1 = time.monotonic()
+        if self._tracing:
+            self._flightrec.record(
+                "prefill_chunk", engine=self.engine_id, slot=s,
+                pos0=pos0, valid=valid, final=final,
+                ms=round((t1 - t0) * 1e3, 3))
+        jlog: List[Tuple] = []
+        finish = None
+        with self._lock:
+            if self._quarantined or self._shutdown:
+                return      # the takeover harvest owns the request now
+            cur = self._chunking.get(s)
+            if cur is None or cur[0] is not req:
+                return      # freed (cancel/deadline) while dispatching
+            self._ewma_locked("_est_prefill", t1 - t0)
+            if not final:
+                cur[2] = pos0 + valid
+            else:
+                del self._chunking[s]
+                self._m["host_readbacks"].inc()
+                if self._journal is not None and \
+                        req.journal_id is not None:
+                    jlog.append((req.journal_id, len(req.generated),
+                                 (tok,)))
+                req.generated.append(tok)
+                if req._first_token_t is None:
+                    req._first_token_t = t1
+                self._m["emitted_tokens"].inc()
+                if self._req_finished(req, tok):
+                    self._m["completed"].inc()
+                    finish = req
+                else:
+                    self._slots[s] = req
+                    self._last_ids[s] = tok
+                    self._positions[s] = len(ctx)
+                    self._temps[s] = req.temperature
+                    self._eos_ids[s] = -1 if req.eos_id is None \
+                        else int(req.eos_id)
+                    # slot contents changed: the block pipeline resyncs
+                    self._carry = None
+        if req.trace is not None:
+            req.trace.add_span("prefill_chunk", t0, t1, pos0=pos0,
+                               valid=valid, final=final)
+        if jlog:
+            # first token journaled before the finisher completes,
+            # outside the engine lock (GL010) — same contract as _admit
+            self._journal.retired(jlog)
+        if finish is not None:
+            finish._complete()
+
     def _any_active(self) -> bool:
-        return any(r is not None for r in self._slots)
+        return any(r is not None for r in self._slots) or \
+            bool(self._chunking)
 
     def _step(self):
         """One decode dispatch: a single batched step (block_size=1, the
-        legacy loop) or one pipelined K-step block cycle."""
+        legacy loop) or one pipelined K-step block cycle. Chunked
+        prefill interleaves here — one prompt window per cycle advances
+        BEFORE the decode dispatch, so long-prompt admission and decode
+        share the device fairly."""
+        if self._chunking:
+            self._advance_chunks()
         if self.block_size > 1:
             return self._step_block()
         self._enforce_slots()
@@ -1552,6 +1995,8 @@ class SlotGenerationEngine:
             key=jax.random.fold_in(self._key, ENGINE_KEY_SALT | step_no))
         nxt_host = device_fetch(nxt, tag="engine.decode")
         t_ret = time.monotonic()
+        with self._lock:
+            self._ewma_locked("_est_step", t_ret - t_disp)
         if self._tracing:
             self._h_block.observe(t_ret - t_disp)
             self._flightrec.record("block_retire", engine=self.engine_id,
@@ -1603,7 +2048,8 @@ class SlotGenerationEngine:
         cancelled mid-pipeline simply has its remaining in-flight tokens
         dropped as overshoot (the dispatch snapshot pins which request
         each lane's tokens belong to)."""
-        k = self.block_size
+        k = self._choose_block_size() if self.adaptive_block \
+            else self.block_size
         self._enforce_slots()
         # resync boundary: the device carry was invalidated (slots were
         # refilled or freed) while a block is still in flight. Host state
@@ -1639,6 +2085,8 @@ class SlotGenerationEngine:
                             self._eos_ids.copy())
         if dispatch is not None:
             (ids, pos, stop), step0, temps, eos = dispatch
+            if self.adaptive_block:
+                self._m_k.labels(self.engine_id, str(k)).inc()
             t_disp = time.monotonic()
             self._faults.fire("engine.step")
             toks, ids_d, pos_d, stop_d, self._caches = \
@@ -1664,6 +2112,8 @@ class SlotGenerationEngine:
         toks_dev, snapshot, k, t_disp = block
         host = device_fetch(toks_dev, tag="engine.decode")
         t_ret = time.monotonic()
+        with self._lock:
+            self._ewma_locked("_est_step", (t_ret - t_disp) / max(1, k))
         if self._tracing:
             self._h_block.observe(t_ret - t_disp)
             self._flightrec.record("block_retire", engine=self.engine_id,
@@ -1776,6 +2226,11 @@ class SlotGenerationEngine:
                                 # engine's heartbeat when it wakes
             harvested.extend(self._admitting)
             self._admitting = []
+            for s in sorted(self._chunking):
+                # mid-chunk prefill: recovery re-prefills from scratch
+                # (no tokens were emitted yet), deterministically
+                harvested.append(self._chunking[s][0])
+            self._chunking = {}
             for s in range(self.num_slots):
                 if self._slots[s] is not None:
                     harvested.append(self._slots[s])
@@ -1797,7 +2252,9 @@ class SlotGenerationEngine:
         out = {key: int(self._m[key].value) for key in _ENGINE_COUNTERS}
         with self._lock:
             out["queue_depth"] = len(self._pending)
-            out["active_slots"] = sum(r is not None for r in self._slots)
+            out["active_slots"] = sum(r is not None
+                                      for r in self._slots) + \
+                len(self._chunking)
         # mesh topology (r12): "<data>x<tp>" for a sharded engine, None
         # for single-device — /snapshot sources surface it verbatim
         from ..parallel.mesh import mesh_tag
@@ -1864,6 +2321,9 @@ class SlotGenerationEngine:
             with self._lock:
                 doomed.extend(self._admitting)
                 self._admitting = []
+                for s in sorted(self._chunking):
+                    doomed.append(self._chunking[s][0])
+                self._chunking = {}
                 for s in range(self.num_slots):
                     if self._slots[s] is not None:
                         doomed.append(self._slots[s])
@@ -1901,6 +2361,9 @@ class SlotGenerationEngine:
                 "SlotGenerationEngine shut down")
             doomed.extend(self._admitting)
             self._admitting = []
+            for s in sorted(self._chunking):
+                doomed.append(self._chunking[s][0])
+            self._chunking = {}
             for s in range(self.num_slots):
                 if self._slots[s] is not None:
                     doomed.append(self._slots[s])

@@ -202,6 +202,51 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
             out = out[:, None]                               # [B, 1, H, Dh]
         return self._project_out(params, out.astype(x.dtype)), new_cache
 
+    # graftlint: traced
+    def chunk_forward(self, params, x, cache: Dict, pos0):
+        """Chunked-prefill step (µ-cuDNN-style micro-batching of a long
+        prompt): x [B, C, n_in] is a WINDOW of C prompt tokens whose
+        first token sits at absolute position ``pos0`` ([B] int32).
+        Writes the window's k/v into the cache at [pos0, pos0+C) (one
+        vmapped ``dynamic_update_slice`` — fixed shape, ONE compile per
+        chunk size) and attends each query i over cache[:, :, :pos0+i+1]
+        via a per-query length mask, so earlier chunks' context is read
+        back through the SAME cache decode_forward uses. Positions past
+        a window's true length carry garbage k/v exactly like padded
+        prefill positions — the length masks never attend them before
+        the decode write-head overwrites them. ``pos0`` is clamped so
+        the window always fits the cache depth (the caller may slide the
+        final window left over already-filled cells; rewriting a cell
+        from the same tokens is idempotent up to float reassociation).
+        Returns (out [B, C, n_out], new_cache)."""
+        q, k, v = self._project_qkv(params, x)         # [B, C, H, Dh]
+        c = x.shape[1]
+        t_max = cache["k"].shape[2]
+        p0 = jnp.clip(jnp.asarray(pos0, jnp.int32).reshape(-1), 0,
+                      max(t_max - c, 0))
+        zero = jnp.zeros((), jnp.int32)
+        upd = lambda cc, u, p: jax.lax.dynamic_update_slice(
+            cc, u, (zero, p, zero))
+        new_cache = {
+            "k": jax.vmap(upd)(cache["k"],
+                               k.transpose(0, 2, 1, 3).astype(
+                                   cache["k"].dtype), p0),
+            "v": jax.vmap(upd)(cache["v"],
+                               v.transpose(0, 2, 1, 3).astype(
+                                   cache["v"].dtype), p0)}
+        ck, cv = new_cache["k"], new_cache["v"]
+        hs = self._head_size()
+        scale = 1.0 / math.sqrt(hs)          # math.sqrt: GL004 (x64)
+        logits = jnp.einsum("bqhd,bhtd->bhqt", q, ck,
+                            preferred_element_type=jnp.float32) * scale
+        kpos = jnp.arange(t_max, dtype=jnp.int32)
+        qpos = p0[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+        keep = kpos[None, None, :] <= qpos[:, :, None]     # [B, C, T]
+        logits = jnp.where(keep[:, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)            # f32
+        out = jnp.einsum("bhqt,bhtd->bqhd", probs.astype(cv.dtype), cv)
+        return self._project_out(params, out.astype(x.dtype)), new_cache
+
 
 @register_config
 @dataclasses.dataclass
@@ -312,3 +357,17 @@ class TokenAndPositionEmbedding(BaseRecurrentLayerConf):
         pos = jnp.minimum(jnp.asarray(positions, jnp.int32).reshape(-1),
                           self.max_length - 1)
         return (params["W"][ids] + params["P"][pos])[:, None, :]
+
+    # graftlint: traced
+    def embed_chunk(self, params, ids, pos0):
+        """Chunked-prefill embedding: ids [B, C] embedded at absolute
+        positions pos0 + [0, C) per row (``pos0`` [B] int32, clamped so
+        the window sits inside max_length) → [B, C, n_out]. The chunk
+        analogue of :meth:`embed_at`; no dropout (inference only)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        c = ids.shape[1]
+        p0 = jnp.asarray(pos0, jnp.int32).reshape(-1)
+        pos = jnp.minimum(p0[:, None] +
+                          jnp.arange(c, dtype=jnp.int32)[None, :],
+                          self.max_length - 1)               # [B, C]
+        return params["W"][ids] + params["P"][pos]

@@ -45,7 +45,8 @@ from .metrics import MetricsRegistry, default_registry
 EVENT_KINDS = ("admission", "block_retire", "shed", "takeover",
                "migration", "reconnect", "fault", "crash",
                "replica_dead", "postmortem", "journal", "recovered",
-               "preempt")
+               "preempt", "prefill_chunk", "scale_up", "descale",
+               "autoscale")
 
 
 class FlightRecorder:

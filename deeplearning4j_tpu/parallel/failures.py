@@ -298,9 +298,16 @@ class EngineSupervisor(HeartbeatMonitor):
             #                          requests CONTINUE their traces
             slo=old._slo, slo_label=old.slo_label,   # one stable SLO
             flight_recorder=old._flightrec,          # label per replica
-            journal=old._journal)   # restarts keep the durable journal:
-        #                             requeued requests keep appending
-        #                             under their original ids
+            journal=old._journal,   # restarts keep the durable journal:
+            #                         requeued requests keep appending
+            #                         under their original ids
+            scheduling=old.scheduling,       # the scheduling policy tier
+            shed_headroom=old.shed_headroom,    # (ISSUE 11) survives the
+            headroom_margin=old.headroom_margin,   # takeover: EDF order,
+            prefill_chunk=old.prefill_chunk,       # headroom shed, chunk
+            adaptive_block=old.adaptive_block,     # size, and the K
+            block_ladder=old.block_ladder,         # ladder all rebuild
+            block_latency_target=old.block_latency_target)
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)

@@ -52,11 +52,26 @@ class Cancelled(RuntimeError):
 class RejectedError(RuntimeError):
     """Admission control shed the request instead of growing the pending
     queue without bound. ``queue_depth`` is the depth observed at
-    rejection time."""
+    rejection time.
 
-    def __init__(self, message: str, queue_depth: int = 0):
+    ``projected_miss_s`` (ISSUE 11, headroom policy): by how many
+    seconds the measured account projected the request would miss its
+    deadline — set only on shed-by-headroom rejections, so callers can
+    tell capacity sheds from deadline-infeasible requests.
+
+    ``replica_depths`` (fleet router): at full-fleet saturation, a
+    per-replica ``{rid: {"depth", "capacity", "state"}}`` table — the
+    caller (and the autoscaler) can tell GLOBAL saturation (every
+    replica deep) from imbalance (one hot replica, the rest dead or
+    unreadable) without re-scraping the fleet."""
+
+    def __init__(self, message: str, queue_depth: int = 0,
+                 projected_miss_s=None, replica_depths=None):
         super().__init__(message)
         self.queue_depth = int(queue_depth)
+        self.projected_miss_s = None if projected_miss_s is None \
+            else float(projected_miss_s)
+        self.replica_depths = replica_depths
 
 
 #: documented injection points — components fire these names.

@@ -46,7 +46,14 @@ class ParallelInference:
                  generation_spec_layout=None,
                  generation_journal_dir: Optional[str] = None,
                  generation_journal_fsync: str = "every_n",
-                 generation_recover: bool = True):
+                 generation_recover: bool = True,
+                 generation_scheduling: str = "fifo",
+                 generation_shed_headroom: bool = False,
+                 generation_headroom_margin: float = 1.0,
+                 generation_prefill_chunk: Optional[int] = None,
+                 generation_adaptive_block: bool = False,
+                 generation_block_ladder=None,
+                 generation_block_latency_target: float = 0.25):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
@@ -84,6 +91,16 @@ class ParallelInference:
         self.generation_journal_dir = generation_journal_dir
         self.generation_journal_fsync = str(generation_journal_fsync)
         self.generation_recover = bool(generation_recover)
+        # scheduling policy tier (ISSUE 11): EDF queue order, headroom
+        # shed, chunked prefill for long prompts, adaptive block size
+        self.generation_scheduling = str(generation_scheduling)
+        self.generation_shed_headroom = bool(generation_shed_headroom)
+        self.generation_headroom_margin = float(generation_headroom_margin)
+        self.generation_prefill_chunk = generation_prefill_chunk
+        self.generation_adaptive_block = bool(generation_adaptive_block)
+        self.generation_block_ladder = generation_block_ladder
+        self.generation_block_latency_target = float(
+            generation_block_latency_target)
         self._gen_journal = None
         self.last_recovery = None          # RecoveryReport of this boot
         self._telemetry = None
@@ -231,7 +248,15 @@ class ParallelInference:
                     tracing=self.generation_tracing,
                     mesh=self.generation_mesh,
                     spec_layout=self.generation_spec_layout,
-                    journal=self._gen_journal)
+                    journal=self._gen_journal,
+                    scheduling=self.generation_scheduling,
+                    shed_headroom=self.generation_shed_headroom,
+                    headroom_margin=self.generation_headroom_margin,
+                    prefill_chunk=self.generation_prefill_chunk,
+                    adaptive_block=self.generation_adaptive_block,
+                    block_ladder=self.generation_block_ladder,
+                    block_latency_target=(
+                        self.generation_block_latency_target))
                 if self.generation_supervised:
                     from .failures import EngineSupervisor
                     self._gen_supervisor = EngineSupervisor(

@@ -2,6 +2,7 @@
 NDArray pub/sub and model serving — NDArrayKafkaClient, DL4jServeRouteBuilder;
 SURVEY.md §2.4)."""
 
+from .autoscale import BurnRateAutoscaler
 from .fleet import (EngineFleetRouter, EngineReplica, FleetLedger,
                     FleetMembership, FleetRequest, KVFleetMembership)
 from .journal import (RecoveryReport, RequestJournal, recover_from_journal,
@@ -16,4 +17,5 @@ __all__ = ["MessageBroker", "NDArrayPublisher", "NDArraySubscriber",
            "TcpMessageBroker", "EngineFleetRouter", "EngineReplica",
            "FleetLedger", "FleetMembership", "FleetRequest",
            "KVFleetMembership", "RequestJournal", "RecoveryReport",
-           "recover_from_journal", "replay_journal"]
+           "recover_from_journal", "replay_journal",
+           "BurnRateAutoscaler"]

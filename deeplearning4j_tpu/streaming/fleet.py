@@ -95,6 +95,9 @@ _FLEET_COUNTERS = {
             "(all replicas saturated or dead)",
     "dispatch_errors": "dispatch attempts that failed in transport "
                        "(retried on the next-best replica)",
+    "scale_ups": "replicas added live (autoscaler or operator)",
+    "scale_downs": "replicas retired live through the graceful "
+                   "preemption drain (autoscaler or operator)",
 }
 
 
@@ -249,16 +252,23 @@ class KVFleetMembership:
     ROUTER/observer side first.)
 
     Because the store is write-once, old beat keys ACCUMULATE — the
-    coordinator footprint and per-scan directory size grow with total
-    beats written (the store has no delete; compaction would need an
-    epoch-prefixed directory rotation, future work). ``ages()`` keeps
-    the scan cheap — one int parse per key and at most one json parse
-    per member per scan — but long-lived fleets should beat coarsely
-    through this seam (``heartbeat_interval`` ≥ 0.5s) rather than at
-    the in-process default."""
+    coordinator footprint and per-scan directory size would grow with
+    total beats written. When the client supports deletion
+    (``key_value_delete``, present on jax's distributed runtime
+    client), ``ages()`` PRUNES every ``prune_every`` scans: per member,
+    all but the newest ``prune_keep`` (epoch, seq) beat keys are
+    deleted — superseded epochs (dead incarnations a rejoin replaced)
+    and the long tail of the live epoch both stay bounded, so a
+    long-lived fleet's scan cost is FLAT in uptime. Members that wrote
+    a ``left`` tombstone have every beat key pruned (the tombstone
+    stays — it is the authority). A client without delete degrades to
+    the old growth behaviour: beat coarsely (``heartbeat_interval`` ≥
+    0.5s) through this seam. ``ages()`` itself stays cheap — one int
+    parse per key and at most one json parse per member per scan."""
 
     def __init__(self, client, fleet_id: str = "fleet0",
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None, prune_keep: int = 4,
+                 prune_every: int = 50):
         self._client = client
         self.fleet_id = str(fleet_id)
         self._prefix = f"dl4j/fleet/{self.fleet_id}/"
@@ -275,6 +285,12 @@ class KVFleetMembership:
         self._seq: Dict[str, int] = {}
         # rid -> [last (epoch, seq) seen, local time it changed, load]
         self._seen: Dict[str, List] = {}
+        # beat-key pruning (r16): superseded keys deleted every
+        # prune_every scans when the client supports it
+        self.prune_keep = max(1, int(prune_keep))
+        self.prune_every = max(1, int(prune_every))
+        self._scan_count = 0
+        self.pruned_keys = 0
 
     def register(self, replica_id: str) -> None:
         self.beat(replica_id, 0)
@@ -333,8 +349,18 @@ class KVFleetMembership:
         except Exception:   # noqa: BLE001 — coordinator hiccup: ages
             entries = None  # keep growing from the local cache
         now = time.monotonic()
+        prune: Optional[Dict[str, List]] = None
         with self._lock:
             if entries is not None:
+                self._scan_count += 1
+                # superseded-key pruning (r16): every prune_every scans,
+                # collect EVERY beat key per member so the pass below —
+                # outside this lock, deletes are I/O — can drop all but
+                # the newest prune_keep
+                collect = self._scan_count % self.prune_every == 0 and \
+                    getattr(self._client, "key_value_delete",
+                            None) is not None
+                all_keys: Dict[str, List] = {}
                 latest: Dict[str, Tuple[Tuple[int, int], str]] = {}
                 left = set()
                 for key, val in entries:
@@ -353,6 +379,9 @@ class KVFleetMembership:
                             else (0, int(tail))
                     except ValueError:
                         continue
+                    if collect:
+                        all_keys.setdefault(rid, []).append(
+                            (stamp, str(key)))
                     if stamp > latest.get(rid, ((-1, -1), ""))[0]:
                         latest[rid] = (stamp, val)
                 for rid in left:
@@ -371,8 +400,40 @@ class KVFleetMembership:
                         except (ValueError, TypeError):
                             continue
                         self._seen[rid] = [stamp, now, load]
-            return {rid: (now - t, load)
-                    for rid, (_, t, load) in self._seen.items()}
+                if collect:
+                    prune = all_keys
+                    for rid in left:    # tombstoned: EVERY beat key of
+                        if rid in prune:   # the dead incarnation goes
+                            prune[rid].append(("left", None))
+            result = {rid: (now - t, load)
+                      for rid, (_, t, load) in self._seen.items()}
+        if prune:
+            self._prune(prune)
+        return result
+
+    def _prune(self, all_keys: Dict[str, List]) -> None:
+        """Delete superseded beat keys (outside the membership lock —
+        deletes are coordinator I/O): per member, keep the newest
+        ``prune_keep`` (epoch, seq) stamps; a member whose list carries
+        the ``left`` marker is tombstoned and loses every beat key.
+        Best-effort — a failed delete is retried by a later pass."""
+        delete = getattr(self._client, "key_value_delete", None)
+        if delete is None:                    # pragma: no cover
+            return
+        removed = 0
+        for rid, stamps in all_keys.items():
+            tombstoned = any(s == "left" for s, _ in stamps)
+            beats = sorted((s for s in stamps if s[0] != "left"),
+                           reverse=True)
+            keep = 0 if tombstoned else self.prune_keep
+            for _, key in beats[keep:]:
+                try:
+                    delete(key)
+                    removed += 1
+                except Exception:   # noqa: BLE001 — raced another
+                    continue        # pruner / key already gone
+        with self._lock:
+            self.pruned_keys += removed
 
 
 # -------------------------------------------------------------- replica
@@ -396,6 +457,9 @@ class EngineReplica:
         self.supervised = hasattr(engine, "_sup_lock")
         inner = engine.engine if self.supervised else engine
         self.capacity = int(inner.max_pending) + int(inner.num_slots)
+        self.slots = int(inner.num_slots)   # decode capacity — the
+        #                                     autoscaler's utilization
+        #                                     denominator
         self.reachable = True
         self._membership = membership
         self._faults = fault_injector if fault_injector is not None \
@@ -633,7 +697,14 @@ class EngineFleetRouter:
                  registry=None, trace_store=None, tracing: bool = True,
                  slo_tracker=None, flight_recorder=None,
                  postmortem_dir: Optional[str] = None,
-                 journal=None):
+                 journal=None, scheduling: str = "fifo",
+                 shed_headroom: bool = False,
+                 headroom_margin: float = 1.0,
+                 prefill_chunk: Optional[int] = None,
+                 adaptive_block: bool = False,
+                 block_ladder=None,
+                 block_latency_target: float = 0.25,
+                 engine_factory=None):
         self.fleet_id = fleet_id if fleet_id is not None \
             else f"fleet{next(_FLEET_SEQ)}"
         self._registry = registry if registry is not None \
@@ -670,36 +741,56 @@ class EngineFleetRouter:
             else int(sticky_prefix)
 
         # ---------------------------------------------------- replicas
-        engines = replicas
-        if engines is None:
-            if net is None:
-                raise ValueError("EngineFleetRouter needs a net (to build "
-                                 "replicas) or prebuilt replicas=[...]")
+        self.heartbeat_interval = float(heartbeat_interval)
+        self._engine_factory = engine_factory
+        if net is not None and replicas is None:
             from ..models.generation import (SlotGenerationEngine,
                                              TransformerDecoder)
             if decoder is None:
                 decoder = TransformerDecoder(net, t_max=t_max)
-            engines = []
-            for i in range(int(num_replicas)):
-                inj = None if replica_injectors is None \
-                    else replica_injectors[i]
+            shared_decoder = decoder
+
+            def _build_engine(rid: str, fault_injector=None):
+                # ONE shared decoder across every replica — built now
+                # AND scaled up later — so migration is token-identical
+                # and a grown replica's steady state compiles nothing
                 eng = SlotGenerationEngine(
                     net, num_slots=num_slots, refill=refill, seed=seed,
-                    decoder=decoder, max_pending=max_pending,
-                    fault_injector=inj, block_size=block_size,
+                    decoder=shared_decoder, max_pending=max_pending,
+                    fault_injector=fault_injector, block_size=block_size,
                     registry=self._registry,
                     trace_store=self._trace_store, tracing=self._tracing,
-                    slo=self._slo_tracker, slo_label=f"r{i}",
+                    slo=self._slo_tracker, slo_label=rid,
                     flight_recorder=self._flightrec,
-                    journal=journal)
+                    journal=journal, scheduling=scheduling,
+                    shed_headroom=shed_headroom,
+                    headroom_margin=headroom_margin,
+                    prefill_chunk=prefill_chunk,
+                    adaptive_block=adaptive_block,
+                    block_ladder=block_ladder,
+                    block_latency_target=block_latency_target)
                 if supervised:
                     from ..parallel.failures import EngineSupervisor
                     eng = EngineSupervisor(
                         eng, timeout=supervisor_timeout,
                         max_restarts=max_restarts,
-                        name=f"{self.fleet_id}:r{i}",
+                        name=f"{self.fleet_id}:{rid}",
                         postmortem_dir=postmortem_dir)
-                engines.append(eng)
+                return eng
+            if self._engine_factory is None:
+                self._engine_factory = _build_engine
+        engines = replicas
+        if engines is None:
+            if net is None:
+                raise ValueError("EngineFleetRouter needs a net (to build "
+                                 "replicas) or prebuilt replicas=[...]")
+            engines = []
+            for i in range(int(num_replicas)):
+                inj = None if replica_injectors is None \
+                    else replica_injectors[i]
+                engines.append(self._engine_factory(f"r{i}",
+                                                    fault_injector=inj))
+        self._next_ridx = itertools.count(len(engines))
         self._replicas: Dict[str, EngineReplica] = {}
         for i, eng in enumerate(engines):
             # prebuilt replicas get the injector too: the heartbeat/kill
@@ -733,9 +824,7 @@ class EngineFleetRouter:
         self._shutdown_flag = False
 
         # ------------------------------------------------- sticky ring
-        self._ring: List[Tuple[int, str]] = sorted(
-            (_ring_hash(f"{rid}#{v}"), rid)
-            for rid in self._replicas for v in range(32))
+        self._ring: List[Tuple[int, str]] = self._build_ring()
 
         # ------------------------------------------------------ metrics
         reg = self._registry
@@ -831,6 +920,16 @@ class EngineFleetRouter:
             return fr
         # every replica saturated, dead, or unreadable: router-level shed
         self._m["shed"].inc()
+        # per-replica depths + health states ride the rejection: callers
+        # and the autoscaler can tell GLOBAL saturation (every replica
+        # deep) from imbalance (one hot replica, the rest dead) without
+        # re-scraping the fleet
+        with self._lock:
+            detail = {rid: {"depth": loads.get(rid),
+                            "capacity": self._replicas[rid].capacity
+                            if rid in self._replicas else None,
+                            "state": h["state"]}
+                      for rid, h in self._health.items()}
         self._flightrec.record("shed", fleet=self.fleet_id,
                                queue_depth=total_depth)
         # a router-shed request was never accepted by an engine (inner
@@ -843,7 +942,7 @@ class EngineFleetRouter:
         fr._fail(RejectedError(
             f"fleet {self.fleet_id}: all {len(self._replicas)} replicas "
             f"saturated or dead — request shed",
-            queue_depth=total_depth))
+            queue_depth=total_depth, replica_depths=detail))
         return fr
 
     def _bind(self, fr: FleetRequest, inner, rep: EngineReplica) -> None:
@@ -915,6 +1014,14 @@ class EngineFleetRouter:
         if prefer is not None and prefer in loads:
             order = [prefer] + [r for r in order if r != prefer]
         return [reps[rid] for rid in order], loads
+
+    def _build_ring(self) -> List[Tuple[int, str]]:
+        """Consistent-hash ring over the CURRENT replica set (32 virtual
+        nodes each) — rebuilt on scale up/down, so a grown fleet takes
+        its share of sticky keys and a retired replica's keys fall to
+        their ring successors deterministically."""
+        return sorted((_ring_hash(f"{rid}#{v}"), rid)
+                      for rid in self._replicas for v in range(32))
 
     def _ring_walk(self, key: str) -> List[str]:
         """All replica ids in consistent-hash preference order for
@@ -1024,6 +1131,169 @@ class EngineFleetRouter:
             rep.stop_heartbeat()
             return
         self._migrate(rid, cause or RuntimeError(f"replica {rid} killed"))
+
+    # ------------------------------------------------------ elastic fleet
+    def add_replica(self, engine=None, *,
+                    replica_id: Optional[str] = None) -> str:
+        """Grow the fleet LIVE — the autoscaler's scale-up seam (and an
+        operator's). Builds the engine through the router's factory
+        (``net``-built routers share ONE decoder, so the new replica's
+        steady state compiles nothing new; prebuilt-replica routers need
+        ``engine_factory=`` or an explicit ``engine=``), registers a
+        heartbeat BEFORE the monitor can see the member (a fresh row
+        must not age into an instant death), rebuilds the sticky ring,
+        and starts serving. Returns the new replica id."""
+        with self._lock:
+            if self._shutdown_flag:
+                raise RuntimeError("EngineFleetRouter shut down")
+            rid = str(replica_id) if replica_id is not None \
+                else f"r{next(self._next_ridx)}"
+            if rid in self._replicas:
+                raise ValueError(f"replica id {rid!r} already exists")
+        if engine is None:
+            if self._engine_factory is None:
+                raise ValueError(
+                    "add_replica needs engine= (or build the router with "
+                    "engine_factory=/net= so it can construct replicas)")
+            engine = self._engine_factory(rid, fault_injector=None)
+        rep = EngineReplica(rid, engine, self._membership,
+                            heartbeat_interval=self.heartbeat_interval)
+        rep._on_kill = self._on_replica_kill
+        self._membership.register(rid)
+        with self._lock:
+            if rid in self._replicas:
+                # lost a race with a concurrent add_replica using the
+                # same explicit id: the winner's live replica must not
+                # be silently overwritten (ours was never started)
+                raise ValueError(f"replica id {rid!r} already exists")
+            self._replicas[rid] = rep
+            self._health[rid] = {"state": REPLICA_ALIVE, "fresh": 0,
+                                 "load": 0, "age": 0.0}
+        with self._migrate_lock:
+            with self._lock:
+                # an explicitly reused id must shed its dead/retired
+                # history: _bind's retired re-check would otherwise
+                # migrate every request straight off the fresh replica,
+                # and a LATER real death would short-circuit in
+                # _migrate's already-handled guard, stranding its work
+                self._dead_handled.discard(rid)
+                self._death_cause.pop(rid, None)
+            self._ring = self._build_ring()
+            self._update_gauges_locked()
+            started = self._started
+        if started:
+            self._wire_crash_hook(rid, rep)
+            rep.start()
+        self._m["scale_ups"].inc()
+        self._flightrec.record("scale_up", fleet=self.fleet_id,
+                               replica=rid)
+        return rid
+
+    def retire_replica(self, rid: str, *, budget: float = 10.0,
+                       reason: str = "descale") -> dict:
+        """Gracefully retire one replica LIVE — the autoscaler's
+        scale-down seam. Rides the r15 preemption drain
+        (``parallel/preemption.PreemptionHandler``): admission closes,
+        the in-flight decode block retires and journals, the engine
+        quarantines WITHOUT failing its requests, the journal fsyncs and
+        a handoff manifest lands in the post-mortem dir — then every
+        harvested request re-dispatches to a survivor under the
+        FleetLedger fence, exactly like a migration off a dead replica.
+        A descale is therefore zero-lost / zero-duplicated by the same
+        arbitration that survives replica death (proven by
+        ``chaos_soak --autoscale``). Refuses to retire the last live
+        replica. Returns a summary dict."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                raise KeyError(f"unknown replica {rid!r}")
+            survivors = [r for r, h in self._health.items()
+                         if r != rid and h["state"] != REPLICA_DEAD]
+            if not survivors:
+                raise ValueError(f"cannot retire {rid}: no surviving "
+                                 "replica to absorb its work")
+            # stop NEW dispatches immediately; _bind's retired re-check
+            # migrates any dispatch that raced this transition
+            self._health[rid]["state"] = REPLICA_DEAD
+            self._update_gauges_locked()
+        cause = RuntimeError(f"replica {rid} retired ({reason})")
+        with self._migrate_lock:
+            with self._lock:
+                self._dead_handled.add(rid)
+                self._death_cause[rid] = cause
+        # drain-or-die through the SAME machinery a TPU preemption uses
+        from ..parallel.preemption import PreemptionHandler
+        handler = PreemptionHandler(
+            rep.engine, journal=self._journal, deadline=float(budget),
+            signals=(), manifest_dir=self._postmortem_dir,
+            flight_recorder=self._flightrec, registry=self._registry)
+        handler.preempt(reason=f"{reason}:{rid}")
+        handler.wait(timeout=float(budget) + 30.0)
+        report = handler.report
+        moved = 0
+        with self._migrate_lock:
+            with self._lock:
+                victims = [fr for fr in self._live.values()
+                           if fr.replica_id == rid and not fr.done()]
+            for fr in victims:
+                if self._redispatch(fr, rep, cause):
+                    moved += 1
+        rep.stop_heartbeat()
+        self._membership.leave(rid)
+        rep.shutdown()
+        with self._lock:
+            self._replicas.pop(rid, None)
+            self._health.pop(rid, None)
+            self._ring = self._build_ring()
+            self._update_gauges_locked()
+        self._m["scale_downs"].inc()
+        if moved:
+            self._m["migrations"].inc(moved)
+        self._flightrec.record(
+            "descale", fleet=self.fleet_id, replica=rid, moved=moved,
+            within_budget=None if report is None else report.within_budget)
+        return {"replica": rid, "moved": moved,
+                "harvested": 0 if report is None
+                else len(report.harvested),
+                "within_budget": None if report is None
+                else report.within_budget,
+                "journal_synced": None if report is None
+                else report.journal_synced,
+                "manifest_path": None if report is None
+                else report.manifest_path}
+
+    def replica_loads(self) -> Dict[str, Tuple[int, int, str]]:
+        """rid → (live load, capacity, health state) over the current
+        fleet — the autoscaler's utilization signal (live gauges first,
+        last beat-carried load as the fallback for unreadable rows)."""
+        with self._lock:
+            reps = dict(self._replicas)
+            states = {rid: h["state"] for rid, h in self._health.items()}
+            beat_loads = {rid: h["load"] for rid, h in
+                          self._health.items()}
+        out: Dict[str, Tuple[int, int, str]] = {}
+        for rid, rep in reps.items():
+            ld = rep.load()
+            if ld is None:
+                ld = beat_loads.get(rid) or 0
+            out[rid] = (int(ld), rep.capacity, states.get(rid, "?"))
+        return out
+
+    def utilization(self) -> float:
+        """Fleet-wide load / DECODE capacity (total cache slots) over
+        non-DEAD replicas: 1.0 = every slot busy, >1 = a queue is
+        building behind the slots — the autoscaler's saturation signal.
+        0.0 on an empty or all-dead fleet."""
+        with self._lock:
+            slot_counts = {rid: self._replicas[rid].slots
+                           for rid in self._replicas}
+        load = slots = 0
+        for rid, (ld, _, state) in self.replica_loads().items():
+            if state == REPLICA_DEAD:
+                continue
+            load += ld
+            slots += slot_counts.get(rid, 0)
+        return 0.0 if slots == 0 else load / slots
 
     def _migrate(self, rid: str, cause: BaseException) -> None:
         """Retire ``rid`` and re-dispatch its non-terminal requests to
@@ -1233,19 +1503,22 @@ class EngineFleetRouter:
             self._migrate(rid, cause)
 
     # ---------------------------------------------------------- lifecycle
+    def _wire_crash_hook(self, rid: str, rep: EngineReplica) -> None:
+        if not rep.supervised:
+            # the fleet IS the supervisor, one level up: a crashing
+            # bare engine reports here instead of failing its
+            # requests, and migration re-runs them exactly once
+            eng = rep.engine
+            eng._supervised = True
+            eng._on_crash = (lambda engine, exc, _rid=rid:
+                             self._on_replica_crash(_rid, engine, exc))
+
     def start(self) -> "EngineFleetRouter":
         if self._started:
             return self
         self._started = True
         for rid, rep in self._replicas.items():
-            if not rep.supervised:
-                # the fleet IS the supervisor, one level up: a crashing
-                # bare engine reports here instead of failing its
-                # requests, and migration re-runs them exactly once
-                eng = rep.engine
-                eng._supervised = True
-                eng._on_crash = (lambda engine, exc, _rid=rid:
-                                 self._on_replica_crash(_rid, engine, exc))
+            self._wire_crash_hook(rid, rep)
             rep.start()
         self._stop_monitor.clear()
         self._monitor = threading.Thread(target=self._monitor_loop,

@@ -247,7 +247,12 @@ class GenerationServingRoute(_RoutePublishMixin):
                  publish_retries: int = 3, retry_backoff: float = 0.05,
                  fault_injector=None, block_size: int = 1, registry=None,
                  trace_store=None, tracing: bool = True, mesh=None,
-                 spec_layout=None, journal=None):
+                 spec_layout=None, journal=None, scheduling: str = "fifo",
+                 shed_headroom: bool = False,
+                 headroom_margin: float = 1.0,
+                 prefill_chunk: Optional[int] = None,
+                 adaptive_block: bool = False, block_ladder=None,
+                 block_latency_target: float = 0.25):
         self._owns_engine = engine is None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
@@ -283,7 +288,18 @@ class GenerationServingRoute(_RoutePublishMixin):
                                           trace_store=trace_store,
                                           tracing=tracing, mesh=mesh,
                                           spec_layout=spec_layout,
-                                          journal=journal)
+                                          journal=journal,
+                                          # scheduling tier (ISSUE 11):
+                                          # EDF order, headroom shed,
+                                          # chunked prefill, adaptive K
+                                          scheduling=scheduling,
+                                          shed_headroom=shed_headroom,
+                                          headroom_margin=headroom_margin,
+                                          prefill_chunk=prefill_chunk,
+                                          adaptive_block=adaptive_block,
+                                          block_ladder=block_ladder,
+                                          block_latency_target=(
+                                              block_latency_target))
         self.engine = engine
         self.broker = broker
         self.input_topic = input_topic

@@ -563,6 +563,207 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
     return summary
 
 
+def run_autoscale_soak(seed: int = 0, max_replicas: int = 3,
+                       num_slots: int = 2, waves: int = 3,
+                       wave_size: int = 8, max_new: int = 6,
+                       vocab: int = 12, wait_s: float = 120.0,
+                       shrink_wait_s: float = 45.0,
+                       prefill_chunk: int = 8,
+                       drain_budget: float = 8.0) -> dict:
+    """Autoscale soak round (``--autoscale``, ISSUE 11): a 1-replica
+    fleet under the full scheduling tier (EDF order, chunked prefill,
+    adaptive block size) takes a burst of mixed short/long-prompt
+    waves; the :class:`BurnRateAutoscaler` must GROW the fleet on the
+    utilization/burn signals, then — once the burst drains and a slow
+    trickle is all that remains — SHRINK it back to one replica through
+    ``retire_replica``'s preemption drain (begin_drain → in-flight
+    block retire → quarantine harvest → ledger-fenced re-dispatch).
+
+    Bars: at least one scale-up and one drain-backed scale-down, the
+    fleet back at min size, ZERO lost (every request completes), ZERO
+    duplicated (ledger-verified), token-identical greedy outputs vs the
+    clean single-engine reference, and a post-shrink steady wave that
+    compiles NOTHING new on the surviving replica — adaptive-K
+    switching and chunk prefill included."""
+    import numpy as np
+
+    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                      TransformerDecoder)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.metrics import default_registry
+    from deeplearning4j_tpu.streaming.autoscale import BurnRateAutoscaler
+    from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                    REPLICA_DEAD)
+
+    rng = np.random.default_rng(seed)
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=32, num_heads=2, num_layers=2, max_length=64,
+        learning_rate=1e-2, seed=5)).init()
+    dec = TransformerDecoder(net)
+    sched = dict(scheduling="edf", prefill_chunk=prefill_chunk,
+                 adaptive_block=True, block_ladder=(1, 2, 4))
+    n_requests = waves * wave_size
+    # mixed stream: half interactive-short, half long prompts that MUST
+    # chunk (len > prefill_chunk); prompt + generated stays inside
+    # t_max=64
+    prompts = []
+    for i in range(n_requests):
+        if i % 2 == 0:
+            prompts.append(rng.integers(0, vocab, int(rng.integers(2, 6))))
+        else:
+            prompts.append(rng.integers(0, vocab,
+                                        int(rng.integers(18, 31))))
+    gens = [int(rng.integers(2, max_new + 1)) for _ in range(n_requests)]
+
+    summary = {"seed": seed, "requests": n_requests,
+               "max_replicas": max_replicas}
+    with CompileAudit() as audit:
+        # clean reference (same decoder + same scheduling tier): ground
+        # truth tokens AND the compile warmup for chunk + rung programs
+        clean = SlotGenerationEngine(net, num_slots=num_slots,
+                                     decoder=dec, **sched)
+        clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+        clean.run_until_drained()
+        expected = [r.result(1) for r in clean_reqs]
+        # warm every adaptive rung explicitly: the clean run's queue
+        # depths need not visit each K, and the steady bar below must
+        # measure SWITCHING, not first-use lowering
+        caches = dec.init_cache(num_slots)
+        ids = np.zeros(num_slots, np.int32)
+        pos = np.full(num_slots, 40, np.int32)
+        for k in (1, 2, 4):
+            # caches are donated per dispatch: thread the returned ones
+            _, _, _, _, caches = dec.decode_block(caches, ids, pos,
+                                                  block_size=k)
+        del caches
+
+        router = EngineFleetRouter(
+            net, num_replicas=1, decoder=dec, num_slots=num_slots,
+            max_pending=max(64, n_requests), heartbeat_interval=0.03,
+            monitor_interval=0.03, suspect_after=0.3, dead_after=1.0,
+            **sched).start()
+        scaler = BurnRateAutoscaler(
+            router, min_replicas=1, max_replicas=max_replicas,
+            saturation_high=1.5, saturation_low=0.5,
+            scale_up_burn=3.0, scale_down_burn=0.9,
+            up_consecutive=1, down_consecutive=8, cooldown_s=0.5,
+            interval=0.05, drain_budget=drain_budget).start()
+
+        # ---- burst: the whole mixed stream lands at once (outstanding
+        # stays far below the shed bound) — the queue builds behind the
+        # slots, utilization crosses the saturation threshold, and the
+        # autoscaler must GROW the fleet. up_consecutive=1: on a warm
+        # jit cache the whole burst can drain in well under a second,
+        # so ONE saturated tick must be enough to trigger (the
+        # hysteresis ladder itself is unit-tested with injected
+        # signals in tests/test_scheduling.py).
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        grown_to = len(router.replica_ids())
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            grown_to = max(grown_to, len(router.replica_ids()))
+            if all(fr.done() for fr in frs):
+                break
+            time.sleep(0.05)
+        stranded = [fr for fr in frs if not fr.done()]
+
+        # ---- idle + trickle: a slow drip keeps SOME work live so the
+        # descale drain has in-flight requests to hand off, while
+        # utilization sits under the scale-down threshold
+        trickle = []
+        t_end = time.monotonic() + shrink_wait_s
+        while time.monotonic() < t_end:
+            live = sum(1 for rid in router.replica_ids()
+                       if router.replica_state(rid) != REPLICA_DEAD)
+            if live <= 1 and router.stats()["scale_downs"] >= 1:
+                break
+            if len(trickle) < 40:
+                tr = router.submit(
+                    prompts[len(trickle) % n_requests],
+                    gens[len(trickle) % n_requests])
+                trickle.append(tr)
+            time.sleep(0.3)
+        trickle_deadline = time.monotonic() + wait_s
+        for fr in trickle:
+            fr._done.wait(max(0.0, trickle_deadline - time.monotonic()))
+        stranded += [fr for fr in trickle if not fr.done()]
+
+        # ---- post-shrink steady wave on the survivor: adaptive-K
+        # switching + chunked prefill must compile NOTHING new. The
+        # scaler stops FIRST: the wave's own saturation must not
+        # re-grow the fleet after the shrink the round just verified.
+        scaler.stop()
+        snap = audit.snapshot()
+        wave = [router.submit(prompts[i], gens[i])
+                for i in range(min(n_requests, 2 * wave_size))]
+        wave_deadline = time.monotonic() + wait_s
+        for fr in wave:
+            fr._done.wait(max(0.0, wave_deadline - time.monotonic()))
+        steady_delta = audit.delta(snap)
+        stranded += [fr for fr in wave if not fr.done()]
+
+        final_live = len(router.replica_ids())
+        stats = router.stats()
+        fleet_table = router.fleet_stats()
+        router.shutdown()
+        ledger = router.ledger.to_dict()
+
+    completed = failed = mismatches = 0
+    for fr, want in zip(frs, expected):
+        if fr.state == fr.DONE:
+            completed += 1
+            if not np.array_equal(fr.result(0), want):
+                mismatches += 1
+        else:
+            failed += 1
+    # trickle/wave reuse the prompt stream modulo n — their references
+    # are the same clean-run rows, so parity covers them too
+    for j, fr in enumerate(trickle):
+        want = expected[j % n_requests]
+        if fr.state == fr.DONE:
+            completed += 1
+            if not np.array_equal(fr.result(0), want):
+                mismatches += 1
+        else:
+            failed += 1
+    for i, fr in enumerate(wave):
+        want = expected[i]
+        if fr.state == fr.DONE:
+            completed += 1
+            if not np.array_equal(fr.result(0), want):
+                mismatches += 1
+        else:
+            failed += 1
+    total = len(frs) + len(trickle) + len(wave)
+    summary.update({
+        "completed": completed, "failed": failed,
+        "total": total, "stranded": len(stranded),
+        "mismatches": mismatches,
+        "grown_to": grown_to, "final_live": final_live,
+        "scale_ups": int(stats["scale_ups"]),
+        "scale_downs": int(stats["scale_downs"]),
+        "descale_moved": int(stats["migrations"]),
+        "trickle": len(trickle),
+        "shed": int(stats["shed"]),
+        "ledger": ledger,
+        "ledger_consistent": ledger["completed"] == total,
+        "steady_new_compiles": steady_delta,
+        "timeline": [{k: v for k, v in e.items() if k != "signals"}
+                     for e in scaler.history],
+        "scaler": scaler.stats(),
+        "metrics": default_registry().snapshot(),
+    })
+    summary["ok"] = bool(
+        not stranded and not mismatches and not failed and
+        summary["scale_ups"] >= 1 and summary["scale_downs"] >= 1 and
+        grown_to >= 2 and final_live == 1 and summary["shed"] == 0 and
+        ledger["duplicates"] == 0 and summary["ledger_consistent"] and
+        not steady_delta)
+    return summary
+
+
 def _fleet_scale_ab(replicas: int, n_requests: int = 24,
                     prompt_len: int = 8, gen: int = 16,
                     num_slots: int = 8) -> dict:
@@ -1143,6 +1344,19 @@ def main(argv=None) -> int:
                          "(ledger-verified), token-identical outputs, "
                          "zero steady compiles per surviving replica, "
                          "near-linear 1->N aggregate tok/s")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="autoscale soak (ISSUE 11): a 1-replica fleet "
+                         "under EDF + chunked prefill + adaptive K "
+                         "takes a mixed short/long burst; the burn-rate "
+                         "autoscaler must GROW the fleet, then drain-"
+                         "SHRINK it back through retire_replica's "
+                         "preemption path — bars: >=1 scale-up, >=1 "
+                         "drain-backed scale-down, zero lost, zero "
+                         "duplicated (ledger-verified), token-identical "
+                         "outputs, {} steady compiles on the survivor "
+                         "across adaptive-K switching")
+    ap.add_argument("--max-replicas", type=int, default=3,
+                    help="autoscale soak: fleet size ceiling")
     ap.add_argument("--no-fleet-scale", action="store_true",
                     help="skip the 1->N aggregate-throughput A/B "
                          "(the slowest part of the fleet soak)")
@@ -1250,6 +1464,39 @@ def main(argv=None) -> int:
                       f"steady_new_compiles="
                       f"{s['steady_new_compiles'] if s['steady_new_compiles'] is not None else '?'}"
                       f"{ab} -> {'ok' if s['ok'] else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.autoscale:
+        if args.mesh or args.replicas or args.process_kill:
+            ap.error("--autoscale runs its own 1->N->1 fleet; it cannot "
+                     "be combined with --mesh/--replicas/--process-kill")
+        ok = True
+        for i in range(args.iterations):
+            s = run_autoscale_soak(seed=args.seed + i,
+                                   max_replicas=args.max_replicas,
+                                   num_slots=args.slots,
+                                   max_new=args.max_new,
+                                   drain_budget=args.drain_deadline)
+            ok = ok and s["ok"]
+            if args.json:
+                print(json.dumps(s, default=str))
+            else:
+                led = s["ledger"]
+                tl = ",".join(f"{e['action']}:{e.get('replica', '?')}"
+                              for e in s["timeline"])
+                print(f"round {i}: autoscale seed={s['seed']} "
+                      f"grew=1->{s['grown_to']}->{s['final_live']} "
+                      f"ups={s['scale_ups']} downs={s['scale_downs']} "
+                      f"moved={s['descale_moved']} "
+                      f"completed={s['completed']}/{s['total']} "
+                      f"stranded={s['stranded']} "
+                      f"mismatches={s['mismatches']} shed={s['shed']} "
+                      f"ledger[ok={led['completed']} "
+                      f"dup={led['duplicates']}] "
+                      f"steady_new_compiles="
+                      f"{s['steady_new_compiles'] or '{}'} "
+                      f"timeline=[{tl}] "
+                      f"-> {'ok' if s['ok'] else 'FAIL'}")
         return 0 if ok else 1
 
     if args.replicas:

@@ -245,6 +245,9 @@ def merge_snapshots(per_url: dict) -> dict:
                 win_pool[win]["n"] += int(agg.get("n") or 0)
                 win_pool[win]["met"] += int(agg.get("met") or 0)
                 row[f"attainment_{win}"] = agg.get("attainment")
+                # per-replica burn rate (ISSUE 11): the autoscaler's
+                # input signal, visible per replica in the fleet table
+                row[f"burn_{win}"] = agg.get("burn_rate")
         overall = slo.get("overall") or {}
         head = overall.get("headroom_s") or {}
         row["headroom_p50_s"] = head.get("p50")
@@ -286,8 +289,9 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
     w = out.write
     w(f"fleet scrape: {doc['up']}/{doc['scraped']} replicas up\n")
     w(f"  {'replica':<36} {'up':>2} {'uptime':>8} {'att-short':>9} "
-      f"{'att-long':>8} {'reqs':>6} {'miss':>5} {'hd-p50':>8} "
-      f"{'hd-min':>8} {'kv-bytes':>10} {'j-pend':>6} {'j-deg':>5}\n")
+      f"{'att-long':>8} {'burn-sh':>8} {'reqs':>6} {'miss':>5} "
+      f"{'hd-p50':>8} {'hd-min':>8} {'kv-bytes':>10} {'j-pend':>6} "
+      f"{'j-deg':>5}\n")
     fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
     for base, row in sorted(doc["replicas"].items()):
         if not row.get("up"):
@@ -297,6 +301,7 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
         w(f"  {base:<36} {'y':>2} {fmt(row.get('uptime_s')):>8} "
           f"{fmt(row.get('attainment_short')):>9} "
           f"{fmt(row.get('attainment_long')):>8} "
+          f"{fmt(row.get('burn_short')):>8} "
           f"{fmt(row.get('requests')):>6} {fmt(row.get('missed')):>5} "
           f"{fmt(row.get('headroom_p50_s')):>8} "
           f"{fmt(row.get('headroom_min_s')):>8} "

@@ -1,0 +1,577 @@
+"""SLO-aware scheduling tier (ISSUE 11): chunked prefill, adaptive
+decode block size, EDF admission with headroom shedding, and the
+burn-rate autoscaler — plus the SLO edge math the policies read.
+
+Parity is the tentpole contract: the scheduling tier re-ORDERS and
+re-CHUNKS work, it must never change any request's greedy tokens."""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                       TransformerDecoder,
+                                       transformer_lm_conf)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.observability.slo import SLOTracker
+from deeplearning4j_tpu.parallel.faults import RejectedError
+from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+from deeplearning4j_tpu.streaming.autoscale import BurnRateAutoscaler
+from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                KVFleetMembership)
+
+
+def _lm(vocab=12, max_length=64, **kw):
+    kw.setdefault("d_model", 32)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("learning_rate", 1e-2)
+    kw.setdefault("seed", 5)
+    return ComputationGraph(transformer_lm_conf(
+        vocab, max_length=max_length, **kw)).init()
+
+
+@pytest.fixture(scope="module")
+def lm_net():
+    return _lm()
+
+
+@pytest.fixture(scope="module")
+def decoder(lm_net):
+    return TransformerDecoder(lm_net)
+
+
+def _prompts(rng, n, lo=2, hi=30, vocab=12):
+    return [rng.integers(0, vocab, int(rng.integers(lo, hi))).astype(
+        np.int32) for _ in range(n)]
+
+
+def _reference(lm_net, decoder, prompts, gens):
+    eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder)
+    reqs = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+    eng.run_until_drained()
+    return [r.result(5) for r in reqs]
+
+
+class TestChunkedPrefill:
+    """Long prompts prefill window by window, token-identically."""
+
+    def test_greedy_parity_vs_whole_prompt(self, lm_net, decoder,
+                                           rng_np):
+        prompts = _prompts(rng_np, 8, lo=2, hi=30)
+        gens = [4 + i % 4 for i in range(8)]
+        want = _reference(lm_net, decoder, prompts, gens)
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder,
+                                   prefill_chunk=8)
+        reqs = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+        eng.run_until_drained()
+        for r, w in zip(reqs, want):
+            assert np.array_equal(r.result(5), w)
+        # the long prompts really went through the chunked path
+        assert eng.stats()["prefill_chunks"] > 0
+
+    def test_chunk_parity_with_block_pipeline(self, lm_net, decoder,
+                                              rng_np):
+        # chunk windows interleave with K>1 decode blocks: the frozen
+        # chunking lane must never clobber the cells the windows fill
+        prompts = _prompts(rng_np, 8, lo=2, hi=30)
+        gens = [3 + i % 5 for i in range(8)]
+        want = _reference(lm_net, decoder, prompts, gens)
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder,
+                                   prefill_chunk=8, block_size=4)
+        reqs = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+        eng.run_until_drained()
+        for r, w in zip(reqs, want):
+            assert np.array_equal(r.result(5), w)
+
+    def test_final_window_slides_at_cache_edge(self, lm_net, rng_np):
+        # prompt long enough that the final window would overhang
+        # t_max: it slides LEFT over already-filled cells instead
+        dec = TransformerDecoder(lm_net)
+        p = rng_np.integers(0, 12, 58).astype(np.int32)   # t_max=64
+        ref = dec.generate([p], 4)[0]
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=dec,
+                                   prefill_chunk=16)
+        req = eng.submit(p, 4)
+        eng.run_until_drained()
+        assert np.array_equal(req.result(5), ref)
+
+    def test_cancel_and_deadline_mid_chunk(self, lm_net, decoder,
+                                           rng_np):
+        from deeplearning4j_tpu.parallel.faults import (Cancelled,
+                                                        DeadlineExceeded)
+        eng = SlotGenerationEngine(lm_net, num_slots=1, decoder=decoder,
+                                   prefill_chunk=8)
+        long_p = rng_np.integers(0, 12, 28).astype(np.int32)
+        r1 = eng.submit(long_p, 4)
+        r1.cancel()
+        eng.run_until_drained()
+        with pytest.raises(Cancelled):
+            r1.result(5)
+        r2 = eng.submit(long_p, 4, deadline=1e-4)
+        time.sleep(0.01)
+        eng.run_until_drained()
+        with pytest.raises(DeadlineExceeded):
+            r2.result(5)
+
+    def test_quarantine_harvests_chunking_requests(self, lm_net,
+                                                   decoder, rng_np):
+        eng = SlotGenerationEngine(lm_net, num_slots=1, decoder=decoder,
+                                   prefill_chunk=8)
+        long_p = rng_np.integers(0, 12, 28).astype(np.int32)
+        req = eng.submit(long_p, 4)
+        # drive ONE chunk by hand, then quarantine mid-prefill
+        eng._sweep_pending()
+        eng._admit()
+        eng._advance_chunks()
+        assert eng._chunking, "request should be mid-chunk"
+        harvested, _ = eng.quarantine()
+        assert req in harvested and not req.done()
+
+    def test_supervisor_restart_preserves_policy(self, lm_net, decoder):
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder,
+                                   prefill_chunk=8, scheduling="edf",
+                                   shed_headroom=True,
+                                   adaptive_block=True,
+                                   block_ladder=(1, 2))
+        sup = EngineSupervisor(eng, timeout=5.0, max_restarts=2).start()
+        try:
+            with sup._sup_lock:        # _restart's caller contract
+                sup._restart(cause=RuntimeError("test"))
+            new = sup.engine
+            assert new is not eng
+            assert new.prefill_chunk == 8
+            assert new.scheduling == "edf"
+            assert new.shed_headroom is True
+            assert new.adaptive_block is True
+            assert new.block_ladder == (1, 2)
+        finally:
+            sup.stop()
+
+
+class TestAdaptiveBlock:
+    """K follows queue depth, capped by measured latency; switching
+    compiles nothing once every rung is warm."""
+
+    def test_policy_depth_and_latency_cap(self, lm_net, decoder):
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder,
+                                   adaptive_block=True,
+                                   block_ladder=(1, 2, 4, 8),
+                                   block_latency_target=0.2)
+        assert eng.block_size == 8          # capacity checks use max K
+        # idle queue -> K=1
+        assert eng._choose_block_size() == 1
+        # deep queue -> largest rung that fits the depth
+        eng._pending.extend([object()] * 3)
+        assert eng._choose_block_size() == 2
+        eng._pending.extend([object()] * 20)
+        assert eng._choose_block_size() == 8
+        # measured latency caps the rung: 0.06 s/step * 8 > 0.2 s
+        eng._est_step = 0.06
+        assert eng._choose_block_size() == 2
+        eng._est_step = 1.0                 # never below the floor rung
+        assert eng._choose_block_size() == 1
+        eng._pending.clear()
+
+    def test_parity_and_zero_compiles_across_switching(self, lm_net,
+                                                       decoder, rng_np):
+        prompts = _prompts(rng_np, 10, lo=2, hi=12)
+        gens = [3 + i % 4 for i in range(10)]
+        want = _reference(lm_net, decoder, prompts, gens)
+        with CompileAudit() as audit:
+            # warm every rung on this decoder
+            caches = decoder.init_cache(2)
+            ids = np.zeros(2, np.int32)
+            pos = np.full(2, 4, np.int32)
+            for k in (1, 2, 4):
+                _, _, _, _, caches = decoder.decode_block(
+                    caches, ids, pos, block_size=k)
+            del caches
+            eng = SlotGenerationEngine(lm_net, num_slots=2,
+                                       decoder=decoder,
+                                       adaptive_block=True,
+                                       block_ladder=(1, 2, 4))
+            warm = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+            eng.run_until_drained()
+            snap = audit.snapshot()
+            reqs = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+            eng.run_until_drained()
+            assert audit.delta(snap) == {}
+        for r, w in zip(warm, want):
+            assert np.array_equal(r.result(5), w)
+        for r, w in zip(reqs, want):
+            assert np.array_equal(r.result(5), w)
+
+
+class TestEDFAdmission:
+    """Earliest deadline pops first; equal headroom falls back to FIFO
+    (no starvation among ties); headroom shed records exactly one SLO
+    miss."""
+
+    def test_edf_pops_earliest_deadline(self, lm_net, decoder):
+        eng = SlotGenerationEngine(lm_net, num_slots=1, decoder=decoder,
+                                   scheduling="edf")
+        order = []
+        late = eng.submit([1, 2], 2, deadline=60.0)
+        none = eng.submit([1, 2], 2)               # no deadline: last
+        early = eng.submit([1, 2], 2, deadline=5.0)
+        for r in (late, none, early):
+            r.add_done_callback(order.append)
+        eng.run_until_drained()
+        assert order == [early, late, none]
+
+    def test_equal_deadline_fifo_tie_break(self, lm_net, decoder):
+        eng = SlotGenerationEngine(lm_net, num_slots=1, decoder=decoder,
+                                   scheduling="edf")
+        now = time.monotonic()
+        reqs = [eng.submit([1, 2], 2, deadline=60.0) for _ in range(6)]
+        for r in reqs:                     # identical ABSOLUTE deadline
+            r._deadline_t = now + 60.0
+        order = []
+        for r in reqs:
+            r.add_done_callback(order.append)
+        eng.run_until_drained()
+        assert order == reqs               # FIFO among ties: none starve
+        del now
+
+    def test_fifo_engine_unchanged(self, lm_net, decoder):
+        eng = SlotGenerationEngine(lm_net, num_slots=1, decoder=decoder)
+        order = []
+        a = eng.submit([1, 2], 2, deadline=60.0)
+        b = eng.submit([1, 2], 2, deadline=5.0)
+        for r in (a, b):
+            r.add_done_callback(order.append)
+        eng.run_until_drained()
+        assert order == [a, b]
+
+    def test_headroom_shed_exactly_one_miss(self, lm_net, decoder):
+        reg = MetricsRegistry()
+        slo = SLOTracker(registry=reg)
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder,
+                                   shed_headroom=True, registry=reg,
+                                   slo=slo)
+        # cold estimates admit everything (no shed on no data): an
+        # infeasible request is QUEUED, not synchronously shed
+        cold = eng.submit([1, 2, 3], 10_000, deadline=50.0)
+        assert not cold.done()
+        cold.cancel()
+        warm = eng.submit([1, 2, 3], 3)
+        eng.run_until_drained()
+        assert eng.stats()["headroom_shed"] == 0
+        assert warm.done()
+        # warm estimates + infeasible budget -> shed with the miss
+        req = eng.submit([1, 2, 3], 10_000, deadline=eng._est_step)
+        assert req.done()
+        with pytest.raises(RejectedError) as ei:
+            req.result(0)
+        assert ei.value.projected_miss_s > 0
+        assert eng.stats()["headroom_shed"] == 1
+        assert eng.stats()["rejected"] >= 1
+        assert slo.snapshot()["by_status"].get("shed") == 1
+        # feasible deadline still admits
+        ok = eng.submit([1, 2, 3], 3, deadline=300.0)
+        eng.run_until_drained()
+        assert np.asarray(ok.result(5)).shape[0] == 6
+        assert slo.snapshot()["by_status"].get("shed") == 1
+
+    def test_headroom_charges_every_chunk_window(self, lm_net, decoder):
+        from deeplearning4j_tpu.models.generation import GenerationRequest
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder,
+                                   shed_headroom=True, prefill_chunk=8)
+        eng._est_step = 1e-4
+        eng._est_prefill = 0.05
+        # 4 tokens = one dispatch (0.05s) fits a 0.15s deadline ...
+        short = GenerationRequest(np.arange(4, dtype=np.int32) % 12, 4,
+                                  0.0, None, deadline=0.15)
+        assert eng._headroom_check(short) is None
+        # ... 32 tokens = FOUR chunk windows (0.2s) does not — the
+        # projection must charge every window, not one
+        long_ = GenerationRequest(np.arange(32, dtype=np.int32) % 12, 4,
+                                  0.0, None, deadline=0.15)
+        exc = eng._headroom_check(long_)
+        assert exc is not None and exc.projected_miss_s > 0
+
+    def test_pop_time_reshed_after_queue_wait(self, lm_net, decoder):
+        eng = SlotGenerationEngine(lm_net, num_slots=2, decoder=decoder,
+                                   shed_headroom=True)
+        warm = eng.submit([1, 2], 2)
+        eng.run_until_drained()
+        assert warm.done()
+        eng._est_step = 0.5                # make the projection slow
+        req = eng.submit([1, 2], 8, deadline=30.0)
+        # headroom evaporates while queued; the pop re-check sheds it
+        req._deadline_t = time.monotonic() + 0.01
+        eng.run_until_drained()
+        with pytest.raises(RejectedError):
+            req.result(0)
+        assert eng.stats()["headroom_shed"] == 1
+
+
+class TestSLOEdgeMath:
+    """Burn-rate math the scheduler/autoscaler depend on, at the
+    edges: empty windows, partial windows, injected clocks."""
+
+    def test_burn_rate_empty_window(self):
+        t = SLOTracker(registry=MetricsRegistry(), target=0.99)
+        assert t.attainment(60.0) == 1.0
+        assert t.burn_rate(60.0) == 0.0     # no traffic burns no budget
+
+    def test_burn_rate_partial_window(self):
+        t = SLOTracker(registry=MetricsRegistry(), target=0.9,
+                       short_window=10.0)
+        now = 1000.0
+        # 2 ok + 1 miss inside the window, 5 misses far outside it
+        for i in range(5):
+            t.record("failed", now=now - 100.0)
+        t.record("ok", now=now - 1.0)
+        t.record("ok", now=now - 2.0)
+        t.record("deadline", headroom=-0.5, now=now - 3.0)
+        att = t.attainment(10.0, now=now)
+        assert att == pytest.approx(2.0 / 3.0)
+        assert t.burn_rate(10.0, now=now) == \
+            pytest.approx((1.0 / 3.0) / 0.1)
+        # whole-history window still counts everything
+        assert t.attainment(None, now=now) == pytest.approx(2.0 / 8.0)
+
+    def test_cancelled_excluded_sheds_counted(self):
+        t = SLOTracker(registry=MetricsRegistry(), target=0.5)
+        t.record("cancelled", now=10.0)
+        assert t.attainment(None, now=11.0) == 1.0   # withdrawn ≠ miss
+        t.record("shed", now=10.5)
+        assert t.attainment(None, now=11.0) == 0.0   # shed IS a miss
+        assert t.burn_rate(None, now=11.0) == pytest.approx(2.0)
+
+
+class TestAutoscaler:
+    """Decision hysteresis with injected signals; live grow/shrink with
+    drain-backed zero-loss is covered by chaos_soak --autoscale."""
+
+    def _router(self, lm_net, decoder, n=1):
+        return EngineFleetRouter(lm_net, num_replicas=n, decoder=decoder,
+                                 num_slots=2).start()
+
+    def test_hysteresis_and_clamps(self, lm_net, decoder):
+        router = self._router(lm_net, decoder)
+        try:
+            asc = BurnRateAutoscaler(router, min_replicas=1,
+                                     max_replicas=2, up_consecutive=3,
+                                     down_consecutive=2, cooldown_s=0.0)
+            hot = {"burn_short": 9.0, "burn_long": 9.0,
+                   "utilization": 3.0, "live_replicas": 1}
+            assert asc.evaluate_once(hot) is None
+            assert asc.evaluate_once(hot) is None
+            assert asc.evaluate_once(hot) == "up"      # 3rd consecutive
+            assert len(router.replica_ids()) == 2
+            hot2 = dict(hot, live_replicas=2)
+            for _ in range(5):                         # clamped at max
+                assert asc.evaluate_once(hot2) is None
+            cold = {"burn_short": 0.0, "burn_long": 0.0,
+                    "utilization": 0.0, "live_replicas": 2}
+            assert asc.evaluate_once(cold) is None
+            assert asc.evaluate_once(cold) == "down"
+            assert len(router.replica_ids()) == 1
+            cold1 = dict(cold, live_replicas=1)
+            for _ in range(5):                         # clamped at min
+                assert asc.evaluate_once(cold1) is None
+            assert asc.stats()["scale_ups"] == 1
+            assert asc.stats()["scale_downs"] == 1
+        finally:
+            router.shutdown()
+
+    def test_cooldown_gates_consecutive_actions(self, lm_net, decoder):
+        router = self._router(lm_net, decoder)
+        try:
+            asc = BurnRateAutoscaler(router, min_replicas=1,
+                                     max_replicas=4, up_consecutive=1,
+                                     down_consecutive=1, cooldown_s=60.0)
+            hot = {"burn_short": 9.0, "burn_long": 9.0,
+                   "utilization": 3.0, "live_replicas": 1}
+            assert asc.evaluate_once(hot, now=100.0) == "up"
+            hot2 = dict(hot, live_replicas=2)
+            assert asc.evaluate_once(hot2, now=100.5) is None  # cooling
+            assert asc.evaluate_once(hot2, now=161.0) == "up"
+        finally:
+            router.shutdown()
+
+    def test_mixed_signal_resets_streaks(self, lm_net, decoder):
+        router = self._router(lm_net, decoder)
+        try:
+            asc = BurnRateAutoscaler(router, min_replicas=1,
+                                     max_replicas=2, up_consecutive=2,
+                                     down_consecutive=2, cooldown_s=0.0)
+            hot = {"burn_short": 9.0, "burn_long": 9.0,
+                   "utilization": 3.0, "live_replicas": 1}
+            calm = {"burn_short": 0.7, "burn_long": 0.7,
+                    "utilization": 1.0, "live_replicas": 1}
+            assert asc.evaluate_once(hot) is None
+            assert asc.evaluate_once(calm) is None     # streak reset
+            assert asc.evaluate_once(hot) is None      # back to 1 of 2
+            assert asc.evaluate_once(hot) == "up"
+        finally:
+            router.shutdown()
+
+
+class TestElasticFleet:
+    """Live grow/shrink with work in flight: zero lost, zero dup."""
+
+    def test_retire_moves_inflight_exactly_once(self, lm_net, decoder,
+                                                rng_np):
+        prompts = _prompts(rng_np, 10, lo=3, hi=16)
+        want = [np.asarray(decoder.generate([p], 6)[0]) for p in prompts]
+        router = EngineFleetRouter(lm_net, num_replicas=1,
+                                   decoder=decoder, num_slots=2).start()
+        try:
+            frs = [router.submit(p, 6) for p in prompts[:5]]
+            rid = router.add_replica()
+            assert rid in router.replica_ids()
+            frs += [router.submit(p, 6) for p in prompts[5:]]
+            time.sleep(0.2)
+            rep = router.retire_replica(rid, budget=5.0)
+            assert rid not in router.replica_ids()
+            outs = [fr.result(60) for fr in frs]
+            for o, w in zip(outs, want):
+                assert np.array_equal(o, w)
+            led = router.ledger.to_dict()
+            assert led["duplicates"] == 0
+            assert led["completed"] == len(frs)
+            assert router.stats()["scale_ups"] == 1
+            assert router.stats()["scale_downs"] == 1
+            assert rep["within_budget"] is True
+        finally:
+            router.shutdown()
+
+    def test_retire_last_replica_refused(self, lm_net, decoder):
+        router = EngineFleetRouter(lm_net, num_replicas=1,
+                                   decoder=decoder, num_slots=2).start()
+        try:
+            with pytest.raises(ValueError, match="no surviving"):
+                router.retire_replica("r0")
+        finally:
+            router.shutdown()
+
+    def test_router_shed_carries_per_replica_detail(self, lm_net,
+                                                    decoder):
+        router = EngineFleetRouter(lm_net, num_replicas=2,
+                                   decoder=decoder, num_slots=1,
+                                   max_pending=0).start()
+        try:
+            fr = router.submit([1, 2, 3], 4)
+            with pytest.raises(RejectedError) as ei:
+                fr.result(5)
+            detail = ei.value.replica_depths
+            assert set(detail) == {"r0", "r1"}
+            for rid, row in detail.items():
+                assert row["state"] in ("ALIVE", "SUSPECT", "DEAD")
+                assert row["capacity"] == 1    # 0 pending + 1 slot
+        finally:
+            router.shutdown()
+
+
+class TestMeshComposition:
+    """The scheduling tier composes with mesh-sharded decode (r12):
+    chunk windows slice/scatter a data-sharded cache under GSPMD."""
+
+    def test_chunk_adaptive_on_sharded_decoder(self, rng_np):
+        from deeplearning4j_tpu.parallel.mesh import generation_mesh
+        net = _lm()
+        dec = TransformerDecoder(net, mesh=generation_mesh(2, 1))
+        ref = TransformerDecoder(net)
+        prompts = _prompts(rng_np, 4, lo=3, hi=26)
+        want = [np.asarray(ref.generate([p], 5)[0]) for p in prompts]
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   prefill_chunk=8, adaptive_block=True,
+                                   block_ladder=(1, 2))
+        reqs = [eng.submit(p, 5) for p in prompts]
+        eng.run_until_drained()
+        for r, w in zip(reqs, want):
+            assert np.array_equal(r.result(5), w)
+        assert eng.stats()["prefill_chunks"] > 0
+
+
+class TestKVMembershipPruning:
+    """Write-once beat keys stay bounded: a long-lived fleet's scan
+    cost is flat (satellite regression)."""
+
+    class FakeKV:
+        def __init__(self):
+            self.store = {}
+
+        def key_value_set(self, k, v):
+            if k in self.store:
+                raise RuntimeError("write-once")
+            self.store[k] = v
+
+        def key_value_dir_get(self, prefix):
+            return [(k, v) for k, v in self.store.items()
+                    if k.startswith(prefix)]
+
+        def key_value_delete(self, k):
+            del self.store[k]
+
+    def test_scan_cost_stays_flat(self):
+        kv = self.FakeKV()
+        m = KVFleetMembership(kv, "f", epoch=7, prune_keep=3,
+                              prune_every=5)
+        bound = 2 * (3 + 2 * 5)      # keep + one prune period of beats
+        for i in range(300):
+            m.beat("rA", i)
+            m.beat("rB", i)
+            ages = m.ages()
+            assert len(kv.store) <= bound, (i, len(kv.store))
+        assert set(ages) == {"rA", "rB"}
+        assert m.pruned_keys > 0
+
+    def test_superseded_epoch_pruned_liveness_kept(self):
+        kv = self.FakeKV()
+        old = KVFleetMembership(kv, "f", epoch=3, prune_every=10_000)
+        for i in range(20):
+            old.beat("rA", i)
+        # rejoin with a NEW epoch; its scans prune the dead incarnation
+        new = KVFleetMembership(kv, "f", epoch=9, prune_keep=2,
+                                prune_every=1)
+        for i in range(3):
+            new.beat("rA", i)
+            ages = new.ages()
+        assert "rA" in ages
+        epoch3 = [k for k in kv.store if "/rA/" in k and
+                  "0000000000000003-" in k]
+        assert not epoch3, epoch3    # superseded epoch fully pruned
+
+    def test_tombstoned_member_loses_all_beat_keys(self):
+        kv = self.FakeKV()
+        m = KVFleetMembership(kv, "f", epoch=1, prune_keep=2,
+                              prune_every=1)
+        for i in range(6):
+            m.beat("rA", i)
+            m.beat("rB", i)
+        m.leave("rA")
+        for i in range(3):
+            m.beat("rB", 10 + i)
+            ages = m.ages()
+        assert "rA" not in ages
+        left_a = [k for k in kv.store if "/rA/" in k]
+        assert left_a == [f"dl4j/fleet/f/rA/left"]
+
+    def test_no_delete_client_degrades_gracefully(self):
+        class NoDeleteKV:
+            def __init__(self):
+                self.store = {}
+
+            def key_value_set(self, k, v):
+                self.store[k] = v
+
+            def key_value_dir_get(self, prefix):
+                return [(k, v) for k, v in self.store.items()
+                        if k.startswith(prefix)]
+
+        kv = NoDeleteKV()
+        m = KVFleetMembership(kv, "f", epoch=1, prune_every=1)
+        for i in range(10):
+            m.beat("rA", i)
+            ages = m.ages()
+        assert "rA" in ages             # scans fine, just no pruning
+        assert m.pruned_keys == 0
+        assert len(kv.store) == 10      # legacy growth behaviour
