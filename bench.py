@@ -525,6 +525,47 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
         eng_blk = float(np.median(
             [batching_run(True, block=chosen) for _ in range(RUNS)]))
 
+    # ---- shared-prefix paged A/B (ISSUE 12): N streams × ONE system
+    # prompt — the dominant millions-of-users pattern. The slab engine
+    # re-prefills the prefix for every request; the paged engine maps
+    # it read-only from the content-hashed prefix cache (after one
+    # priming request) and prefills only the tail.
+    pfx_len = int(os.environ.get("BENCH_GEN_PREFIX",
+                                 str(max(16, tp // 2))))
+    pfx_n = int(os.environ.get("BENCH_GEN_PREFIX_REQUESTS",
+                               str(2 * slots)))
+    ps = next(c for c in (32, 16, 8, 4, 2, 1) if dec.t_max % c == 0)
+    sys_p = req_rng.integers(0, v, pfx_len).astype(np.int32)
+    pfx_prompts = [np.concatenate(
+        [sys_p, req_rng.integers(0, v, 8).astype(np.int32)])
+        for _ in range(pfx_n)]
+
+    def prefix_run(paged: bool):
+        eng = SlotGenerationEngine(dec.net, num_slots=slots,
+                                   decoder=dec, paged=paged,
+                                   page_size=ps)
+        if paged:
+            # prime: the first request registers the prefix chain, so
+            # the measured stream is the steady (all-hit) state
+            eng.submit(pfx_prompts[0], 1)
+            eng.run_until_drained()
+        for p in pfx_prompts:
+            eng.submit(p, 4)
+        t0 = time.perf_counter()
+        eng.run_until_drained()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        return (sum(len(p) for p in pfx_prompts) / wall,
+                st["prefix_cache_hits"], st["prefix_cache_misses"])
+
+    prefix_run(False)                        # warm both paths' compiles
+    prefix_run(True)
+    pfx_off = float(np.median([prefix_run(False)[0]
+                               for _ in range(RUNS)]))
+    pfx_on_runs = [prefix_run(True) for _ in range(RUNS)]
+    pfx_on = float(np.median([r[0] for r in pfx_on_runs]))
+    pfx_hits, pfx_misses = pfx_on_runs[-1][1], pfx_on_runs[-1][2]
+
     result = {
         "metric": "lm_generate_decode_tokens_per_sec",
         "value": round(dec_med, 2),
@@ -557,6 +598,15 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
                 "block_k_tokens_per_sec": round(eng_blk, 2)
                 if eng_blk is not None else None,
                 "slots": slots, "requests": n_req},
+            "shared_prefix": {
+                "prefix_len": pfx_len, "requests": pfx_n,
+                "page_size": ps,
+                "slab_prompt_tokens_per_sec": round(pfx_off, 2),
+                "paged_prompt_tokens_per_sec": round(pfx_on, 2),
+                "paged_prefill_speedup": round(pfx_on / pfx_off, 3)
+                if pfx_off > 0 else None,
+                "prefix_hits": pfx_hits,
+                "prefix_misses": pfx_misses},
             "config": {"batch": b, "prompt_t": tp, "decode_steps": steps,
                        "vocab": v},
         },

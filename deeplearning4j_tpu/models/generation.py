@@ -96,6 +96,9 @@ _ENGINE_COUNTERS = {
     "cancelled": "requests cancelled by their caller",
     "requeued": "requests recovered into this engine after a takeover",
     "failed": "requests failed by engine crash/shutdown",
+    "page_preempted": "requests preempted mid-decode on KV page-pool "
+                      "pressure (re-queued at the head; exactly-once "
+                      "preserved — re-admission re-prefills)",
 }
 #: unique per-engine metric label values (e0, e1, ...)
 _ENGINE_SEQ = itertools.count()
@@ -193,6 +196,7 @@ class TransformerDecoder:
         self._cache_sharding = None
         self._impl_suffix = ""          # per-mesh compile attribution
         self._row_shardings = None
+        self._pool_shardings_cached = None   # paged-pool NamedShardings
         if mesh is not None:
             from ..parallel.mesh import mesh_tag, validate_decode_mesh
             from ..parallel.spec_layout import (SpecLayout,
@@ -285,6 +289,33 @@ class TransformerDecoder:
                     sharding=self._cache_sharding)
                 for name in self.attn_names}
 
+    def _pool_shardings(self):
+        """Paged-pool NamedSharding tree (heads over tp, pages and the
+        in-page dim unsharded) for the paged impls' in/out constraints;
+        None on an unsharded decoder."""
+        if self.mesh is None:
+            return None
+        if self._pool_shardings_cached is None:
+            psh = NamedSharding(self.mesh, self._layout.kv_pages())
+            self._pool_shardings_cached = {n: {"k": psh, "v": psh}
+                                           for n in self.attn_names}
+        return self._pool_shardings_cached
+
+    def init_paged_pool(self, num_pages: int,
+                        page_size: int) -> Dict[str, Dict]:
+        """{attn_name: {"k","v" [P, H, page_size, Dh]}} — one paged
+        pool per attention vertex, replacing the contiguous slab.
+        With a mesh the pool is BORN sharded heads-over-tp (the same
+        axis the slab shards H on); pages replicate over data, since
+        any slot may map any page."""
+        sharding = None
+        if self.mesh is not None:
+            sharding = NamedSharding(self.mesh, self._layout.kv_pages())
+        return {name: self.net.conf.vertices[name].layer.init_page_pool(
+                    int(num_pages), int(page_size),
+                    self.net.compute_dtype, sharding=sharding)
+                for name in self.attn_names}
+
     # -------------------------------------------------------------- walks
     # graftlint: traced
     def _walk_prefill(self, params, state, caches, tokens, lengths):
@@ -367,6 +398,76 @@ class TransformerDecoder:
                     isinstance(v.layer, SelfAttentionLayer):
                 acts[name], new_caches[name] = v.layer.chunk_forward(
                     params[name], xs[0], caches[name], pos0)
+            elif name == self.output_name:
+                idx = jnp.clip(valid - 1, 0)[:, None, None]
+                h_last = jnp.take_along_axis(xs[0], idx, axis=1)
+                logits = v.layer.preoutput(params[name], h_last)[:, 0]
+            else:
+                y, _ = v.forward(params[name], state[name], xs, train=False,
+                                 rng=None, masks=[None] * len(xs))
+                acts[name] = y
+        return logits.astype(jnp.float32), new_caches
+
+    # graftlint: traced
+    def _walk_paged_decode(self, params, state, caches, ptables, ids,
+                           positions):
+        """One single-token step over PAGED pools: like
+        :meth:`_walk_decode`, but every attention vertex writes/reads
+        through the shared per-slot page table (``ptables`` [B, NP] —
+        one table serves every layer, like a slot index does)."""
+        conf = self.net.conf
+        acts = {self.input_name: ids}
+        new_caches = {}
+        logits = None
+        for name in conf.topological_order:
+            v = conf.vertices[name]
+            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            if isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, TokenAndPositionEmbedding):
+                acts[name] = v.layer.embed_at(params[name], xs[0], positions)
+            elif isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, SelfAttentionLayer):
+                acts[name], new_caches[name] = v.layer.paged_decode_forward(
+                    params[name], xs[0], caches[name], ptables, positions)
+            elif name == self.output_name:
+                logits = v.layer.preoutput(params[name], xs[0])[:, 0]
+            else:
+                y, _ = v.forward(params[name], state[name], xs, train=False,
+                                 rng=None, masks=[None] * len(xs))
+                acts[name] = y
+        return logits.astype(jnp.float32), new_caches
+
+    # graftlint: traced
+    def _walk_paged_chunk(self, params, state, caches, ptables, tokens,
+                          pos0, valid):
+        """One paged prefill/chunk window: tokens [B, C] at absolute
+        start positions ``pos0`` [B] (0 for fresh prompts, the shared-
+        prefix length after a prefix-cache hit) with ``valid`` [B] real
+        tokens per row. The paged analogue of :meth:`_walk_chunk` —
+        earlier context (including READ-ONLY shared prefix pages) is
+        attended through the page tables, so a prefix-cache hit
+        prefills only the tail. Returns (logits at each row's last real
+        window position [B, V] f32, new pools)."""
+        conf = self.net.conf
+        acts = {self.input_name: tokens}
+        new_caches = {}
+        logits = None
+        for name in conf.topological_order:
+            v = conf.vertices[name]
+            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            if isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, TokenAndPositionEmbedding):
+                acts[name] = v.layer.embed_chunk(params[name], xs[0], pos0)
+            elif isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, SelfAttentionLayer):
+                # through the prefill-named seam (which delegates to
+                # paged_chunk_forward): admission tails and chunk
+                # windows are the same computation, and the fused
+                # paged-prefill kernel (ROADMAP 5) overrides here
+                acts[name], new_caches[name] = \
+                    v.layer.paged_prefill_forward(
+                        params[name], xs[0], caches[name], ptables,
+                        pos0, valid)
             elif name == self.output_name:
                 idx = jnp.clip(valid - 1, 0)[:, None, None]
                 h_last = jnp.take_along_axis(xs[0], idx, axis=1)
@@ -568,6 +669,64 @@ class TransformerDecoder:
                 in_specs=(psh, None, csh, None, None, None, None, None,
                           None),
                 out_specs=(None, csh))
+        elif name == "paged_prefill":
+            def paged_prefill_impl(params, state, caches, tokens, pos0,
+                                   valid, ptables, temps, key):
+                # batched PAGED admission: every row is a tail window
+                # [pos0, pos0+valid) prefilled straight through its page
+                # table — a prefix-cache hit never recomputes the shared
+                # prefix's forward, it only attends its resident pages.
+                # Count and window-length are bucketed by the caller
+                # (pow2), so the signature set is finite.
+                logits, caches = self._walk_paged_chunk(
+                    params, state, caches, ptables, tokens, pos0, valid)
+                return self._select(logits, temps, key), caches
+            pool_sh = self._pool_shardings()
+            # admission buckets may undershoot the data axis, so the
+            # batch-side inputs stay unconstrained (like prefill_slots);
+            # only the POOL keeps its pinned layout through the scatter
+            fn = self._jit_sharded(
+                paged_prefill_impl, donate,
+                in_specs=(psh, None, pool_sh, None, None, None, None,
+                          None, None),
+                out_specs=(None, pool_sh))
+        elif isinstance(name, tuple) and name[0] == "paged_block":
+            k_steps = int(name[1])
+
+            def paged_decode_block_impl(params, state, caches, ptables,
+                                        ids, positions, stopped, temps,
+                                        eos_ids, key, step0, key_salt):
+                # K decode steps over PAGED pools in ONE device program:
+                # same carry/freeze/key schedule as decode_block_impl
+                # (token-for-token parity paged-vs-slab is the bar), the
+                # page tables ride as a per-dispatch input — the host
+                # grows them between blocks (lazy page allocation), the
+                # scan itself never re-maps
+                def body(carry, _):
+                    caches, ids, pos, stop, step = carry
+                    pos_c = jnp.minimum(pos, self.t_max - 1)
+                    logits, caches = self._walk_paged_decode(
+                        params, state, caches, ptables, ids, pos_c)
+                    kk = jax.random.fold_in(
+                        key, jnp.bitwise_or(key_salt, step + 1))
+                    nxt = self._select(logits, temps, kk)
+                    nxt = jnp.where(stop, ids, nxt)
+                    hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
+                    new_pos = jnp.where(stop, pos, pos + 1)
+                    new_stop = stop | hit_eos | (new_pos >= self.t_max)
+                    return (caches, nxt, new_pos, new_stop, step + 1), nxt
+                (caches, ids, positions, stopped, _), toks = jax.lax.scan(
+                    body, (caches, ids, positions, stopped, step0), None,
+                    length=k_steps)
+                return toks.T, ids, positions, stopped, caches
+            paged_decode_block_impl.__name__ = \
+                f"paged_decode_block{k_steps}_impl"
+            pool_sh = self._pool_shardings()
+            fn = self._jit_sharded(
+                paged_decode_block_impl, donate,
+                in_specs=(psh, None, pool_sh, mat, row, row, row, row,
+                          row, None, None, None),
+                out_specs=(mat, row, row, row, pool_sh))
         elif isinstance(name, tuple) and name[0] == "block":
             k_steps = int(name[1])
 
@@ -621,11 +780,15 @@ class TransformerDecoder:
         (per-K, per-mesh) — devstats keys its cost table the same way,
         so the two views line up row for row."""
         base = {"prefill": "prefill_impl", "step": "decode_step_impl",
-                "prefill_slots": "prefill_slots_impl"}.get(name)
+                "prefill_slots": "prefill_slots_impl",
+                "paged_prefill": "paged_prefill_impl"}.get(name)
         if base is None and isinstance(name, tuple) and name[0] == "block":
             base = f"decode_block{int(name[1])}_impl"
         if base is None and isinstance(name, tuple) and name[0] == "chunk":
             base = f"prefill_chunk{int(name[1])}_impl"
+        if base is None and isinstance(name, tuple) and \
+                name[0] == "paged_block":
+            base = f"paged_decode_block{int(name[1])}_impl"
         return (base or str(name)) + self._impl_suffix
 
     def _with_cost_seam(self, name, jitted):
@@ -696,6 +859,53 @@ class TransformerDecoder:
         return self._fn(("block", int(block_size)))(
             self._device_params(), self.net._inference_state(), caches,
             jnp.asarray(ids, jnp.int32), jnp.asarray(positions, jnp.int32),
+            jnp.asarray(stopped, jnp.bool_), jnp.asarray(temps),
+            jnp.asarray(eos), key, jnp.asarray(step0, jnp.int32),
+            jnp.asarray(key_salt, jnp.int32))
+
+    # ------------------------------------------------------------- paged
+    def paged_prefill(self, caches, tokens, pos0, valid, ptables,
+                      temps=None, key=None):
+        """Batched tail prefill over PAGED pools: tokens [M, C] are
+        each row's prompt tail starting at absolute position ``pos0``
+        [M] (0 on a prefix-cache miss), ``valid`` [M] real tokens per
+        row, ``ptables`` [M, NP] the rows' page tables. Returns
+        (sampled next ids [M], pools) — ONE readback serves the whole
+        admission wave, exactly like the slab's batched admission."""
+        m = np.shape(tokens)[0]
+        temps = np.zeros(m, np.float32) if temps is None \
+            else np.broadcast_to(np.asarray(temps, np.float32), (m,))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return self._fn("paged_prefill")(
+            self._device_params(), self.net._inference_state(), caches,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(pos0, jnp.int32),
+            jnp.asarray(valid, jnp.int32), jnp.asarray(ptables, jnp.int32),
+            jnp.asarray(temps), key)
+
+    def paged_decode_block(self, caches, ptables, ids, positions,
+                           temps=None, key=None, *, block_size: int,
+                           eos_ids=None, stopped=None, step0=0,
+                           key_salt: int = 0):
+        """``block_size`` fused decode steps over PAGED pools — the
+        paged twin of :meth:`decode_block` (same carry contract, same
+        absolute-step key schedule, so outputs are token-for-token
+        identical to the slab path). ``ptables`` [B, NP] is a
+        per-dispatch input: the host allocates pages lazily between
+        blocks and passes the grown tables with the next dispatch."""
+        b = np.shape(ids)[0]
+        temps = np.zeros(b, np.float32) if temps is None \
+            else np.broadcast_to(np.asarray(temps, np.float32), (b,))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        eos = np.full(b, -1, np.int32) if eos_ids is None \
+            else np.broadcast_to(np.asarray(eos_ids, np.int32), (b,))
+        if stopped is None:
+            stopped = np.zeros(b, bool)
+        return self._fn(("paged_block", int(block_size)))(
+            self._device_params(), self.net._inference_state(), caches,
+            jnp.asarray(ptables, jnp.int32), jnp.asarray(ids, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
             jnp.asarray(stopped, jnp.bool_), jnp.asarray(temps),
             jnp.asarray(eos), key, jnp.asarray(step0, jnp.int32),
             jnp.asarray(key_salt, jnp.int32))
@@ -1069,7 +1279,10 @@ class SlotGenerationEngine:
                  prefill_chunk: Optional[int] = None,
                  adaptive_block: bool = False,
                  block_ladder: Optional[Sequence[int]] = None,
-                 block_latency_target: float = 0.25):
+                 block_latency_target: float = 0.25,
+                 paged: bool = False, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: bool = True):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -1142,7 +1355,51 @@ class SlotGenerationEngine:
         self._est_prefill: Optional[float] = None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
-        self._caches = self.decoder.init_cache(self.num_slots)
+        # ---- paged KV cache + prefix caching (ISSUE 12) ----
+        # paged=True replaces the [S, H, t_max, Dh] slab with per-layer
+        # page POOLS [P, H, page_size, Dh] + per-slot page tables: a
+        # slot holds only the pages its live context needs (lazy
+        # allocation as it grows), so max concurrency is bounded by
+        # ACTUAL footprint, not worst-case length — and identical
+        # prompt prefixes map already-resident pages read-only instead
+        # of re-prefilling (content-hashed prefix cache, page-granular
+        # copy-on-write: shared pages are always full and never
+        # rewritten; the first divergent token starts a private page).
+        self.page_size = int(page_size)
+        self.prefix_cache = bool(prefix_cache)
+        self._pager = None
+        self._pages_per_slot = 0
+        if paged:
+            from .paging import PageAllocator
+            if self.t_max % self.page_size:
+                raise ValueError(
+                    f"page_size {self.page_size} must divide t_max "
+                    f"{self.t_max}: page-aligned logical views keep the "
+                    "paged attention shapes (and therefore its logits) "
+                    "identical to the slab path")
+            self._pages_per_slot = self.t_max // self.page_size
+            if num_pages is None:
+                # slab-equivalent capacity (+1 for the reserved null
+                # page): the default can never admit LESS than the slab
+                # did — pool sizing below that is the operator's
+                # concurrency-vs-memory lever
+                num_pages = self.num_slots * self._pages_per_slot + 1
+            self._pager = PageAllocator(int(num_pages), self.page_size,
+                                        prefix_cache=self.prefix_cache)
+        self.num_pages = None if self._pager is None \
+            else self._pager.num_pages
+        if self._pager is not None:
+            self._caches = self.decoder.init_paged_pool(
+                self._pager.num_pages, self.page_size)
+        else:
+            self._caches = self.decoder.init_cache(self.num_slots)
+        # per-slot page state (paged mode): the logical page list (the
+        # single source of truth for this slot's mapping refs) and the
+        # host page-table matrix shipped with every paged dispatch
+        self._slot_pages: List[List[int]] = \
+            [[] for _ in range(self.num_slots)]
+        self._ptables = np.zeros(
+            (self.num_slots, max(1, self._pages_per_slot)), np.int32)
         self._slots: List[Optional[GenerationRequest]] = \
             [None] * self.num_slots
         self._last_ids = np.zeros(self.num_slots, np.int32)
@@ -1231,6 +1488,24 @@ class SlotGenerationEngine:
             "generation_adaptive_k_total",
             "decode blocks dispatched, by adaptively chosen K",
             ("engine", "k"))
+        # prefix-cache visibility (ISSUE 12): hit/miss per admitted
+        # request plus the prompt tokens whose prefill compute the
+        # shared pages saved — the SAME content hash keys the fleet's
+        # sticky_prefix routing (models/paging.prefix_route_key), so
+        # these counters measure exactly what that routing optimizes
+        self._m_prefix_hit = reg.counter(
+            "prefix_cache_hit_total",
+            "requests admitted with >= 1 shared prefix page mapped",
+            ("engine",)).labels(self.engine_id)
+        self._m_prefix_miss = reg.counter(
+            "prefix_cache_miss_total",
+            "requests admitted with no resident prefix page",
+            ("engine",)).labels(self.engine_id)
+        self._m_prefix_tokens = reg.counter(
+            "prefix_cache_hit_tokens_total",
+            "prompt tokens served from shared prefix pages "
+            "(prefill compute skipped)",
+            ("engine",)).labels(self.engine_id)
         # depth gauges evaluate lazily at collection time through a WEAK
         # reference: the process-default registry must never keep a dead
         # engine (and its device caches) alive
@@ -1244,6 +1519,31 @@ class SlotGenerationEngine:
             lambda: (lambda s: 0 if s is None else
                      sum(r is not None for r in s._slots) +
                      len(s._chunking))(wself()))
+        if self._pager is not None:
+            # page-granular KV accounting (ISSUE 12 satellite): pool
+            # state by page, pool bytes, and the internal-fragmentation
+            # gauge — all weakref'd collection-time reads like the
+            # depth gauges above
+            pg = reg.gauge("generation_kv_pages",
+                           "KV page pool, pages by state",
+                           ("engine", "state"))
+            for st in ("free", "used", "cached", "shared"):
+                pg.labels(self.engine_id, st).set_function(
+                    lambda _st=st: (lambda s: 0 if s is None else
+                                    s._pager.stats()[_st])(wself()))
+            reg.gauge("generation_kv_pool_bytes",
+                      "paged KV pool bytes allocated (global, all "
+                      "layers)", ("engine",)).labels(
+                self.engine_id).set_function(
+                lambda: (lambda s: 0 if s is None else
+                         s._pool_bytes())(wself()))
+            reg.gauge("generation_kv_page_fragmentation",
+                      "allocated-but-unwritten fraction of mapped "
+                      "pages (internal fragmentation)",
+                      ("engine",)).labels(self.engine_id).set_function(
+                lambda: (lambda s: 0.0 if s is None else
+                         (s.kv_page_stats() or {}).get(
+                             "fragmentation", 0.0))(wself()))
         # adaptive-K rungs warm at CONSTRUCTION: the first escalation
         # to a bigger K under a traffic burst must not block the serve
         # loop on a jit compile — that stall would land exactly when
@@ -1257,9 +1557,17 @@ class SlotGenerationEngine:
             w_pos = np.full(self.num_slots, self.t_max - 1, np.int32)
             w_stop = np.ones(self.num_slots, bool)
             for k in self.block_ladder:
-                _, _, _, _, self._caches = self.decoder.decode_block(
-                    self._caches, w_ids, w_pos, stopped=w_stop,
-                    block_size=k)
+                if self._pager is not None:
+                    # all-zero page tables: every frozen warmup write
+                    # lands in the reserved null page
+                    _, _, _, _, self._caches = \
+                        self.decoder.paged_decode_block(
+                            self._caches, self._ptables, w_ids, w_pos,
+                            stopped=w_stop, block_size=k)
+                else:
+                    _, _, _, _, self._caches = self.decoder.decode_block(
+                        self._caches, w_ids, w_pos, stopped=w_stop,
+                        block_size=k)
         # mesh topology gauges (r12): one child per mesh axis so the
         # telemetry endpoint can chart per-axis sizes; set once — the
         # mesh never changes for an engine's lifetime
@@ -1582,6 +1890,119 @@ class SlotGenerationEngine:
         self._admitting.remove(req)
         return True
 
+    # -------------------------------------------------------------- pages
+    def _map_slot_pages(self, s: int, pages: List[int]) -> None:
+        """Install ``pages`` as slot ``s``'s logical mapping (caller
+        holds the engine lock; the pages already carry this mapping's
+        refs — matched shared pages via match_and_ref, fresh ones via
+        alloc)."""
+        self._slot_pages[s] = list(pages)
+        self._ptables[s, :] = 0
+        self._ptables[s, :len(pages)] = pages
+
+    def _release_slot_pages(self, s: int) -> None:
+        """Unmap slot ``s`` (caller holds the engine lock): one unref
+        per mapped page, and the page-table row redirected to the null
+        page so a stale frozen lane's per-block rewrite lands in trash
+        instead of pages the allocator may hand to the next request.
+        Pages the prefix index retains stay resident (refcount falls to
+        the index's 1) — that retention IS the prefix cache."""
+        if self._pager is None:
+            return
+        pages, self._slot_pages[s] = self._slot_pages[s], []
+        self._ptables[s, :] = 0
+        for pid in pages:
+            self._pager.unref(pid)
+
+    def _release_all_pages(self) -> None:
+        """Caller holds the engine lock — the quarantine/shutdown/crash
+        drains release every mapping so the harvest leaves refcounts
+        balanced (audit-clean: only prefix-index retention remains)."""
+        if self._pager is None:
+            return
+        for s in range(self.num_slots):
+            self._release_slot_pages(s)
+
+    def _ensure_decode_pages_locked(self, k: int
+                                    ) -> List[GenerationRequest]:
+        """Grow each active lane's page table to cover this block's
+        furthest write (position + k - 1, clamped to the context edge);
+        caller holds the engine lock. A lane the pool cannot serve —
+        even after evicting cache-only prefix pages — is PREEMPTED:
+        unmapped, re-queued at the head, and returned for the caller's
+        out-of-lock bookkeeping (exactly-once holds: generated tokens
+        ride the request and re-admission re-prefills them). Highest
+        slots are visited first, so their released pages immediately
+        serve the surviving lower lanes."""
+        ps = self.page_size
+        preempted: List[GenerationRequest] = []
+        # pipeline lead: with a block in flight, the device carry (and
+        # therefore the NEXT dispatch's write positions) runs one block
+        # ahead of the host positions — cover it, or a boundary-
+        # crossing write would silently redirect to the null page
+        lead = self._inflight[2] if self._inflight is not None else 0
+        for s in reversed(range(self.num_slots)):
+            req = self._slots[s]
+            if req is None:
+                continue
+            upto = min(int(self._positions[s]) + lead + k - 1,
+                       self.t_max - 1)
+            delta = upto // ps + 1 - len(self._slot_pages[s])
+            if delta <= 0:
+                continue
+            fresh = self._pager.alloc(delta)
+            if fresh is not None:
+                base = len(self._slot_pages[s])
+                self._slot_pages[s].extend(fresh)
+                self._ptables[s, base:base + len(fresh)] = fresh
+                continue
+            self._slots[s] = None
+            self._release_slot_pages(s)
+            req._running = False
+            self._pending.appendleft(req)
+            self._m["page_preempted"].inc()
+            # freed lane: resync the pipeline. Caller holds the engine
+            # lock (the _locked contract), the analyzer just can't see
+            # across the call boundary.
+            self._carry = None   # graftlint: disable=GL006
+            preempted.append(req)
+        return preempted
+
+    def _pool_bytes(self) -> int:
+        if self._pager is None:
+            return 0
+        total = 0
+        for layer in self._caches.values():
+            for leaf in layer.values():
+                total += int(leaf.size) * int(leaf.dtype.itemsize)
+        return total
+
+    def kv_page_stats(self) -> Optional[Dict]:
+        """Page-granular KV accounting (devstats `/snapshot` +
+        telemetry_dump --scrape): allocator pool state, mapped pages,
+        pool bytes, and internal fragmentation (the fraction of mapped
+        page cells no live context has written — the page-size waste
+        knob). None on a slab engine."""
+        if self._pager is None:
+            return None
+        st = self._pager.stats()
+        with self._lock:
+            mapped = sum(len(p) for p in self._slot_pages)
+            written = 0
+            for s in range(self.num_slots):
+                if not self._slot_pages[s]:
+                    continue
+                if s in self._chunking:
+                    written += int(self._chunking[s][2])
+                elif self._slots[s] is not None:
+                    written += int(self._positions[s])
+        st["mapped"] = mapped
+        st["pool_bytes"] = self._pool_bytes()
+        span = mapped * self.page_size
+        st["fragmentation"] = 0.0 if not span else round(
+            max(0.0, 1.0 - written / span), 4)
+        return st
+
     def _req_finished(self, req: GenerationRequest, tok: int) -> bool:
         return (req.eos_id is not None and tok == req.eos_id) or \
             len(req.generated) >= req.max_new_tokens or \
@@ -1625,12 +2046,14 @@ class SlotGenerationEngine:
                     continue
                 if req._cancel_requested:
                     self._slots[s] = None
+                    self._release_slot_pages(s)
                     self._m["cancelled"].inc()
                     doomed.append((req, Cancelled(
                         f"cancelled mid-decode after "
                         f"{len(req.generated)} tokens")))
                 elif req._expired(now):
                     self._slots[s] = None
+                    self._release_slot_pages(s)
                     self._m["deadline_exceeded"].inc()
                     doomed.append((req, DeadlineExceeded(
                         f"deadline of {req.deadline}s exceeded after "
@@ -1647,6 +2070,98 @@ class SlotGenerationEngine:
             b *= 2
         return min(b, self.num_slots)
 
+    def _next_admittable(self) -> Tuple[Optional[GenerationRequest],
+                                        Optional[np.ndarray], bool]:
+        """Pop the next queued request through the lifecycle gates
+        (cancel / deadline / headroom re-projection / recovered-already-
+        finished), parked in ``_admitting`` throughout — shared by the
+        slab and paged admission paths. Returns (req, ctx, aborted):
+        req None + aborted False means the queue drained; aborted True
+        means a takeover drain owns the popped request and the caller
+        must stop admitting entirely."""
+        while True:
+            req = self._pop_for_admit()
+            if req is None:
+                return None, None, False
+            # lifecycle beats admission: never spend prefill compute on
+            # a request that is already cancelled / out of deadline /
+            # (recovered) already finished — and the headroom policy
+            # re-projects with what the queue wait left (a request that
+            # can no longer make its deadline sheds here, not after
+            # decoding)
+            exc = None
+            if req._cancel_requested:
+                exc = Cancelled("cancelled while queued")
+            elif req._expired():
+                exc = DeadlineExceeded(
+                    f"deadline of {req.deadline}s passed while "
+                    "queued")
+            elif self.shed_headroom:
+                exc = self._headroom_check(
+                    req, remaining=req.max_new_tokens -
+                    len(req.generated))
+            if exc is not None:
+                with self._lock:
+                    if not self._unpark(req):
+                        return None, None, True   # a drain owns it now
+                    if isinstance(exc, Cancelled):
+                        self._m["cancelled"].inc()
+                    elif isinstance(exc, RejectedError):
+                        self._m["rejected"].inc()
+                        self._m["headroom_shed"].inc()
+                    else:
+                        self._m["deadline_exceeded"].inc()
+                req._fail(exc)
+                continue
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            if len(ctx) >= self.t_max or \
+                    len(req.generated) >= req.max_new_tokens:
+                # recovered request already at a stop condition
+                with self._lock:
+                    if not self._unpark(req):
+                        return None, None, True
+                    self._m["completed"].inc()
+                req._complete()
+                continue
+            return req, ctx, False
+
+    def _enter_chunking(self, s: int, req: GenerationRequest,
+                        ctx: np.ndarray, filled: int) -> bool:
+        """Occupy slot ``s`` for windowed prefill: the slot is taken
+        but prefill proceeds in bounded windows interleaved with decode
+        blocks (_advance_chunks) — one burst of 10k-token prompts
+        degrades throughput gracefully instead of stalling every
+        stream. ``filled`` is the absolute resume position (0 on the
+        slab; the shared-prefix length after a paged prefix-cache
+        hit). False = a takeover drain owns the request — stop
+        admitting."""
+        with self._lock:
+            if not self._unpark(req):
+                return False
+            self._chunking[s] = [req, ctx, filled]
+            # park the lane's decode write-head at the LAST cache cell:
+            # a frozen lane re-writes its own cell every block, and a
+            # stale position would clobber chunk-prefilled cells
+            # mid-fill. Cell t_max-1 is attended only at position
+            # t_max-1, which the decode write-head overwrites first.
+            # (A paged lane's cell maps through its page table, whose
+            # unallocated tail entries redirect the write to the null
+            # page.)
+            self._positions[s] = self.t_max - 1
+            self._last_ids[s] = 0
+            # and resync the block pipeline: the device carry may still
+            # hold this lane frozen at its PREVIOUS occupant's
+            # position, whose per-block rewrite would clobber the cells
+            # the chunks are about to fill
+            self._carry = None
+            req._running = True
+            self._m["prefills"].inc()
+        if req.trace is not None:
+            req.trace.add_span("queued", req._submit_t,
+                               time.monotonic())
+        return True
+
     def _admit(self):
         """Batched admission: coalesce EVERY admittable pending request
         into one bucketed prefill call with a single host readback —
@@ -1656,7 +2171,11 @@ class SlotGenerationEngine:
         path. A recovered request re-prefills prompt + generated-so-far,
         so decoding resumes exactly where the dead engine stopped.
         Count and prompt-length are both pow2-bucketed; padded rows
-        replicate row 0 (identical scatter → harmless write ordering)."""
+        replicate row 0 (identical scatter → harmless write ordering).
+        Paged engines route to :meth:`_admit_paged` — same gates, same
+        bucketing, page-table mapping + prefix-cache matching on top."""
+        if self._pager is not None:
+            return self._admit_paged()
         while True:
             with self._lock:
                 free = [s for s in range(self.num_slots)
@@ -1667,90 +2186,18 @@ class SlotGenerationEngine:
             batch: List[Tuple[GenerationRequest, int, np.ndarray]] = []
             drained = False
             for s in free:
-                req = None
-                while req is None:
-                    req = self._pop_for_admit()
-                    if req is None:
-                        drained = True
-                        break
-                    # lifecycle beats admission: never spend prefill
-                    # compute on a request that is already cancelled /
-                    # out of deadline / (recovered) already finished —
-                    # and the headroom policy re-projects with what the
-                    # queue wait left (a request that can no longer make
-                    # its deadline sheds here, not after decoding)
-                    exc = None
-                    if req._cancel_requested:
-                        exc = Cancelled("cancelled while queued")
-                    elif req._expired():
-                        exc = DeadlineExceeded(
-                            f"deadline of {req.deadline}s passed while "
-                            "queued")
-                    elif self.shed_headroom:
-                        exc = self._headroom_check(
-                            req, remaining=req.max_new_tokens -
-                            len(req.generated))
-                    if exc is not None:
-                        with self._lock:
-                            if not self._unpark(req):
-                                return   # a takeover drain owns it now
-                            if isinstance(exc, Cancelled):
-                                self._m["cancelled"].inc()
-                            elif isinstance(exc, RejectedError):
-                                self._m["rejected"].inc()
-                                self._m["headroom_shed"].inc()
-                            else:
-                                self._m["deadline_exceeded"].inc()
-                        req._fail(exc)
-                        req = None
-                        continue
-                    ctx = np.concatenate(
-                        [req.prompt, np.asarray(req.generated, np.int32)])
-                    if len(ctx) >= self.t_max or \
-                            len(req.generated) >= req.max_new_tokens:
-                        # recovered request already at a stop condition
-                        with self._lock:
-                            if not self._unpark(req):
-                                return
-                            self._m["completed"].inc()
-                        req._complete()
-                        req = None
-                        continue
-                    if self.prefill_chunk is not None and \
-                            len(ctx) > self.prefill_chunk:
-                        # long prompt: the slot is taken but prefill
-                        # proceeds in bounded windows interleaved with
-                        # decode blocks (_advance_chunks) — one burst of
-                        # 10k-token prompts degrades throughput
-                        # gracefully instead of stalling every stream
-                        with self._lock:
-                            if not self._unpark(req):
-                                return
-                            self._chunking[s] = [req, ctx, 0]
-                            # park the lane's decode write-head at the
-                            # LAST cache cell: a frozen lane re-writes
-                            # its own cell every block, and a stale
-                            # position would clobber chunk-prefilled
-                            # cells mid-fill. Cell t_max-1 is attended
-                            # only at position t_max-1, which the decode
-                            # write-head overwrites first.
-                            self._positions[s] = self.t_max - 1
-                            self._last_ids[s] = 0
-                            # and resync the block pipeline: the device
-                            # carry may still hold this lane frozen at
-                            # its PREVIOUS occupant's position, whose
-                            # per-block rewrite would clobber the cells
-                            # the chunks are about to fill
-                            self._carry = None
-                            req._running = True
-                            self._m["prefills"].inc()
-                        if req.trace is not None:
-                            req.trace.add_span("queued", req._submit_t,
-                                               time.monotonic())
-                        break          # this slot is occupied; next one
-                    batch.append((req, s, ctx))
-                if drained:
+                req, ctx, aborted = self._next_admittable()
+                if aborted:
+                    return
+                if req is None:
+                    drained = True
                     break
+                if self.prefill_chunk is not None and \
+                        len(ctx) > self.prefill_chunk:
+                    if not self._enter_chunking(s, req, ctx, 0):
+                        return
+                    continue           # this slot is occupied; next one
+                batch.append((req, s, ctx))
             if not batch:
                 return
             m = len(batch)
@@ -1847,6 +2294,216 @@ class SlotGenerationEngine:
             if drained:
                 return
 
+    def _pool_blocked(self, req: GenerationRequest, n_need: int,
+                      batch_live: bool = False) -> None:
+        """Pool-exhausted admission decision: with work in flight the
+        request waits AT THE QUEUE HEAD (completions free pages; the
+        next admission round retries — graceful degradation, not
+        failure). With nothing in flight to ever free a page, the pool
+        simply cannot hold this request: shed with RejectedError.
+        ``batch_live`` marks an admission round whose earlier rows are
+        already mapped but not yet slot-assigned — they WILL decode and
+        free pages, so they count as in-flight work."""
+        with self._lock:
+            active = batch_live or \
+                any(r is not None for r in self._slots) or \
+                bool(self._chunking)
+            if not self._unpark(req):
+                return                 # a takeover drain owns it now
+            if active:
+                req._running = False
+                self._pending.appendleft(req)
+                return
+            self._m["rejected"].inc()
+        self._flightrec.record("shed", engine=self.engine_id,
+                               reason="kv_pool", pages_needed=n_need)
+        req._fail(RejectedError(
+            f"KV page pool exhausted: {n_need} pages needed, none free "
+            "after eviction and nothing in flight to free one — "
+            "request shed"))
+
+    def _admit_paged(self):
+        """Paged batched admission (ISSUE 12): same lifecycle gates and
+        pow2 bucketing as the slab path, except each request first maps
+        the longest content-hash-matched shared prefix already resident
+        in the pool (read-only, refcount++) and allocates private pages
+        only for its tail — then ONE bucketed ``paged_prefill_impl``
+        dispatch prefills ONLY the tails, with a single readback for
+        the wave. Afterwards every full prompt page is published into
+        the prefix index, so the next identical prefix maps instead of
+        recomputing. Pool pressure degrades gracefully via
+        :meth:`_pool_blocked`."""
+        ps = self.page_size
+        while True:
+            with self._lock:
+                free = [s for s in range(self.num_slots)
+                        if self._slots[s] is None and
+                        s not in self._chunking]
+            if not free:
+                return
+            batch: List[Tuple[GenerationRequest, int, np.ndarray, int]] \
+                = []
+            drained = blocked = False
+            for s in free:
+                req, ctx, aborted = self._next_admittable()
+                if aborted:
+                    return
+                if req is None:
+                    drained = True
+                    break
+                # longest resident chain prefix — capped one token
+                # short of the context, because the tail must produce
+                # the next-token logits (a fully-cached context would
+                # leave nothing to prefill FROM)
+                shared, start = self._pager.match_and_ref(
+                    ctx, max_tokens=len(ctx) - 1)
+                tail = len(ctx) - start
+                chunked = self.prefill_chunk is not None and \
+                    tail > self.prefill_chunk
+                if chunked:
+                    # windowed prefill allocates ITS OWN pages window
+                    # by window (_advance_chunks) — reserving the whole
+                    # long prompt's pages here would be exactly the
+                    # up-front worst-case reservation paging removes
+                    fresh = []
+                else:
+                    # private pages covering [start, len(ctx)] — the
+                    # tail plus the cell the first decode token writes;
+                    # decode growth past that allocates lazily per block
+                    n_need = len(ctx) // ps + 1 - len(shared)
+                    fresh = self._pager.alloc(n_need)
+                    if fresh is None:
+                        for pid in shared:
+                            self._pager.unref(pid)
+                        self._pool_blocked(req, n_need,
+                                           batch_live=bool(batch))
+                        blocked = True
+                        break
+                pages = shared + fresh
+                with self._lock:
+                    if self._quarantined or self._shutdown:
+                        # the request stays parked for the drain's
+                        # harvest; the unmapped pages go back now
+                        for pid in pages:
+                            self._pager.unref(pid)
+                        return
+                    # map BEFORE dispatch: from here the drain's
+                    # _release_all_pages owns the mapping, so a
+                    # takeover mid-admission leaves refcounts balanced
+                    self._map_slot_pages(s, pages)
+                if start:
+                    self._m_prefix_hit.inc()
+                    self._m_prefix_tokens.inc(start)
+                    if req.trace is not None:
+                        req.trace.event("prefix_hit", tokens=start,
+                                        pages=len(shared))
+                else:
+                    self._m_prefix_miss.inc()
+                if chunked:
+                    # long TAIL: windowed prefill resumes at the shared
+                    # prefix's end; each window ensures its own pages
+                    # (incremental allocation). The slot mapping was
+                    # installed above; _enter_chunking's unpark-failure
+                    # path leaves it for the drain's release.
+                    if not self._enter_chunking(s, req, ctx, start):
+                        return
+                    continue
+                batch.append((req, s, ctx, start))
+            if not batch:
+                return
+            m = len(batch)
+            mb = self._count_bucket(m)
+            c = min(_round_up_pow2(max(len(ctx) - start
+                                       for _, _, ctx, start in batch)),
+                    self.t_max)
+            tokens = np.zeros((mb, c), np.int32)
+            pos0 = np.zeros(mb, np.int32)
+            valid = np.zeros(mb, np.int32)
+            ptab = np.zeros((mb, self._pages_per_slot), np.int32)
+            temps = np.zeros(mb, np.float32)
+            with self._lock:
+                if self._shutdown or self._quarantined:
+                    return   # batch stays parked; the drain owns it
+                for i in range(mb):
+                    req, s, ctx, start = batch[i if i < m else 0]
+                    tail_toks = ctx[start:]          # pad rows = row 0
+                    tokens[i, :len(tail_toks)] = tail_toks
+                    pos0[i] = start
+                    valid[i] = len(tail_toks)
+                    ptab[i] = self._ptables[s]
+                    temps[i] = req.temperature
+                self._m["prefills"].inc(m)
+                batch_no = self._m["prefill_batches"].inc()
+            t_pre0 = time.monotonic()
+            self._faults.fire("engine.prefill")
+            nxt, self._caches = self.decoder.paged_prefill(
+                self._caches, tokens, pos0, valid, ptab, temps,
+                key=jax.random.fold_in(self._key,
+                                       PREFILL_BATCH_SALT | batch_no))
+            toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
+            t_pre1 = time.monotonic()
+            finishers: List[GenerationRequest] = []
+            jlog: List[Tuple] = []
+            with self._lock:
+                if self._shutdown or self._quarantined:
+                    return   # the drain harvested the batch (and
+                             # released its page mappings) mid-dispatch
+                self._m["host_readbacks"].inc()
+                self._ewma_locked("_est_prefill", t_pre1 - t_pre0)
+                for i, (req, s, ctx, start) in enumerate(batch):
+                    if req not in self._admitting:
+                        continue          # pragma: no cover — defensive
+                    self._admitting.remove(req)
+                    tok = int(toks[i])
+                    req._running = True
+                    if self._journal is not None and \
+                            req.journal_id is not None:
+                        jlog.append((req.journal_id, len(req.generated),
+                                     (tok,)))
+                    req.generated.append(tok)
+                    if req._admitted_t is None:
+                        req._admitted_t = t_pre0
+                    if req._first_token_t is None:
+                        req._first_token_t = t_pre1
+                    self._m["emitted_tokens"].inc()
+                    if req.trace is not None:
+                        req.trace.add_span("queued", req._submit_t, t_pre0)
+                        req.trace.add_span("prefill", t_pre0, t_pre1,
+                                           batch=m, bucket=mb, tp=c,
+                                           ctx=len(ctx), prefix=start)
+                    # publish the context's FULL pages (never written
+                    # again: decode lands past the context end) into
+                    # the prefix index — the next identical prefix
+                    # maps these instead of recomputing their forward
+                    self._pager.register_chain(
+                        ctx, self._slot_pages[s][:len(ctx) // ps])
+                    if self._req_finished(req, tok):
+                        self._m["completed"].inc()
+                        finishers.append(req)   # done at the first token
+                        self._release_slot_pages(s)  # registration
+                        #            above keeps its prompt pages cached
+                    else:
+                        self._slots[s] = req
+                        self._last_ids[s] = tok
+                        self._positions[s] = len(ctx)  # next write pos
+                        self._temps[s] = req.temperature
+                        self._eos_ids[s] = -1 if req.eos_id is None \
+                            else int(req.eos_id)
+                # slot contents changed: the block-decode pipeline must
+                # resync its device carry from host state
+                self._carry = None
+            if self._tracing:
+                self._flightrec.record(
+                    "admission", engine=self.engine_id, batch=m,
+                    bucket=mb, tp=c, paged=True,
+                    wait_ms=round((t_pre1 - t_pre0) * 1e3, 3))
+            if jlog:
+                self._journal.retired(jlog)
+            for req in finishers:
+                req._complete()
+            if drained or blocked:
+                return
+
     def _advance_chunks(self):
         """One chunked-prefill dispatch (round-robin over chunking
         slots), interleaved with decode blocks by the serve loop: long
@@ -1870,12 +2527,14 @@ class SlotGenerationEngine:
                     doomed.append((req, Cancelled(
                         "cancelled during chunked prefill")))
                     del self._chunking[s]
+                    self._release_slot_pages(s)
                 elif req._expired():
                     self._m["deadline_exceeded"].inc()
                     doomed.append((req, DeadlineExceeded(
                         f"deadline of {req.deadline}s passed during "
                         "chunked prefill")))
                     del self._chunking[s]
+                    self._release_slot_pages(s)
             if self._chunking:
                 slots = sorted(self._chunking)
                 s = slots[self._chunk_rr % len(slots)]
@@ -1897,19 +2556,66 @@ class SlotGenerationEngine:
         final = pos0 + valid >= len(ctx)
         tokens = np.zeros((1, c), np.int32)
         tokens[0, :valid] = window
+        ptab = None
+        if self._pager is not None:
+            # incremental allocation (ISSUE 12): each window ensures
+            # exactly the pages IT writes (plus the first decode
+            # token's cell on the final window) — a long prompt's pool
+            # footprint grows with its fill, never reserved up front
+            ps = self.page_size
+            upto = (len(ctx) if final else pos0 + valid - 1)
+            need = min(upto, self.t_max - 1) // ps + 1
+            with self._lock:
+                if self._quarantined or self._shutdown:
+                    return
+                cur = self._chunking.get(s)
+                if cur is None or cur[0] is not req:
+                    return
+                delta = need - len(self._slot_pages[s])
+                fresh = self._pager.alloc(delta) if delta > 0 else []
+                if fresh is not None:
+                    base = len(self._slot_pages[s])
+                    self._slot_pages[s].extend(fresh)
+                    self._ptables[s, base:base + len(fresh)] = fresh
+                    ptab = self._ptables[s:s + 1].copy()
+                else:
+                    # pool pressure mid-chunking: with DECODING work in
+                    # flight, skip this window and retry next cycle
+                    # (completions free pages). Other chunkers don't
+                    # count — they only consume more pages as they
+                    # progress — so with none decoding, shedding this
+                    # chunker is what frees pages for the rest.
+                    if any(r is not None for r in self._slots):
+                        return
+                    del self._chunking[s]
+                    self._release_slot_pages(s)
+                    self._m["rejected"].inc()
+            if ptab is None:
+                req._fail(RejectedError(
+                    "KV page pool exhausted mid-chunked-prefill and "
+                    "nothing in flight to free a page — request shed"))
+                return
         chunk_no = self._m["prefill_chunks"].inc()
         t0 = time.monotonic()
         if req._admitted_t is None:
             req._admitted_t = t0          # SLO queue-wait ends at the
         #                                   FIRST window's dispatch
         self._faults.fire("engine.prefill")
-        nxt, self._caches = self.decoder._fn(("chunk", c))(
-            self.decoder._device_params(),
-            self.decoder.net._inference_state(), self._caches,
-            jnp.asarray(tokens), jnp.asarray([pos0], jnp.int32),
-            jnp.asarray([valid], jnp.int32), jnp.asarray([s], jnp.int32),
-            jnp.asarray([req.temperature], jnp.float32),
-            jax.random.fold_in(self._key, CHUNK_SALT | chunk_no))
+        if self._pager is not None:
+            nxt, self._caches = self.decoder.paged_prefill(
+                self._caches, tokens, np.asarray([pos0], np.int32),
+                np.asarray([valid], np.int32), ptab,
+                np.asarray([req.temperature], np.float32),
+                key=jax.random.fold_in(self._key, CHUNK_SALT | chunk_no))
+        else:
+            nxt, self._caches = self.decoder._fn(("chunk", c))(
+                self.decoder._device_params(),
+                self.decoder.net._inference_state(), self._caches,
+                jnp.asarray(tokens), jnp.asarray([pos0], jnp.int32),
+                jnp.asarray([valid], jnp.int32),
+                jnp.asarray([s], jnp.int32),
+                jnp.asarray([req.temperature], jnp.float32),
+                jax.random.fold_in(self._key, CHUNK_SALT | chunk_no))
         tok = None
         if final:
             tok = int(device_fetch(nxt, tag="engine.prefill")[0])
@@ -1941,9 +2647,16 @@ class SlotGenerationEngine:
                 if req._first_token_t is None:
                     req._first_token_t = t1
                 self._m["emitted_tokens"].inc()
+                if self._pager is not None:
+                    # the fully-filled context's whole pages become
+                    # shareable now, exactly like direct admission
+                    self._pager.register_chain(
+                        ctx, self._slot_pages[s][:len(ctx) //
+                                                 self.page_size])
                 if self._req_finished(req, tok):
                     self._m["completed"].inc()
                     finish = req
+                    self._release_slot_pages(s)
                 else:
                     self._slots[s] = req
                     self._last_ids[s] = tok
@@ -1975,7 +2688,11 @@ class SlotGenerationEngine:
         share the device fairly."""
         if self._chunking:
             self._advance_chunks()
-        if self.block_size > 1:
+        if self.block_size > 1 or self._pager is not None:
+            # paged engines always decode through the block path (K=1
+            # blocks included): one paged_decode_block{K}_impl family
+            # serves every configuration, and page growth/preemption
+            # has exactly one seam
             return self._step_block()
         self._enforce_slots()
         with self._lock:
@@ -2051,12 +2768,23 @@ class SlotGenerationEngine:
         k = self._choose_block_size() if self.adaptive_block \
             else self.block_size
         self._enforce_slots()
+        preempted: List[GenerationRequest] = []
         # resync boundary: the device carry was invalidated (slots were
         # refilled or freed) while a block is still in flight. Host state
         # lags that block by K steps, so a host-state dispatch now would
         # REPLAY them — retire the in-flight block first (serializing
         # this one boundary), then dispatch from caught-up host state.
+        # The paged page-ensure runs BEFORE this boundary: a pool-
+        # pressure preemption invalidates the carry, and the stale
+        # pickup below must see that invalidation in the same cycle.
         with self._lock:
+            if self._pager is not None and \
+                    not (self._quarantined or self._shutdown):
+                # lazy growth: each active lane's table must cover this
+                # block's furthest write BEFORE dispatch; lanes the
+                # pool cannot serve are preempted (exactly-once: their
+                # tokens ride the request, re-admission re-prefills)
+                preempted = self._ensure_decode_pages_locked(k)
             stale = self._inflight if self._carry is None else None
             if stale is not None:
                 self._inflight = None
@@ -2081,19 +2809,38 @@ class SlotGenerationEngine:
                              np.asarray([self._slots[s] is None
                                          for s in range(self.num_slots)],
                                         bool))
+                ptab = None if self._pager is None \
+                    else self._ptables.copy()
                 dispatch = (carry, self._step_no - k, self._temps.copy(),
-                            self._eos_ids.copy())
+                            self._eos_ids.copy(), ptab)
+        for req in preempted:
+            # out-of-lock bookkeeping for pool-pressure preemptions
+            if req.trace is not None:
+                req.trace.event("page_preempt", engine=self.engine_id,
+                                generated=len(req.generated))
+            self._flightrec.record("page_preempt", engine=self.engine_id,
+                                   generated=len(req.generated))
+            if self._journal is not None and req.journal_id is not None:
+                self._journal.requeued(req)
         if dispatch is not None:
-            (ids, pos, stop), step0, temps, eos = dispatch
+            (ids, pos, stop), step0, temps, eos, ptab = dispatch
             if self.adaptive_block:
                 self._m_k.labels(self.engine_id, str(k)).inc()
             t_disp = time.monotonic()
             self._faults.fire("engine.step")
-            toks, ids_d, pos_d, stop_d, self._caches = \
-                self.decoder.decode_block(
-                    self._caches, ids, pos, temps, key=self._key,
-                    block_size=k, eos_ids=eos, stopped=stop, step0=step0,
-                    key_salt=ENGINE_KEY_SALT)
+            if self._pager is not None:
+                toks, ids_d, pos_d, stop_d, self._caches = \
+                    self.decoder.paged_decode_block(
+                        self._caches, ptab, ids, pos, temps,
+                        key=self._key, block_size=k, eos_ids=eos,
+                        stopped=stop, step0=step0,
+                        key_salt=ENGINE_KEY_SALT)
+            else:
+                toks, ids_d, pos_d, stop_d, self._caches = \
+                    self.decoder.decode_block(
+                        self._caches, ids, pos, temps, key=self._key,
+                        block_size=k, eos_ids=eos, stopped=stop,
+                        step0=step0, key_salt=ENGINE_KEY_SALT)
             with self._lock:
                 if not (self._quarantined or self._shutdown):
                     self._carry = (ids_d, pos_d, stop_d)
@@ -2141,6 +2888,7 @@ class SlotGenerationEngine:
                     took += 1
                     if self._req_finished(req, tok):
                         self._slots[s] = None
+                        self._release_slot_pages(s)
                         self._m["completed"].inc()
                         finished.append(req)
                         closed = True
@@ -2241,6 +2989,10 @@ class SlotGenerationEngine:
             # (recovery re-prefills and regenerates them exactly)
             self._inflight = None
             self._carry = None
+            # release every page mapping: the harvest leaves the
+            # allocator audit-balanced (only prefix-index retention
+            # remains; the pool dies with this engine either way)
+            self._release_all_pages()
             cause = self._dead
         self._work.set()
         return [r for r in harvested if not r.done()], cause
@@ -2250,6 +3002,11 @@ class SlotGenerationEngine:
         labeled registry children (ISSUE 5), same keys as ever, plus the
         two live gauges read under the engine lock."""
         out = {key: int(self._m[key].value) for key in _ENGINE_COUNTERS}
+        # prefix-cache outcomes (ISSUE 12): plain ints, so supervisor
+        # takeover accounting merges them like any other counter
+        out["prefix_cache_hits"] = int(self._m_prefix_hit.value)
+        out["prefix_cache_misses"] = int(self._m_prefix_miss.value)
+        out["prefix_cache_hit_tokens"] = int(self._m_prefix_tokens.value)
         with self._lock:
             out["queue_depth"] = len(self._pending)
             out["active_slots"] = sum(r is not None
@@ -2332,6 +3089,7 @@ class SlotGenerationEngine:
                 self._pending.clear()
                 self._inflight = None
                 self._carry = None
+                self._release_all_pages()
                 self._m["failed"].inc(len(doomed))
             for req in doomed:
                 req._fail(exc)
@@ -2372,6 +3130,7 @@ class SlotGenerationEngine:
             self._pending.clear()
             self._inflight = None
             self._carry = None
+            self._release_all_pages()
             self._m["failed"].inc(len(doomed))
         for req in doomed:
             req._fail(exc)

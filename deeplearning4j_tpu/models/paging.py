@@ -1,0 +1,364 @@
+"""Paged KV-cache allocation + content-hashed prefix caching (ISSUE 12).
+
+The slab cache reserves a full contiguous ``t_max`` row per slot, so max
+concurrency is capped by WORST-CASE length even though the live mix is
+mostly short sequences — and identical prompt prefixes (system prompts,
+the dominant pattern at millions-of-users scale) re-prefill every time.
+This module is the host-side half of the paged replacement:
+
+- :class:`PageAllocator` — a free-list allocator over a fixed pool of
+  ``page_size``-token pages (page 0 is reserved as the NULL/trash page:
+  unmapped page-table entries point at it, and a freed lane's redirected
+  writes land in it — it is never attended). Allocation is atomic
+  (``n`` pages or ``None``, never partial) and evicts cache-only prefix
+  pages LRU-first under pressure.
+
+- **Content-hashed prefix cache** — every full page of a served context
+  is published under a running chain digest (``blake2b`` over the
+  previous page's digest + this page's token bytes, so a chain hash
+  commits to the WHOLE prefix, not one page). A new prompt whose chain
+  prefix is already resident maps those pages read-only (refcount++)
+  and prefills only the tail. Sharing is at page granularity, which IS
+  the copy-on-write fork: a shared page is always FULL and therefore
+  never written again (decode writes land at positions >= the context
+  length, always in a private tail page), so the first divergent token
+  forks by reference into a fresh page instead of copying anything.
+
+- **Refcounts** — one per mapping (a slot's page table holding the
+  page) plus one retention ref held by the prefix index itself. A page
+  returns to the free list at zero; :meth:`audit` proves the balance
+  (every refcount equals its observed holders) after chaos harvests.
+
+The device-side half (pools, gather/scatter attention over page
+tables) lives in ``nn/conf/layers/attention.py`` and
+``models/generation.py``. The same chain digest also keys the fleet's
+``sticky_prefix`` routing (:func:`prefix_route_key`): same content ⇒
+same key ⇒ same replica ⇒ that replica's prefix cache hits.
+
+Thread-safety: all public methods are atomic under one internal lock.
+Eviction happens only inside :meth:`alloc` — callers that match-then-map
+use :meth:`match_and_ref` (match and refcount in ONE critical section),
+so a matched page can never be evicted out from under its new holder.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: default page size (tokens per page) shared by the engine and the
+#: fleet's sticky-prefix routing — both sides must hash identical page
+#: boundaries for "same content ⇒ same key ⇒ same replica" to hold
+DEFAULT_PAGE_SIZE = 16
+
+#: reserved NULL/trash page: unmapped table entries and freed lanes'
+#: redirected writes target it; length masks keep it from ever being
+#: attended, so its contents are don't-care by construction
+NULL_PAGE = 0
+
+#: chain-digest domain separator (versioned: a future layout change
+#: must not silently alias old keys)
+_CHAIN_SEED = b"dl4j-tpu-kv-chain-v1"
+
+
+def _page_digest(prev: bytes, tokens: np.ndarray) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev)
+    h.update(np.ascontiguousarray(
+        np.asarray(tokens, np.int32)).tobytes())
+    return h.digest()
+
+
+def chain_digests(tokens: Sequence, page_size: int) -> List[bytes]:
+    """Running prefix digests, one per FULL page of ``tokens``:
+    ``out[j]`` commits to tokens[0 : (j+1)*page_size]. Tokens are
+    canonicalized to int32 bytes, so int64 fleet prompts and int32
+    engine prompts hash identically."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    out: List[bytes] = []
+    prev = _CHAIN_SEED
+    for j in range(len(toks) // int(page_size)):
+        prev = _page_digest(prev,
+                            toks[j * page_size:(j + 1) * page_size])
+        out.append(prev)
+    return out
+
+
+def prefix_route_key(tokens: Sequence,
+                     page_size: int = DEFAULT_PAGE_SIZE) -> str:
+    """Sticky-routing key for the fleet router: the chain digest of the
+    LAST full page of ``tokens`` (hex) — the SAME content hash the
+    prefix cache keys pages under, so requests the router groups onto
+    one replica are exactly the requests whose pages that replica can
+    share. A trailing sub-page remainder is folded into the digest
+    (chained from the last full page), so the key commits to the WHOLE
+    slice the caller chose: two prompts sharing the full pages but
+    diverging in the remainder route separately — page quantization
+    must not coarsen routing beyond what the caller asked for."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    full = (len(toks) // int(page_size)) * int(page_size)
+    ds = chain_digests(toks[:full], page_size)
+    prev = ds[-1] if ds else _CHAIN_SEED
+    rem = toks[full:]
+    if len(rem) or not ds:
+        return _page_digest(prev, rem).hex()
+    return prev.hex()
+
+
+class PageAllocator:
+    """Free-list page allocator + content-hashed prefix index.
+
+    ``num_pages`` includes the reserved NULL page 0, so the usable pool
+    is ``num_pages - 1`` pages of ``page_size`` tokens each. The engine
+    maps pages into per-slot page tables (one mapping ref each); the
+    prefix index retains published pages with one cache ref, which is
+    what keeps a hot system prompt resident between requests. Under
+    pressure, :meth:`alloc` evicts cache-only pages (refcount exactly 1,
+    held by the index alone) in LRU order — matched chains are touched
+    parent-last, so leaves age out before the prefixes they depend on."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 prefix_cache: bool = True):
+        if int(page_size) < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if int(num_pages) < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page {NULL_PAGE} is the "
+                f"reserved null/trash page), got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.prefix_cache = bool(prefix_cache)
+        self._lock = threading.Lock()
+        self._free: collections.deque = collections.deque(
+            range(1, self.num_pages))
+        self._refs = np.zeros(self.num_pages, np.int64)
+        # prefix index: chain digest -> page id (holds one cache ref);
+        # _digest_of is the reverse map; _lru orders digests for
+        # eviction (front = coldest)
+        self._chains: Dict[bytes, int] = {}
+        self._digest_of: Dict[int, bytes] = {}
+        self._lru: collections.OrderedDict = collections.OrderedDict()
+        self.evictions = 0
+        self.alloc_failures = 0
+        # stats() memo: telemetry collections read the pool state up to
+        # six times per scrape (per-state gauges, fragmentation,
+        # devstats) — recompute the O(num_pages) summary only after a
+        # mutation, so scrapes don't contend with the serving path
+        self._mutations = 0
+        self._stats_memo: Optional[Tuple[Dict[str, int], int]] = None
+
+    # -------------------------------------------------------- allocation
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages (each born with ONE ref — the caller's
+        mapping) or ``None`` — never a partial grant. Evicts cache-only
+        prefix pages LRU-first when the free list is short."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            short = n - len(self._free)
+            if short > 0:
+                # feasibility BEFORE eviction: an unsatisfiable request
+                # must fail without touching the cache — evicting the
+                # hot shared-prefix pages and then failing anyway would
+                # collapse the hit rate for every subsequent request
+                evictable = sum(1 for pid in self._chains.values()
+                                if self._refs[pid] == 1)
+                if short > evictable:
+                    self.alloc_failures += 1
+                    return None
+                self._evict_locked(short)
+            if len(self._free) < n:      # pragma: no cover — defensive
+                self.alloc_failures += 1
+                return None
+            out = [self._free.popleft() for _ in range(n)]
+            for pid in out:
+                self._refs[pid] += 1
+            self._mutations += 1
+            return out
+
+    def _evict_locked(self, need: int) -> None:
+        for dg in list(self._lru):
+            if need <= 0:
+                return
+            pid = self._chains.get(dg)
+            if pid is None or self._refs[pid] != 1:
+                continue          # still mapped by a slot: not evictable
+            del self._chains[dg]
+            self._lru.pop(dg, None)
+            self._digest_of.pop(pid, None)
+            self._unref_locked(pid)     # cache ref was the last holder
+            self.evictions += 1
+            need -= 1
+
+    def ref(self, pid: int) -> None:
+        """One more holder for an already-held page (shared mapping)."""
+        with self._lock:
+            if self._refs[pid] <= 0:
+                raise RuntimeError(
+                    f"page {pid}: ref() on an unheld page")
+            self._refs[pid] += 1
+            self._mutations += 1
+
+    def unref(self, pid: int) -> None:
+        """Drop one holder; the page returns to the free list at zero."""
+        with self._lock:
+            self._unref_locked(pid)
+            self._mutations += 1
+
+    def _unref_locked(self, pid: int) -> None:
+        self._refs[pid] -= 1
+        if self._refs[pid] < 0:
+            raise RuntimeError(f"page {pid}: refcount underflow")
+        if self._refs[pid] == 0:
+            # defensive: a cached page holds the index's ref, so it can
+            # only reach zero through eviction (digest already dropped)
+            dg = self._digest_of.pop(pid, None)
+            if dg is not None:              # pragma: no cover
+                self._chains.pop(dg, None)
+                self._lru.pop(dg, None)
+            self._free.append(pid)
+
+    # ------------------------------------------------------ prefix cache
+    def match_and_ref(self, tokens: Sequence,
+                      max_tokens: Optional[int] = None
+                      ) -> Tuple[List[int], int]:
+        """Longest resident chain prefix of ``tokens`` (whole pages,
+        capped at ``max_tokens``), with each matched page ref'd for the
+        caller's mapping IN the match's critical section — an eviction
+        can never race the map. Returns (page ids, matched tokens)."""
+        if not self.prefix_cache:
+            return [], 0
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        limit = len(toks) if max_tokens is None \
+            else min(len(toks), int(max_tokens))
+        digests = chain_digests(toks[:(limit // self.page_size) *
+                                     self.page_size], self.page_size)
+        with self._lock:
+            matched: List[Tuple[bytes, int]] = []
+            for dg in digests:
+                pid = self._chains.get(dg)
+                if pid is None:
+                    break
+                matched.append((dg, pid))
+            for _, pid in matched:
+                self._refs[pid] += 1
+            if matched:
+                self._mutations += 1
+            # touch parent-LAST so eviction takes leaves before the
+            # prefixes they chain from
+            for dg, _ in reversed(matched):
+                self._lru.move_to_end(dg)
+            return ([pid for _, pid in matched],
+                    len(matched) * self.page_size)
+
+    def register_chain(self, tokens: Sequence,
+                       pages: Sequence[int]) -> int:
+        """Publish a served context's FULL pages into the prefix index:
+        ``pages`` is the slot's logical page list, ``pages[j]`` holding
+        tokens[j*ps : (j+1)*ps]. Digests already resident keep their
+        existing page (same content — no double-cache); new entries
+        take one cache retention ref. Only positions strictly below the
+        context length are ever published (full pages are never written
+        again: decode writes land past the context end), so a cached
+        page's contents are immutable for its lifetime. Returns the
+        newly published count. (Known trade: the chain digests are
+        recomputed here even though match_and_ref hashed the same
+        prefix at admission — blake2b runs ~1 GB/s, so even an 8k-token
+        context costs ~30µs; threading the digest list through the
+        engine's batch state wasn't worth the coupling.)"""
+        if not self.prefix_cache:
+            return 0
+        digests = chain_digests(tokens, self.page_size)
+        added = 0
+        with self._lock:
+            n = min(len(digests), len(pages))
+            for j in range(n):
+                dg = digests[j]
+                if dg in self._chains:
+                    continue
+                pid = int(pages[j])
+                if pid == NULL_PAGE or self._refs[pid] <= 0:
+                    continue      # pragma: no cover — defensive
+                self._refs[pid] += 1            # the index's retention
+                self._chains[dg] = pid
+                self._digest_of[pid] = dg
+                self._lru[dg] = None
+                added += 1
+            for dg in reversed(digests[:n]):    # parents most recent
+                if dg in self._lru:
+                    self._lru.move_to_end(dg)
+            if added:
+                self._mutations += 1
+        return added
+
+    # ------------------------------------------------------ observation
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            if self._stats_memo is not None and \
+                    self._stats_memo[1] == self._mutations:
+                return dict(self._stats_memo[0])
+            free = len(self._free)
+            used = self.num_pages - 1 - free
+            # "shared" = genuinely multi-holder pages: >= 2 refs AFTER
+            # discounting the prefix index's own retention ref (every
+            # freshly registered page sits at mapping+index = 2 refs —
+            # that is retention, not sharing, and must not inflate the
+            # share ratio devstats reports)
+            indexed = np.zeros(self.num_pages, np.int64)
+            for pid in self._chains.values():
+                indexed[pid] = 1
+            out = {
+                "num_pages": self.num_pages - 1,   # usable (page 0 out)
+                "page_size": self.page_size,
+                "free": free,
+                "used": used,
+                "cached": len(self._chains),
+                "shared": int(np.sum((self._refs - indexed) >= 2)),
+                "evictions": int(self.evictions),
+                "alloc_failures": int(self.alloc_failures),
+            }
+            self._stats_memo = (out, self._mutations)
+            return dict(out)
+
+    def audit(self, mappings: Sequence[Sequence[int]]) -> List[str]:
+        """Refcount balance proof (chaos_soak's post-harvest bar):
+        every page's refcount must equal its observed holders — one per
+        appearance in ``mappings`` (the engine's per-slot page lists)
+        plus one if the prefix index retains it; free-listed pages must
+        be unheld and listed exactly once; page 0 must be unheld."""
+        problems: List[str] = []
+        with self._lock:
+            counts = np.zeros(self.num_pages, np.int64)
+            for table in mappings:
+                for pid in table:
+                    counts[int(pid)] += 1
+            for pid in self._chains.values():
+                counts[int(pid)] += 1
+            if counts[NULL_PAGE] or self._refs[NULL_PAGE]:
+                problems.append(
+                    f"null page held: mapped {int(counts[NULL_PAGE])}x, "
+                    f"refcount {int(self._refs[NULL_PAGE])}")
+            for pid in range(1, self.num_pages):
+                if self._refs[pid] != counts[pid]:
+                    problems.append(
+                        f"page {pid}: refcount {int(self._refs[pid])} "
+                        f"!= {int(counts[pid])} observed holders")
+            seen = collections.Counter(self._free)
+            for pid, k in seen.items():
+                if k != 1:
+                    problems.append(f"page {pid}: on the free list "
+                                    f"{k} times")
+                if self._refs[pid] != 0:
+                    problems.append(f"page {pid}: free but refcount "
+                                    f"{int(self._refs[pid])}")
+            live = self.num_pages - 1 - len(seen)
+            held = int(np.sum(self._refs[1:] > 0))
+            if live != held:
+                problems.append(f"{live} pages off the free list but "
+                                f"{held} pages held")
+        return problems

@@ -247,6 +247,159 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         out = jnp.einsum("bhqt,bhtd->bqhd", probs.astype(cv.dtype), cv)
         return self._project_out(params, out.astype(x.dtype)), new_cache
 
+    # ---- paged KV cache (models/paging.py + models/generation.py) ----
+    def init_page_pool(self, num_pages: int, page_size: int,
+                       dtype=jnp.float32, sharding=None) -> Dict:
+        """Paged decode cache: {"k", "v"} each [P, H, page_size, Dh] —
+        a pool of fixed-size pages shared by every slot, addressed
+        through per-slot page tables instead of contiguous rows. Heads
+        shard over tp exactly like the slab cache's H dim (pages do NOT
+        shard over data: any slot may hold any page). Page 0 is the
+        reserved null/trash page — unmapped table entries and freed
+        lanes' redirected writes land there, and length masks keep it
+        from ever being attended."""
+        if not self.causal:
+            raise ValueError("KV-cache decoding needs causal=True "
+                             "(autoregressive attention)")
+        hs = self._head_size()
+        shape = (num_pages, self.num_heads, page_size, hs)
+        if sharding is not None:
+            # born distributed, like init_cache: the pool is the
+            # dominant serving allocation
+            return {"k": jnp.zeros(shape, dtype, device=sharding),
+                    "v": jnp.zeros(shape, dtype, device=sharding)}
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    # graftlint: traced
+    def _paged_gather(self, pool, ptable):
+        """Page table [B, NP] → the slot's contiguous logical view
+        [B, H, NP*page_size, Dh]. The gather reconstructs logical token
+        order (table entry j covers positions [j*ps, (j+1)*ps)), so the
+        downstream attention math is IDENTICAL to the slab path — cells
+        beyond a row's mapped pages read the null page and are length-
+        masked exactly like a slab row's unwritten tail. The transient
+        gather materialization is the documented cost of the kernel-free
+        paged route; the fused paged-attention kernel (ROADMAP item 5)
+        removes it."""
+        b, n_pages = ptable.shape
+        ps = pool.shape[2]
+        g = pool[ptable]                     # [B, NP, H, ps, Dh]
+        return g.transpose(0, 2, 1, 3, 4).reshape(
+            b, self.num_heads, n_pages * ps, -1)
+
+    # graftlint: traced
+    def paged_decode_forward(self, params, x, pool: Dict, ptable,
+                             positions):
+        """One decode step over a paged cache: x [B, 1, n_in] at
+        ``positions`` [B]. Writes each row's k/v into its page table's
+        page for that position (one advanced-index scatter — fixed
+        shape, ONE compile serves every step) and attends over the
+        gathered logical view with the SAME length-masked math as
+        :meth:`decode_forward`, so paged and slab logits are bitwise
+        identical at every unmasked cell. Routed through a
+        kind="paged_decode_attention" helper seam so the fused paged
+        kernel (ROADMAP item 5) can slot in. Returns (out [B, 1,
+        n_out], new_pool)."""
+        q, k, v = self._project_qkv(params, x)      # [B, 1, H, Dh]
+        ps = pool["k"].shape[2]
+        t_cap = ptable.shape[1] * ps
+        pos = jnp.minimum(jnp.asarray(positions, jnp.int32).reshape(-1),
+                          t_cap - 1)
+        rows = jnp.arange(ptable.shape[0], dtype=jnp.int32)
+        pids = ptable[rows, pos // ps]              # [B]
+        offs = pos % ps
+        # advanced indices (dim 0 and 2) around the H slice: the update
+        # lands as [B, H, Dh]. Freed/frozen lanes' tables are redirected
+        # to the null page — duplicate trash-cell writes race only with
+        # each other and the cell is never attended.
+        new_pool = {
+            "k": pool["k"].at[pids, :, offs, :].set(
+                k[:, 0].astype(pool["k"].dtype)),
+            "v": pool["v"].at[pids, :, offs, :].set(
+                v[:, 0].astype(pool["v"].dtype))}
+        ck = self._paged_gather(new_pool["k"], ptable)
+        cv = self._paged_gather(new_pool["v"], ptable)
+        helper = get_helper("paged_decode_attention")
+        out = helper(self, q, ck, cv, pos) if helper is not None else None
+        if out is None:
+            hs = self._head_size()
+            scale = 1.0 / math.sqrt(hs)     # math.sqrt: GL004 (x64)
+            logits = jnp.einsum("bhd,bhtd->bht", q[:, 0], ck,
+                                preferred_element_type=jnp.float32) * scale
+            kpos = jnp.arange(ck.shape[2], dtype=jnp.int32)
+            keep = kpos[None, :] <= pos[:, None]
+            logits = jnp.where(keep[:, None, :], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)          # f32
+            out = jnp.einsum("bht,bhtd->bhd", probs.astype(cv.dtype), cv)
+            out = out[:, None]                               # [B,1,H,Dh]
+        return self._project_out(params, out.astype(x.dtype)), new_pool
+
+    # graftlint: traced
+    def paged_chunk_forward(self, params, x, pool: Dict, ptable, pos0,
+                            valid=None):
+        """Chunked/tail prefill over a paged cache: x [B, C, n_in] is a
+        window whose first token sits at absolute position ``pos0``
+        ([B] int32 — 0 for a fresh prompt, the shared-prefix length
+        after a prefix-cache hit, a window multiple mid-chunking).
+        Writes the window's k/v through the page table (positions below
+        ``pos0`` are NEVER written — that is what makes mapped shared
+        pages read-only) and attends each query i over the gathered
+        view at positions <= pos0+i, the same per-query mask as
+        :meth:`chunk_forward`. Window cells at or past a row's true
+        length (``valid`` [B], default the full window) are REDIRECTED
+        to the null page: unlike the slab, where padded garbage lands
+        harmlessly in the row's own tail, a padded paged write could
+        cross into a page another slot owns — masked writes make the
+        window byte-exact to its declared extent. Returns (out [B, C,
+        n_out], new_pool)."""
+        q, k, v = self._project_qkv(params, x)        # [B, C, H, Dh]
+        c = x.shape[1]
+        ps = pool["k"].shape[2]
+        n_pages = ptable.shape[1]
+        t_cap = n_pages * ps
+        p0 = jnp.asarray(pos0, jnp.int32).reshape(-1)
+        vcount = jnp.full(p0.shape, c, jnp.int32) if valid is None \
+            else jnp.asarray(valid, jnp.int32).reshape(-1)
+        w = p0[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]  # [B,C]
+        keep_w = (jnp.arange(c, dtype=jnp.int32)[None, :] <
+                  vcount[:, None]) & (w < t_cap)
+        pids = jnp.take_along_axis(ptable,
+                                   jnp.minimum(w // ps, n_pages - 1),
+                                   axis=1)                         # [B,C]
+        pids = jnp.where(keep_w, pids, 0)           # null-page redirect
+        offs = jnp.where(keep_w, w % ps, 0)
+        new_pool = {
+            "k": pool["k"].at[pids, :, offs, :].set(
+                k.astype(pool["k"].dtype)),
+            "v": pool["v"].at[pids, :, offs, :].set(
+                v.astype(pool["v"].dtype))}
+        ck = self._paged_gather(new_pool["k"], ptable)
+        cv = self._paged_gather(new_pool["v"], ptable)
+        hs = self._head_size()
+        scale = 1.0 / math.sqrt(hs)          # math.sqrt: GL004 (x64)
+        logits = jnp.einsum("bqhd,bhtd->bhqt", q, ck,
+                            preferred_element_type=jnp.float32) * scale
+        kpos = jnp.arange(ck.shape[2], dtype=jnp.int32)
+        keep = kpos[None, None, :] <= w[:, :, None]        # [B, C, T]
+        logits = jnp.where(keep[:, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)            # f32
+        out = jnp.einsum("bhqt,bhtd->bqhd", probs.astype(cv.dtype), cv)
+        return self._project_out(params, out.astype(x.dtype)), new_pool
+
+    # graftlint: traced
+    def paged_prefill_forward(self, params, x, pool: Dict, ptable,
+                              pos0=None, valid=None):
+        """Prompt prefill into pages — the paged analogue of
+        :meth:`prefill_forward`. A prefill IS one chunk window starting
+        at each row's absolute start (0 for a fresh prompt, the shared-
+        prefix length after a prefix-cache hit), so this delegates to
+        :meth:`paged_chunk_forward`; kept as its own seam so callers
+        and a future fused kernel can distinguish the phases."""
+        if pos0 is None:
+            pos0 = jnp.zeros(x.shape[0], jnp.int32)
+        return self.paged_chunk_forward(params, x, pool, ptable, pos0,
+                                        valid)
+
 
 @register_config
 @dataclasses.dataclass

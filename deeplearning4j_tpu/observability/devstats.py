@@ -112,6 +112,23 @@ def kv_cache_stats(engine) -> dict:
         "dtype": str(first.dtype),
         "bytes_per_slot": total // max(1, int(first.shape[0])),
     }
+    # paged engine (ISSUE 12): slot_shape is the POOL shape
+    # [P, H, page_size, Dh] and bytes_per_slot is bytes per PAGE; the
+    # page-granular account (free/used/cached/shared, mapped pages,
+    # refcount'd share ratio, internal fragmentation) rides alongside —
+    # pool bytes are FIXED by construction, which is exactly what makes
+    # concurrency-at-fixed-memory a devstats-verifiable claim
+    try:
+        fn = getattr(engine, "kv_page_stats", None)
+        pages = fn() if fn is not None else None
+    except Exception:   # noqa: BLE001 — a probe must not 500 the view
+        pages = None
+    if pages is not None:
+        out["paged"] = True
+        out["pages"] = pages
+        used = pages.get("used", 0)
+        out["pages"]["share_ratio"] = round(
+            pages.get("shared", 0) / used, 4) if used else 0.0
     mesh = getattr(engine, "mesh", None)
     if mesh is not None:
         from ..parallel.mesh import mesh_tag

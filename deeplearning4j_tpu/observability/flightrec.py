@@ -46,7 +46,7 @@ EVENT_KINDS = ("admission", "block_retire", "shed", "takeover",
                "migration", "reconnect", "fault", "crash",
                "replica_dead", "postmortem", "journal", "recovered",
                "preempt", "prefill_chunk", "scale_up", "descale",
-               "autoscale")
+               "autoscale", "page_preempt")
 
 
 class FlightRecorder:

@@ -307,7 +307,13 @@ class EngineSupervisor(HeartbeatMonitor):
             prefill_chunk=old.prefill_chunk,       # headroom shed, chunk
             adaptive_block=old.adaptive_block,     # size, and the K
             block_ladder=old.block_ladder,         # ladder all rebuild
-            block_latency_target=old.block_latency_target)
+            block_latency_target=old.block_latency_target,
+            # paged KV cache (ISSUE 12): the rebuilt engine gets a
+            # FRESH pool/allocator of the same geometry — harvested
+            # requests re-prefill into it (page tables rebuild), and
+            # its prefix index warms back up as traffic flows
+            paged=old._pager is not None, page_size=old.page_size,
+            num_pages=old.num_pages, prefix_cache=old.prefix_cache)
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)

@@ -53,7 +53,11 @@ class ParallelInference:
                  generation_prefill_chunk: Optional[int] = None,
                  generation_adaptive_block: bool = False,
                  generation_block_ladder=None,
-                 generation_block_latency_target: float = 0.25):
+                 generation_block_latency_target: float = 0.25,
+                 generation_paged: bool = False,
+                 generation_page_size: int = 16,
+                 generation_num_pages: Optional[int] = None,
+                 generation_prefix_cache: bool = True):
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = inference_mode
@@ -101,6 +105,11 @@ class ParallelInference:
         self.generation_block_ladder = generation_block_ladder
         self.generation_block_latency_target = float(
             generation_block_latency_target)
+        # paged KV cache + prefix caching (ISSUE 12)
+        self.generation_paged = bool(generation_paged)
+        self.generation_page_size = int(generation_page_size)
+        self.generation_num_pages = generation_num_pages
+        self.generation_prefix_cache = bool(generation_prefix_cache)
         self._gen_journal = None
         self.last_recovery = None          # RecoveryReport of this boot
         self._telemetry = None
@@ -256,7 +265,11 @@ class ParallelInference:
                     adaptive_block=self.generation_adaptive_block,
                     block_ladder=self.generation_block_ladder,
                     block_latency_target=(
-                        self.generation_block_latency_target))
+                        self.generation_block_latency_target),
+                    paged=self.generation_paged,
+                    page_size=self.generation_page_size,
+                    num_pages=self.generation_num_pages,
+                    prefix_cache=self.generation_prefix_cache)
                 if self.generation_supervised:
                     from .failures import EngineSupervisor
                     self._gen_supervisor = EngineSupervisor(

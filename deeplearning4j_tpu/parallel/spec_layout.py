@@ -86,6 +86,14 @@ class SpecLayout:
         """[S, H, T_max, Dh]: slots over data, heads over tp."""
         return P(self.data_axis, self.tp_axis, None, None)
 
+    def kv_pages(self) -> P:
+        """Paged pool [P, H, page_size, Dh]: heads over tp exactly like
+        the slab's H dim. Pages do NOT shard over data — any slot may
+        map any page, so the pool replicates across the data axis (the
+        documented memory cost of paging on data>1 meshes until the
+        disaggregated tier gives pages a home replica)."""
+        return P(None, self.tp_axis, None, None)
+
     def batch(self, ndim: int = 1) -> P:
         """Per-row host inputs (ids/positions/temps [B], tokens [B, T]):
         batch over data."""

@@ -704,6 +704,10 @@ class EngineFleetRouter:
                  adaptive_block: bool = False,
                  block_ladder=None,
                  block_latency_target: float = 0.25,
+                 paged: bool = False, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 sticky_page_size: Optional[int] = None,
                  engine_factory=None):
         self.fleet_id = fleet_id if fleet_id is not None \
             else f"fleet{next(_FLEET_SEQ)}"
@@ -739,6 +743,15 @@ class EngineFleetRouter:
         self.recover_beats = int(recover_beats)
         self.sticky_prefix = sticky_prefix if sticky_prefix is None \
             else int(sticky_prefix)
+        # sticky keys hash through the SAME content chain the replicas'
+        # prefix caches use (models/paging.chain_digests), at the same
+        # page boundaries — so the requests this router groups onto one
+        # replica are exactly the requests whose pages that replica can
+        # share. Default page size follows the replicas' pools.
+        from ..models.paging import DEFAULT_PAGE_SIZE
+        self.sticky_page_size = int(sticky_page_size) \
+            if sticky_page_size is not None \
+            else (int(page_size) if paged else DEFAULT_PAGE_SIZE)
 
         # ---------------------------------------------------- replicas
         self.heartbeat_interval = float(heartbeat_interval)
@@ -768,7 +781,9 @@ class EngineFleetRouter:
                     prefill_chunk=prefill_chunk,
                     adaptive_block=adaptive_block,
                     block_ladder=block_ladder,
-                    block_latency_target=block_latency_target)
+                    block_latency_target=block_latency_target,
+                    paged=paged, page_size=page_size,
+                    num_pages=num_pages, prefix_cache=prefix_cache)
                 if supervised:
                     from ..parallel.failures import EngineSupervisor
                     eng = EngineSupervisor(
@@ -872,8 +887,13 @@ class EngineFleetRouter:
             return fr
         key = sticky_key
         if key is None and self.sticky_prefix:
-            key = ",".join(str(int(t))
-                           for t in fr.prompt[:self.sticky_prefix])
+            # the prefix-cache content hash, not a token join: the ring
+            # key and the replicas' page-chain keys are ONE function
+            # (models/paging), so sticky routing concentrates exactly
+            # the prompts whose prefix pages can be shared
+            from ..models.paging import prefix_route_key
+            key = prefix_route_key(fr.prompt[:self.sticky_prefix],
+                                   self.sticky_page_size)
         order, loads = self._dispatch_order(prefer=replica_id,
                                             sticky_key=key)
         total_depth = 0

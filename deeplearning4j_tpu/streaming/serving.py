@@ -252,7 +252,9 @@ class GenerationServingRoute(_RoutePublishMixin):
                  headroom_margin: float = 1.0,
                  prefill_chunk: Optional[int] = None,
                  adaptive_block: bool = False, block_ladder=None,
-                 block_latency_target: float = 0.25):
+                 block_latency_target: float = 0.25,
+                 paged: bool = False, page_size: int = 16,
+                 num_pages=None, prefix_cache: bool = True):
         self._owns_engine = engine is None
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
@@ -299,7 +301,13 @@ class GenerationServingRoute(_RoutePublishMixin):
                                           adaptive_block=adaptive_block,
                                           block_ladder=block_ladder,
                                           block_latency_target=(
-                                              block_latency_target))
+                                              block_latency_target),
+                                          # paged KV cache + prefix
+                                          # caching (ISSUE 12)
+                                          paged=paged,
+                                          page_size=page_size,
+                                          num_pages=num_pages,
+                                          prefix_cache=prefix_cache)
         self.engine = engine
         self.broker = broker
         self.input_topic = input_topic

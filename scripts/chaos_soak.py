@@ -111,7 +111,7 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
              hang_seconds: float = None, wait_s: float = 180.0,
              steady_wave: int = 4, overhead_ab: bool = True,
              lock_audit: bool = False, mesh_shape: str = None,
-             postmortem_dir: str = None) -> dict:
+             postmortem_dir: str = None, paged: bool = False) -> dict:
     """One soak iteration; returns a summary dict (see keys below).
 
     Prompt lengths and generation budgets are drawn so every prefill —
@@ -159,7 +159,14 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
 
     summary = {"seed": seed, "requests": n_requests, "crashes": crashes,
                "hangs": hangs,
-               "mesh": mesh_shape if mesh_shape else None}
+               "mesh": mesh_shape if mesh_shape else None,
+               "paged": bool(paged)}
+    # --paged (ISSUE 12): the WHOLE soak — clean reference, chaos run,
+    # takeovers, steady wave — on a block-paged KV cache with the
+    # prefix cache live (slab-equivalent pool: the chaos invariants
+    # must hold before the pool is ever squeezed); every harvest must
+    # leave the allocator's refcounts provably balanced
+    eng_kw = {"paged": True, "page_size": 8} if paged else {}
     # --lock-audit: every lock constructed during the soak (all three
     # engines, the supervisor, replacement engines built by takeovers)
     # is instrumented; observed acquisition orders are cross-checked
@@ -174,7 +181,8 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
             (la if la is not None else contextlib.nullcontext()):
         # --- clean reference run: the uninterrupted ground truth, and
         # the compile warmup (same decoder => same jitted programs)
-        clean = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec)
+        clean = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec,
+                                     **eng_kw)
         clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
         clean.run_until_drained()
         expected = [r.result(1) for r in clean_reqs]
@@ -208,7 +216,7 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
         # --- chaos run under supervision
         eng = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec,
                                    fault_injector=inj,
-                                   flight_recorder=flightrec)
+                                   flight_recorder=flightrec, **eng_kw)
         sup = EngineSupervisor(eng, timeout=supervisor_timeout,
                                interval=0.1,
                                max_restarts=crashes + hangs + 2,
@@ -231,6 +239,19 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
         steady_delta = audit.delta(snap)
         stranded += [r for r in wave if not r.done()]
         stats = sup.stats()
+        if paged:
+            # refcount balance after every harvest: the FINAL engine
+            # (every predecessor was quarantine-harvested, which
+            # releases all mappings by construction) must audit clean,
+            # with only prefix-index retention left resident
+            fin = sup._engine
+            summary["page_audit"] = fin._pager.audit(fin._slot_pages)
+            summary["kv_pages"] = fin.kv_page_stats()
+            fst = fin.stats()
+            summary["prefix_cache"] = {
+                "hits": fst["prefix_cache_hits"],
+                "misses": fst["prefix_cache_misses"],
+                "hit_tokens": fst["prefix_cache_hit_tokens"]}
         sup.stop()
 
     mismatches = 0
@@ -393,7 +414,8 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
                    wait_s: float = 120.0, steady_wave: int = 2,
                    fleet_scale: bool = True,
                    lock_audit: bool = False,
-                   postmortem_dir: str = None) -> dict:
+                   postmortem_dir: str = None,
+                   paged: bool = False) -> dict:
     """One fleet soak round (``--replicas N``): N replicas behind an
     ``EngineFleetRouter`` under load, one hard-crashed mid-stream and
     (N ≥ 3) one zombied, with the exactly-once / token-parity /
@@ -428,7 +450,13 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
                for _ in range(n_requests)]
     gens = [int(rng.integers(2, max_new + 1)) for _ in range(n_requests)]
 
-    summary = {"seed": seed, "replicas": replicas, "requests": n_requests}
+    summary = {"seed": seed, "replicas": replicas,
+               "requests": n_requests, "paged": bool(paged)}
+    # --paged --replicas (ISSUE 12): crash + MIGRATION on paged
+    # replicas — a harvested paged engine's requests re-prefill into
+    # another replica's pool, and every replica's allocator must audit
+    # balanced afterwards
+    eng_kw = {"paged": True, "page_size": 8} if paged else {}
     la = LockAudit(patch=True) if lock_audit else None
     with CompileAudit() as audit, \
             (la if la is not None else contextlib.nullcontext()):
@@ -472,7 +500,7 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
             replica_injectors=injs, heartbeat_interval=0.03,
             monitor_interval=0.03, suspect_after=0.15, dead_after=0.4,
             recover_beats=3, flight_recorder=flightrec,
-            postmortem_dir=postmortem_dir).start()
+            postmortem_dir=postmortem_dir, **eng_kw).start()
         frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
         deadline = time.monotonic() + wait_s
         for fr in frs:
@@ -496,6 +524,18 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
         stranded += [fr for fr in wave if not fr.done()]
 
         fleet_table = router.fleet_stats()
+        if paged:
+            # every replica's allocator — survivors AND harvested
+            # corpses — must balance: slot refs all released, only
+            # prefix-index retention resident
+            page_audit = []
+            for rid, rep in sorted(router._replicas.items()):
+                inner = rep.engine.engine if rep.supervised \
+                    else rep.engine
+                if getattr(inner, "_pager", None) is not None:
+                    page_audit += [f"{rid}: {p}" for p in
+                                   inner._pager.audit(inner._slot_pages)]
+            summary["page_audit"] = page_audit
         router.shutdown()       # fails the zombie's leftover inners →
         #                         their late publishes land in the ledger
         ledger = router._ledger.to_dict()
@@ -1365,6 +1405,14 @@ def main(argv=None) -> int:
                          "('2x1', '1x2', '2x2', or a bare device "
                          "count); forces a virtual host-device CPU "
                          "mesh, so no hardware is needed")
+    ap.add_argument("--paged", action="store_true",
+                    help="run the round on a block-paged KV cache with "
+                         "content-hashed prefix caching (ISSUE 12): "
+                         "same chaos bars, plus the allocator refcount "
+                         "audit must balance after every harvest "
+                         "(composes with --mesh for a paged SHARDED "
+                         "engine and with --replicas for paged "
+                         "crash+migration)")
     ap.add_argument("--lock-audit", action="store_true",
                     help="instrument every lock (LockAudit patch mode), "
                          "cross-check observed acquisition orders "
@@ -1433,10 +1481,10 @@ def main(argv=None) -> int:
         os.environ["XLA_FLAGS"] = " ".join(flags)
 
     if args.process_kill:
-        if args.mesh or args.replicas:
+        if args.mesh or args.replicas or args.paged:
             ap.error("--process-kill runs a single-engine child "
-                     "process; it cannot be combined with --mesh or "
-                     "--replicas")
+                     "process; it cannot be combined with --mesh, "
+                     "--replicas, or --paged")
         ok = True
         for i in range(args.iterations):
             s = run_process_kill_soak(
@@ -1467,9 +1515,10 @@ def main(argv=None) -> int:
         return 0 if ok else 1
 
     if args.autoscale:
-        if args.mesh or args.replicas or args.process_kill:
+        if args.mesh or args.replicas or args.process_kill or args.paged:
             ap.error("--autoscale runs its own 1->N->1 fleet; it cannot "
-                     "be combined with --mesh/--replicas/--process-kill")
+                     "be combined with --mesh/--replicas/--process-kill/"
+                     "--paged")
         ok = True
         for i in range(args.iterations):
             s = run_autoscale_soak(seed=args.seed + i,
@@ -1514,7 +1563,8 @@ def main(argv=None) -> int:
                                num_slots=args.slots, max_new=args.max_new,
                                fleet_scale=not args.no_fleet_scale,
                                lock_audit=args.lock_audit,
-                               postmortem_dir=args.postmortem_dir)
+                               postmortem_dir=args.postmortem_dir,
+                               paged=args.paged)
             scale = s.get("fleet_scale") or {}
             # near-linear bar: >= 0.8x per replica (2.4x at N=3)
             scale_bad = bool(scale) and \
@@ -1525,7 +1575,7 @@ def main(argv=None) -> int:
             bad = s["stranded"] or s["mismatches"] or s["failed"] or \
                 s["steady_new_compiles"] or s["migrations"] == 0 or \
                 not s["ledger_consistent"] or scale_bad or lock_bad or \
-                pm_bad
+                pm_bad or bool(s.get("page_audit"))
             ok = ok and not bad
             if args.json:
                 print(json.dumps(s, default=str))
@@ -1564,7 +1614,8 @@ def main(argv=None) -> int:
                      supervisor_timeout=args.supervisor_timeout,
                      overhead_ab=not args.no_overhead_ab,
                      lock_audit=args.lock_audit, mesh_shape=args.mesh,
-                     postmortem_dir=args.postmortem_dir)
+                     postmortem_dir=args.postmortem_dir,
+                     paged=args.paged)
         over_budget = (s.get("telemetry_overhead_pct") or 0.0) > 5.0
         lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
                         s.get("lock_audit", {}).get("cycles"))
@@ -1572,7 +1623,8 @@ def main(argv=None) -> int:
         bad = s["stranded"] or s["mismatches"] or s["failed"] or \
             s["steady_new_compiles"] or s["trace_problems"] or \
             (s["readbacks_per_block"] or 0.0) > 1.0 or lock_bad or \
-            (args.strict_overhead and over_budget) or pm_bad
+            (args.strict_overhead and over_budget) or pm_bad or \
+            bool(s.get("page_audit"))
         ok = ok and not bad
         if args.json:
             print(json.dumps(s, default=str))
@@ -1588,6 +1640,11 @@ def main(argv=None) -> int:
                       f"{len(d['novel'])}novel/"
                       f"{len(d['inversions'])}inversions")
             mz = "" if not s.get("mesh") else f" mesh={s['mesh']}"
+            if s.get("paged"):
+                pc = s.get("prefix_cache") or {}
+                mz += (f" paged[audit="
+                       f"{'clean' if not s.get('page_audit') else 'BAD'}"
+                       f" hits={pc.get('hits')}]")
             pm = "" if "postmortem_ok" not in s else \
                 (f" postmortems={len(s['postmortems'])}"
                  f"{'' if s['postmortem_ok'] else ' MISMATCH'}")

@@ -39,8 +39,15 @@ On CPU the script forces XLA_FLAGS=--xla_force_host_platform_device_
 count=8 (GEN_MESH_DEVICES overrides) so the sweep runs without TPU
 hardware.
 
+--shared-prefix (ISSUE 12) runs the paged-vs-slab A/B on N streams ×
+one common system prompt: slab prompt-prefill tok/s vs paged-with-
+prefix-cache-hits, max concurrent sequences at byte-identical KV pool
+budgets (devstats-verified), and the prefix hit rate. ``--gate [X]``
+enforces the acceptance bars (paged prefill speedup >= X, default 5.0;
+concurrency ratio >= 3x; hit rate >= 0.9) with a non-zero exit.
+
 Run: [JAX_PLATFORMS=...] python scripts/perf_generate.py \
-         [--block-sweep | --mesh-sweep]
+         [--block-sweep | --mesh-sweep | --shared-prefix [--gate [X]]]
 """
 
 from __future__ import annotations
@@ -282,6 +289,125 @@ def mesh_sweep() -> int:
     return 0 if parity_ok else 1
 
 
+def shared_prefix_sweep(gate: float = None) -> int:
+    """--shared-prefix (ISSUE 12): N streams × ONE common system prompt
+    — the paged-vs-slab A/B on the workload prefix caching exists for.
+    Reports (a) prompt-prefill tok/s slab vs paged-with-prefix-hits and
+    the speedup, (b) max CONCURRENT sequences at byte-identical KV pool
+    budgets (devstats-verified), and (c) the prefix hit rate. With
+    ``--gate X`` (default 5.0) exits non-zero unless the paged prefill
+    speedup >= X, the concurrency ratio >= 3x, and the steady hit rate
+    >= 0.9 — the ISSUE 12 acceptance bars.
+
+    Knobs: GEN_PREFIX_LEN (default 192), GEN_PREFIX_TAIL (16),
+    GEN_PREFIX_REQUESTS (16), GEN_PREFIX_GEN (4), GEN_SLOTS (4),
+    GEN_PAGE_SIZE (16) — plus the model knobs above."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                           TransformerDecoder,
+                                           transformer_lm_conf)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.devstats import kv_cache_stats
+
+    pfx = int(os.environ.get("GEN_PREFIX_LEN", "192"))
+    tail = int(os.environ.get("GEN_PREFIX_TAIL", "16"))
+    gen_t = int(os.environ.get("GEN_PREFIX_GEN", "4"))
+    n_req = int(os.environ.get("GEN_PREFIX_REQUESTS", "16"))
+    slots = int(os.environ.get("GEN_SLOTS", "4"))
+    ps = int(os.environ.get("GEN_PAGE_SIZE", "16"))
+    t_max = ((pfx + tail + gen_t) // ps + 2) * ps    # ps | t_max
+    conf = transformer_lm_conf(vocab_size=VOCAB, d_model=DMODEL,
+                               num_heads=HEADS, num_layers=LAYERS,
+                               max_length=t_max)
+    net = ComputationGraph(conf, compute_dtype=jnp.bfloat16).init()
+    dec = TransformerDecoder(net)
+    rng = np.random.default_rng(0)
+    sys_p = rng.integers(0, VOCAB, pfx).astype(np.int32)
+    prompts = [np.concatenate(
+        [sys_p, rng.integers(0, VOCAB, tail).astype(np.int32)])
+        for _ in range(n_req)]
+    prompt_tokens = sum(len(p) for p in prompts)
+
+    def stream_run(paged: bool):
+        eng = SlotGenerationEngine(net, num_slots=slots, decoder=dec,
+                                   paged=paged, page_size=ps)
+        if paged:
+            # prime: one request registers the prefix chain — the
+            # measured stream is the steady (all-hit) serving state
+            eng.submit(prompts[0], 1)
+            eng.run_until_drained()
+        for p in prompts:
+            eng.submit(p, gen_t)
+        t0 = time.perf_counter()
+        eng.run_until_drained()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        return (prompt_tokens / wall, st["prefix_cache_hits"],
+                st["prefix_cache_misses"])
+
+    stream_run(False)                        # warm both compile paths
+    stream_run(True)
+    slab_med, slab_spread = _median(lambda: stream_run(False)[0])
+    on_runs = [stream_run(True) for _ in range(RUNS)]
+    paged_med = float(np.median([r[0] for r in on_runs]))
+    hits, misses = on_runs[-1][1], on_runs[-1][2]
+    hit_rate = hits / max(1, hits + misses)
+    speedup = paged_med / slab_med if slab_med else 0.0
+
+    # ---- max concurrent sequences at byte-identical pool budgets ----
+    # the slab reserves t_max per slot; at the SAME devstats-verified
+    # KV bytes the paged pool admits every short sequence its pages
+    # actually fit — count live slots after ONE admission wave
+    short = [rng.integers(0, VOCAB, max(2, ps // 2)).astype(np.int32)
+             for _ in range(8 * slots)]
+    slab_eng = SlotGenerationEngine(net, num_slots=slots, decoder=dec)
+    paged_eng = SlotGenerationEngine(
+        net, num_slots=8 * slots, decoder=dec, paged=True, page_size=ps,
+        num_pages=slots * (t_max // ps) + 1)
+    slab_bytes = kv_cache_stats(slab_eng)["bytes"]
+    paged_bytes = kv_cache_stats(paged_eng)["bytes"]
+    for eng in (slab_eng, paged_eng):
+        for p in short:
+            eng.submit(p, 2)
+        eng._sweep_pending()
+        eng._admit()
+    slab_live = sum(r is not None for r in slab_eng._slots)
+    paged_live = sum(r is not None for r in paged_eng._slots)
+    slab_eng.run_until_drained()
+    paged_eng.run_until_drained()
+    ratio = paged_live / max(1, slab_live)
+
+    out = {
+        "shared_prefix": {
+            "prefix_len": pfx, "tail_len": tail, "requests": n_req,
+            "gen_tokens": gen_t, "slots": slots, "page_size": ps,
+            "slab_prompt_tok_s": round(slab_med, 1),
+            "slab_spread_pct": slab_spread,
+            "paged_prompt_tok_s": round(paged_med, 1),
+            "paged_prefill_speedup": round(speedup, 2),
+            "prefix_hit_rate": round(hit_rate, 4),
+            "prefix_hit_tokens": int(hits) * (pfx // ps) * ps,
+        },
+        "concurrency_at_fixed_bytes": {
+            "kv_pool_bytes": {"slab": slab_bytes,
+                              "paged": paged_bytes},
+            "slab_concurrent": int(slab_live),
+            "paged_concurrent": int(paged_live),
+            "ratio": round(ratio, 2),
+        },
+    }
+    ok = True
+    if gate is not None:
+        out["gate"] = {"min_prefill_speedup": gate,
+                       "min_concurrency_ratio": 3.0,
+                       "min_hit_rate": 0.9}
+        ok = (speedup >= gate and ratio >= 3.0 and hit_rate >= 0.9)
+        out["ok"] = ok
+    print(json.dumps(out, indent=1), flush=True)
+    return 0 if ok else 1
+
+
 def main() -> int:
     import jax.numpy as jnp
 
@@ -404,4 +530,12 @@ if __name__ == "__main__":
         sys.exit(block_sweep())
     if "--mesh-sweep" in sys.argv[1:]:
         sys.exit(mesh_sweep())
+    if "--shared-prefix" in sys.argv[1:]:
+        _gate = None
+        if "--gate" in sys.argv[1:]:
+            _i = sys.argv.index("--gate")
+            _nxt = sys.argv[_i + 1] if _i + 1 < len(sys.argv) else ""
+            _gate = float(_nxt) if _nxt.replace(
+                ".", "", 1).isdigit() else 5.0
+        sys.exit(shared_prefix_sweep(gate=_gate))
     sys.exit(main())

@@ -208,15 +208,18 @@ def _kv_bytes(snap: dict):
     return sum(vals) if vals else None
 
 
-def _gauge_sum(snap: dict, family: str):
+def _gauge_sum(snap: dict, family: str, label: str = None):
     """Sum a gauge family's children from a snapshot's metrics (e.g.
     ``journal_pending`` across a replica's journals); None when the
-    family is absent."""
+    family is absent. ``label`` restricts to children carrying that
+    exact ``name=value`` pair (e.g. ``state=free`` of
+    ``generation_kv_pages`` across a replica's engines)."""
     doc = (snap.get("metrics") or {}).get(family) or {}
     if doc.get("type") != "gauge":
         return None
-    vals = [v for v in (doc.get("values") or {}).values()
-            if isinstance(v, (int, float))]
+    vals = [v for k, v in (doc.get("values") or {}).items()
+            if isinstance(v, (int, float)) and
+            (label is None or label in str(k).split(","))]
     return sum(vals) if vals else None
 
 
@@ -253,6 +256,14 @@ def merge_snapshots(per_url: dict) -> dict:
         row["headroom_p50_s"] = head.get("p50")
         row["headroom_min_s"] = head.get("min")
         row["ttft_p99_s"] = (overall.get("ttft_s") or {}).get("p99")
+        # paged-KV health (ISSUE 12): pool pages free / prefix-shared
+        # per replica (gauge sums across its engines) plus the fleet's
+        # prefix hit rate from the summed counters below — the scrape
+        # view of the concurrency-at-fixed-memory claim
+        row["kv_pages_free"] = _gauge_sum(
+            snap, "generation_kv_pages", label="state=free")
+        row["kv_pages_shared"] = _gauge_sum(
+            snap, "generation_kv_pages", label="state=shared")
         # journal health (ISSUE 10): durable-WAL backlog + degraded flag
         # per replica — a degraded journal means the replica serves with
         # no durability and deserves the same attention as a missed SLO
@@ -290,8 +301,8 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
     w(f"fleet scrape: {doc['up']}/{doc['scraped']} replicas up\n")
     w(f"  {'replica':<36} {'up':>2} {'uptime':>8} {'att-short':>9} "
       f"{'att-long':>8} {'burn-sh':>8} {'reqs':>6} {'miss':>5} "
-      f"{'hd-p50':>8} {'hd-min':>8} {'kv-bytes':>10} {'j-pend':>6} "
-      f"{'j-deg':>5}\n")
+      f"{'hd-p50':>8} {'hd-min':>8} {'kv-bytes':>10} {'pg-free':>7} "
+      f"{'pg-shr':>6} {'j-pend':>6} {'j-deg':>5}\n")
     fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
     for base, row in sorted(doc["replicas"].items()):
         if not row.get("up"):
@@ -306,8 +317,17 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
           f"{fmt(row.get('headroom_p50_s')):>8} "
           f"{fmt(row.get('headroom_min_s')):>8} "
           f"{fmt(row.get('kv_cache_bytes')):>10} "
+          f"{fmt(row.get('kv_pages_free')):>7} "
+          f"{fmt(row.get('kv_pages_shared')):>6} "
           f"{fmt(row.get('journal_pending')):>6} "
           f"{'-' if jd is None else ('Y' if jd else 'n'):>5}\n")
+    hits = doc["counters"].get("prefix_cache_hit_total")
+    misses = doc["counters"].get("prefix_cache_miss_total")
+    if hits is not None or misses is not None:
+        total = (hits or 0) + (misses or 0)
+        rate = "-" if not total else f"{(hits or 0) / total:.3f}"
+        w(f"  prefix cache: {hits or 0} hits / {misses or 0} misses "
+          f"(hit rate {rate})\n")
     agg = doc["slo"]
     w(f"  fleet SLO (target {agg['target']}): "
       f"attainment short={agg['attainment_short']} "

@@ -573,7 +573,12 @@ class TestFleetRouting:
             first = router.submit([5, 7, 1], 2)
             first.result(30)
             owner = first.replica_id
-            ring = router._ring_walk("5,7")
+            # r17: the sticky key IS the prefix-cache content hash
+            # (models/paging.prefix_route_key), not a token join — one
+            # function on both sides of the routing/caching contract
+            from deeplearning4j_tpu.models.paging import prefix_route_key
+            ring = router._ring_walk(prefix_route_key(
+                [5, 7], router.sticky_page_size))
             assert ring[0] == owner
             successor = next(r for r in ring if r != owner)
             router.kill_replica(owner, mode="crash")

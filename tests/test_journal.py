@@ -746,6 +746,104 @@ class TestJournalLintClean:
         assert found == [], "\n".join(str(f) for f in found)
 
 
+class TestPagedJournalRecovery:
+    """ISSUE 12 satellite: journal/supervisor recovery on a PAGED
+    engine — a mid-stream kill recovers onto a fresh pool with page
+    tables rebuilt by re-prefill, token-identical resume, and
+    refcounts provably balanced (allocator audit) afterwards."""
+
+    @pytest.mark.parametrize("block_size", [1, 4])
+    def test_kill_midstream_rebuilds_page_tables_token_identical(
+            self, journal_net, tmp_path, block_size):
+        net, dec = journal_net
+        prompts, gens = _prompts(6, seed=21)
+        expected = _expected(journal_net, prompts, gens,
+                             block_size=block_size)
+        jr = RequestJournal(tmp_path)
+        inj = FaultInjector(flight_recorder=FlightRecorder(
+            registry=MetricsRegistry()))
+        inj.hang_for("engine.step", seconds=0.08, at=1, times=500)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr, paged=True, page_size=8,
+                                   block_size=block_size,
+                                   fault_injector=inj).start()
+        for i, (p, g) in enumerate(zip(prompts, gens)):
+            eng.submit(p, g, journal_id=f"pg{i}")
+        time.sleep(0.4)                        # mid-stream "kill"
+        eng.quarantine()                       # harvest w/o failing
+        # the harvest left the dead engine's refcounts balanced: every
+        # slot mapping released, only prefix-index retention remains
+        assert eng._pager.audit(eng._slot_pages) == []
+        assert sum(len(p) for p in eng._slot_pages) == 0
+        jr.close()
+        # "restart": fresh journal + fresh PAGED engine (fresh pool —
+        # page tables must rebuild from the WAL's prompt+tokens alone)
+        jr2 = RequestJournal(tmp_path)
+        eng2 = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                    journal=jr2, paged=True,
+                                    page_size=8,
+                                    block_size=block_size).start()
+        rep = recover_from_journal(jr2, eng2)
+        assert set(rep.recovered) | set(rep.completed) | \
+            set(rep.already_done) == {f"pg{i}" for i in range(6)}
+        assert not rep.unrecoverable and not rep.fenced
+        for rq in rep.requests:
+            i = int(rq.journal_id[2:])
+            assert np.array_equal(rq.result(30), expected[i])
+        # steady state: tables of completed requests are released and
+        # the allocator audit balances on the NEW engine too
+        assert eng2._pager.audit(eng2._slot_pages) == []
+        eng2.shutdown()
+        jr2.close()
+
+    def test_recovered_prefix_rehits_its_own_registered_pages(
+            self, journal_net, tmp_path):
+        """A recovered long-prefix request re-prefills THROUGH the
+        prefix cache: requests completed before the kill registered
+        their pages, so recovery's re-prefill of a same-prefix request
+        maps them instead of recomputing — and stays token-identical."""
+        net, dec = journal_net
+        rng = np.random.default_rng(31)
+        sys_p = rng.integers(0, VOCAB, 17)
+        prompts = [np.concatenate([sys_p,
+                                   rng.integers(0, VOCAB, 2 + i)])
+                   for i in range(4)]
+        gens = [4] * 4
+        expected = _expected(journal_net, prompts, gens)
+        jr = RequestJournal(tmp_path)
+        eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                                   journal=jr, paged=True, page_size=8)
+        reqs = [eng.submit(p, g, journal_id=f"px{i}")
+                for i, (p, g) in enumerate(zip(prompts, gens))]
+        # serve the first pair only, then "die" with the rest queued
+        eng._sweep_pending()
+        eng._admit()
+        while eng._any_active():
+            eng._step()
+        eng.quarantine()
+        jr.close()
+        jr2 = RequestJournal(tmp_path)
+        # one slot: recovered requests re-admit in SEPARATE waves, so
+        # the second's re-prefill can map what the first registered
+        # (same-wave rows deliberately never share — registration is
+        # post-dispatch)
+        eng2 = SlotGenerationEngine(net, num_slots=1, decoder=dec,
+                                    journal=jr2, paged=True,
+                                    page_size=8)
+        rep = recover_from_journal(jr2, eng2)
+        eng2.run_until_drained()
+        by_id = {rq.journal_id: rq for rq in rep.requests}
+        done = {f"px{i}": r for i, r in enumerate(reqs) if r.done()}
+        for i in range(4):
+            rq = by_id.get(f"px{i}", done.get(f"px{i}"))
+            assert np.array_equal(rq.result(5), expected[i])
+        st = eng2.stats()
+        assert st["prefix_cache_hits"] >= 1   # recovery re-prefills
+        #            mapped the shared prefix instead of recomputing it
+        assert eng2._pager.audit(eng2._slot_pages) == []
+        jr2.close()
+
+
 def _load_chaos_soak():
     spec = importlib.util.spec_from_file_location(
         "chaos_soak_pk", os.path.join(os.path.dirname(__file__),

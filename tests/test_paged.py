@@ -1,0 +1,534 @@
+"""Paged KV cache + content-hashed prefix caching (ISSUE 12):
+allocator/refcount semantics, token-for-token paged-vs-slab parity
+(greedy AND fixed-seed sampled) across mesh shapes × block sizes with
+zero steady-state compiles and ≤1 readback per decode block,
+concurrency-at-fixed-pool-bytes, prefix-cache hits/eviction, pool-
+pressure preemption, harvest refcount balance, fleet sticky-key
+wiring, and the devstats/telemetry page accounting."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import CompileAudit, TransferAudit
+from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                       TransformerDecoder, lm_batch,
+                                       transformer_lm_conf)
+from deeplearning4j_tpu.models.paging import (DEFAULT_PAGE_SIZE, NULL_PAGE,
+                                              PageAllocator, chain_digests,
+                                              prefix_route_key)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.observability.devstats import kv_cache_stats
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.parallel.faults import RejectedError
+from deeplearning4j_tpu.parallel.mesh import generation_mesh
+
+VOCAB = 12
+#: acceptance bar (ISSUE 12): parity across these shapes × these Ks
+MESH_SHAPES = [(1, 1), (2, 1), (1, 2)]
+BLOCK_SIZES = [1, 4]
+
+
+def _tiny_lm(**kw):
+    kw.setdefault("d_model", 32)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("max_length", 32)
+    kw.setdefault("learning_rate", 1e-2)
+    kw.setdefault("seed", 5)
+    return ComputationGraph(transformer_lm_conf(VOCAB, **kw)).init()
+
+
+@pytest.fixture(scope="module")
+def trained_net():
+    rng = np.random.default_rng(4242)
+    net = _tiny_lm()
+    starts = rng.integers(0, VOCAB, (16, 1))
+    seq = (starts + np.arange(17)[None, :]) % VOCAB
+    x, y = lm_batch(seq, VOCAB)
+    ds = DataSet(x, y)
+    for _ in range(120):
+        net.fit_batch(ds)
+    return net
+
+
+def _run(engine, prompts, gens, temps=None):
+    temps = temps or [0.0] * len(prompts)
+    reqs = [engine.submit(p, g, temperature=t)
+            for p, g, t in zip(prompts, gens, temps)]
+    engine.run_until_drained()
+    return [r.result(5) for r in reqs]
+
+
+def _shared_prefix_prompts(rng, n, prefix_len=17):
+    sys_p = rng.integers(0, VOCAB, prefix_len)
+    return [np.concatenate([sys_p, rng.integers(
+                0, VOCAB, int(rng.integers(1, 4)))]) for _ in range(n)]
+
+
+# ===================================================================
+# PageAllocator (no jax involved)
+# ===================================================================
+class TestPageAllocator:
+    def test_null_page_reserved_and_bounds(self):
+        pa = PageAllocator(5, 4)
+        got = pa.alloc(4)
+        assert got is not None and NULL_PAGE not in got
+        assert sorted(got) == [1, 2, 3, 4]
+        assert pa.alloc(1) is None          # exhausted, never partial
+        assert pa.alloc_failures == 1
+        with pytest.raises(ValueError):
+            PageAllocator(1, 4)             # page 0 alone is no pool
+        with pytest.raises(ValueError):
+            PageAllocator(8, 0)
+
+    def test_ref_unref_and_underflow(self):
+        pa = PageAllocator(4, 4)
+        (pid,) = pa.alloc(1)
+        pa.ref(pid)
+        pa.unref(pid)
+        pa.unref(pid)                       # back on the free list
+        assert sorted(pa.alloc(3)) == [1, 2, 3]
+        pa.unref(pid)                       # back to zero again
+        with pytest.raises(RuntimeError, match="underflow"):
+            pa.unref(pid)
+        with pytest.raises(RuntimeError, match="unheld"):
+            PageAllocator(4, 4).ref(1)
+
+    def test_match_register_and_cap(self):
+        pa = PageAllocator(8, 4)
+        toks = np.arange(12)                # 3 full pages
+        pages = pa.alloc(3)
+        assert pa.register_chain(toks, pages) == 3
+        got, n = pa.match_and_ref(toks)
+        assert got == pages and n == 12
+        for pid in got:
+            pa.unref(pid)
+        # cap: one token short leaves the last page unmatched
+        got, n = pa.match_and_ref(toks, max_tokens=11)
+        assert got == pages[:2] and n == 8
+        for pid in got:
+            pa.unref(pid)
+        # re-registration of resident digests adds nothing
+        assert pa.register_chain(toks, pages) == 0
+
+    def test_divergent_content_misses_from_divergence_on(self):
+        pa = PageAllocator(8, 4)
+        toks = np.arange(12)
+        pages = pa.alloc(3)
+        pa.register_chain(toks, pages)
+        other = np.concatenate([toks[:4], [99] * 8])
+        got, n = pa.match_and_ref(other)
+        assert got == pages[:1] and n == 4  # chain digest commits to
+        for pid in got:                     # the WHOLE prefix
+            pa.unref(pid)
+
+    def test_eviction_lru_leaves_before_parents(self):
+        pa = PageAllocator(4, 4, prefix_cache=True)
+        toks = np.arange(12)                # 3 pages fill the pool
+        pages = pa.alloc(3)
+        pa.register_chain(toks, pages)
+        for pid in pages:
+            pa.unref(pid)                   # cache-only now
+        # pool full of cache-only pages: alloc(1) must evict exactly
+        # one, and the LEAF (deepest chain entry), not the root
+        (fresh,) = pa.alloc(1)
+        assert fresh == pages[-1] and pa.evictions == 1
+        got, n = pa.match_and_ref(toks)
+        assert n == 8 and got == pages[:2]  # parents survived
+        for pid in got:
+            pa.unref(pid)
+        pa.unref(fresh)
+
+    def test_still_mapped_pages_are_not_evictable(self):
+        pa = PageAllocator(3, 4)
+        toks = np.arange(8)
+        pages = pa.alloc(2)
+        pa.register_chain(toks, pages)      # refs: 2 each (map + index)
+        assert pa.alloc(1) is None          # nothing evictable
+        # retention is NOT sharing: one mapping + the index's ref must
+        # not count toward the share ratio...
+        assert pa.stats()["shared"] == 0
+        got, _ = pa.match_and_ref(toks)     # ...a SECOND holder does
+        assert pa.stats()["shared"] == 2
+        for pid in got:
+            pa.unref(pid)
+
+    def test_unsatisfiable_alloc_never_evicts_the_cache(self):
+        """A request the pool can NEVER satisfy must fail WITHOUT
+        evicting the hot prefix pages — evict-then-fail would collapse
+        the hit rate for every subsequent request, for nothing."""
+        pa = PageAllocator(4, 4)
+        pages = pa.alloc(3)
+        pa.register_chain(np.arange(12), pages)
+        for pid in pages:
+            pa.unref(pid)                   # cache-only now
+        assert pa.alloc(4) is None          # > usable pool
+        assert pa.evictions == 0
+        got, n = pa.match_and_ref(np.arange(12))
+        assert n == 12                      # cache fully intact
+        for pid in got:
+            pa.unref(pid)
+
+    def test_audit_balance_and_detection(self):
+        pa = PageAllocator(6, 4)
+        pages = pa.alloc(2)
+        pa.register_chain(np.arange(8), pages)
+        assert pa.audit([pages]) == []
+        problems = pa.audit([])             # mappings lie about holders
+        assert any("refcount" in p for p in problems)
+
+    def test_prefix_cache_off_is_inert(self):
+        pa = PageAllocator(6, 4, prefix_cache=False)
+        pages = pa.alloc(2)
+        assert pa.register_chain(np.arange(8), pages) == 0
+        assert pa.match_and_ref(np.arange(8)) == ([], 0)
+
+
+class TestChainHashes:
+    def test_canonicalization_int32_int64(self):
+        a = np.arange(8, dtype=np.int64)
+        b = np.arange(8, dtype=np.int32)
+        assert chain_digests(a, 4) == chain_digests(b, 4)
+        assert prefix_route_key(a, 4) == prefix_route_key(b, 4)
+
+    def test_route_key_subpage_fallback_and_page_sensitivity(self):
+        assert prefix_route_key([1, 2], 4) != prefix_route_key([2, 1], 4)
+        assert prefix_route_key(np.arange(8), 4) != \
+            prefix_route_key(np.arange(8), 8)
+
+    def test_router_and_allocator_share_the_hash(self, trained_net):
+        """Sticky routing and the prefix cache must key on the SAME
+        content function: the router key of a prompt equals the hex of
+        the allocator's deepest chain digest for its full pages."""
+        from deeplearning4j_tpu.streaming.fleet import EngineFleetRouter
+        router = EngineFleetRouter(trained_net, num_replicas=2,
+                                   num_slots=2, sticky_prefix=16,
+                                   paged=True, page_size=8)
+        try:
+            prompt = np.arange(20) % VOCAB
+            expect = chain_digests(prompt[:16], 8)[-1].hex()
+            assert prefix_route_key(prompt[:16], 8) == expect
+            assert router.sticky_page_size == 8
+        finally:
+            router.shutdown()
+
+
+# ===================================================================
+# engine-level parity + audits (the acceptance bar)
+# ===================================================================
+class TestPagedParity:
+    def test_engine_rejects_unaligned_page_size(self, trained_net):
+        with pytest.raises(ValueError, match="must divide t_max"):
+            SlotGenerationEngine(trained_net, num_slots=2, paged=True,
+                                 page_size=5)
+
+    def test_parity_across_meshes_and_blocks_audited(self, trained_net):
+        """Token-for-token greedy AND fixed-seed sampled parity
+        paged-vs-slab across {1x1, 2x1, 1x2} × K∈{1,4}, with zero
+        steady-state compiles and ≤1 readback per decode block."""
+        rng = np.random.default_rng(9)
+        prompts = _shared_prefix_prompts(rng, 8)
+        gens = [int(rng.integers(3, 9)) for _ in range(8)]
+        temps = [0.0, 0.9] * 4             # mixed greedy/sampled rows
+        ref_dec = TransformerDecoder(trained_net)
+        expected = {}
+        for k in BLOCK_SIZES:
+            slab = SlotGenerationEngine(trained_net, num_slots=2,
+                                        decoder=ref_dec, block_size=k,
+                                        seed=3)
+            expected[k] = _run(slab, prompts, gens, temps)
+        for a, b in zip(expected[1], expected[BLOCK_SIZES[-1]]):
+            np.testing.assert_array_equal(a, b)    # slab K-consistency
+        for data, tp in MESH_SHAPES:
+            mesh = None if (data, tp) == (1, 1) \
+                else generation_mesh(data, tp)
+            dec = ref_dec if mesh is None \
+                else TransformerDecoder(trained_net, mesh=mesh)
+            for k in BLOCK_SIZES:
+                with CompileAudit() as audit, TransferAudit() as tr:
+                    pag = SlotGenerationEngine(
+                        trained_net, num_slots=2, decoder=dec,
+                        block_size=k, seed=3, paged=True, page_size=8)
+                    got = _run(pag, prompts, gens, temps)   # warm run
+                    for a, b in zip(expected[k], got):
+                        np.testing.assert_array_equal(
+                            a, b, err_msg=f"mesh={data}x{tp} K={k}")
+                    assert pag._pager.audit(pag._slot_pages) == []
+                    # steady state: a SECOND engine over the same
+                    # decoder re-serves the stream compiling NOTHING
+                    snap = audit.snapshot()
+                    pag2 = SlotGenerationEngine(
+                        trained_net, num_slots=2, decoder=dec,
+                        block_size=k, seed=3, paged=True, page_size=8)
+                    got2 = _run(pag2, prompts, gens, temps)
+                    for a, b in zip(expected[k], got2):
+                        np.testing.assert_array_equal(a, b)
+                    assert audit.delta(snap) == {}, \
+                        f"steady compiles mesh={data}x{tp} K={k}"
+                    blocks = pag2.decode_blocks
+                    fetched = tr.fetches("engine.decode")
+                    assert fetched <= 2 * blocks   # both engines: ≤1
+                    #                                readback per block
+
+    def test_prefix_hits_skip_tail_only(self, trained_net):
+        """After one prompt warms the cache, an identical-prefix prompt
+        admits with the shared pages mapped and only the tail
+        prefilled; outputs stay token-identical to the slab."""
+        rng = np.random.default_rng(10)
+        prompts = _shared_prefix_prompts(rng, 6)
+        gens = [4] * 6
+        ref = _run(SlotGenerationEngine(trained_net, num_slots=2),
+                   prompts, gens)
+        pag = SlotGenerationEngine(trained_net, num_slots=2, paged=True,
+                                   page_size=8)
+        got = _run(pag, prompts, gens)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
+        st = pag.stats()
+        assert st["prefix_cache_hits"] >= 4
+        assert st["prefix_cache_hit_tokens"] >= 4 * 16
+        assert st["prefix_cache_hits"] + st["prefix_cache_misses"] == 6
+        assert pag._pager.stats()["cached"] > 0
+
+    def test_chunked_paged_prefill_with_prefix_hit(self, trained_net):
+        """prefill_chunk composes with paging: windows allocate pages
+        incrementally and a prefix hit resumes chunking AT the shared
+        boundary (satellite: r16 windows allocate pages lazily)."""
+        rng = np.random.default_rng(11)
+        sys_p = rng.integers(0, VOCAB, 17)
+        long_p = [np.concatenate([sys_p, rng.integers(0, VOCAB, 8)])
+                  for _ in range(3)]
+        gens = [4, 4, 4]
+        ref = _run(SlotGenerationEngine(trained_net, num_slots=2,
+                                        prefill_chunk=8, block_size=2),
+                   long_p, gens)
+        pag = SlotGenerationEngine(trained_net, num_slots=2,
+                                   prefill_chunk=8, block_size=2,
+                                   paged=True, page_size=8)
+        got = _run(pag, long_p, gens)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
+        st = pag.stats()
+        assert st["prefill_chunks"] > 0
+        assert st["prefix_cache_hits"] >= 1
+        assert pag._pager.audit(pag._slot_pages) == []
+        # incremental allocation is OBSERVABLE: admission maps nothing
+        # beyond the shared prefix for a chunk-routed prompt (a fresh
+        # engine: nothing), then each window grows the table
+        inc = SlotGenerationEngine(trained_net, num_slots=2,
+                                   prefill_chunk=8, paged=True,
+                                   page_size=8)
+        inc.submit(long_p[0], 4)
+        inc._sweep_pending()
+        inc._admit()
+        s = next(iter(inc._chunking))
+        assert len(inc._slot_pages[s]) == 0   # no up-front reservation
+        inc._advance_chunks()
+        assert len(inc._slot_pages[s]) == 1   # exactly window 1's page
+        inc.quarantine()
+        assert inc._pager.audit(inc._slot_pages) == []
+
+
+# ===================================================================
+# concurrency at fixed pool bytes (the devstats-verified claim)
+# ===================================================================
+class TestConcurrencyAtFixedMemory:
+    def test_4x_concurrent_sequences_at_equal_pool_bytes(self,
+                                                         trained_net):
+        """At EXACTLY the slab's KV byte budget (devstats-verified),
+        the paged engine admits 4x the concurrent sequences on a
+        short-sequence mix — the slab reserves t_max per slot, pages
+        hold only live footprint (acceptance bar: >= 3x)."""
+        rng = np.random.default_rng(12)
+        prompts = [rng.integers(0, VOCAB, 3) for _ in range(8)]
+        gens = [3] * 8                      # ctx+gen <= 6 << t_max=32
+        slab = SlotGenerationEngine(trained_net, num_slots=2)
+        pag = SlotGenerationEngine(trained_net, num_slots=8, paged=True,
+                                   page_size=8, num_pages=9)
+        slab_bytes = kv_cache_stats(slab)["bytes"]
+        pag_stats = kv_cache_stats(pag)
+        assert pag_stats["bytes"] == slab_bytes + \
+            slab_bytes // (2 * 4)           # +1 null page of 8 tokens
+        # tighter: usable pages (8) hold EXACTLY the slab's 2x32 tokens
+        assert pag_stats["pages"]["num_pages"] * 8 == 2 * 32
+        for eng in (slab, pag):
+            for p, g in zip(prompts, gens):
+                eng.submit(p, g)
+            eng._sweep_pending()
+            eng._admit()                    # ONE admission wave
+        slab_live = sum(r is not None for r in slab._slots)
+        pag_live = sum(r is not None for r in pag._slots)
+        assert slab_live == 2               # slab: capacity-capped
+        assert pag_live == 8 >= 4 * slab_live
+        slab.run_until_drained()
+        pag.run_until_drained()
+        assert pag.completed == 8 and slab.completed == 8
+        assert pag._pager.audit(pag._slot_pages) == []
+
+    def test_pool_pressure_preempts_exactly_once(self, trained_net):
+        """A pool too small for every admitted sequence's full length
+        preempts lanes (re-queued, re-prefilled) instead of corrupting
+        or deadlocking — results stay token-identical to the slab."""
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(0, VOCAB, 3) for _ in range(6)]
+        gens = [14] * 6                     # grows past 2 pages of 8
+        ref = _run(SlotGenerationEngine(trained_net, num_slots=4,
+                                        block_size=2), prompts, gens)
+        pag = SlotGenerationEngine(trained_net, num_slots=4, paged=True,
+                                   page_size=8, num_pages=7,
+                                   block_size=2)
+        got = _run(pag, prompts, gens)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
+        assert pag.stats()["page_preempted"] > 0
+        assert pag._pager.audit(pag._slot_pages) == []
+
+    def test_oversized_request_is_shed_not_deadlocked(self, trained_net):
+        """A single request the pool can NEVER hold (even after
+        eviction, with nothing in flight) is shed with RejectedError —
+        the engine must not spin forever on it."""
+        pag = SlotGenerationEngine(trained_net, num_slots=2, paged=True,
+                                   page_size=8, num_pages=3)
+        req = pag.submit(np.arange(20) % VOCAB, 8)   # needs 3+ pages
+        pag.run_until_drained()
+        with pytest.raises(RejectedError, match="pool exhausted"):
+            req.result(1)
+        assert pag._pager.audit(pag._slot_pages) == []
+
+
+# ===================================================================
+# lifecycle: harvest, shutdown, supervisor — refcounts provably balanced
+# ===================================================================
+class TestPagedLifecycle:
+    def test_quarantine_harvest_releases_every_mapping(self,
+                                                       trained_net):
+        pag = SlotGenerationEngine(trained_net, num_slots=2, paged=True,
+                                   page_size=8)
+        rng = np.random.default_rng(14)
+        for _ in range(4):
+            pag.submit(rng.integers(0, VOCAB, 10), 6)
+        pag._sweep_pending()
+        pag._admit()
+        assert sum(len(p) for p in pag._slot_pages) > 0
+        harvested, _ = pag.quarantine()
+        assert len(harvested) == 4
+        assert sum(len(p) for p in pag._slot_pages) == 0
+        assert pag._pager.audit(pag._slot_pages) == []
+        st = pag._pager.stats()
+        assert st["used"] == st["cached"]   # only index retention left
+
+    def test_cancel_mid_decode_releases_pages(self, trained_net):
+        pag = SlotGenerationEngine(trained_net, num_slots=2, paged=True,
+                                   page_size=8)
+        req = pag.submit(np.arange(5) % VOCAB, 20)
+        pag._sweep_pending()
+        pag._admit()
+        pag._step()
+        req.cancel()
+        pag._step()
+        assert req.state == "CANCELLED"
+        assert pag._pager.audit(pag._slot_pages) == []
+
+    def test_supervised_restart_rebuilds_paged_engine(self, trained_net):
+        from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+        from deeplearning4j_tpu.parallel.faults import FaultInjector
+        rng = np.random.default_rng(15)
+        prompts = [rng.integers(0, VOCAB, int(rng.integers(2, 5)))
+                   for _ in range(6)]
+        gens = [int(rng.integers(3, 7)) for _ in range(6)]
+        dec = TransformerDecoder(trained_net)
+        ref = _run(SlotGenerationEngine(trained_net, num_slots=2,
+                                        decoder=dec), prompts, gens)
+        fi = FaultInjector()
+        fi.raise_once("engine.step", RuntimeError("boom"), at=3)
+        eng = SlotGenerationEngine(trained_net, num_slots=2, decoder=dec,
+                                   paged=True, page_size=8,
+                                   num_pages=9, prefix_cache=False,
+                                   fault_injector=fi)
+        sup = EngineSupervisor(eng, timeout=5.0)
+        eng.start()
+        reqs = [sup.submit(p, g) for p, g in zip(prompts, gens)]
+        got = [r.result(60) for r in reqs]
+        try:
+            for a, b in zip(ref, got):
+                np.testing.assert_array_equal(a, b)
+            assert sup.restarts >= 1
+            cur = sup._engine
+            # the rebuilt engine kept the paged geometry + knobs
+            assert cur._pager is not None
+            assert cur.page_size == 8 and cur.num_pages == 9
+            assert cur.prefix_cache is False
+            assert cur._pager.audit(cur._slot_pages) == []
+        finally:
+            sup.stop()
+
+
+# ===================================================================
+# observability: devstats pages + scrape columns
+# ===================================================================
+class TestPagedObservability:
+    def test_kv_cache_stats_reports_pages(self, trained_net):
+        pag = SlotGenerationEngine(trained_net, num_slots=2, paged=True,
+                                   page_size=8)
+        _run(pag, [np.arange(10) % VOCAB], [4])
+        st = kv_cache_stats(pag)
+        assert st["paged"] is True
+        pages = st["pages"]
+        for key in ("free", "used", "cached", "shared", "mapped",
+                    "fragmentation", "pool_bytes", "share_ratio"):
+            assert key in pages
+        assert pages["pool_bytes"] == st["bytes"]
+        slab = SlotGenerationEngine(trained_net, num_slots=2)
+        assert "paged" not in kv_cache_stats(slab)
+
+    def test_engine_gauges_registered(self, trained_net):
+        from deeplearning4j_tpu.observability.metrics import \
+            MetricsRegistry
+        reg = MetricsRegistry()
+        pag = SlotGenerationEngine(trained_net, num_slots=2, paged=True,
+                                   page_size=8, registry=reg)
+        _run(pag, [np.arange(10) % VOCAB, np.arange(10) % VOCAB], [4, 4])
+        snap = reg.snapshot()
+        assert "generation_kv_pages" in snap
+        vals = snap["generation_kv_pages"]["values"]
+        assert any("state=free" in k for k in vals)
+        assert snap["generation_kv_pool_bytes"]["values"]
+        assert snap["prefix_cache_hit_total"]["values"]
+
+    def test_scrape_merge_page_columns(self, trained_net):
+        from scripts.telemetry_dump import merge_snapshots
+        snap = {"metrics": {
+            "generation_kv_pages": {"type": "gauge", "values": {
+                "engine=e0,state=free": 5, "engine=e0,state=shared": 2,
+                "engine=e1,state=free": 3}},
+            "prefix_cache_hit_total": {"type": "counter",
+                                       "values": {"engine=e0": 7}},
+            "prefix_cache_miss_total": {"type": "counter",
+                                        "values": {"engine=e0": 3}}},
+            "slo": {}, "uptime_s": 1}
+        doc = merge_snapshots({"http://r0": snap})
+        row = doc["replicas"]["http://r0"]
+        assert row["kv_pages_free"] == 8
+        assert row["kv_pages_shared"] == 2
+        assert doc["counters"]["prefix_cache_hit_total"] == 7
+        assert doc["counters"]["prefix_cache_miss_total"] == 3
+
+
+# ===================================================================
+# static-analysis acceptance: the new module arrives debt-free
+# ===================================================================
+class TestPagedLintClean:
+    def test_paging_module_is_clean(self):
+        """CI satellite: the allocator's lock discipline (GL006,
+        GL009-GL012) arrives with zero findings and zero new baselined
+        keys — same acceptance the journal/preemption modules carry."""
+        import os
+
+        from deeplearning4j_tpu.analysis.lint import lint_paths
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(root, "deeplearning4j_tpu", "models",
+                              "paging.py")]
+        found = lint_paths(paths, repo_root=root,
+                           rules=["GL006", "GL009", "GL010", "GL011",
+                                  "GL012"])
+        assert found == [], "\n".join(str(f) for f in found)
