@@ -832,31 +832,68 @@ def _side_metrics() -> dict:
     return side
 
 
+def _attach_trajectory(result: dict) -> dict:
+    """ISSUE 13: every bench run ships its normalized flat metric record
+    (``history_record`` — the machine-readable trajectory future rounds
+    accumulate instead of raw tails) plus the perf-regression verdict
+    against the archived BENCH_r*.json rounds (informational side
+    metric here; ``scripts/perf_regress.py`` is the gating CLI the
+    verify recipe runs)."""
+    try:
+        # spec-load the sentinel module: scripts/ holds top-level names
+        # (lint.py, telemetry_dump.py) that a sys.path prepend would
+        # shadow for the rest of the host process
+        import importlib.util
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "_bench_perf_regress",
+            os.path.join(here, "scripts", "perf_regress.py"))
+        pr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pr)
+        normalize_record = pr.normalize_record
+        load_history = pr.load_history
+        record_fingerprint = pr.record_fingerprint
+        regression_report = pr.regression_report
+        rec = normalize_record(result)
+        result["history_record"] = rec
+        rep = regression_report(
+            load_history(os.path.join(here, "BENCH_r*.json")),
+            rec, headline_only=True,
+            fingerprint=record_fingerprint(result))
+        result["perf_regress"] = {
+            "ok": rep["ok"], "checked": rep["checked"],
+            "rounds": len(rep["rounds"]),
+            "regressions": rep["regressions"]}
+    except Exception as e:  # noqa: BLE001 — trajectory must not kill a run
+        result["perf_regress"] = {"error": str(e)[:200]}
+    return result
+
+
 def main() -> int:
     if MODE == "generate":
-        print(json.dumps(_generate_result()))
+        print(json.dumps(_attach_trajectory(_generate_result())))
         return 0
     if MODE == "transformer":
         med, spread, k = _median_runs(_transformer_measure())
-        print(json.dumps({
+        print(json.dumps(_attach_trajectory({
             "metric": "transformer_lm_train_tokens_per_sec",
             "value": round(med, 2),
             "unit": "tokens/sec",
             "vs_baseline": round(med / TRANSFORMER_BASELINE, 4)
             if TRANSFORMER_BASELINE > 0 else 1.0,
             "spread_pct": spread, "runs": k,
-        }))
+        })))
         return 0
     if MODE == "charrnn":
         med, spread, k = _median_runs(_charrnn_measure())
-        print(json.dumps({
+        print(json.dumps(_attach_trajectory({
             "metric": "charrnn_train_tokens_per_sec",
             "value": round(med, 2),
             "unit": "tokens/sec",
             "vs_baseline": round(med / CHARRNN_BASELINE, 4)
             if CHARRNN_BASELINE > 0 else 1.0,
             "spread_pct": spread, "runs": k,
-        }))
+        })))
         return 0
     net = _build_net()
     if MODE == "pipeline":
@@ -882,7 +919,7 @@ def main() -> int:
         if SIDE:
             del net                       # free the ResNet before the LM
             result["side_metrics"] = _side_metrics()
-    print(json.dumps(result))
+    print(json.dumps(_attach_trajectory(result)))
     return 0
 
 

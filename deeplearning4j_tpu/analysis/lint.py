@@ -64,17 +64,21 @@ RULES: Dict[str, str] = {
     "GL015": "metric-family naming violation (counters must end _total, "
              "histograms _seconds/_bytes) or flight-recorder/devstats/"
              "SLO recording inside jitted/traced code",
+    "GL016": "profiler/phase-stamp recording inside jit-traced or "
+             "shard_map code (phase stamps are host interval-clock "
+             "anchors recorded from the readback thread; under trace "
+             "they would fire once per compile, never per block)",
 }
 
 #: rules decided per module (cacheable per file); the rest (GL009-GL012)
 #: need the whole-package call graph
 PER_FILE_RULES = frozenset({"GL001", "GL002", "GL003", "GL004", "GL005",
                             "GL006", "GL007", "GL008", "GL013", "GL014",
-                            "GL015"})
+                            "GL015", "GL016"})
 PACKAGE_RULES = frozenset({"GL009", "GL010", "GL011", "GL012"})
 
 #: bump to invalidate cached per-file results when any pass changes
-LINT_VERSION = 13
+LINT_VERSION = 14
 
 #: wrappers whose function arguments are traced when called
 _TRACE_WRAPPERS = {
@@ -125,6 +129,14 @@ _GL015_RECORD_METHODS = {"record", "dump", "write_postmortem",
 _GL015_NAME_SUFFIXES = {"counter": ("_total",),
                         "histogram": ("_seconds", "_bytes")}
 _GL015_REGISTRY_HINTS = ("registry", "reg")
+#: GL016 — the ISSUE 13 phase profiler: phase-stamp/bubble recording
+#: must stay on the host readback thread (same receiver-hint machinery
+#: as GL008/GL015, its own rule id so the new subsystem gets its own
+#: baseline rows). The sharding pass applies the same sets inside
+#: shard_map/pjit regions.
+_GL016_NAME_HINTS = ("profiler", "prof", "phase", "timeline")
+_GL016_RECORD_METHODS = {"record_block", "record_admission",
+                         "record_chunk", "channel", "attach_decoder"}
 #: callees whose results are NOT "just-dispatched device work" for GL007:
 #: python builtins and host-side helpers a loop legitimately materializes
 _GL007_SAFE_CALLEES = {"range", "len", "list", "tuple", "dict", "set",
@@ -436,6 +448,20 @@ class ModuleLint:
                                    "(once per compile, never per "
                                    "event); record outside the jitted "
                                    "region")
+            if isinstance(node, ast.Call) and "GL016" in enabled:
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    recv = _dotted_name(f.value).lower()
+                    if f.attr in _GL016_RECORD_METHODS and any(
+                            w in recv for w in _GL016_NAME_HINTS):
+                        self._emit(out, "GL016", node, qual,
+                                   f".{f.attr}() records profiler phase "
+                                   "stamps under trace — it would fire "
+                                   "at TRACE time (once per compile, "
+                                   "never per block) and its interval "
+                                   "anchors would be trace-time "
+                                   "constants; record on the readback "
+                                   "thread, outside the jitted region")
             if isinstance(node, ast.Call) and "GL004" in enabled:
                 np_fn = _is_np_call(node.func)
                 if np_fn and np_fn not in _NP_SAFE and \
@@ -776,10 +802,10 @@ class ModuleLint:
         self._check_lock_discipline(out, enabled)
         self._check_host_loop_syncs(out, enabled, jit_ids)
         self._check_metric_naming(out, enabled)
-        if enabled & {"GL013", "GL014"}:
+        if enabled & {"GL013", "GL014", "GL016"}:
             from .sharding import run_sharding_pass
             run_sharding_pass(
-                self.tree, sorted(enabled & {"GL013", "GL014"}),
+                self.tree, sorted(enabled & {"GL013", "GL014", "GL016"}),
                 lambda rule, line, func, message:
                 self._emit_at(out, rule, line, func, message))
         return out

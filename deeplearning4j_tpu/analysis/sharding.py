@@ -51,7 +51,8 @@ _OBS_NAME_HINTS = ("metric", "gauge", "counter", "hist", "trace", "span",
                    "registry", "telemetry")
 
 
-from .lint import _dotted_name, _dotted_tail
+from .lint import (_GL016_NAME_HINTS, _GL016_RECORD_METHODS,
+                   _dotted_name, _dotted_tail)
 
 
 def _literal_strings(node: ast.AST) -> List[str]:
@@ -285,6 +286,27 @@ class ShardingLint:
                              "must stay host-side (GL008 generalized "
                              "to the SPMD seams)")
 
+    def check_gl016(self, emit) -> None:
+        """Profiler/phase-stamp recording inside an SPMD region — the
+        shard_map half of GL016 (lint.py's jit-body pass covers plain
+        jit contexts with the same hint/method sets)."""
+        for fn, qual in self._spmd_functions():
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for node in [n for b in body for n in ast.walk(b)]:
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute)):
+                    continue
+                tail = node.func.attr
+                recv = _dotted_name(node.func.value).lower()
+                if tail in _GL016_RECORD_METHODS and any(
+                        w in recv for w in _GL016_NAME_HINTS):
+                    emit("GL016", node.lineno, qual,
+                         f".{tail}() records profiler phase stamps "
+                         "inside a shard_map/pjit region — stamps are "
+                         "host interval-clock anchors and must be "
+                         "recorded on the readback thread, outside "
+                         "the SPMD seam")
+
 
 def run_sharding_pass(tree: ast.Module, enabled: Sequence[str], emit
                       ) -> None:
@@ -293,3 +315,5 @@ def run_sharding_pass(tree: ast.Module, enabled: Sequence[str], emit
         lint.check_gl013(emit)
     if "GL014" in enabled:
         lint.check_gl014(emit)
+    if "GL016" in enabled:
+        lint.check_gl016(emit)

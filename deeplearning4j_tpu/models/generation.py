@@ -39,7 +39,6 @@ from __future__ import annotations
 import collections
 import itertools
 import threading
-import time
 import uuid
 import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -54,8 +53,9 @@ from ..nn.conf.layers import (RnnOutputLayer, SelfAttentionLayer,
 from ..nn.graph.vertices import LayerVertex
 from ..observability.flightrec import default_flight_recorder
 from ..observability.metrics import default_registry
+from ..observability.profiler import default_profiler
 from ..observability.slo import default_slo_tracker
-from ..observability.tracing import Trace, default_trace_ring
+from ..observability.tracing import Trace, default_trace_ring, interval_now
 from ..ops.platform import train_donate_argnums
 from ..ops.transfer import device_fetch
 from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
@@ -1052,7 +1052,7 @@ class GenerationRequest:
         self.eos_id = eos_id
         self.deadline = None if deadline is None else float(deadline)
         self._deadline_t = None if deadline is None \
-            else time.monotonic() + float(deadline)
+            else interval_now() + float(deadline)
         self.generated: List[int] = []
         self._seq = next(_REQ_SEQ)       # EDF tie-break: FIFO by creation
         self._done = threading.Event()
@@ -1071,7 +1071,7 @@ class GenerationRequest:
         # a recovered request keeps its original timeline (plus a
         # `takeover` span per restart) instead of starting a second one
         self.trace: Optional[Trace] = None
-        self._submit_t = time.monotonic()
+        self._submit_t = interval_now()
         # SLO clocks (observability/slo.py): anchored at the ORIGINAL
         # submission and written once — requeue resets _submit_t (the
         # per-engine queued-span clock) but never these, so deadline
@@ -1169,7 +1169,7 @@ class GenerationRequest:
 
     def _expired(self, now: Optional[float] = None) -> bool:
         return self._deadline_t is not None and \
-            (now if now is not None else time.monotonic()) > self._deadline_t
+            (now if now is not None else interval_now()) > self._deadline_t
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -1282,7 +1282,8 @@ class SlotGenerationEngine:
                  block_latency_target: float = 0.25,
                  paged: bool = False, page_size: int = 16,
                  num_pages: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 profiler=None, profiling: Optional[bool] = None):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -1471,6 +1472,21 @@ class SlotGenerationEngine:
             else self.engine_id
         self._flightrec = flight_recorder if flight_recorder is not None \
             else default_flight_recorder()
+        # hot-loop phase profiler (ISSUE 13): per-block phase/bubble
+        # decomposition + measured steady durations for the roofline,
+        # recorded from the readback thread only — ``profiling``
+        # defaults to the tracing flag (the telemetry-off A/B baseline
+        # records nothing), and the channel is keyed by the STABLE
+        # slo_label, so a supervisor-rebuilt engine continues the same
+        # phase account and the timeline ring survives the takeover
+        self._profiling = self._tracing if profiling is None \
+            else bool(profiling)
+        self._profiler = profiler if profiler is not None \
+            else default_profiler()
+        self._prof = self._profiler.channel(
+            self.slo_label, num_slots=self.num_slots,
+            decoder=self.decoder) if self._profiling else None
+        self._prof_impl_names: Dict = {}
         reg = self._registry
         self._m = {key: reg.counter(f"generation_{key}_total", desc,
                                     ("engine",)).labels(self.engine_id)
@@ -1735,7 +1751,7 @@ class SlotGenerationEngine:
             req._slo = self._slo
         req._slo_labels = dict(req._slo_labels or {},
                                replica=self.slo_label)
-        req._submit_t = time.monotonic()
+        req._submit_t = interval_now()
         with self._lock:
             dead = self._dead
             alive = not (self._shutdown or dead is not None)
@@ -1808,7 +1824,7 @@ class SlotGenerationEngine:
             dispatches = -(-ctx // self.prefill_chunk)      # ceil
         need = ((pre or 0.0) * dispatches +
                 max(0, tokens) * est) * self.headroom_margin
-        headroom = req._deadline_t - time.monotonic()
+        headroom = req._deadline_t - interval_now()
         if need <= headroom:
             return None
         return RejectedError(
@@ -2003,6 +2019,26 @@ class SlotGenerationEngine:
             max(0.0, 1.0 - written / span), 4)
         return st
 
+    def _prof_impl(self, kind: str, k: Optional[int] = None) -> str:
+        """Audit-keyed impl name for the profiler's roofline join
+        (memoized — one dict hit per record in steady state): the same
+        per-K, per-mesh key devstats and CompileAudit use, so the
+        measured-duration table lines up with the cost table row for
+        row."""
+        name = self._prof_impl_names.get((kind, k))
+        if name is None:
+            if kind == "block":
+                key = ("paged_block" if self._pager is not None
+                       else "block", int(k))
+            elif kind == "prefill":
+                key = "paged_prefill" if self._pager is not None \
+                    else "prefill_slots"
+            else:
+                key = kind
+            name = self.decoder._impl_audit_name(key)
+            self._prof_impl_names[(kind, k)] = name
+        return name
+
     def _req_finished(self, req: GenerationRequest, tok: int) -> bool:
         return (req.eos_id is not None and tok == req.eos_id) or \
             len(req.generated) >= req.max_new_tokens or \
@@ -2012,7 +2048,7 @@ class SlotGenerationEngine:
         """Fail queued requests that were cancelled or ran out of
         deadline before ever taking a slot — a caller must not wait on
         a request the engine will never run."""
-        now = time.monotonic()
+        now = interval_now()
         doomed: List[Tuple[GenerationRequest, BaseException]] = []
         with self._lock:
             if self._pending:
@@ -2037,7 +2073,7 @@ class SlotGenerationEngine:
         """Free slots whose requests were cancelled or exceeded their
         deadline MID-DECODE; the refill seam reuses the slot for the
         next queued prompt."""
-        now = time.monotonic()
+        now = interval_now()
         doomed: List[Tuple[GenerationRequest, BaseException]] = []
         with self._lock:
             for s in range(self.num_slots):
@@ -2159,7 +2195,7 @@ class SlotGenerationEngine:
             self._m["prefills"].inc()
         if req.trace is not None:
             req.trace.add_span("queued", req._submit_t,
-                               time.monotonic())
+                               interval_now())
         return True
 
     def _admit(self):
@@ -2220,7 +2256,7 @@ class SlotGenerationEngine:
                              # quarantine/shutdown drain owns it now
                 self._m["prefills"].inc(m)
                 batch_no = self._m["prefill_batches"].inc()
-            t_pre0 = time.monotonic()
+            t_pre0 = interval_now()
             self._faults.fire("engine.prefill")
             nxt, _, self._caches = self.decoder._fn("prefill_slots")(
                 self.decoder._device_params(),
@@ -2230,7 +2266,7 @@ class SlotGenerationEngine:
                 jax.random.fold_in(self._key,
                                    PREFILL_BATCH_SALT | batch_no))
             toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
-            t_pre1 = time.monotonic()
+            t_pre1 = interval_now()
             finishers: List[GenerationRequest] = []
             jlog: List[Tuple] = []       # journal appends, written
             #                              OUTSIDE the engine lock below
@@ -2284,13 +2320,21 @@ class SlotGenerationEngine:
                     "admission", engine=self.engine_id, batch=m,
                     bucket=mb, tp=tp,
                     wait_ms=round((t_pre1 - t_pre0) * 1e3, 3))
+            prof = self._prof
+            t_host = interval_now() if prof is not None else t_pre1
             if jlog:
                 # first tokens journaled BEFORE the finishers complete,
                 # outside the engine lock (GL010) — a done record never
                 # races ahead of the tokens it summarizes
                 self._journal.retired(jlog)
+            t_journal = interval_now() if prof is not None else t_host
             for req in finishers:
                 req._complete()
+            if prof is not None:
+                prof.record_admission(
+                    impl=self._prof_impl("prefill"), count=m,
+                    t_dispatch=t_pre0, t_fetched=t_pre1, t_host=t_host,
+                    t_journal=t_journal, t_publish=interval_now())
             if drained:
                 return
 
@@ -2434,14 +2478,14 @@ class SlotGenerationEngine:
                     temps[i] = req.temperature
                 self._m["prefills"].inc(m)
                 batch_no = self._m["prefill_batches"].inc()
-            t_pre0 = time.monotonic()
+            t_pre0 = interval_now()
             self._faults.fire("engine.prefill")
             nxt, self._caches = self.decoder.paged_prefill(
                 self._caches, tokens, pos0, valid, ptab, temps,
                 key=jax.random.fold_in(self._key,
                                        PREFILL_BATCH_SALT | batch_no))
             toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
-            t_pre1 = time.monotonic()
+            t_pre1 = interval_now()
             finishers: List[GenerationRequest] = []
             jlog: List[Tuple] = []
             with self._lock:
@@ -2497,10 +2541,18 @@ class SlotGenerationEngine:
                     "admission", engine=self.engine_id, batch=m,
                     bucket=mb, tp=c, paged=True,
                     wait_ms=round((t_pre1 - t_pre0) * 1e3, 3))
+            prof = self._prof
+            t_host = interval_now() if prof is not None else t_pre1
             if jlog:
                 self._journal.retired(jlog)
+            t_journal = interval_now() if prof is not None else t_host
             for req in finishers:
                 req._complete()
+            if prof is not None:
+                prof.record_admission(
+                    impl=self._prof_impl("prefill"), count=m,
+                    t_dispatch=t_pre0, t_fetched=t_pre1, t_host=t_host,
+                    t_journal=t_journal, t_publish=interval_now())
             if drained or blocked:
                 return
 
@@ -2596,7 +2648,7 @@ class SlotGenerationEngine:
                     "nothing in flight to free a page — request shed"))
                 return
         chunk_no = self._m["prefill_chunks"].inc()
-        t0 = time.monotonic()
+        t0 = interval_now()
         if req._admitted_t is None:
             req._admitted_t = t0          # SLO queue-wait ends at the
         #                                   FIRST window's dispatch
@@ -2619,12 +2671,19 @@ class SlotGenerationEngine:
         tok = None
         if final:
             tok = int(device_fetch(nxt, tag="engine.prefill")[0])
-        t1 = time.monotonic()
+        t1 = interval_now()
         if self._tracing:
             self._flightrec.record(
                 "prefill_chunk", engine=self.engine_id, slot=s,
                 pos0=pos0, valid=valid, final=final,
                 ms=round((t1 - t0) * 1e3, 3))
+        if self._prof is not None:
+            # non-final windows never sync (t1 is dispatch-return):
+            # only the device phase is attributable, but the window
+            # still anchors the bubble account — it keeps the device
+            # busy between decode blocks either way
+            self._prof.record_chunk(t_dispatch=t0, t_done=t1,
+                                    final=final)
         jlog: List[Tuple] = []
         finish = None
         with self._lock:
@@ -2704,14 +2763,14 @@ class SlotGenerationEngine:
             step_no = self._step_no
         if not active:
             return                # lifecycle enforcement freed every slot
-        t_disp = time.monotonic()
+        t_disp = interval_now()
         self._faults.fire("engine.step")
         nxt, _, self._caches = self.decoder.decode_step(
             self._caches, self._last_ids,
             np.minimum(self._positions, self.t_max - 1), self._temps,
             key=jax.random.fold_in(self._key, ENGINE_KEY_SALT | step_no))
         nxt_host = device_fetch(nxt, tag="engine.decode")
-        t_ret = time.monotonic()
+        t_ret = interval_now()
         with self._lock:
             self._ewma_locked("_est_step", t_ret - t_disp)
         if self._tracing:
@@ -2728,6 +2787,7 @@ class SlotGenerationEngine:
         with self._lock:
             self._m["host_readbacks"].inc()
             emitted = 0
+            qdepth = len(self._pending)
             for s in range(self.num_slots):
                 req = self._slots[s]
                 if req is None:
@@ -2749,10 +2809,22 @@ class SlotGenerationEngine:
                     finished.append(req)
             self._m["emitted_tokens"].inc(emitted)
             self._first_step_done = True
+        # phase stamps (ISSUE 13) ride the readback thread, outside the
+        # engine lock, like flightrec — telescoping interval-clock
+        # anchors so the recorded phases sum to the block wall time
+        prof = self._prof
+        t_host = interval_now() if prof is not None else t_ret
         if jlog:
             self._journal.retired(jlog)   # one batched append, no locks
+        t_journal = interval_now() if prof is not None else t_host
         for req in finished:
             req._complete()
+        if prof is not None:
+            prof.record_block(
+                impl=self._prof_impl("step"), k=1, lanes=emitted,
+                queued=qdepth, t_dispatch=t_disp, t_fetched=t_ret,
+                t_host=t_host, t_journal=t_journal,
+                t_publish=interval_now())
 
     def _step_block(self):
         """One pipelined block cycle (block_size=K): dispatch the next
@@ -2812,7 +2884,8 @@ class SlotGenerationEngine:
                 ptab = None if self._pager is None \
                     else self._ptables.copy()
                 dispatch = (carry, self._step_no - k, self._temps.copy(),
-                            self._eos_ids.copy(), ptab)
+                            self._eos_ids.copy(), ptab,
+                            len(self._pending))
         for req in preempted:
             # out-of-lock bookkeeping for pool-pressure preemptions
             if req.trace is not None:
@@ -2823,10 +2896,10 @@ class SlotGenerationEngine:
             if self._journal is not None and req.journal_id is not None:
                 self._journal.requeued(req)
         if dispatch is not None:
-            (ids, pos, stop), step0, temps, eos, ptab = dispatch
+            (ids, pos, stop), step0, temps, eos, ptab, qdepth = dispatch
             if self.adaptive_block:
                 self._m_k.labels(self.engine_id, str(k)).inc()
-            t_disp = time.monotonic()
+            t_disp = interval_now()
             self._faults.fire("engine.step")
             if self._pager is not None:
                 toks, ids_d, pos_d, stop_d, self._caches = \
@@ -2844,7 +2917,7 @@ class SlotGenerationEngine:
             with self._lock:
                 if not (self._quarantined or self._shutdown):
                     self._carry = (ids_d, pos_d, stop_d)
-                    self._inflight = (toks, snapshot, k, t_disp)
+                    self._inflight = (toks, snapshot, k, t_disp, qdepth)
         # prev was dispatched LAST cycle and has been computing since;
         # its fetch + bookkeeping overlap the block dispatched above.
         # With no active lanes left, prev's tokens are pure overshoot
@@ -2856,9 +2929,9 @@ class SlotGenerationEngine:
         """Fetch one block's [S, K] token matrix (ONE host readback) and
         run its host bookkeeping: per-lane appends until a stop, slot
         frees, request completions."""
-        toks_dev, snapshot, k, t_disp = block
+        toks_dev, snapshot, k, t_disp, qdepth = block
         host = device_fetch(toks_dev, tag="engine.decode")
-        t_ret = time.monotonic()
+        t_ret = interval_now()
         with self._lock:
             self._ewma_locked("_est_step", (t_ret - t_disp) / max(1, k))
         if self._tracing:
@@ -2909,13 +2982,25 @@ class SlotGenerationEngine:
                 # freed lanes must not keep decoding from the device
                 # carry: resync (and let _admit refill) next dispatch
                 self._carry = None
+        # phase stamps (ISSUE 13), readback thread, outside the engine
+        # lock: dispatch → fetched → host → journal → publish telescope,
+        # so the per-phase account sums exactly to the block wall time
+        prof = self._prof
+        t_host = interval_now() if prof is not None else t_ret
         if jlog:
             # batched per block on the readback thread, OUTSIDE the
             # engine lock (GL010-clean): one buffer write (and at most
             # one fsync per the journal's policy) per decode block
             self._journal.retired(jlog)
+        t_journal = interval_now() if prof is not None else t_host
         for req in finished:
             req._complete()
+        if prof is not None:
+            prof.record_block(
+                impl=self._prof_impl("block", k), k=k,
+                lanes=len(snapshot), queued=qdepth, t_dispatch=t_disp,
+                t_fetched=t_ret, t_host=t_host, t_journal=t_journal,
+                t_publish=interval_now())
 
     # -------------------------------------------------------- preemption
     def begin_drain(self) -> None:
@@ -2937,14 +3022,14 @@ class SlotGenerationEngine:
         redo), then quarantine-harvest everything still live. Harvested
         requests are NOT failed: their journal records stay open, and
         post-restart recovery resumes them token-identically."""
-        t_end = time.monotonic() + max(0.0, float(budget))
+        t_end = interval_now() + max(0.0, float(budget))
         with self._lock:
             self._draining = True
             self._drain_stop = True
         self._work.set()
         w = self._worker
         if w is not None and w is not threading.current_thread():
-            w.join(timeout=max(0.0, t_end - time.monotonic()))
+            w.join(timeout=max(0.0, t_end - interval_now()))
         stale = None
         with self._lock:
             loop_stopped = w is None or not w.is_alive()
@@ -2954,7 +3039,7 @@ class SlotGenerationEngine:
             # budget-gated: retiring fetches the block (a device sync);
             # with no budget left the tokens are abandoned instead —
             # recovery regenerates them deterministically
-            if time.monotonic() < t_end:
+            if interval_now() < t_end:
                 self._retire_block(stale)
         return self.quarantine()
 

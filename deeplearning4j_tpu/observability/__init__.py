@@ -32,9 +32,22 @@ hot path and everything around it:
   reconnect, fault) with post-mortem JSON artifacts bundling events +
   traces + registry snapshot + transfer/compile-audit state, written by
   the supervisor and fleet router on crash/wedge/replica death.
+- :mod:`.profiler` — :class:`PhaseProfiler`: hot-loop phase/bubble
+  accounting (device/host/journal/publish decomposition per decode
+  block — phases sum to block wall time — plus pipeline-bubble and
+  lane-bubble measures) and the roofline join of devstats' theoretical
+  flops/bytes with MEASURED steady block durations: attained GFLOP/s,
+  GB/s, arithmetic intensity, and a memory-/compute-bound verdict per
+  impl per mesh tag, with a bounded :class:`PhaseTimeline` ring that
+  survives supervisor engine rebuilds.
 - :mod:`.telemetry` — :class:`TelemetryServer`, a background HTTP
-  endpoint (``/metrics``, ``/snapshot``, ``/slo``, ``/traces/recent``)
-  reusing the training UI's HTTP plumbing.
+  endpoint (``/metrics``, ``/snapshot``, ``/slo``, ``/profile``,
+  ``/traces/recent``) reusing the training UI's HTTP plumbing.
+
+Every duration above derives from ONE interval clock
+(:func:`.tracing.interval_now`, ``time.perf_counter``): wall-clock time
+appears only as per-trace display anchors, so an NTP step can never
+corrupt a span, headroom, or phase histogram.
 
 Instrumentation is host-side only (wall clocks, counter bumps): it
 compiles nothing, adds no device syncs beyond the existing
@@ -48,14 +61,18 @@ from .devstats import (DeviceStats, device_memory_snapshot,
 from .flightrec import FlightRecorder, default_flight_recorder
 from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, default_registry, percentiles)
+from .profiler import (EngineChannel, PhaseProfiler, PhaseTimeline,
+                       default_profiler)
 from .slo import SLORecord, SLOTracker, default_slo_tracker
 from .telemetry import TelemetryServer
-from .tracing import Span, Trace, TraceRing, default_trace_ring
+from .tracing import (Span, Trace, TraceRing, default_trace_ring,
+                      interval_now)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS", "default_registry", "percentiles",
-    "Span", "Trace", "TraceRing", "default_trace_ring",
+    "Span", "Trace", "TraceRing", "default_trace_ring", "interval_now",
+    "EngineChannel", "PhaseProfiler", "PhaseTimeline", "default_profiler",
     "SLORecord", "SLOTracker", "default_slo_tracker",
     "DeviceStats", "device_memory_snapshot", "impl_cost_analysis",
     "kv_cache_stats",

@@ -23,7 +23,11 @@ they can size anything:
   analysis for every compiled decode impl (``prefill`` /
   ``decode_block{K}`` / ``prefill_slots`` / ``decode_step``, per mesh
   tag): the measured-cost table μ-cuDNN-style block-size policies read
-  instead of guessing. The decoder captures each impl's abstract arg
+  instead of guessing, and the THEORETICAL side of the roofline join —
+  ``observability/profiler.py`` divides these flops/bytes by its
+  measured steady per-step durations to report attained GFLOP/s / GB/s
+  and the bound-class verdict at ``GET /profile`` (note: XLA counts a
+  ``lax.scan`` body once, so ``decode_block{K}`` rows are per STEP). The decoder captures each impl's abstract arg
   signature at its FIRST dispatch (one dict lookup per call, host-side);
   cost extraction then lowers from those specs on demand. Lowering logs
   one compile record per impl the first time (cached after), so cost

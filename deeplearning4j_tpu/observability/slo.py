@@ -13,7 +13,10 @@ reset, so a supervisor takeover or cross-replica migration does not
 restart any clock), and the request's exactly-once completion path calls
 :meth:`SLOTracker.observe_request`.
 
-Definitions (all host ``time.monotonic`` seconds):
+Definitions (all host interval-clock seconds — every anchor and every
+``now`` comes from :func:`..tracing.interval_now` (``time.perf_counter``),
+the observability layer's single interval clock, so a wall-clock NTP
+step can never produce a negative queue-wait or garbage headroom):
 
 - ``queue_wait``  — created → admitted (first prefill dispatch);
 - ``ttft``        — created → first emitted token;
@@ -47,12 +50,12 @@ the ≤5% telemetry A/B holds. graftlint GL015 statically rejects
 from __future__ import annotations
 
 import threading
-import time
 import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
 from .metrics import MetricsRegistry, default_registry
+from .tracing import interval_now
 
 #: deadline-headroom histogram buckets (seconds): headroom can be
 #: NEGATIVE (finished past the deadline the engine was racing), so the
@@ -183,7 +186,7 @@ class SLOTracker:
                now: Optional[float] = None) -> SLORecord:
         """Record one completed request. ``now`` is injectable for
         deterministic window tests; production callers omit it."""
-        t = time.monotonic() if now is None else float(now)
+        t = interval_now() if now is None else float(now)
         counted = status != "cancelled"
         ok = status == "ok" and (headroom is None or headroom >= 0.0)
         rec = SLORecord(t, str(status), ok, counted, queue_wait, ttft,
@@ -211,7 +214,7 @@ class SLOTracker:
         its completion path (``_complete``/``_fail`` fire once); the
         clocks are anchored at the ORIGINAL submission, so supervisor
         takeover and fleet migration never reset them."""
-        now = time.monotonic()
+        now = interval_now()
         created = getattr(req, "_created_t", None)
         if created is None:                      # degrade, never raise
             created = now
@@ -235,7 +238,7 @@ class SLOTracker:
     # ------------------------------------------------------------- windows
     def _window_records(self, window: Optional[float],
                         now: Optional[float] = None) -> List[SLORecord]:
-        t = time.monotonic() if now is None else float(now)
+        t = interval_now() if now is None else float(now)
         with self._lock:
             recs = list(self._records)
         if window is None:
@@ -292,7 +295,7 @@ class SLOTracker:
     def snapshot(self, now: Optional[float] = None) -> dict:
         """The `/slo` endpoint document: lifetime totals, both burn-rate
         windows, latency quantiles, and per-route / per-replica splits."""
-        t = time.monotonic() if now is None else float(now)
+        t = interval_now() if now is None else float(now)
         recs = self._window_records(None)
         with self._lock:
             totals = dict(self._totals)

@@ -18,6 +18,11 @@ serving process:
 - ``GET /slo``            — the SLO tracker's full document: rolling
   short/long-window attainment + burn rate, deadline-headroom /
   TTFT / queue-wait quantiles, per-route and per-replica splits;
+- ``GET /profile``        — the hot-loop phase profiler: per-engine
+  decode-block phase decomposition (device/host/journal/publish +
+  pipeline bubble, lane bubble), the roofline join (attained GFLOP/s /
+  GB/s / arithmetic intensity / bound verdict per impl per mesh tag),
+  and ``?timeline=N`` for the last N PhaseTimeline entries;
 - ``GET /traces/recent``  — the completed-trace ring as JSON timelines
   (``?n=`` limits the count, ``?status=`` filters — ``failed`` matches
   every ``failed:*`` status, any exact status works);
@@ -45,6 +50,7 @@ from ..ui.server import BackgroundHTTPServer, JsonHTTPHandler
 from .devstats import DeviceStats, impl_cost_analysis
 from .flightrec import FlightRecorder, default_flight_recorder
 from .metrics import MetricsRegistry, default_registry
+from .profiler import PhaseProfiler, default_profiler
 from .slo import SLOTracker, default_slo_tracker
 from .tracing import TraceRing, default_trace_ring
 
@@ -69,6 +75,13 @@ class _TelemetryHandler(JsonHTTPHandler):
             self._json(srv.snapshot())
         elif url.path == "/slo":
             self._json(srv.slo_tracker.snapshot())
+        elif url.path == "/profile":
+            q = parse_qs(url.query)
+            try:
+                tl = int(q.get("timeline", ["0"])[0]) or None
+            except ValueError:
+                tl = None
+            self._json(srv.profiler.snapshot(timeline_n=tl))
         elif url.path == "/traces/recent":
             q = parse_qs(url.query)
             try:
@@ -94,8 +107,8 @@ class _TelemetryHandler(JsonHTTPHandler):
             self._json({"ok": True, "uptime_s": round(srv.uptime, 3)})
         else:
             self._json({"error": "not found", "endpoints": [
-                "/metrics", "/snapshot", "/slo", "/traces/recent",
-                "/healthz"]}, code=404)
+                "/metrics", "/snapshot", "/slo", "/profile",
+                "/traces/recent", "/healthz"]}, code=404)
 
 
 class TelemetryServer:
@@ -113,7 +126,8 @@ class TelemetryServer:
                  audit_compiles: bool = False,
                  slo_tracker: Optional[SLOTracker] = None,
                  devstats: Optional[DeviceStats] = None,
-                 flight_recorder: Optional[FlightRecorder] = None):
+                 flight_recorder: Optional[FlightRecorder] = None,
+                 profiler: Optional[PhaseProfiler] = None):
         # loopback by default: the endpoint is unauthenticated and
         # /snapshot+/traces expose serving internals — exposing it
         # beyond the host is an explicit host="0.0.0.0" decision
@@ -127,6 +141,8 @@ class TelemetryServer:
             else DeviceStats(registry=self.registry)
         self.flight_recorder = flight_recorder \
             if flight_recorder is not None else default_flight_recorder()
+        self.profiler = profiler if profiler is not None \
+            else default_profiler()
         self._http = BackgroundHTTPServer(None, host=host, port=port)
         self._sources: Dict[str, Callable[[], dict]] = {}
         self._audit = None
@@ -241,6 +257,13 @@ class TelemetryServer:
             out["flightrec"] = self.flight_recorder.stats()
         except Exception as e:   # noqa: BLE001
             out["flightrec"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # lightweight profiler summary (no cost lowering — the full
+        # roofline join lives at /profile): the fleet scrape's
+        # bubble-% column reads the headline straight from /snapshot
+        try:
+            out["profiler"] = self.profiler.summary()
+        except Exception as e:   # noqa: BLE001
+            out["profiler"] = {"error": f"{type(e).__name__}: {e}"[:200]}
         sources = {}
         for name, fn in self._sources.items():
             try:

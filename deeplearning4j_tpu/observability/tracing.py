@@ -14,7 +14,11 @@ half-traces.
 Overhead rules (the ≤5% telemetry A/B bar and the zero-new-compiles
 acceptance gate):
 
-- spans carry host wall times only (``time.monotonic``) — recording a
+- spans carry host INTERVAL-clock times only (:func:`interval_now`,
+  ``time.perf_counter`` — the one clock every duration in the
+  observability layer derives from; an NTP wall-clock step can never
+  produce a negative or garbage span, and each trace keeps exactly ONE
+  ``time.time()`` anchor, ``wall_anchor``, for display) — recording a
   span never touches the device, never syncs beyond the serving path's
   existing ``device_fetch`` seam, and compiles nothing;
 - recording is bounded: a trace keeps at most ``max_spans`` spans
@@ -34,6 +38,18 @@ from collections import deque
 from typing import Dict, List, Optional
 
 _TRACE_IDS = itertools.count(1)
+
+
+def interval_now() -> float:
+    """The ONE interval clock for every observability duration (spans,
+    SLO clocks, request deadlines, profiler phase stamps):
+    ``time.perf_counter`` — monotonic, NTP-step-immune, and the highest
+    resolution clock the host offers. Durations are only ever computed
+    between two ``interval_now()`` anchors; wall-clock time
+    (``time.time``) appears exactly once per trace (``wall_anchor``),
+    for human display, and NEVER in interval math — a backwards
+    wall-clock step cannot corrupt a histogram (regression-tested)."""
+    return time.perf_counter()
 
 
 class Span:
@@ -82,7 +98,10 @@ class Trace:
         self._lock = threading.Lock()
         self._spans: List[Span] = []
         self.dropped_spans = 0
-        self.created_at = time.monotonic()
+        self.created_at = interval_now()
+        #: the single wall-clock anchor (display only): created_at on
+        #: the wall clock — interval math never touches it
+        self.wall_anchor = time.time()
         self.finished_at: Optional[float] = None
         self.status: Optional[str] = None
         self.attrs: Dict = {}
@@ -90,7 +109,7 @@ class Trace:
     # ---------------------------------------------------------- recording
     def add_span(self, name: str, t0: Optional[float] = None,
                  t1: Optional[float] = None, **attrs) -> None:
-        now = time.monotonic()
+        now = interval_now()
         span = Span(name, now if t0 is None else t0,
                     now if t1 is None else t1, attrs or None)
         with self._lock:
@@ -131,7 +150,7 @@ class Trace:
         with self._lock:
             if self.finished_at is not None:
                 return
-            self.finished_at = time.monotonic()
+            self.finished_at = interval_now()
             self.status = status
             if attrs:
                 self.attrs.update(attrs)
@@ -160,6 +179,7 @@ class Trace:
                 "duration_ms": None if self.finished_at is None else
                 round((self.finished_at - base) * 1e3, 3),
                 "dropped_spans": self.dropped_spans,
+                "wall_time": round(self.wall_anchor, 6),
                 "attrs": dict(self.attrs),
             }
         out["spans"] = [{**s.to_dict(),
@@ -177,13 +197,13 @@ class _SpanCtx:
         self._attrs = attrs
 
     def __enter__(self) -> "_SpanCtx":
-        self._t0 = time.monotonic()
+        self._t0 = interval_now()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc_type is not None:
             self._attrs = dict(self._attrs, error=exc_type.__name__)
-        self._trace.add_span(self._name, self._t0, time.monotonic(),
+        self._trace.add_span(self._name, self._t0, interval_now(),
                              **self._attrs)
 
 

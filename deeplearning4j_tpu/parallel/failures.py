@@ -313,7 +313,11 @@ class EngineSupervisor(HeartbeatMonitor):
             # requests re-prefill into it (page tables rebuild), and
             # its prefix index warms back up as traffic flows
             paged=old._pager is not None, page_size=old.page_size,
-            num_pages=old.num_pages, prefix_cache=old.prefix_cache)
+            num_pages=old.num_pages, prefix_cache=old.prefix_cache,
+            # phase profiler (ISSUE 13): same profiler, same stable
+            # channel key (slo_label) — the phase account and the
+            # timeline ring continue across the rebuild
+            profiler=old._profiler, profiling=old._profiling)
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)

@@ -70,7 +70,8 @@ import numpy as np
 from ..observability.flightrec import default_flight_recorder
 from ..observability.metrics import default_registry
 from ..observability.slo import default_slo_tracker
-from ..observability.tracing import default_trace_ring
+from ..observability.tracing import (default_trace_ring,
+                                     interval_now)
 from ..parallel.faults import NULL_INJECTOR, RejectedError
 
 #: replica health states (the membership protocol's vocabulary)
@@ -587,9 +588,9 @@ class FleetRequest:
         self.eos_id = eos_id
         self.deadline = None if deadline is None else float(deadline)
         self._deadline_t = None if deadline is None \
-            else time.monotonic() + float(deadline)
+            else interval_now() + float(deadline)
         self.sticky_key = sticky_key
-        self._created_t = time.monotonic()   # original submission clock
+        self._created_t = interval_now()   # original submission clock
         self.migrations = 0
         self.replica_id: Optional[str] = None
         self._inner = None
@@ -707,6 +708,7 @@ class EngineFleetRouter:
                  paged: bool = False, page_size: int = 16,
                  num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
+                 profiler=None, profiling: Optional[bool] = None,
                  sticky_page_size: Optional[int] = None,
                  engine_factory=None):
         self.fleet_id = fleet_id if fleet_id is not None \
@@ -783,7 +785,12 @@ class EngineFleetRouter:
                     block_ladder=block_ladder,
                     block_latency_target=block_latency_target,
                     paged=paged, page_size=page_size,
-                    num_pages=num_pages, prefix_cache=prefix_cache)
+                    num_pages=num_pages, prefix_cache=prefix_cache,
+                    # phase profiler (ISSUE 13): forwarded like every
+                    # other sink — replica channels key on rid (the
+                    # slo_label), so one injected profiler carries the
+                    # whole fleet's phase account
+                    profiler=profiler, profiling=profiling)
                 if supervised:
                     from ..parallel.failures import EngineSupervisor
                     eng = EngineSupervisor(
@@ -956,9 +963,9 @@ class EngineFleetRouter:
         # sync-fails run unarmed, _slo_sync_fail=False, so the spilled
         # handles recorded nothing) — the fleet records the ONE miss
         self._slo_tracker.record(
-            "shed", latency=time.monotonic() - fr._created_t,
+            "shed", latency=interval_now() - fr._created_t,
             headroom=None if fr._deadline_t is None
-            else fr._deadline_t - time.monotonic(), route=route)
+            else fr._deadline_t - interval_now(), route=route)
         fr._fail(RejectedError(
             f"fleet {self.fleet_id}: all {len(self._replicas)} replicas "
             f"saturated or dead — request shed",

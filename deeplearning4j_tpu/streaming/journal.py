@@ -72,6 +72,7 @@ import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..observability.flightrec import default_flight_recorder
+from ..observability.tracing import interval_now
 from ..observability.metrics import default_registry
 
 #: journal record kinds (the WAL vocabulary)
@@ -568,7 +569,7 @@ class RequestJournal:
         rid = getattr(req, "journal_id", None)
         if rid is None:
             return
-        wall = time.time() - max(0.0, time.monotonic() - req._created_t)
+        wall = time.time() - max(0.0, interval_now() - req._created_t)
         with self._lock:
             self._state.setdefault(rid, "open")
         self._append([{"k": "sub", "id": rid,
@@ -783,7 +784,7 @@ def recover_from_journal(journal, engine, *, ledger=None,
     report.truncated_frames = int(rep.get("truncated_frames", 0))
     counters = getattr(journal, "_m", None)
     now_wall = time.time()
-    now_mono = time.monotonic()
+    now_mono = interval_now()
     for rid in sorted(entries):
         e = entries[rid]
         if e.status != "open":

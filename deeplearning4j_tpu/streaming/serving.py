@@ -19,6 +19,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..observability.metrics import default_registry
+from ..observability.tracing import interval_now
 from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
                                RejectedError)
 from .pubsub import MessageBroker, NDArrayPublisher, NDArraySubscriber
@@ -347,7 +348,7 @@ class GenerationServingRoute(_RoutePublishMixin):
             arr = self._poll_safe(timeout=0.1)
             if arr is None:
                 continue
-            t_c0 = time.monotonic()
+            t_c0 = interval_now()
             try:
                 prompt = np.asarray(arr).astype(np.int64).reshape(-1)
                 # route= labels the request's SLO record (attainment per
@@ -363,7 +364,7 @@ class GenerationServingRoute(_RoutePublishMixin):
                 # (message arrival → request queued)
                 tr = getattr(req, "trace", None)
                 if tr is not None:
-                    tr.add_span("consume", t_c0, time.monotonic(),
+                    tr.add_span("consume", t_c0, interval_now(),
                                 topic=self.input_topic,
                                 route=self.route_id)
                 with self._inflight_lock:
@@ -397,7 +398,7 @@ class GenerationServingRoute(_RoutePublishMixin):
             with self._inflight_lock:
                 self._inflight.popleft()
             if out is not None:
-                t_p0 = time.monotonic()
+                t_p0 = interval_now()
                 if self._publish_safe(np.asarray(out, np.int32)):
                     self._m["served"].inc()
                     # close the request's timeline: its trace is already
@@ -406,7 +407,7 @@ class GenerationServingRoute(_RoutePublishMixin):
                     # shows consume→publish coverage
                     tr = getattr(req, "trace", None)
                     if tr is not None:
-                        tr.add_span("publish", t_p0, time.monotonic(),
+                        tr.add_span("publish", t_p0, interval_now(),
                                     route=self.route_id)
 
     def start(self) -> "GenerationServingRoute":

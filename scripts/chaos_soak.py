@@ -111,7 +111,8 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
              hang_seconds: float = None, wait_s: float = 180.0,
              steady_wave: int = 4, overhead_ab: bool = True,
              lock_audit: bool = False, mesh_shape: str = None,
-             postmortem_dir: str = None, paged: bool = False) -> dict:
+             postmortem_dir: str = None, paged: bool = False,
+             profile: bool = False) -> dict:
     """One soak iteration; returns a summary dict (see keys below).
 
     Prompt lengths and generation budgets are drawn so every prefill —
@@ -167,6 +168,18 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
     # must hold before the pool is ever squeezed); every harvest must
     # leave the allocator's refcounts provably balanced
     eng_kw = {"paged": True, "page_size": 8} if paged else {}
+    # --profile (ISSUE 13): the soak rides the process-default phase
+    # profiler (every tracing-on engine records into it); the round
+    # asserts the accounting stays consistent ACROSS the supervisor
+    # takeover — no negative phases, and the PhaseTimeline ring keeps
+    # recording through the engine rebuild (the supervisor passes the
+    # profiler + stable channel key through)
+    prof = tl0 = tl_mid = None
+    if profile:
+        from deeplearning4j_tpu.observability.profiler import \
+            default_profiler
+        prof = default_profiler()
+        tl0 = prof.timeline.total_added
     # --lock-audit: every lock constructed during the soak (all three
     # engines, the supervisor, replacement engines built by takeovers)
     # is instrumented; observed acquisition orders are cross-checked
@@ -226,6 +239,8 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
         for r in reqs:
             r._done.wait(max(0.0, deadline - time.monotonic()))
         stranded = [r for r in reqs if not r.done()]
+        if prof is not None:
+            tl_mid = prof.timeline.total_added
 
         # --- post-restart steady state: faults cleared, a fresh wave
         # must complete without ONE new lowering
@@ -253,6 +268,18 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
                 "misses": fst["prefix_cache_misses"],
                 "hit_tokens": fst["prefix_cache_hit_tokens"]}
         sup.stop()
+        if prof is not None:
+            # consistency across the takeover, plus: the chaos engine's
+            # channel (stable slo_label key across supervisor rebuilds)
+            # accumulated real blocks
+            doc, ok = _profile_round_check(prof, tl0, tl_mid,
+                                           "recorded_after_takeover")
+            chan = prof.channels().get(eng.slo_label)
+            doc["channel"] = None if chan is None else chan.summary()
+            summary["profile"] = doc
+            summary["profile_ok"] = bool(
+                ok and doc["channel"] is not None and
+                doc["channel"]["blocks"] > 0)
 
     mismatches = 0
     completed = failed = 0
@@ -337,6 +364,23 @@ def run_soak(seed: int = 0, n_requests: int = 16, num_slots: int = 2,
     return summary
 
 
+def _profile_round_check(prof, tl0, tl_mid, after_key):
+    """The --profile round's consistency scan, shared by the
+    single-engine and fleet soaks: every timeline entry THIS round
+    recorded has non-negative phases/bubble, and the ring kept
+    recording on both sides of the takeover/migration. Returns
+    (summary dict, ok)."""
+    tl_end = prof.timeline.total_added
+    recent = prof.timeline.recent(min(len(prof.timeline), tl_end - tl0))
+    neg = sum(1 for e in recent
+              if e.get("bubble_ms", 0) < 0 or
+              any(v < 0 for v in (e.get("phases_ms") or {}).values()))
+    doc = {"timeline_recorded": tl_end - tl0,
+           after_key: tl_end - tl_mid,
+           "negative_phases": neg}
+    return doc, bool(neg == 0 and tl_end > tl_mid > tl0)
+
+
 def _verify_postmortems(paths, known_trace_ids, expected: int,
                         id_key: str, known_harvest_ids=None,
                         exact: bool = True) -> tuple:
@@ -415,7 +459,8 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
                    fleet_scale: bool = True,
                    lock_audit: bool = False,
                    postmortem_dir: str = None,
-                   paged: bool = False) -> dict:
+                   paged: bool = False,
+                   profile: bool = False) -> dict:
     """One fleet soak round (``--replicas N``): N replicas behind an
     ``EngineFleetRouter`` under load, one hard-crashed mid-stream and
     (N ≥ 3) one zombied, with the exactly-once / token-parity /
@@ -457,6 +502,16 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
     # another replica's pool, and every replica's allocator must audit
     # balanced afterwards
     eng_kw = {"paged": True, "page_size": 8} if paged else {}
+    # --profile (ISSUE 13): replica engines record into the process-
+    # default profiler (tracing-on default); the round asserts the
+    # accounting survives FLEET MIGRATION — entries land before and
+    # after the replica deaths, with no negative phase anywhere
+    prof = tl0 = tl_mid = None
+    if profile:
+        from deeplearning4j_tpu.observability.profiler import \
+            default_profiler
+        prof = default_profiler()
+        tl0 = prof.timeline.total_added
     la = LockAudit(patch=True) if lock_audit else None
     with CompileAudit() as audit, \
             (la if la is not None else contextlib.nullcontext()):
@@ -506,6 +561,8 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
         for fr in frs:
             fr._done.wait(max(0.0, deadline - time.monotonic()))
         stranded = [fr for fr in frs if not fr.done()]
+        if prof is not None:
+            tl_mid = prof.timeline.total_added
 
         # --- post-migration steady state: a wave PINNED to each
         # surviving replica must complete without one new lowering
@@ -575,6 +632,12 @@ def run_fleet_soak(seed: int = 0, replicas: int = 3,
         "fleet": fleet_table,
         "metrics": default_registry().snapshot(),
     })
+    if prof is not None:
+        doc, ok = _profile_round_check(prof, tl0, tl_mid,
+                                       "recorded_after_migration")
+        doc["engines_profiled"] = len(prof.channels())
+        summary["profile"] = doc
+        summary["profile_ok"] = ok
     if postmortem_dir:
         # one artifact per replica kill, trace-id-matched to the round:
         # every migrated request must appear in some artifact's harvest
@@ -1314,8 +1377,13 @@ def _process_kill_child(workdir: str, incarnation: int,
                 if not req.done():
                     continue
                 del pending[rid]
+                # _created_t is an interval_now (perf_counter) anchor:
+                # the elapsed delta must come from the SAME clock, like
+                # journal.py's wall reconstruction
+                from deeplearning4j_tpu.observability.tracing import \
+                    interval_now
                 cw = time.time() - max(
-                    0.0, time.monotonic() - req._created_t)
+                    0.0, interval_now() - req._created_t)
                 if req._error is not None:
                     emit(rid, {"failed": f"{type(req._error).__name__}: "
                                          f"{req._error}", "cw": cw})
@@ -1413,6 +1481,13 @@ def main(argv=None) -> int:
                          "(composes with --mesh for a paged SHARDED "
                          "engine and with --replicas for paged "
                          "crash+migration)")
+    ap.add_argument("--profile", action="store_true",
+                    help="run the round with the hot-loop phase "
+                         "profiler armed and assert phase accounting "
+                         "stays consistent across supervisor takeover "
+                         "/ fleet migration (no negative phases, "
+                         "timeline ring survives the engine rebuild); "
+                         "archived in --json output")
     ap.add_argument("--lock-audit", action="store_true",
                     help="instrument every lock (LockAudit patch mode), "
                          "cross-check observed acquisition orders "
@@ -1564,7 +1639,7 @@ def main(argv=None) -> int:
                                fleet_scale=not args.no_fleet_scale,
                                lock_audit=args.lock_audit,
                                postmortem_dir=args.postmortem_dir,
-                               paged=args.paged)
+                               paged=args.paged, profile=args.profile)
             scale = s.get("fleet_scale") or {}
             # near-linear bar: >= 0.8x per replica (2.4x at N=3)
             scale_bad = bool(scale) and \
@@ -1572,10 +1647,11 @@ def main(argv=None) -> int:
             lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
                             s.get("lock_audit", {}).get("cycles"))
             pm_bad = args.postmortem_dir and not s.get("postmortem_ok")
+            prof_bad = args.profile and not s.get("profile_ok")
             bad = s["stranded"] or s["mismatches"] or s["failed"] or \
                 s["steady_new_compiles"] or s["migrations"] == 0 or \
                 not s["ledger_consistent"] or scale_bad or lock_bad or \
-                pm_bad or bool(s.get("page_audit"))
+                pm_bad or prof_bad or bool(s.get("page_audit"))
             ok = ok and not bad
             if args.json:
                 print(json.dumps(s, default=str))
@@ -1603,7 +1679,9 @@ def main(argv=None) -> int:
                       f"fenced={led['fenced']} dup={led['duplicates']}] "
                       f"steady_new_compiles="
                       f"{s['steady_new_compiles'] or '{}'}"
-                      f"{sc}{lk}{pm} -> {'FAIL' if bad else 'ok'}")
+                      f"{sc}{lk}{pm}"
+                      f"{'' if not args.profile else ' profile=' + ('ok' if s.get('profile_ok') else 'FAIL')}"
+                      f" -> {'FAIL' if bad else 'ok'}")
         return 0 if ok else 1
 
     ok = True
@@ -1615,16 +1693,17 @@ def main(argv=None) -> int:
                      overhead_ab=not args.no_overhead_ab,
                      lock_audit=args.lock_audit, mesh_shape=args.mesh,
                      postmortem_dir=args.postmortem_dir,
-                     paged=args.paged)
+                     paged=args.paged, profile=args.profile)
         over_budget = (s.get("telemetry_overhead_pct") or 0.0) > 5.0
         lock_bad = bool(s.get("lock_audit", {}).get("inversions") or
                         s.get("lock_audit", {}).get("cycles"))
         pm_bad = args.postmortem_dir and not s.get("postmortem_ok")
+        prof_bad = args.profile and not s.get("profile_ok")
         bad = s["stranded"] or s["mismatches"] or s["failed"] or \
             s["steady_new_compiles"] or s["trace_problems"] or \
             (s["readbacks_per_block"] or 0.0) > 1.0 or lock_bad or \
             (args.strict_overhead and over_budget) or pm_bad or \
-            bool(s.get("page_audit"))
+            prof_bad or bool(s.get("page_audit"))
         ok = ok and not bad
         if args.json:
             print(json.dumps(s, default=str))
@@ -1648,6 +1727,11 @@ def main(argv=None) -> int:
             pm = "" if "postmortem_ok" not in s else \
                 (f" postmortems={len(s['postmortems'])}"
                  f"{'' if s['postmortem_ok'] else ' MISMATCH'}")
+            if args.profile:
+                pr = s.get("profile") or {}
+                pm += (f" profile[{pr.get('timeline_recorded')}rec/"
+                       f"{pr.get('negative_phases')}neg"
+                       f"{'' if s.get('profile_ok') else ' FAIL'}]")
             print(f"round {i}:{mz}{pm} seed={s['seed']} "
                   f"restarts={s['restarts']} "
                   f"recovered={s['recovered_requests']} "

@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over the bench trajectory (ISSUE 13).
+
+Five rounds of BENCH_r*.json snapshots existed with no regression
+tracking: nothing failed when a PR shaved 15% off steady decode tok/s.
+This script normalizes the archived bench history plus the current
+``bench.py`` run into per-metric series and FAILS (exit 1) on
+noise-aware regressions:
+
+- **Normalization** — every bench document (the driver's archived
+  ``{"parsed": {...}}`` wrapper or a raw ``bench.py`` JSON line, any
+  BENCH_MODE) flattens to one ``{metric_key: value}`` record. Headline
+  metrics by either spelling land on the same key (a
+  ``BENCH_MODE=generate`` run and a default run's ``lm_generate`` side
+  metric both feed ``lm_generate.decode_tokens_per_sec``), so the
+  series stays continuous across protocol changes. ``bench.py`` now
+  emits this record itself (``history_record``) so future rounds
+  accumulate a machine-readable trajectory instead of raw tails.
+
+- **Noise-aware tolerance** — per metric, the band is
+  ``max(tolerance_floor, spread_mult × historical relative spread)``:
+  a metric that historically wobbles 8% run-to-run (char-RNN re-warm
+  noise, BASELINE.md r8) gets a wide band; a 0.1%-stable headline gets
+  the floor. Direction-aware: ``*_per_sec`` regress DOWN, latency
+  (``*_ms``, ``p50``/``p99``) regresses UP. Metrics with fewer than
+  ``--min-history`` samples are reported, never failed.
+
+- **Headline gate** — ``--headline-only`` restricts the exit-code gate
+  to the serving headliners (steady decode tok/s, prefill tok/s, p99
+  per-token) plus the training headline; everything else is
+  informational either way (side metrics with known re-warm noise
+  still print their bands).
+
+Usage:
+    python scripts/perf_regress.py --current bench_out.json
+    python scripts/perf_regress.py --current - < bench_out.json
+    python scripts/perf_regress.py --current out.json --json
+    python scripts/perf_regress.py --current out.json --degrade 0.5
+
+``--degrade F`` scales the current record's throughput metrics down
+(and latency up) by ``F`` before checking — the self-test hook the
+verify recipe uses: a degraded run MUST exit 1 while the real one
+exits 0. ``--history`` globs the archived rounds (default
+``BENCH_r*.json`` next to the repo root). Exit codes: 0 clean, 1
+regression, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: metric-key suffixes where LOWER is better (latency); everything else
+#: is a throughput-style higher-is-better series
+_LOWER_IS_BETTER = ("_ms", ".p50", ".p99", "_seconds")
+
+#: the headline gate set (--headline-only): the serving metrics every
+#: perf PR is judged by, plus the training headline
+HEADLINE_KEYS = (
+    "lm_generate.decode_tokens_per_sec",
+    "lm_generate.prefill_tokens_per_sec",
+    "lm_generate.p99_ms",
+    "resnet50_train_images_per_sec_per_chip",
+)
+
+
+def lower_is_better(key: str) -> bool:
+    return key.endswith(_LOWER_IS_BETTER)
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+#: generate-protocol side-metric names _flat_generate consumes -- the
+#: generic side-metric loop must not re-emit them under bare keys (one
+#: canonical key per quantity, or a prefill regression gates twice)
+_GEN_CONSUMED = frozenset({
+    "prefill_tokens_per_sec", "decode_token_latency_ms", "block_sweep",
+    "continuous_batching", "shared_prefix",
+    "nocache_recompute_tokens_per_sec", "block_size",
+    "block_speedup_vs_k1", "decode_vs_recompute_speedup", "mesh_sweep",
+    "config", "compile_audit", "metrics_snapshot"})
+
+
+def _flat_generate(side: dict, out: Dict[str, float]) -> None:
+    """Flatten a generate-protocol document (side_metrics of a generate
+    run, or the ``lm_generate`` side metric of a default run) into the
+    canonical ``lm_generate.*`` keys."""
+    v = _num(side.get("value"))
+    if v is not None:
+        out["lm_generate.decode_tokens_per_sec"] = v
+    pre = side.get("prefill_tokens_per_sec")
+    if isinstance(pre, dict) and _num(pre.get("value")) is not None:
+        out["lm_generate.prefill_tokens_per_sec"] = _num(pre["value"])
+    lat = side.get("decode_token_latency_ms")
+    if isinstance(lat, dict):
+        for q in ("p50", "p99"):
+            if _num(lat.get(q)) is not None:
+                out[f"lm_generate.{q}_ms"] = _num(lat[q])
+    sweep = side.get("block_sweep")
+    if isinstance(sweep, dict):
+        for k, row in sweep.items():
+            if isinstance(row, dict) and \
+                    _num(row.get("decode_tokens_per_sec")) is not None:
+                out[f"lm_generate.block_sweep.k{k}"
+                    ".decode_tokens_per_sec"] = \
+                    _num(row["decode_tokens_per_sec"])
+    cb = side.get("continuous_batching")
+    if isinstance(cb, dict):
+        for key in ("refill_on_tokens_per_sec",
+                    "refill_off_tokens_per_sec"):
+            if _num(cb.get(key)) is not None:
+                out[f"lm_generate.{key}"] = _num(cb[key])
+    sp = side.get("shared_prefix")
+    if isinstance(sp, dict) and \
+            _num(sp.get("paged_prompt_tokens_per_sec")) is not None:
+        out["lm_generate.paged_prompt_tokens_per_sec"] = \
+            _num(sp["paged_prompt_tokens_per_sec"])
+    nc = side.get("nocache_recompute_tokens_per_sec")
+    if isinstance(nc, dict) and _num(nc.get("value")) is not None:
+        out["lm_generate.nocache_recompute_tokens_per_sec"] = \
+            _num(nc["value"])
+
+
+def normalize_record(doc: dict) -> Dict[str, float]:
+    """One bench document (archived wrapper or raw result, any mode) →
+    a flat ``{metric_key: value}`` record. Unknown/error-shaped side
+    metrics are skipped — normalization must survive five generations
+    of protocol drift."""
+    doc = doc.get("parsed", doc) or {}
+    out: Dict[str, float] = {}
+    metric = doc.get("metric")
+    v = _num(doc.get("value"))
+    gen_mode = metric == "lm_generate_decode_tokens_per_sec"
+    if gen_mode:
+        # a BENCH_MODE=generate run: same keys as the side-metric form
+        _flat_generate({**doc.get("side_metrics", {}), "value": v}, out)
+    elif isinstance(metric, str) and v is not None:
+        out[metric] = v
+    for name, side in (doc.get("side_metrics") or {}).items():
+        if not isinstance(side, dict) or "error" in side:
+            continue
+        if name == "lm_generate":
+            _flat_generate(side, out)
+        elif _num(side.get("value")) is not None and \
+                not (gen_mode and name in _GEN_CONSUMED):
+            out[name] = _num(side["value"])
+    return out
+
+
+def record_fingerprint(doc: dict) -> Optional[str]:
+    """The generate-protocol shape fingerprint (batch/prompt/steps/
+    vocab from the ``config`` side metric): ``lm_generate.*`` series
+    only gate against rounds measured at the SAME shape -- a d64 smoke
+    run must never be judged against d256 full-bench history (and a
+    real regression must not hide inside cross-shape spread). None
+    when the document carries no generate config (pre-r6 rounds,
+    training-only runs) -- None fences only against other None
+    rounds."""
+    doc = doc.get("parsed", doc) or {}
+    side = doc.get("side_metrics") or {}
+    cfg = side.get("config")
+    if not isinstance(cfg, dict):
+        lm = side.get("lm_generate")
+        cfg = lm.get("config") if isinstance(lm, dict) else None
+    if not isinstance(cfg, dict):
+        return None
+    return "b{batch}xt{prompt_t}xs{decode_steps}xv{vocab}".format(
+        **{k: cfg.get(k) for k in ("batch", "prompt_t", "decode_steps",
+                                   "vocab")})
+
+
+def load_history(pattern: str
+                 ) -> List[Tuple[str, Dict[str, float], Optional[str]]]:
+    """(round label, normalized record, generate-shape fingerprint)
+    per archived bench snapshot, oldest first. Rounds that already carry a ``history_record`` (bench
+    emits one from now on) use it verbatim; older rounds re-normalize."""
+    rounds = []
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed", doc)
+        rec = parsed.get("history_record") \
+            if isinstance(parsed, dict) else None
+        if not isinstance(rec, dict) or not rec:
+            rec = normalize_record(doc)
+        rec = {k: _num(v) for k, v in rec.items() if _num(v) is not None}
+        if rec:
+            label = os.path.splitext(os.path.basename(path))[0]
+            rounds.append((label, rec, record_fingerprint(doc)))
+    return rounds
+
+
+def check_metric(key: str, history: List[float], current: float,
+                 tolerance_floor: float = 0.10,
+                 spread_mult: float = 1.5,
+                 min_history: int = 2) -> dict:
+    """One metric's verdict. The tolerance band is
+    ``max(floor, mult × (max−min)/median)`` of the HISTORY — a noisy
+    series earns a wide band, a stable one the floor — applied below
+    the historical median (throughput) or above it (latency)."""
+    row = {"metric": key, "n_history": len(history), "current": current,
+           "lower_is_better": lower_is_better(key)}
+    if len(history) < min_history:
+        row["status"] = "no-history"
+        return row
+    med = statistics.median(history)
+    spread = (max(history) - min(history)) / med if med else 0.0
+    band = max(tolerance_floor, spread_mult * abs(spread))
+    row.update({"median": round(med, 4),
+                "spread_pct": round(100.0 * spread, 2),
+                "band_pct": round(100.0 * band, 2),
+                "delta_pct": round(100.0 * (current - med) / med, 2)
+                if med else None})
+    if med == 0:
+        row["status"] = "ok"
+    elif lower_is_better(key):
+        row["status"] = "regression" if current > med * (1.0 + band) \
+            else ("improved" if current < med * (1.0 - band) else "ok")
+    else:
+        row["status"] = "regression" if current < med * (1.0 - band) \
+            else ("improved" if current > med * (1.0 + band) else "ok")
+    return row
+
+
+def regression_report(history: List[Tuple],
+                      current: Dict[str, float],
+                      tolerance_floor: float = 0.10,
+                      spread_mult: float = 1.5,
+                      min_history: int = 2,
+                      headline_only: bool = False,
+                      fingerprint: Optional[str] = None) -> dict:
+    """The full verdict document: one row per current metric, plus the
+    gate outcome. ``headline_only`` restricts the exit-code gate (not
+    the report) to :data:`HEADLINE_KEYS`; ``fingerprint`` fences the
+    ``lm_generate.*`` series to rounds at the SAME generate shape."""
+    rows = []
+    for key in sorted(current):
+        series = [rec[key] for _, rec, fp in history
+                  if key in rec and
+                  (not key.startswith("lm_generate.") or
+                   fp == fingerprint)]
+        rows.append(check_metric(key, series, current[key],
+                                 tolerance_floor, spread_mult,
+                                 min_history))
+    gated = [r for r in rows if r["status"] == "regression" and
+             (not headline_only or r["metric"] in HEADLINE_KEYS)]
+    return {
+        "rounds": [r[0] for r in history],
+        "fingerprint": fingerprint,
+        "checked": len(rows),
+        "regressions": [r["metric"] for r in gated],
+        "ok": not gated,
+        "rows": rows,
+    }
+
+
+def degrade_record(rec: Dict[str, float], factor: float
+                   ) -> Dict[str, float]:
+    """Self-test hook: scale throughput down / latency up by ``factor``
+    — the synthetically slowed run the acceptance gate requires to
+    exit 1."""
+    return {k: (v / factor if lower_is_better(k) else v * factor)
+            for k, v in rec.items()}
+
+
+def _print_report(rep: dict, out=sys.stdout) -> None:
+    w = out.write
+    w(f"perf_regress: {len(rep['rounds'])} historical round(s) "
+      f"({', '.join(rep['rounds']) or 'none'})\n")
+    w(f"  {'metric':<52} {'hist':>4} {'median':>12} {'band':>7} "
+      f"{'current':>12} {'delta':>8}  status\n")
+    for r in rep["rows"]:
+        med = r.get("median")
+        band = r.get("band_pct")
+        delta = r.get("delta_pct")
+        fmt = (lambda v, spec=".4g": "-" if v is None
+               else format(v, spec))
+        mark = {"regression": "REGRESSION", "improved": "improved",
+                "no-history": "no-history"}.get(r["status"], "ok")
+        w(f"  {r['metric']:<52} {r['n_history']:>4} {fmt(med):>12} "
+          f"{fmt(band, '.1f') + '%' if band is not None else '-':>7} "
+          f"{fmt(r['current']):>12} "
+          f"{fmt(delta, '+.1f') + '%' if delta is not None else '-':>8}"
+          f"  {mark}\n")
+    if rep["regressions"]:
+        w(f"  FAIL: {len(rep['regressions'])} regression(s): "
+          f"{', '.join(rep['regressions'])}\n")
+    else:
+        w("  OK: no gated regressions\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap.add_argument("--history", default=os.path.join(root,
+                                                      "BENCH_r*.json"),
+                    metavar="GLOB",
+                    help="archived bench rounds (default: BENCH_r*.json "
+                         "at the repo root)")
+    ap.add_argument("--current", default=None, metavar="FILE",
+                    help="current bench.py output (JSON; '-' = stdin). "
+                         "Required.")
+    ap.add_argument("--tolerance-floor", type=float, default=0.10,
+                    help="minimum relative tolerance band (default 0.10)")
+    ap.add_argument("--spread-mult", type=float, default=1.5,
+                    help="band = max(floor, mult * historical spread)")
+    ap.add_argument("--min-history", type=int, default=2,
+                    help="samples required before a metric can gate")
+    ap.add_argument("--headline-only", action="store_true",
+                    help="gate the exit code on the headline metrics "
+                         "only (full report either way)")
+    ap.add_argument("--degrade", type=float, default=None, metavar="F",
+                    help="self-test: scale the current record's "
+                         "throughput down (latency up) by F before "
+                         "checking — must exit 1 for F well below the "
+                         "band")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    args = ap.parse_args(argv)
+
+    if args.current is None:
+        print("perf_regress: --current FILE (or '-') is required",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.current == "-":
+            doc = json.load(sys.stdin)
+        else:
+            with open(args.current, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_regress: cannot read current run: {e}",
+              file=sys.stderr)
+        return 2
+    current = normalize_record(doc)
+    if not current:
+        print("perf_regress: current run yielded no numeric metrics",
+              file=sys.stderr)
+        return 2
+    if args.degrade is not None:
+        current = degrade_record(current, float(args.degrade))
+    history = load_history(args.history)
+    rep = regression_report(history, current,
+                            tolerance_floor=args.tolerance_floor,
+                            spread_mult=args.spread_mult,
+                            min_history=args.min_history,
+                            headline_only=args.headline_only,
+                            fingerprint=record_fingerprint(doc))
+    if args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        _print_report(rep)
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

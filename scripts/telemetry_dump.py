@@ -198,6 +198,16 @@ def scrape_fleet(urls, timeout: float = 5.0) -> dict:
         except (urllib.error.URLError, OSError, ValueError,
                 TimeoutError) as e:
             per_url[base] = {"__error__": f"{type(e).__name__}: {e}"}
+            continue
+        # profiler roofline (ISSUE 13): one extra GET per live replica
+        # for the attained-GB/s column; absent/old replicas degrade to
+        # a '-' cell, never a failed scrape
+        try:
+            per_url[base]["__profile__"] = fetch(f"{base}/profile",
+                                                 timeout)
+        except (urllib.error.URLError, OSError, ValueError,
+                TimeoutError):
+            pass
     return merge_snapshots(per_url)
 
 
@@ -206,6 +216,25 @@ def _kv_bytes(snap: dict):
     vals = [v.get("bytes") for v in kv.values()
             if isinstance(v, dict) and isinstance(v.get("bytes"), int)]
     return sum(vals) if vals else None
+
+
+def _profile_cols(snap: dict):
+    """(bubble_pct, attained_gbs) for one replica: bubble-% from the
+    /snapshot profiler headline, attained GB/s as the best measured
+    decode-block impl in the /profile roofline (None when the replica
+    predates the profiler)."""
+    head = ((snap.get("profiler") or {}).get("headline") or {})
+    bubble = head.get("bubble_pct")
+    gbs = None
+    roof = ((snap.get("__profile__") or {}).get("roofline") or {})
+    for impl, row in roof.items():
+        if not isinstance(row, dict):
+            continue
+        if "decode" in impl and isinstance(row.get("attained_gbs"),
+                                           (int, float)):
+            gbs = row["attained_gbs"] if gbs is None \
+                else max(gbs, row["attained_gbs"])
+    return bubble, gbs
 
 
 def _gauge_sum(snap: dict, family: str, label: str = None):
@@ -270,6 +299,9 @@ def merge_snapshots(per_url: dict) -> dict:
         row["journal_pending"] = _gauge_sum(snap, "journal_pending")
         deg = _gauge_sum(snap, "journal_degraded")
         row["journal_degraded"] = None if deg is None else bool(deg)
+        # hot-loop profiler (ISSUE 13): decode pipeline bubble-% and
+        # best attained decode GB/s per replica
+        row["bubble_pct"], row["attained_gbs"] = _profile_cols(snap)
         if target is None and slo.get("target") is not None:
             target = float(slo["target"])
         requests += int(slo.get("requests") or 0)
@@ -302,7 +334,8 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
     w(f"  {'replica':<36} {'up':>2} {'uptime':>8} {'att-short':>9} "
       f"{'att-long':>8} {'burn-sh':>8} {'reqs':>6} {'miss':>5} "
       f"{'hd-p50':>8} {'hd-min':>8} {'kv-bytes':>10} {'pg-free':>7} "
-      f"{'pg-shr':>6} {'j-pend':>6} {'j-deg':>5}\n")
+      f"{'pg-shr':>6} {'j-pend':>6} {'j-deg':>5} {'bub%':>6} "
+      f"{'GB/s':>7}\n")
     fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
     for base, row in sorted(doc["replicas"].items()):
         if not row.get("up"):
@@ -320,7 +353,9 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
           f"{fmt(row.get('kv_pages_free')):>7} "
           f"{fmt(row.get('kv_pages_shared')):>6} "
           f"{fmt(row.get('journal_pending')):>6} "
-          f"{'-' if jd is None else ('Y' if jd else 'n'):>5}\n")
+          f"{'-' if jd is None else ('Y' if jd else 'n'):>5} "
+          f"{fmt(row.get('bubble_pct')):>6} "
+          f"{fmt(row.get('attained_gbs')):>7}\n")
     hits = doc["counters"].get("prefix_cache_hit_total")
     misses = doc["counters"].get("prefix_cache_miss_total")
     if hits is not None or misses is not None:

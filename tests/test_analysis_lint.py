@@ -1239,6 +1239,97 @@ class TestMetricNamingAndSinkRule:
         assert out == []
 
 
+class TestProfilerStampRule:
+    """GL016 (ISSUE 13): profiler/phase-stamp recording banned from
+    jit-traced AND shard_map contexts — phase stamps are host
+    interval-clock anchors recorded from the readback thread; under
+    trace they would fire once per compile with trace-time constants."""
+
+    def test_record_block_inside_jit_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x, profiler):
+                profiler.record_block(impl="step", k=1, lanes=2,
+                                      queued=0, t_dispatch=0.0,
+                                      t_fetched=1.0, t_host=1.0,
+                                      t_journal=1.0, t_publish=1.0)
+                return x + 1
+        """, rules=["GL016"])
+        assert _rules(out) == ["GL016"]
+        assert ".record_block()" in out[0].message
+
+    def test_record_chunk_in_scan_body_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+
+            def body(carry, t, prof):
+                prof.record_chunk(t_dispatch=0.0, t_done=1.0, final=True)
+                return carry, t
+
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+        """, rules=["GL016"])
+        assert _rules(out) == ["GL016"]
+
+    def test_record_admission_inside_shard_map_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            from jax.experimental.shard_map import shard_map
+
+            def region(x, phase_channel):
+                phase_channel.record_admission(impl="prefill", count=2,
+                                               t_dispatch=0.0,
+                                               t_fetched=1.0, t_host=1.0,
+                                               t_journal=1.0,
+                                               t_publish=1.0)
+                return x
+
+            def run(mesh, x):
+                return shard_map(region, mesh=mesh, in_specs=None,
+                                 out_specs=None)(x)
+        """, rules=["GL016"])
+        # the jit-body pass (shard_map is a trace wrapper) and the
+        # sharding pass both witness it — one GL016 rule either way
+        assert _rules(out) == ["GL016"]
+        assert any(".record_admission()" in f.message for f in out)
+
+    def test_recording_on_readback_thread_is_fine(self, tmp_path):
+        """The engine's actual call shape — record_* on the readback
+        thread, outside any traced region — must stay clean."""
+        out = _lint_src(tmp_path, """
+            def _retire_block(self, block, profiler):
+                toks, k, t_disp = block
+                profiler.record_block(impl="block", k=k, lanes=2,
+                                      queued=0, t_dispatch=t_disp,
+                                      t_fetched=1.0, t_host=1.0,
+                                      t_journal=1.0, t_publish=1.0)
+        """, rules=["GL016"])
+        assert out == []
+
+    def test_unhinted_receiver_in_jit_is_not_gl016(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x, session):
+                session.record_block(1)
+                return x
+        """, rules=["GL016"])
+        assert out == []
+
+    def test_inline_disable_suppresses_gl016(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x, profiler):
+                profiler.record_chunk(t_dispatch=0.0, t_done=1.0, final=True)  # graftlint: disable=GL016
+                return x
+        """, rules=["GL016"])
+        assert out == []
+
+
 class TestLintCacheAndCLI:
     _SRC = textwrap.dedent("""
         import jax

@@ -20,8 +20,9 @@ from deeplearning4j_tpu.models import (SlotGenerationEngine,
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.observability import (DeviceStats, FlightRecorder,
                                               Histogram, MetricsRegistry,
-                                              SLOTracker, TelemetryServer,
-                                              Trace, TraceRing,
+                                              PhaseProfiler, SLOTracker,
+                                              TelemetryServer, Trace,
+                                              TraceRing,
                                               device_memory_snapshot,
                                               impl_cost_analysis,
                                               kv_cache_stats, percentiles)
@@ -1091,3 +1092,492 @@ class TestFleetScrape:
             assert "watch sample" in capsys.readouterr().out
         finally:
             srv.stop()
+
+
+class TestPhaseProfiler:
+    """Hot-loop phase profiler (ISSUE 13): telescoping phase exactness
+    under injected clocks, pipeline/lane bubble semantics, the /profile
+    endpoint over real HTTP, the on/off overhead A/B, and channel
+    continuity across a supervisor takeover."""
+
+    def test_phases_sum_to_wall_time_exactly_under_injected_clocks(self):
+        prof = PhaseProfiler(registry=MetricsRegistry())
+        ch = prof.channel("eX", num_slots=4)
+        # block 1: dispatch 10.00 -> fetched 10.25 -> host 10.31 ->
+        # journal 10.34 -> publish 10.35
+        ch.record_block(impl="decode_block4_impl", k=4, lanes=3,
+                        queued=2, t_dispatch=10.0, t_fetched=10.25,
+                        t_host=10.31, t_journal=10.34, t_publish=10.35)
+        s = ch.summary()
+        assert sum(s["phase_seconds"].values()) == pytest.approx(
+            0.35, abs=1e-9)
+        assert s["phase_seconds"]["device"] == pytest.approx(0.25)
+        assert s["phase_seconds"]["host"] == pytest.approx(0.06)
+        assert s["phase_seconds"]["journal"] == pytest.approx(0.03)
+        assert s["phase_seconds"]["publish"] == pytest.approx(0.01)
+        assert s["bubble_seconds"] == 0.0        # first block: no anchor
+        # block 2 dispatched 0.65s after block 1's data was ready:
+        # that gap IS the pipeline bubble
+        ch.record_block(impl="decode_block4_impl", k=4, lanes=3,
+                        queued=0, t_dispatch=10.9, t_fetched=11.0,
+                        t_host=11.0, t_journal=11.0, t_publish=11.0)
+        s = ch.summary()
+        assert s["bubble_seconds"] == pytest.approx(0.65)
+        # overlapped dispatch (double buffer: dispatch BEFORE the
+        # previous retire) contributes zero bubble
+        ch.record_block(impl="decode_block4_impl", k=4, lanes=3,
+                        queued=0, t_dispatch=10.95, t_fetched=11.4,
+                        t_host=11.45, t_journal=11.45, t_publish=11.5)
+        assert ch.summary()["bubble_seconds"] == pytest.approx(0.65)
+        # every timeline entry is non-negative and internally consistent
+        for e in prof.timeline.recent(None):
+            assert e["bubble_ms"] >= 0
+            assert all(v >= 0 for v in e["phases_ms"].values())
+        assert prof.timeline.total_added == 3
+
+    def test_lane_bubble_counts_idle_lanes_only_while_queued(self):
+        prof = PhaseProfiler(registry=MetricsRegistry())
+        ch = prof.channel("eY", num_slots=4)
+        # 2 of 4 lanes busy for 1s WITH work queued: half the slot-time
+        # is chargeable lane bubble
+        ch.record_block(impl="i", k=1, lanes=2, queued=3, t_dispatch=0.0,
+                        t_fetched=1.0, t_host=1.0, t_journal=1.0,
+                        t_publish=1.0)
+        assert ch.summary()["lane_bubble_pct"] == pytest.approx(50.0)
+        # idle lanes with an EMPTY queue are not waste
+        ch.record_block(impl="i", k=1, lanes=2, queued=0, t_dispatch=1.0,
+                        t_fetched=2.0, t_host=2.0, t_journal=2.0,
+                        t_publish=2.0)
+        assert ch.summary()["lane_bubble_pct"] == pytest.approx(25.0)
+
+    def test_warmup_dispatch_excluded_from_steady_durations(self):
+        prof = PhaseProfiler(registry=MetricsRegistry())
+        ch = prof.channel("eW", num_slots=2)
+        # first block (compile-laden, 5s) must not pollute the steady
+        # mean; the two post-warmup blocks define it
+        for t0, t1 in ((0.0, 5.0), (5.0, 5.1), (6.0, 6.1)):
+            ch.record_block(impl="decode_block2_impl", k=2, lanes=2,
+                            queued=0, t_dispatch=t0, t_fetched=t1,
+                            t_host=t1, t_journal=t1, t_publish=t1)
+        m = ch.summary()["impl_measured"]["decode_block2_impl"]
+        assert m["n"] == 2
+        assert m["mean_s"] == pytest.approx(0.1, rel=1e-6)
+
+    def test_live_engine_accounting_consistency(self, shared_decoder,
+                                                rng_np):
+        reg = MetricsRegistry()
+        prof = PhaseProfiler(registry=reg)
+        eng = _engine(shared_decoder, num_slots=2, block_size=4,
+                      registry=reg, profiler=prof)
+        for _ in range(6):
+            eng.submit(rng_np.integers(0, VOCAB, 3), 6)
+        eng.run_until_drained()
+        ch = prof.channels()[eng.slo_label]
+        s = ch.summary()
+        # every RETIRED block is recorded; a dispatched-but-dropped
+        # in-flight block (wave drained mid-pipeline: its tokens are
+        # pure overshoot, fetched never) is not — so recorded <= dispatched
+        assert 0 < s["blocks"] <= eng.decode_blocks
+        assert s["admissions"] == eng.prefill_batches
+        assert all(v >= 0 for v in s["phase_seconds"].values())
+        assert s["bubble_seconds"] >= 0
+        for e in prof.timeline.recent(None):
+            assert e["bubble_ms"] >= 0
+            assert all(v >= 0 for v in e["phases_ms"].values())
+        # the registry histograms carry the same observation counts
+        fam = reg.get("profiler_phase_seconds")
+        dev = fam.labels(eng.slo_label, "device")
+        assert dev.count == s["blocks"] + s["admissions"] + s["chunks"]
+
+    def test_k1_legacy_loop_bubbles_more_than_pipelined_k4(
+            self, shared_decoder, rng_np):
+        """The double-buffer overlap measure: the K=1 dispatch->sync->
+        bookkeep loop leaves the device idle every step, the K=4
+        pipelined loop overlaps — its bubble fraction must be lower."""
+        prompts = [rng_np.integers(0, VOCAB, 3) for _ in range(4)]
+
+        def bubble_pct(block: int) -> float:
+            reg = MetricsRegistry()
+            prof = PhaseProfiler(registry=reg)
+            eng = _engine(shared_decoder, num_slots=2, block_size=block,
+                          registry=reg, profiler=prof)
+            for p in prompts:
+                eng.submit(p, 16)
+            eng.run_until_drained()
+            return prof.channels()[eng.slo_label].summary()["bubble_pct"]
+
+        b1, b4 = bubble_pct(1), bubble_pct(4)
+        assert b1 > b4, f"K=1 bubble {b1}% should exceed K=4 {b4}%"
+
+    def test_static_waves_show_higher_lane_bubble_than_refill(
+            self, shared_decoder, rng_np):
+        """Bubble-%% sanity (the continuous-batching claim, measured):
+        refill=False strands finished lanes until the wave drains while
+        work is queued — strictly higher lane bubble than continuous
+        batching on the same mixed-length stream."""
+        prompts = [rng_np.integers(0, VOCAB, 3) for _ in range(8)]
+        gens = [4, 16, 4, 16, 4, 16, 4, 16]   # uneven: stragglers strand
+        #                                       short lanes in a wave
+
+        def lane_bubble(refill: bool) -> float:
+            reg = MetricsRegistry()
+            prof = PhaseProfiler(registry=reg)
+            eng = _engine(shared_decoder, num_slots=2, block_size=4,
+                          refill=refill, registry=reg, profiler=prof)
+            for p, g in zip(prompts, gens):
+                eng.submit(p, g)
+            eng.run_until_drained()
+            return prof.channels()[
+                eng.slo_label].summary()["lane_bubble_pct"]
+
+        off, on = lane_bubble(False), lane_bubble(True)
+        assert off > on, \
+            f"static waves lane-bubble {off}% should exceed " \
+            f"continuous batching {on}%"
+
+    def test_profile_endpoint_over_http(self, shared_decoder, rng_np):
+        reg = MetricsRegistry()
+        prof = PhaseProfiler(registry=reg)
+        eng = _engine(shared_decoder, num_slots=2, block_size=4,
+                      registry=reg, profiler=prof)
+        for _ in range(4):
+            eng.submit(rng_np.integers(0, VOCAB, 3), 8)
+        eng.run_until_drained()
+        srv = TelemetryServer(registry=reg, trace_store=TraceRing(8),
+                              profiler=prof).start()
+        try:
+            with urllib.request.urlopen(f"{srv.url}/profile",
+                                        timeout=10) as r:
+                doc = json.loads(r.read())
+            ch = doc["engines"][eng.slo_label]
+            assert ch["blocks"] > 0
+            assert set(ch["phase_seconds"]) == {"device", "host",
+                                               "journal", "publish"}
+            # roofline join: the decode-block impl reports attained
+            # GFLOP/s / GB/s / intensity and a bound verdict
+            roof = doc["roofline"]
+            key = [k for k in roof if k.startswith("decode_block4")]
+            assert key, f"no decode_block4 row in {sorted(roof)}"
+            row = roof[key[0]]
+            assert row["attained_gflops"] > 0
+            assert row["attained_gbs"] > 0
+            assert row["intensity_flops_per_byte"] > 0
+            assert row["bound"] in ("memory_bound", "compute_bound")
+            # ?timeline=N returns the ring tail
+            with urllib.request.urlopen(f"{srv.url}/profile?timeline=5",
+                                        timeout=10) as r:
+                doc = json.loads(r.read())
+            assert 0 < len(doc["timeline"]["recent"]) <= 5
+            # /snapshot embeds the lightweight summary for the scrape
+            with urllib.request.urlopen(f"{srv.url}/snapshot",
+                                        timeout=10) as r:
+                snap = json.loads(r.read())
+            assert snap["profiler"]["headline"]["blocks"] > 0
+            assert "bubble_pct" in snap["profiler"]["headline"]
+        finally:
+            srv.stop()
+
+    def test_profiler_overhead_within_5pct(self, shared_decoder, rng_np):
+        """The profiler on/off A/B at the K=4 soak shape (tracing ON in
+        both arms, so the delta isolates the profiler): same interleaved
+        best-of-N + escalation protocol as the telemetry A/B."""
+        prompts = [rng_np.integers(0, VOCAB, int(n))
+                   for n in rng_np.integers(2, 6, 12)]
+        gens = [int(g) for g in rng_np.integers(8, 17, 12)]
+
+        def drain(profiling: bool) -> float:
+            eng = _engine(shared_decoder, num_slots=4, block_size=4,
+                          profiling=profiling)
+            for p, g in zip(prompts, gens):
+                eng.submit(p, g)
+            t0 = time.perf_counter()
+            eng.run_until_drained()
+            return eng.emitted_tokens / (time.perf_counter() - t0)
+
+        def measure_overhead():
+            on, off = [], []
+            for _ in range(5):
+                on.append(drain(True))
+                off.append(drain(False))
+            return 1.0 - max(on) / max(off), max(on), max(off)
+
+        drain(True)
+        drain(False)
+        results = []
+        for _ in range(3):
+            results.append(measure_overhead())
+            if results[-1][0] <= 0.05:
+                break
+        overhead, on_best, off_best = results[-1]
+        assert overhead <= 0.05, \
+            f"profiler overhead over the 5% budget on " \
+            f"{len(results)} consecutive best-of-5 measurements: " \
+            f"{[f'{r[0]:.1%}' for r in results]} (last: on " \
+            f"{on_best:.0f} vs off {off_best:.0f} tok/s)"
+
+    def test_channel_and_timeline_survive_takeover(self, shared_decoder,
+                                                   rng_np):
+        """The supervisor passes the profiler + stable channel key
+        through the engine rebuild: ONE channel keeps accumulating and
+        the timeline ring records on both sides of the restart."""
+        reg = MetricsRegistry()
+        prof = PhaseProfiler(registry=reg)
+        inj = FaultInjector()
+        inj.raise_once("engine.step", RuntimeError("boom"), at=3)
+        eng = _engine(shared_decoder, num_slots=2, block_size=4,
+                      registry=reg, profiler=prof, fault_injector=inj)
+        label = eng.slo_label
+        sup = EngineSupervisor(eng, timeout=2.0, interval=0.05,
+                               max_restarts=2).start()
+        try:
+            reqs = [sup.submit(rng_np.integers(0, VOCAB, 3), 8)
+                    for _ in range(4)]
+            assert _wait(lambda: all(r.done() for r in reqs))
+            assert sup.stats()["restarts"] >= 1
+            chans = prof.channels()
+            assert list(chans) == [label]       # ONE channel, rebuilt
+            #                                     engine re-entered it
+            assert chans[label].summary()["blocks"] > 0
+            assert prof.timeline.total_added > 0
+            for e in prof.timeline.recent(None):
+                assert all(v >= 0 for v in e["phases_ms"].values())
+        finally:
+            sup.stop()
+
+
+class TestClockDiscipline:
+    """Satellite (ISSUE 13): every observability duration derives from
+    the single interval clock — a backwards wall-clock step (NTP) can
+    never produce a negative span, SLO quantity, or phase."""
+
+    def test_interval_now_is_monotonic_nondecreasing(self):
+        from deeplearning4j_tpu.observability import interval_now
+        vals = [interval_now() for _ in range(100)]
+        assert all(b >= a for a, b in zip(vals, vals[1:]))
+
+    def test_backwards_wall_clock_cannot_corrupt_spans(self, monkeypatch):
+        """Regression: step time.time() BACKWARDS 1h mid-trace — span
+        durations, trace duration, and SLO quantities all stay
+        non-negative (interval math never reads the wall clock; the
+        trace keeps exactly one wall anchor for display)."""
+        import deeplearning4j_tpu.observability.tracing as tracing_mod
+        ring = TraceRing(4)
+        tr = Trace(store=ring)
+        wall = {"t": 1_700_000_000.0}
+        monkeypatch.setattr(tracing_mod.time, "time",
+                            lambda: wall["t"])
+        with tr.span("prefill"):
+            wall["t"] -= 3600.0                  # NTP step, 1h backwards
+            time.sleep(0.002)
+        tr.add_span("decode_block")
+        wall["t"] -= 3600.0
+        tr.finish("ok")
+        assert tr.duration is not None and tr.duration >= 0
+        doc = tr.to_dict()
+        for s in doc["spans"]:
+            assert s["duration_ms"] >= 0
+        assert doc["duration_ms"] >= 0
+        # SLO account through the same storm: stamps are interval
+        # anchors, so every derived quantity is non-negative
+        trk = SLOTracker(registry=MetricsRegistry(), name="ntp")
+        req = type("R", (), {})()
+        from deeplearning4j_tpu.observability import interval_now
+        now = interval_now()
+        req._created_t = now - 0.5
+        req._admitted_t = now - 0.4
+        req._first_token_t = now - 0.3
+        req._deadline_t = now + 10.0
+        req.generated = [1, 2, 3]
+        req._slo_labels = {}
+        wall["t"] -= 3600.0
+        rec = trk.observe_request(req, "ok")
+        assert rec.queue_wait >= 0 and rec.ttft >= 0
+        assert rec.latency >= 0 and rec.per_token >= 0
+        assert rec.headroom > 0
+
+    def test_trace_keeps_one_wall_anchor_for_display(self):
+        tr = Trace()
+        tr.finish()
+        doc = tr.to_dict()
+        assert doc["wall_time"] == pytest.approx(tr.wall_anchor)
+
+    def test_engine_request_clocks_ride_the_interval_clock(
+            self, shared_decoder, rng_np):
+        """The serving path end-to-end: request clocks are interval
+        anchors (generation.py stamps interval_now), so every derived
+        SLO quantity is non-negative by construction."""
+        reg = MetricsRegistry()
+        trk = SLOTracker(registry=reg, name="clockless")
+        eng = _engine(shared_decoder, registry=reg, slo=trk)
+        r = eng.submit(rng_np.integers(0, VOCAB, 3), 4, deadline=30.0)
+        eng.run_until_drained()
+        assert r.state == r.DONE
+        rec = trk.recent(1)[0]
+        assert rec["queue_wait_s"] >= 0 and rec["ttft_s"] >= 0
+        assert rec["latency_s"] >= 0
+        assert rec["headroom_s"] is not None and rec["headroom_s"] > 0
+
+
+def _load_perf_regress():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "perf_regress", os.path.join(os.path.dirname(__file__),
+                                     "..", "scripts",
+                                     "perf_regress.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestPerfRegress:
+    """Perf-regression sentinel (ISSUE 13): normalization across
+    protocol generations, noise-aware direction-correct bands, and the
+    CLI gate (real run exits 0, synthetically slowed run exits 1)."""
+
+    GEN_DOC = {
+        "metric": "lm_generate_decode_tokens_per_sec", "value": 4000.0,
+        "unit": "tokens/sec",
+        "side_metrics": {
+            "prefill_tokens_per_sec": {"value": 90000.0},
+            "decode_token_latency_ms": {"p50": 2.0, "p99": 4.0},
+            "block_sweep": {"4": {"decode_tokens_per_sec": 4000.0}},
+            "continuous_batching": {
+                "refill_on_tokens_per_sec": 900.0,
+                "refill_off_tokens_per_sec": 700.0},
+        },
+    }
+
+    def test_normalize_spans_protocol_generations(self):
+        pr = _load_perf_regress()
+        # a BENCH_MODE=generate run and a default run's lm_generate
+        # side metric land on the SAME canonical keys
+        a = pr.normalize_record(self.GEN_DOC)
+        default_doc = {
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": 2600.0,
+            "side_metrics": {"lm_generate": dict(
+                self.GEN_DOC["side_metrics"], value=4000.0)},
+        }
+        b = pr.normalize_record({"parsed": default_doc})
+        key = "lm_generate.decode_tokens_per_sec"
+        assert a[key] == b[key] == 4000.0
+        assert a["lm_generate.p99_ms"] == 4.0
+        assert b["resnet50_train_images_per_sec_per_chip"] == 2600.0
+        assert b["lm_generate.block_sweep.k4.decode_tokens_per_sec"] \
+            == 4000.0
+
+    def test_noise_aware_band_and_direction(self):
+        pr = _load_perf_regress()
+        # stable throughput history: the 10% floor applies
+        r = pr.check_metric("x_per_sec", [100.0, 101.0, 99.0], 95.0)
+        assert r["status"] == "ok"
+        r = pr.check_metric("x_per_sec", [100.0, 101.0, 99.0], 85.0)
+        assert r["status"] == "regression"
+        # noisy history earns a wider band: 25% spread -> ~37.5% band
+        r = pr.check_metric("x_per_sec", [100.0, 125.0, 100.0], 75.0)
+        assert r["status"] == "ok"
+        # latency regresses UP
+        r = pr.check_metric("lm_generate.p99_ms", [10.0, 11.0], 15.0)
+        assert r["status"] == "regression"
+        r = pr.check_metric("lm_generate.p99_ms", [10.0, 11.0], 8.0)
+        assert r["status"] == "improved"
+        # thin history never gates
+        r = pr.check_metric("x_per_sec", [100.0], 10.0)
+        assert r["status"] == "no-history"
+
+    def test_cli_real_exits_0_degraded_exits_1(self, tmp_path, capsys):
+        pr = _load_perf_regress()
+        for i in range(3):
+            (tmp_path / f"BENCH_r0{i}.json").write_text(json.dumps(
+                {"parsed": dict(self.GEN_DOC,
+                                value=4000.0 + 20 * i)}))
+        cur = tmp_path / "current.json"
+        cur.write_text(json.dumps(self.GEN_DOC))
+        hist = str(tmp_path / "BENCH_r*.json")
+        assert pr.main(["--history", hist, "--current", str(cur)]) == 0
+        capsys.readouterr()
+        rc = pr.main(["--history", hist, "--current", str(cur),
+                      "--degrade", "0.5"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION" in out
+        assert "lm_generate.decode_tokens_per_sec" in out
+        # headline-only gating still trips on the headline metrics
+        assert pr.main(["--history", hist, "--current", str(cur),
+                        "--degrade", "0.5", "--headline-only"]) == 1
+        capsys.readouterr()
+
+    def test_history_record_preferred_over_renormalization(
+            self, tmp_path):
+        pr = _load_perf_regress()
+        doc = {"parsed": {"metric": "m_per_sec", "value": 1.0,
+                          "history_record": {"canonical_per_sec": 42.0}}}
+        (tmp_path / "BENCH_r07.json").write_text(json.dumps(doc))
+        hist = pr.load_history(str(tmp_path / "BENCH_r*.json"))
+        assert hist == [("BENCH_r07", {"canonical_per_sec": 42.0}, None)]
+
+    def test_shape_fingerprint_fences_generate_series(self, tmp_path):
+        """A smoke-shape run must not gate against full-shape history:
+        lm_generate.* series draw only from same-fingerprint rounds."""
+        pr = _load_perf_regress()
+        big = dict(self.GEN_DOC, value=9000.0)
+        big["side_metrics"] = dict(
+            self.GEN_DOC["side_metrics"],
+            config={"batch": 32, "prompt_t": 512, "decode_steps": 64,
+                    "vocab": 32000})
+        small = dict(self.GEN_DOC)
+        small["side_metrics"] = dict(
+            self.GEN_DOC["side_metrics"],
+            config={"batch": 8, "prompt_t": 32, "decode_steps": 16,
+                    "vocab": 256})
+        for i in range(3):
+            (tmp_path / f"BENCH_r0{i}.json").write_text(
+                json.dumps({"parsed": big}))
+        hist = pr.load_history(str(tmp_path / "BENCH_r*.json"))
+        cur = pr.normalize_record(small)        # 4000 tok/s vs 9000
+        rep = pr.regression_report(
+            hist, cur, fingerprint=pr.record_fingerprint(small))
+        row = [r for r in rep["rows"]
+               if r["metric"] == "lm_generate.decode_tokens_per_sec"][0]
+        assert row["status"] == "no-history"    # fenced, not regressed
+        # the same current at the SAME shape DOES gate
+        rep = pr.regression_report(
+            hist, cur, fingerprint=pr.record_fingerprint(big))
+        row = [r for r in rep["rows"]
+               if r["metric"] == "lm_generate.decode_tokens_per_sec"][0]
+        assert row["status"] == "regression"
+
+    def test_no_duplicate_canonical_keys(self):
+        """A generate-mode doc emits ONE key per quantity: the bare
+        prefill/nocache side metrics fold into lm_generate.* instead of
+        forming parallel gating series."""
+        pr = _load_perf_regress()
+        doc = dict(self.GEN_DOC)
+        doc["side_metrics"] = dict(
+            self.GEN_DOC["side_metrics"],
+            nocache_recompute_tokens_per_sec={"value": 1682.0})
+        rec = pr.normalize_record(doc)
+        assert "prefill_tokens_per_sec" not in rec
+        assert "nocache_recompute_tokens_per_sec" not in rec
+        assert rec["lm_generate.prefill_tokens_per_sec"] == 90000.0
+        assert rec["lm_generate.nocache_recompute_tokens_per_sec"] \
+            == 1682.0
+
+    def test_bench_emits_history_record(self):
+        """bench.py's _attach_trajectory ships the normalized record +
+        verdict without touching the measured result."""
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "bench_mod", os.path.join(os.path.dirname(__file__),
+                                      "..", "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        result = dict(self.GEN_DOC)
+        out = bench._attach_trajectory(result)
+        assert out["history_record"][
+            "lm_generate.decode_tokens_per_sec"] == 4000.0
+        assert "perf_regress" in out
+        assert "ok" in out["perf_regress"] or \
+            "error" in out["perf_regress"]
