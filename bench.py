@@ -566,6 +566,36 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
     pfx_on = float(np.median([r[0] for r in pfx_on_runs]))
     pfx_hits, pfx_misses = pfx_on_runs[-1][1], pfx_on_runs[-1][2]
 
+    # ---- disaggregated-tier A/B (ISSUE 14): a smoke-shaped
+    # symmetric-vs-PhaseRouter burst-isolation run riding the same
+    # driver (scripts/perf_disagg.py is the full gating CLI; this side
+    # metric keeps the headline numbers in the bench trajectory so
+    # perf_regress tracks them round over round). BENCH_DISAGG=0 skips.
+    disagg_side = {"skipped": True}
+    if os.environ.get("BENCH_DISAGG", "1") not in ("0", "false", "no"):
+        try:
+            import importlib.util as _ilu
+            _spec = _ilu.spec_from_file_location(
+                "_bench_perf_disagg",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", "perf_disagg.py"))
+            _pd = _ilu.module_from_spec(_spec)
+            _spec.loader.exec_module(_pd)
+            _ab = _pd.run_ab(seed=0, shape={
+                "d_model": 128, "vocab": 128, "n_steady": 10,
+                "n_burst": 4, "burst_prompt": 256, "steady_gen": 32})
+            disagg_side = {
+                "value": _ab["steady_p99_improvement_x"],
+                "decode_tok_s_ratio": _ab["decode_tok_s_ratio"],
+                "transfer_kb_per_handoff":
+                    (_ab["disagg"].get("transfer") or {}).get(
+                        "kb_per_handoff"),
+                "transfer_exact":
+                    (_ab["disagg"].get("transfer") or {}).get("exact"),
+                "shape": _ab["shape"]}
+        except Exception as e:  # noqa: BLE001 — a side metric must not
+            disagg_side = {"error": str(e)[:200]}   # kill the bench run
+
     result = {
         "metric": "lm_generate_decode_tokens_per_sec",
         "value": round(dec_med, 2),
@@ -607,6 +637,7 @@ def _generate_protocol(SlotGenerationEngine, audit) -> dict:
                 if pfx_off > 0 else None,
                 "prefix_hits": pfx_hits,
                 "prefix_misses": pfx_misses},
+            "disagg": disagg_side,
             "config": {"batch": b, "prompt_t": tp, "decode_steps": steps,
                        "vocab": v},
         },

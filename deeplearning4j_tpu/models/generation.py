@@ -99,6 +99,11 @@ _ENGINE_COUNTERS = {
     "page_preempted": "requests preempted mid-decode on KV page-pool "
                       "pressure (re-queued at the head; exactly-once "
                       "preserved — re-admission re-prefills)",
+    "handoffs": "prefilled requests handed off to the disagg tier "
+                "(prefill-only engines: KV pages exported, request "
+                "leaves through the handoff sink)",
+    "adopted": "requests adopted with imported KV state (decode-only "
+               "engines: the disaggregated handoff receive path)",
 }
 #: unique per-engine metric label values (e0, e1, ...)
 _ENGINE_SEQ = itertools.count()
@@ -727,6 +732,34 @@ class TransformerDecoder:
                 in_specs=(psh, None, pool_sh, mat, row, row, row, row,
                           row, None, None, None),
                 out_specs=(mat, row, row, row, pool_sh))
+        elif name == "kv_export":
+            def kv_export_impl(caches, pids):
+                # gather ``pids``'s page contents out of every layer's
+                # pool — the device half of a KV handoff export
+                # (streaming/disagg). Page count is pow2-bucketed by
+                # the caller; pad rows gather the null/trash page and
+                # are sliced off on host. Read-only: no donation.
+                return {n: {kk: caches[n][kk][pids] for kk in ("k", "v")}
+                        for n in self.attn_names}
+            pool_sh = self._pool_shardings()
+            fn = self._jit_sharded(kv_export_impl, (),
+                                   in_specs=(pool_sh, None),
+                                   out_specs=None)
+        elif name == "kv_import":
+            def kv_import_impl(caches, pids, frames):
+                # scatter imported page frames into this pool — the
+                # receive half of a KV handoff. Pad rows target the
+                # null page: duplicate index-0 writes land in trash in
+                # unspecified order, which is exactly what the trash
+                # page is for.
+                return {n: {kk: caches[n][kk].at[pids].set(frames[n][kk])
+                            for kk in ("k", "v")}
+                        for n in self.attn_names}
+            pool_sh = self._pool_shardings()
+            fn = self._jit_sharded(kv_import_impl,
+                                   train_donate_argnums((0,)),
+                                   in_specs=(pool_sh, None, None),
+                                   out_specs=pool_sh)
         elif isinstance(name, tuple) and name[0] == "block":
             k_steps = int(name[1])
 
@@ -781,7 +814,9 @@ class TransformerDecoder:
         so the two views line up row for row."""
         base = {"prefill": "prefill_impl", "step": "decode_step_impl",
                 "prefill_slots": "prefill_slots_impl",
-                "paged_prefill": "paged_prefill_impl"}.get(name)
+                "paged_prefill": "paged_prefill_impl",
+                "kv_export": "kv_export_impl",
+                "kv_import": "kv_import_impl"}.get(name)
         if base is None and isinstance(name, tuple) and name[0] == "block":
             base = f"decode_block{int(name[1])}_impl"
         if base is None and isinstance(name, tuple) and name[0] == "chunk":
@@ -909,6 +944,22 @@ class TransformerDecoder:
             jnp.asarray(stopped, jnp.bool_), jnp.asarray(temps),
             jnp.asarray(eos), key, jnp.asarray(step0, jnp.int32),
             jnp.asarray(key_salt, jnp.int32))
+
+    def kv_export(self, caches, pids):
+        """Gather page contents ({layer: {"k","v"} [n, H, page_size,
+        Dh]}) off the paged pools — the device half of a disaggregated
+        KV handoff (streaming/disagg). ``pids`` should arrive
+        pow2-bucketed (pad with the null page) so the signature set
+        stays finite; the pools are read, never donated."""
+        return self._fn("kv_export")(caches, jnp.asarray(pids, jnp.int32))
+
+    def kv_import(self, caches, pids, frames):
+        """Scatter imported page frames into the paged pools at
+        ``pids`` (donating the old pools) — the receive half of a KV
+        handoff. Same bucketing contract as :meth:`kv_export`; pad
+        rows target the null/trash page."""
+        return self._fn("kv_import")(caches, jnp.asarray(pids, jnp.int32),
+                                     frames)
 
     # ----------------------------------------------------------- generate
     def generate(self, prompts: Sequence, max_new_tokens: int,
@@ -1283,7 +1334,8 @@ class SlotGenerationEngine:
                  paged: bool = False, page_size: int = 16,
                  num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
-                 profiler=None, profiling: Optional[bool] = None):
+                 profiler=None, profiling: Optional[bool] = None,
+                 phase: str = "both", handoff=None):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -1389,6 +1441,29 @@ class SlotGenerationEngine:
                                         prefix_cache=self.prefix_cache)
         self.num_pages = None if self._pager is None \
             else self._pager.num_pages
+        # ---- phase specialization (disaggregated serving tier) ----
+        # "prefill": this engine fills KV pages and hands every
+        # non-finished request to the ``handoff`` sink (the disagg
+        # router) instead of decoding it; "decode": fresh prompts are
+        # rejected (the router never sends any) and requests arrive
+        # through adopt() with their KV state imported. Pages are the
+        # transfer unit, so both roles require the paged cache.
+        # Recovery re-prefill (supervisor requeue) stays allowed on
+        # decode engines — role purity is a ROUTING contract, not a
+        # capability cut.
+        if phase not in ("both", "prefill", "decode"):
+            raise ValueError(f"phase must be 'both', 'prefill' or "
+                             f"'decode', got {phase!r}")
+        if phase != "both" and self._pager is None:
+            raise ValueError("phase-specialized engines need paged=True: "
+                             "KV pages are the handoff transfer unit")
+        self.phase = phase
+        self._handoff = handoff
+        # handoff-received (request, PageFrameSet) pairs awaiting a free
+        # slot + page import, admitted by the serve loop ahead of the
+        # prefill queue (they are mid-stream — their tokens are already
+        # flowing to a caller)
+        self._adopted: collections.deque = collections.deque()
         if self._pager is not None:
             self._caches = self.decoder.init_paged_pool(
                 self._pager.num_pages, self.page_size)
@@ -1526,9 +1601,19 @@ class SlotGenerationEngine:
         # reference: the process-default registry must never keep a dead
         # engine (and its device caches) alive
         wself = weakref.ref(self)
-        reg.gauge("generation_queue_depth", "pending requests queued",
+        reg.gauge("generation_queue_depth", "pending requests queued "
+                  "(incl. adopted handoffs awaiting a slot)",
                   ("engine",)).labels(self.engine_id).set_function(
-            lambda: (lambda s: 0 if s is None else len(s._pending))(wself()))
+            lambda: (lambda s: 0 if s is None else
+                     len(s._pending) + len(s._adopted))(wself()))
+        if self.phase != "both":
+            # phase-specialized role marker (disagg tier): the scrape
+            # view derives each replica's P/D column from this family
+            reg.gauge("generation_engine_role",
+                      "phase-specialized engine role (1 = this engine "
+                      "serves the labeled role)",
+                      ("engine", "role")).labels(
+                self.engine_id, self.phase).set(1)
         reg.gauge("generation_active_slots",
                   "cache slots decoding or chunk-prefilling",
                   ("engine",)).labels(self.engine_id).set_function(
@@ -1638,6 +1723,16 @@ class SlotGenerationEngine:
             # must learn the engine is gone even for no-op requests
             req._fail(dead or RuntimeError(
                 "SlotGenerationEngine shut down"))
+            return req
+        if self.phase == "decode":
+            # routing-contract safety net: the disagg router dispatches
+            # fresh prompts to PREFILL workers only; a prompt landing
+            # here is a router bug, not a degradation to absorb.
+            # (requeue()/adopt() remain open — recovery re-prefill and
+            # the handoff receive are this role's legitimate intakes.)
+            req._fail(RuntimeError(
+                "decode-only engine: fresh prompts belong on a prefill "
+                "worker (handoff receives arrive via adopt())"))
             return req
         if len(req.prompt) < 1:
             req._fail(ValueError("empty prompt"))
@@ -1939,6 +2034,298 @@ class SlotGenerationEngine:
         for s in range(self.num_slots):
             self._release_slot_pages(s)
 
+    # ------------------------------------------------- disagg handoff
+    def _export_pages(self, pids: List[int]) -> Dict:
+        """Gather ``pids``'s page contents to host numpy (pow2-bucketed
+        ``kv_export_impl`` dispatch; pad rows gather the trash page and
+        are sliced off). 2·layers readbacks, all under the
+        ``kv_handoff`` transfer tag — a handoff is one export, not one
+        decode block, so the ≤1-readback-per-block audit is untouched."""
+        nb = _round_up_pow2(len(pids), floor=1)
+        pad = np.zeros(nb, np.int32)
+        pad[:len(pids)] = pids
+        tree = self.decoder.kv_export(self._caches, pad)
+        return {n: {kk: device_fetch(kv[kk],
+                                     tag="kv_handoff")[:len(pids)]
+                    for kk in ("k", "v")}
+                for n, kv in tree.items()}
+
+    def _import_pages(self, pids: List[int], frames: Dict) -> None:
+        """Scatter host page frames into this pool at ``pids``
+        (pow2-bucketed ``kv_import_impl``; pad rows write the trash
+        page). Serve-loop thread only — the pools are donated per
+        dispatch like every other impl."""
+        nb = _round_up_pow2(len(pids), floor=1)
+        pad = np.zeros(nb, np.int32)
+        pad[:len(pids)] = pids
+        dev = {}
+        for n, kv in frames.items():
+            dev[n] = {}
+            for kk in ("k", "v"):
+                arr = np.asarray(kv[kk])
+                if len(pids) != nb:
+                    buf = np.zeros((nb,) + arr.shape[1:], arr.dtype)
+                    buf[:len(pids)] = arr
+                    arr = buf
+                dev[n][kk] = jnp.asarray(arr)
+        # _caches is serve-loop-thread-owned (every dispatch site
+        # threads the donated pools the same way); the analyzer can't
+        # see the single-thread ownership contract
+        self._caches = self.decoder.kv_import(  # graftlint: disable=GL006
+            self._caches, pad, dev)
+
+    def _handoff_one(self, req: GenerationRequest, s: int,
+                     ctx: np.ndarray) -> None:
+        """Export slot ``s``'s KV pages and pass the request to the
+        disagg handoff sink (prefill-only engines; serve-loop thread).
+        The request holds its first token already; the frames cover the
+        context cells ``[0, len(ctx))`` the receiver's decode attends.
+        Quarantine/shutdown racing the export: the drain owns the
+        request (and released the pages) — ship nothing."""
+        from .paging import PageFrameSet
+        ps = self.page_size
+        n_xfer = (len(ctx) - 1) // ps + 1
+        with self._lock:
+            if self._quarantined or self._shutdown:
+                return
+            pages = list(self._slot_pages[s][:n_xfer])
+        t0 = interval_now()
+        frames = self._export_pages(pages)
+        t1 = interval_now()
+        state = PageFrameSet(ps, ctx, frames)
+        cancelled = req._cancel_requested
+        with self._lock:
+            if self._quarantined or self._shutdown:
+                return          # drain released the mapping already
+            self._release_slot_pages(s)
+            if cancelled:
+                self._m["cancelled"].inc()
+            else:
+                self._m["handoffs"].inc()
+        if cancelled:
+            req._fail(Cancelled("cancelled at prefill handoff"))
+            return
+        if req.trace is not None:
+            req.trace.add_span("kv_export", t0, t1, pages=len(pages),
+                               bytes=state.nbytes)
+        if self._tracing:
+            self._flightrec.record(
+                "kv_handoff", engine=self.engine_id, stage="export",
+                pages=len(pages), bytes=state.nbytes,
+                ms=round((t1 - t0) * 1e3, 3))
+        sink = self._handoff
+        if sink is None:
+            # a prefill-only engine without a tier wired must not
+            # strand its caller in result(None) forever
+            req._fail(RuntimeError(
+                "prefill-only engine has no handoff sink"))
+            return
+        try:
+            sink(req, state)
+        except Exception as exc:   # noqa: BLE001 — a broken sink must
+            req._fail(exc)         # not kill the serve loop
+
+    def adopt(self, req: GenerationRequest, kv) -> None:
+        """Adopt a prefilled request WITH its exported KV state — the
+        decode-side intake of the disaggregated handoff. ``kv``
+        duck-types :class:`models.paging.PageFrameSet` (``page_size``,
+        ``tokens``, ``layers``). Geometry is validated synchronously
+        (:class:`ValueError` — the router's fall-back-to-re-prefill
+        seam); the import itself runs on the serve loop: pages allocate
+        from THIS pool (resident same-content chains are reused
+        read-only — the decode-side shared-prefix tier), frames scatter
+        in, and decode resumes token-identically at position
+        ``len(kv.tokens)``. Like ``requeue``, adoption bypasses
+        admission control: inherited mid-stream work is never shed by a
+        queue bound (pool pressure still applies)."""
+        if self._pager is None:
+            raise ValueError("adopt() needs a paged engine (pages are "
+                             "the handoff transfer unit)")
+        if int(kv.page_size) != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: frames carry {kv.page_size}, this "
+                f"pool uses {self.page_size} — disaggregated roles must "
+                "share one page geometry")
+        for n, pool in self._caches.items():
+            lf = kv.layers.get(n)
+            if lf is None:
+                raise ValueError(f"page frames missing attention vertex "
+                                 f"{n!r}")
+            for kk in ("k", "v"):
+                arr = lf[kk]
+                want = tuple(int(x) for x in pool[kk].shape[1:])
+                if tuple(int(x) for x in np.shape(arr)[1:]) != want:
+                    raise ValueError(
+                        f"frame shape {tuple(np.shape(arr))} does not "
+                        f"match pool page geometry {want} at {n!r}")
+                if np.dtype(arr.dtype) != np.dtype(pool[kk].dtype):
+                    raise ValueError(
+                        f"frame dtype {arr.dtype} != pool dtype "
+                        f"{pool[kk].dtype} at {n!r}")
+        expect = len(req.prompt) + len(req.generated) - 1
+        if len(kv.tokens) != expect:
+            raise ValueError(
+                f"frame set covers {len(kv.tokens)} context tokens; the "
+                f"request resumes at {expect}")
+        if req.trace is not None:
+            req.trace.event("adopt", engine=self.engine_id,
+                            ctx=len(kv.tokens))
+        # SLO continuity: same contract as requeue — re-point the sink
+        # and replica label, never touch the created/admitted/first-
+        # token clocks (the handoff must not reset any SLO clock)
+        if not req._slo_done:
+            req._slo = self._slo
+        req._slo_labels = dict(req._slo_labels or {},
+                               replica=self.slo_label)
+        req._submit_t = interval_now()
+        with self._lock:
+            dead = self._dead
+            alive = not (self._shutdown or dead is not None)
+            if alive:
+                req._running = False
+                req._engine = self
+                self._adopted.append((req, kv))
+                self._m["adopted"].inc()
+        if not alive:
+            req._fail(dead or RuntimeError(
+                "SlotGenerationEngine shut down"))
+            return
+        jr = self._journal
+        if jr is not None and req.journal_id is not None:
+            # hop marker, like a takeover: replay-inert, forensically
+            # visible — the WAL shows where the stream changed workers
+            jr.requeued(req)
+            self._hook_journal(req)
+        self._work.set()
+
+    def _admit_adopted(self):
+        """Admit adopted handoffs (serve-loop thread): map + import
+        each request's KV pages into this pool and install decode state
+        directly — NO prefill dispatch; the shipped pages ARE the
+        prefill. Resident same-content chains are reused read-only
+        (match_and_ref) and only the remaining frames scatter in."""
+        ps = self.page_size
+        while True:
+            entry = None
+            with self._lock:
+                if self._adopted and not (self._quarantined or
+                                          self._shutdown):
+                    free = [s for s in range(self.num_slots)
+                            if self._slots[s] is None and
+                            s not in self._chunking and
+                            not self._slot_pages[s]]
+                    if free:
+                        req, kv = self._adopted.popleft()
+                        self._admitting.append(req)
+                        entry = (free[0], req, kv)
+            if entry is None:
+                return
+            s, req, kv = entry
+            exc = None
+            if req._cancel_requested:
+                exc = Cancelled("cancelled before adoption")
+            elif req._expired():
+                exc = DeadlineExceeded(
+                    f"deadline of {req.deadline}s passed in handoff")
+            if exc is not None:
+                with self._lock:
+                    if not self._unpark(req):
+                        return
+                    self._m["cancelled" if isinstance(exc, Cancelled)
+                            else "deadline_exceeded"].inc()
+                req._fail(exc)
+                continue
+            tokens = np.asarray(kv.tokens, np.int32).reshape(-1)
+            n_ctx = len(tokens)
+            total = n_ctx // ps + 1     # incl. the next write cell
+            shared, start = self._pager.match_and_ref(tokens,
+                                                      max_tokens=n_ctx)
+            fresh = self._pager.alloc(total - len(shared))
+            if fresh is None:
+                for pid in shared:
+                    self._pager.unref(pid)
+                # pool-exhausted receiver: with work in flight, wait at
+                # the head (completions free pages); with nothing in
+                # flight this pool can NEVER hold the import — shed,
+                # and the router's completion gate sees the rejection
+                requeued = False
+                with self._lock:
+                    if not self._unpark(req):
+                        return
+                    if any(r is not None for r in self._slots) or \
+                            self._chunking:
+                        self._adopted.appendleft((req, kv))
+                        requeued = True
+                    else:
+                        self._m["rejected"].inc()
+                if requeued:
+                    return
+                self._flightrec.record(
+                    "shed", engine=self.engine_id, reason="kv_pool_adopt",
+                    pages_needed=total - len(shared))
+                req._fail(RejectedError(
+                    f"KV page pool exhausted on handoff receive: "
+                    f"{total - len(shared)} pages needed, none free "
+                    "after eviction and nothing in flight to free one"))
+                continue
+            pages = shared + fresh
+            n_xfer = min((n_ctx - 1) // ps + 1, int(kv.n_pages))
+            import_idx = list(range(len(shared), n_xfer))
+            t0 = interval_now()
+            if import_idx:
+                frames = {n: {kk: np.asarray(lf[kk])[import_idx]
+                              for kk in ("k", "v")}
+                          for n, lf in kv.layers.items()}
+                self._import_pages([pages[j] for j in import_idx],
+                                   frames)
+            t1 = interval_now()
+            finish = None
+            with self._lock:
+                if self._quarantined or self._shutdown or \
+                        not self._unpark(req):
+                    # the drain owns the request; our unmapped refs go
+                    # back now so its harvest audits balanced
+                    for pid in pages:
+                        self._pager.unref(pid)
+                    return
+                self._map_slot_pages(s, pages)
+                # the imported context's full pages become shareable:
+                # a second stream with the same prefix adopted here
+                # maps them instead of importing its own copies
+                self._pager.register_chain(tokens,
+                                           pages[:n_ctx // ps])
+                if req._admitted_t is None:
+                    req._admitted_t = t0
+                tok = int(req.generated[-1])
+                if len(req.prompt) + len(req.generated) >= self.t_max \
+                        or len(req.generated) >= req.max_new_tokens:
+                    # defensive: senders complete finishers themselves
+                    self._m["completed"].inc()
+                    finish = req
+                    self._release_slot_pages(s)
+                else:
+                    self._slots[s] = req
+                    req._running = True
+                    self._last_ids[s] = tok
+                    self._positions[s] = n_ctx
+                    self._temps[s] = req.temperature
+                    self._eos_ids[s] = -1 if req.eos_id is None \
+                        else int(req.eos_id)
+                    self._carry = None    # pipeline resync: new lane
+            if req.trace is not None:
+                req.trace.add_span("queued", req._submit_t, t0)
+                req.trace.add_span("kv_import", t0, t1,
+                                   pages=len(import_idx),
+                                   shared_pages=len(shared),
+                                   shared_tokens=start)
+            if self._tracing:
+                self._flightrec.record(
+                    "kv_handoff", engine=self.engine_id, stage="import",
+                    pages=len(import_idx), shared=len(shared),
+                    ms=round((t1 - t0) * 1e3, 3))
+            if finish is not None:
+                finish._complete()
+
     def _ensure_decode_pages_locked(self, k: int
                                     ) -> List[GenerationRequest]:
         """Grow each active lane's page table to cover this block's
@@ -2066,6 +2453,21 @@ class SlotGenerationEngine:
                     else:
                         keep.append(req)
                 self._pending = keep
+            if self._adopted:
+                keep_a: collections.deque = collections.deque()
+                for req, kv in self._adopted:
+                    if req._cancel_requested:
+                        self._m["cancelled"].inc()
+                        doomed.append((req, Cancelled(
+                            "cancelled while awaiting adoption")))
+                    elif req._expired(now):
+                        self._m["deadline_exceeded"].inc()
+                        doomed.append((req, DeadlineExceeded(
+                            f"deadline of {req.deadline}s passed while "
+                            "awaiting adoption")))
+                    else:
+                        keep_a.append((req, kv))
+                self._adopted = keep_a
         for req, exc in doomed:
             req._fail(exc)
 
@@ -2209,8 +2611,11 @@ class SlotGenerationEngine:
         Count and prompt-length are both pow2-bucketed; padded rows
         replicate row 0 (identical scatter → harmless write ordering).
         Paged engines route to :meth:`_admit_paged` — same gates, same
-        bucketing, page-table mapping + prefix-cache matching on top."""
+        bucketing, page-table mapping + prefix-cache matching on top.
+        Adopted handoffs (decode role) admit FIRST: they are mid-stream
+        work whose callers are already consuming tokens."""
         if self._pager is not None:
+            self._admit_adopted()
             return self._admit_paged()
         while True:
             with self._lock:
@@ -2487,6 +2892,7 @@ class SlotGenerationEngine:
             toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
             t_pre1 = interval_now()
             finishers: List[GenerationRequest] = []
+            handoffs: List[Tuple[GenerationRequest, int, np.ndarray]] = []
             jlog: List[Tuple] = []
             with self._lock:
                 if self._shutdown or self._quarantined:
@@ -2526,6 +2932,12 @@ class SlotGenerationEngine:
                         finishers.append(req)   # done at the first token
                         self._release_slot_pages(s)  # registration
                         #            above keeps its prompt pages cached
+                    elif self.phase == "prefill":
+                        # phase-specialized worker: this request never
+                        # decodes HERE — its pages stay mapped (the slot
+                        # stays reserved via _slot_pages) until the
+                        # export below ships them to a decode worker
+                        handoffs.append((req, s, ctx))
                     else:
                         self._slots[s] = req
                         self._last_ids[s] = tok
@@ -2553,6 +2965,13 @@ class SlotGenerationEngine:
                     impl=self._prof_impl("prefill"), count=m,
                     t_dispatch=t_pre0, t_fetched=t_pre1, t_host=t_host,
                     t_journal=t_journal, t_publish=interval_now())
+            # prefill-role handoffs run AFTER the wave's bookkeeping,
+            # still on this serve-loop thread: each export gathers the
+            # slot's pages, releases them, and hands the request to the
+            # disagg sink before the next admission round can reuse the
+            # slot
+            for req, s, ctx in handoffs:
+                self._handoff_one(req, s, ctx)
             if drained or blocked:
                 return
 
@@ -2686,6 +3105,7 @@ class SlotGenerationEngine:
                                     final=final)
         jlog: List[Tuple] = []
         finish = None
+        handoff_entry = None
         with self._lock:
             if self._quarantined or self._shutdown:
                 return      # the takeover harvest owns the request now
@@ -2716,6 +3136,11 @@ class SlotGenerationEngine:
                     self._m["completed"].inc()
                     finish = req
                     self._release_slot_pages(s)
+                elif self.phase == "prefill":
+                    # chunked long prompt on a prefill worker: the
+                    # final window's token is the handoff point — pages
+                    # stay mapped for the export below
+                    handoff_entry = (req, s, ctx)
                 else:
                     self._slots[s] = req
                     self._last_ids[s] = tok
@@ -2734,6 +3159,8 @@ class SlotGenerationEngine:
             self._journal.retired(jlog)
         if finish is not None:
             finish._complete()
+        if handoff_entry is not None:
+            self._handoff_one(*handoff_entry)
 
     def _any_active(self) -> bool:
         return any(r is not None for r in self._slots) or \
@@ -3059,6 +3486,11 @@ class SlotGenerationEngine:
                                 # engine's heartbeat when it wakes
             harvested.extend(self._admitting)
             self._admitting = []
+            # adopted handoffs not yet in a slot: recovery re-prefills
+            # them from prompt + generated (their shipped frames are
+            # dropped — deterministic re-prefill regenerates the KV)
+            harvested.extend(r for r, _ in self._adopted)
+            self._adopted.clear()
             for s in sorted(self._chunking):
                 # mid-chunk prefill: recovery re-prefills from scratch
                 # (no tokens were emitted yet), deterministically
@@ -3093,7 +3525,9 @@ class SlotGenerationEngine:
         out["prefix_cache_misses"] = int(self._m_prefix_miss.value)
         out["prefix_cache_hit_tokens"] = int(self._m_prefix_tokens.value)
         with self._lock:
-            out["queue_depth"] = len(self._pending)
+            # adopted handoffs awaiting a slot ARE queued work: the
+            # disagg router's least-loaded decode dispatch reads this
+            out["queue_depth"] = len(self._pending) + len(self._adopted)
             out["active_slots"] = sum(r is not None
                                       for r in self._slots) + \
                 len(self._chunking)
@@ -3114,7 +3548,7 @@ class SlotGenerationEngine:
             self._sweep_pending()
             self._admit()
             if not self._any_active():
-                if not self._pending:
+                if not self._pending and not self._adopted:
                     return
                 continue                      # wave finished at token 1
             while self._any_active():
@@ -3163,6 +3597,8 @@ class SlotGenerationEngine:
             with self._lock:
                 doomed.extend(self._admitting)
                 self._admitting = []
+                doomed.extend(r for r, _ in self._adopted)
+                self._adopted.clear()
                 for s in sorted(self._chunking):
                     doomed.append(self._chunking[s][0])
                 self._chunking = {}
@@ -3204,6 +3640,8 @@ class SlotGenerationEngine:
                 "SlotGenerationEngine shut down")
             doomed.extend(self._admitting)
             self._admitting = []
+            doomed.extend(r for r, _ in self._adopted)
+            self._adopted.clear()
             for s in sorted(self._chunking):
                 doomed.append(self._chunking[s][0])
             self._chunking = {}

@@ -45,7 +45,10 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import json
+import struct
 import threading
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -362,3 +365,213 @@ class PageAllocator:
                 problems.append(f"{live} pages off the free list but "
                                 f"{held} pages held")
         return problems
+
+
+# --------------------------------------------------------- page frames
+class PageFrameError(ValueError):
+    """A page-frame payload failed validation (bad magic/version, CRC
+    mismatch, truncated buffer, or geometry that does not match the
+    receiving pool)."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype NAME back to numpy, including the ml_dtypes
+    extension types (bfloat16) a low-precision pool serializes as."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp
+        return np.dtype(jnp.dtype(name))
+
+
+def _pack_buf(raw: bytes) -> bytes:
+    return struct.pack("<QI", len(raw), zlib.crc32(raw)) + raw
+
+
+def _unpack_buf(data: bytes, off: int) -> Tuple[bytes, int]:
+    if off + 12 > len(data):
+        raise PageFrameError("page frame truncated in buffer header")
+    n, crc = struct.unpack_from("<QI", data, off)
+    off += 12
+    if off + n > len(data):
+        raise PageFrameError("page frame truncated in buffer body")
+    raw = data[off:off + n]
+    if zlib.crc32(raw) != crc:
+        raise PageFrameError("page frame CRC mismatch — corrupt buffer")
+    return raw, off + n
+
+
+class PageFrameSet:
+    """Host-side snapshot of one context's KV pages — the unit a
+    disaggregated prefill→decode handoff ships (``streaming/disagg``).
+
+    ``layers`` maps each attention vertex to ``{"k", "v"}`` arrays of
+    shape ``[n_pages, H, page_size, Dh]``: page ``j`` holds the KV
+    written for tokens ``[j*page_size, (j+1)*page_size)`` of
+    ``tokens`` (the context the frames cover — prompt + any resumed
+    generation, exactly the positions the receiver's decode will
+    attend). The last page may be partially written; its tail cells
+    are don't-care garbage masked out by length-masked attention, and
+    they ship as-is so export→import is byte-identical page-for-page.
+
+    Two wire encodings, both CRC-framed and versioned:
+
+    - :meth:`to_bytes` / :meth:`from_bytes` — one bulk buffer (the
+      simple broker-payload form);
+    - :meth:`to_frames` / :meth:`from_frames` — a header frame plus ONE
+      frame per page, so a streaming transport can ship pages as the
+      sender produces them and overlap the wire with prefill compute
+      (µ-cuDNN's micro-chunking idea applied to the transfer; the
+      "Densifying Assumed-sparse Tensors" warning is why the framing
+      is measured — every byte is counted by the shipping router).
+
+    The in-process fast path never serializes: the SAME object crosses
+    by reference (:class:`streaming.disagg.InProcessKVTransport`)."""
+
+    MAGIC = b"DKVF"
+    FRAME_MAGIC = b"DKVP"
+    VERSION = 1
+
+    def __init__(self, page_size: int, tokens: Sequence,
+                 layers: Dict[str, Dict[str, np.ndarray]]):
+        self.page_size = int(page_size)
+        self.tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32).reshape(-1))
+        self.layers = {str(n): {kk: np.ascontiguousarray(kv[kk])
+                                for kk in ("k", "v")}
+                       for n, kv in layers.items()}
+        if not self.layers:
+            raise PageFrameError("PageFrameSet needs >= 1 layer")
+        first = next(iter(self.layers.values()))["k"]
+        self.n_pages = int(first.shape[0])
+        self.dtype = str(first.dtype)
+        for n, kv in self.layers.items():
+            for kk in ("k", "v"):
+                a = kv[kk]
+                if a.ndim != 4 or int(a.shape[0]) != self.n_pages or \
+                        int(a.shape[2]) != self.page_size:
+                    raise PageFrameError(
+                        f"layer {n!r} {kk} frames have shape "
+                        f"{tuple(a.shape)}; expected [{self.n_pages}, H, "
+                        f"{self.page_size}, Dh]")
+
+    # ------------------------------------------------------------- views
+    @property
+    def nbytes(self) -> int:
+        """Exact payload bytes a handoff moves (tokens + every page
+        frame) — what ``kv_transfer_bytes_total`` counts, gated against
+        devstats' per-page pool accounting in ``perf_disagg``."""
+        return int(self.tokens.nbytes) + sum(
+            int(kv[kk].nbytes) for kv in self.layers.values()
+            for kk in ("k", "v"))
+
+    def _header(self) -> Dict:
+        return {"v": self.VERSION, "page_size": self.page_size,
+                "n_ctx": len(self.tokens), "n_pages": self.n_pages,
+                "dtype": self.dtype,
+                "layers": {n: list(map(int, kv["k"].shape[1:]))
+                           for n, kv in self.layers.items()}}
+
+    # ------------------------------------------------------ bulk encoding
+    def to_bytes(self) -> bytes:
+        head = json.dumps(self._header(), sort_keys=True).encode()
+        parts = [self.MAGIC, struct.pack("<II", self.VERSION, len(head)),
+                 head, _pack_buf(self.tokens.tobytes())]
+        for n in sorted(self.layers):
+            for kk in ("k", "v"):
+                parts.append(_pack_buf(self.layers[n][kk].tobytes()))
+        return b"".join(parts)
+
+    @classmethod
+    def _parse_header(cls, data: bytes, magic: bytes) -> Tuple[Dict, int]:
+        if data[:4] != magic:
+            raise PageFrameError(f"bad page-frame magic {data[:4]!r}")
+        ver, hlen = struct.unpack_from("<II", data, 4)
+        if ver != cls.VERSION:
+            raise PageFrameError(f"page-frame version {ver} unsupported "
+                                 f"(this build speaks {cls.VERSION})")
+        try:
+            head = json.loads(data[12:12 + hlen])
+        except ValueError as e:
+            raise PageFrameError(f"unparseable page-frame header: {e}")
+        return head, 12 + hlen
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PageFrameSet":
+        head, off = cls._parse_header(data, cls.MAGIC)
+        dt = _np_dtype(head["dtype"])
+        raw, off = _unpack_buf(data, off)
+        tokens = np.frombuffer(raw, np.int32)
+        if len(tokens) != int(head["n_ctx"]):
+            raise PageFrameError("token buffer does not match header")
+        layers = {}
+        for n in sorted(head["layers"]):
+            shape = (int(head["n_pages"]),) + \
+                tuple(int(x) for x in head["layers"][n])
+            kv = {}
+            for kk in ("k", "v"):
+                raw, off = _unpack_buf(data, off)
+                arr = np.frombuffer(raw, dt)
+                if arr.size != int(np.prod(shape)):
+                    raise PageFrameError(
+                        f"layer {n!r} {kk} buffer does not match header "
+                        f"shape {shape}")
+                kv[kk] = arr.reshape(shape)
+            layers[n] = kv
+        return cls(int(head["page_size"]), tokens, layers)
+
+    # ------------------------------------------------- per-page streaming
+    def to_frames(self) -> List[bytes]:
+        """Header frame + one frame per page, in fill order — the
+        streaming encoding: a transport can put each frame on the wire
+        as soon as its page is final, overlapping transfer with the
+        prefill compute still filling later pages."""
+        head = json.dumps(self._header(), sort_keys=True).encode()
+        out = [self.MAGIC + struct.pack("<II", self.VERSION, len(head)) +
+               head + _pack_buf(self.tokens.tobytes())]
+        for j in range(self.n_pages):
+            parts = [self.FRAME_MAGIC, struct.pack("<I", j)]
+            for n in sorted(self.layers):
+                for kk in ("k", "v"):
+                    parts.append(_pack_buf(self.layers[n][kk][j].tobytes()))
+            out.append(b"".join(parts))
+        return out
+
+    @classmethod
+    def from_frames(cls, frames: Sequence[bytes]) -> "PageFrameSet":
+        if not frames:
+            raise PageFrameError("empty page-frame stream")
+        head, off = cls._parse_header(frames[0], cls.MAGIC)
+        dt = _np_dtype(head["dtype"])
+        raw, _ = _unpack_buf(frames[0], off)
+        tokens = np.frombuffer(raw, np.int32)
+        n_pages = int(head["n_pages"])
+        if len(frames) != n_pages + 1:
+            raise PageFrameError(f"page-frame stream carries "
+                                 f"{len(frames) - 1} pages; header "
+                                 f"promises {n_pages}")
+        layers = {n: {kk: np.zeros((n_pages,) + tuple(int(x) for x in sh),
+                                   dt)
+                      for kk in ("k", "v")}
+                  for n, sh in head["layers"].items()}
+        seen = set()
+        for fr in frames[1:]:
+            if fr[:4] != cls.FRAME_MAGIC:
+                raise PageFrameError(f"bad page frame magic {fr[:4]!r}")
+            (j,) = struct.unpack_from("<I", fr, 4)
+            if j >= n_pages or j in seen:
+                raise PageFrameError(f"page frame index {j} out of range "
+                                     "or duplicated")
+            seen.add(j)
+            off = 8
+            for n in sorted(head["layers"]):
+                for kk in ("k", "v"):
+                    raw, off = _unpack_buf(fr, off)
+                    page = layers[n][kk][j]
+                    arr = np.frombuffer(raw, dt)
+                    if arr.size != page.size:
+                        raise PageFrameError(
+                            f"page {j} layer {n!r} {kk} buffer size "
+                            "mismatch")
+                    layers[n][kk][j] = arr.reshape(page.shape)
+        return cls(int(head["page_size"]), tokens, layers)

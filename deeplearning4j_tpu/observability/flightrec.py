@@ -46,7 +46,8 @@ EVENT_KINDS = ("admission", "block_retire", "shed", "takeover",
                "migration", "reconnect", "fault", "crash",
                "replica_dead", "postmortem", "journal", "recovered",
                "preempt", "prefill_chunk", "scale_up", "descale",
-               "autoscale", "page_preempt")
+               "autoscale", "page_preempt", "kv_handoff",
+               "handoff_fenced", "handoff_failed")
 
 
 class FlightRecorder:

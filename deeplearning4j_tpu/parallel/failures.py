@@ -317,7 +317,13 @@ class EngineSupervisor(HeartbeatMonitor):
             # phase profiler (ISSUE 13): same profiler, same stable
             # channel key (slo_label) — the phase account and the
             # timeline ring continue across the rebuild
-            profiler=old._profiler, profiling=old._profiling)
+            profiler=old._profiler, profiling=old._profiling,
+            # disaggregated role (ISSUE 14): a restarted prefill/decode
+            # worker keeps its phase AND its handoff sink — requeued
+            # prefill work re-prefills and hands off again, adopted
+            # decode work re-prefills locally (the documented recovery
+            # escape hatch)
+            phase=old.phase, handoff=old._handoff)
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)
@@ -334,6 +340,15 @@ class EngineSupervisor(HeartbeatMonitor):
         with self._sup_lock:
             eng = self._current_engine()
             return eng.submit(*args, **kwargs)
+
+    def adopt(self, req, kv) -> None:
+        """Adopt a KV handoff through the CURRENT engine (disagg
+        decode-role intake) — serialized against takeovers like
+        ``submit``, so imported state never lands in an engine a
+        restart is about to replace."""
+        with self._sup_lock:
+            eng = self._current_engine()
+            eng.adopt(req, kv)
 
     def requeue(self, req) -> None:
         """Re-queue a recovered request through the CURRENT engine — the

@@ -84,9 +84,14 @@ class RejectedError(RuntimeError):
 #: migrated immediately). Fleet chaos schedules stay deterministic by
 #: arming ONE injector per replica — concurrent replicas never interleave
 #: on a shared hit counter.
+#: The disagg tier (streaming/disagg.py) fires ``disagg.ship`` once per
+#: KV handoff on the router's handoff thread, BEFORE the transport
+#: moves any byte (raise = mid-handoff transport failure → the request
+#: re-prefills on a surviving prefill worker, exactly-once under the
+#: ledger fence).
 POINTS = ("engine.step", "engine.prefill", "broker.send", "broker.recv",
           "route.publish", "route.consume", "fleet.dispatch",
-          "fleet.heartbeat", "replica.kill")
+          "fleet.heartbeat", "replica.kill", "disagg.ship")
 
 
 class _NullInjector:

@@ -3,6 +3,8 @@ NDArray pub/sub and model serving — NDArrayKafkaClient, DL4jServeRouteBuilder;
 SURVEY.md §2.4)."""
 
 from .autoscale import BurnRateAutoscaler
+from .disagg import (InProcessKVTransport, KVTransport, KVTransportError,
+                     PhaseAutoscaler, PhaseRouter, SerializedKVTransport)
 from .fleet import (EngineFleetRouter, EngineReplica, FleetLedger,
                     FleetMembership, FleetRequest, KVFleetMembership)
 from .journal import (RecoveryReport, RequestJournal, recover_from_journal,
@@ -18,4 +20,6 @@ __all__ = ["MessageBroker", "NDArrayPublisher", "NDArraySubscriber",
            "FleetLedger", "FleetMembership", "FleetRequest",
            "KVFleetMembership", "RequestJournal", "RecoveryReport",
            "recover_from_journal", "replay_journal",
-           "BurnRateAutoscaler"]
+           "BurnRateAutoscaler", "PhaseRouter", "PhaseAutoscaler",
+           "KVTransport", "KVTransportError", "InProcessKVTransport",
+           "SerializedKVTransport"]

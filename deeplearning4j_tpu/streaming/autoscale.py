@@ -69,11 +69,25 @@ class BurnRateAutoscaler:
                  up_consecutive: int = 2, down_consecutive: int = 4,
                  cooldown_s: float = 2.0, interval: float = 0.25,
                  drain_budget: float = 10.0,
-                 registry=None, flight_recorder=None):
+                 registry=None, flight_recorder=None,
+                 role: Optional[str] = None):
         if not 1 <= int(min_replicas) <= int(max_replicas):
             raise ValueError(f"need 1 <= min_replicas <= max_replicas, "
                              f"got {min_replicas}..{max_replicas}")
         self.router = router
+        # per-role mode (disagg tier, ISSUE 14): when ``role`` is set,
+        # every signal, clamp, and action restricts to that pool — burn
+        # from the role's replicas (router.role_burn_rate), utilization
+        # from the role's slots, scale-up adds a same-role worker, and
+        # victim selection never touches the other phase. Two of these
+        # controllers (streaming.disagg.PhaseAutoscaler) scale prefill
+        # and decode capacity independently on their own burn rates.
+        self.role = role
+        if role is not None and (
+                not hasattr(router, "role_burn_rate") or
+                not hasattr(router, "replica_role")):
+            raise ValueError("role= needs a role-aware router "
+                             "(streaming.disagg.PhaseRouter)")
         self.tracker = tracker if tracker is not None \
             else router._slo_tracker
         self.min_replicas = int(min_replicas)
@@ -107,16 +121,33 @@ class BurnRateAutoscaler:
         self._g_util = g.labels("utilization")
 
     # ------------------------------------------------------------ signals
+    def _role_rids(self):
+        """The rids this controller governs (None = whole fleet)."""
+        if self.role is None:
+            return None
+        return set(self.router.role_ids(self.role))
+
     def signals(self) -> Dict[str, float]:
         """Live inputs: short/long burn rate, utilization, and the
-        non-DEAD replica count."""
+        non-DEAD replica count — fleet-wide, or restricted to this
+        controller's role pool."""
         loads = self.router.replica_loads()
-        live = sum(1 for _, (_, _, st) in loads.items() if st != _DEAD)
-        util = self.router.utilization()
+        rids = self._role_rids()
+        live = sum(1 for rid, (_, _, st) in loads.items()
+                   if st != _DEAD and (rids is None or rid in rids))
+        if self.role is None:
+            util = self.router.utilization()
+            burn_s = self.tracker.burn_rate(self.tracker.short_window)
+            burn_l = self.tracker.burn_rate(self.tracker.long_window)
+        else:
+            util = self.router.utilization(role=self.role)
+            burn_s = self.router.role_burn_rate(
+                self.role, self.tracker.short_window)
+            burn_l = self.router.role_burn_rate(
+                self.role, self.tracker.long_window)
         return {
-            "burn_short": self.tracker.burn_rate(
-                self.tracker.short_window),
-            "burn_long": self.tracker.burn_rate(self.tracker.long_window),
+            "burn_short": burn_s,
+            "burn_long": burn_l,
             "utilization": util,
             "live_replicas": live,
         }
@@ -169,7 +200,11 @@ class BurnRateAutoscaler:
                              for k, v in sig.items()}}
         try:
             if action == "up":
-                entry["replica"] = self.router.add_replica()
+                entry["replica"] = self.router.add_replica() \
+                    if self.role is None \
+                    else self.router.add_replica(role=self.role)
+                if self.role is not None:
+                    entry["role"] = self.role
             else:
                 victim = self._pick_victim()
                 if victim is None:
@@ -194,12 +229,13 @@ class BurnRateAutoscaler:
         requests); highest id breaks ties so repeated descales retire
         the replicas scale-up added, newest first."""
         loads = self.router.replica_loads()
+        rids = self._role_rids()
         live = [(ld, rid) for rid, (ld, _, st) in loads.items()
-                if st != _DEAD]
+                if st != _DEAD and (rids is None or rid in rids)]
         if len(live) <= self.min_replicas:
             return None
-        live.sort(key=lambda p: (p[0], -int(p[1].lstrip("r") or 0)
-                                 if p[1].lstrip("r").isdigit() else 0))
+        live.sort(key=lambda p: (p[0], -int(p[1].lstrip("rpd") or 0)
+                                 if p[1].lstrip("rpd").isdigit() else 0))
         return live[0][1]
 
     # ---------------------------------------------------------- lifecycle

@@ -157,6 +157,23 @@ class FleetLedger:
             self.reassignments += 1
             return True
 
+    def try_reassign_from(self, req_id: str, from_replica: str,
+                          to_replica: str) -> bool:
+        """Conditional ownership move: succeeds only while
+        ``from_replica`` still owns the request and it has not
+        completed. The disagg tier's handoff fence — a prefill worker
+        declared dead (its work re-dispatched) that later ships its
+        frames loses this compare-and-swap and the stale handoff is
+        dropped instead of forking the stream."""
+        with self._lock:
+            if req_id in self._completed:
+                return False
+            if self._assignee.get(req_id) != from_replica:
+                return False
+            self._assignee[req_id] = to_replica
+            self.reassignments += 1
+            return True
+
     def try_complete(self, req_id: str, replica_id: str) -> str:
         """Record a completion attempt; returns ``"ok"`` (first
         completion by the current assignee), ``"duplicate"`` (already
@@ -477,6 +494,11 @@ class EngineReplica:
     def requeue(self, req) -> None:
         self.engine.requeue(req)
 
+    def adopt(self, req, kv) -> None:
+        """KV-handoff receive (disagg decode role): bare engines and
+        supervisors both expose ``adopt``."""
+        self.engine.adopt(req, kv)
+
     def quarantine(self):
         return self.engine.quarantine()
 
@@ -710,7 +732,8 @@ class EngineFleetRouter:
                  prefix_cache: bool = True,
                  profiler=None, profiling: Optional[bool] = None,
                  sticky_page_size: Optional[int] = None,
-                 engine_factory=None):
+                 engine_factory=None,
+                 replica_ids: Optional[List[str]] = None):
         self.fleet_id = fleet_id if fleet_id is not None \
             else f"fleet{next(_FLEET_SEQ)}"
         self._registry = registry if registry is not None \
@@ -812,6 +835,9 @@ class EngineFleetRouter:
                     else replica_injectors[i]
                 engines.append(self._engine_factory(f"r{i}",
                                                     fault_injector=inj))
+        if replica_ids is not None and len(replica_ids) != len(engines):
+            raise ValueError(f"replica_ids has {len(replica_ids)} names "
+                             f"for {len(engines)} replicas")
         self._next_ridx = itertools.count(len(engines))
         self._replicas: Dict[str, EngineReplica] = {}
         for i, eng in enumerate(engines):
@@ -819,7 +845,8 @@ class EngineFleetRouter:
             # points live on the EngineReplica, not the engine
             inj = None if replica_injectors is None \
                 else replica_injectors[i]
-            rep = EngineReplica(f"r{i}", eng, self._membership,
+            rid = f"r{i}" if replica_ids is None else str(replica_ids[i])
+            rep = EngineReplica(rid, eng, self._membership,
                                 fault_injector=inj,
                                 heartbeat_interval=heartbeat_interval)
             rep._on_kill = self._on_replica_kill
@@ -1001,19 +1028,24 @@ class EngineFleetRouter:
                     self._m["migrations"].inc()
 
     def _dispatch_order(self, prefer: Optional[str] = None,
-                        sticky_key=None
+                        sticky_key=None, rids=None
                         ) -> Tuple[List[EngineReplica], Dict[str, int]]:
         """Candidate replicas in dispatch-preference order, plus their
         observed loads. Base policy: ALIVE by ascending load, then
         SUSPECT by ascending load (a slow replica takes traffic only
         when no healthy one can), DEAD never. A sticky key reorders the
         live set to its consistent-hash ring walk; an explicit pin goes
-        first."""
+        first. ``rids`` restricts candidates to a subset — the disagg
+        tier's role pools (PhaseRouter) filter through it."""
         with self._lock:
             states = {rid: h["state"] for rid, h in self._health.items()}
             beat_loads = {rid: h["load"] for rid, h in
                           self._health.items()}
             reps = dict(self._replicas)
+        if rids is not None:
+            allowed = set(rids)
+            reps = {rid: rep for rid, rep in reps.items()
+                    if rid in allowed}
         loads: Dict[str, int] = {}
         for rid, rep in reps.items():
             if states[rid] == REPLICA_DEAD:

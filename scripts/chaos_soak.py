@@ -867,6 +867,250 @@ def run_autoscale_soak(seed: int = 0, max_replicas: int = 3,
     return summary
 
 
+def run_disagg_soak(seed: int = 0, prefill_workers: int = 2,
+                    decode_workers: int = 2, n_requests: int = 24,
+                    num_slots: int = 2, max_new: int = 8,
+                    vocab: int = 12, wait_s: float = 120.0,
+                    steady_wave: int = 2, prefill_chunk: int = 8,
+                    lock_audit: bool = False) -> dict:
+    """Disaggregated-tier soak round (``--disagg``, ISSUE 14): a
+    phase-skewed workload — steady short-prompt decode streams with
+    prefill-heavy long-prompt bursts on top — against a
+    :class:`PhaseRouter` (prefill workers hand KV pages to decode
+    workers over the serialized per-page transport), with THREE deaths
+    mid-stream: an injected transport failure mid-handoff (the frames
+    are lost on the wire), a decode-worker crash holding live streams
+    and queued adoptions, and a prefill-worker crash holding queued
+    prompts. Bars: zero lost, zero duplicated (ledger-verified),
+    token-identical vs the symmetric (single both-phase engine)
+    reference, SLO clocks continuous across every handoff, ``{}``
+    steady compiles on BOTH roles afterwards, every allocator refcount
+    audit clean, and the transfer account EXACT: shipped bytes ==
+    pages x per-page pool bytes + token payload, byte for byte."""
+    import contextlib
+
+    import numpy as np
+
+    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.analysis.lock_audit import LockAudit
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                      TransformerDecoder)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.metrics import default_registry
+    from deeplearning4j_tpu.parallel.faults import FaultInjector
+    from deeplearning4j_tpu.streaming.disagg import (PhaseRouter,
+                                                     SerializedKVTransport)
+    from deeplearning4j_tpu.streaming.fleet import REPLICA_ALIVE
+
+    page_size = 8
+    rng = np.random.default_rng(seed)
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=32, num_heads=2, num_layers=2, max_length=64,
+        learning_rate=1e-2, seed=5)).init()
+    dec = TransformerDecoder(net)
+    # phase-skewed mix: 2/3 steady decode streams (short prompt, long
+    # gen — bandwidth-bound phase dominates), 1/3 prefill-heavy burst
+    # rows (long prompt, short gen — compute-bound phase dominates);
+    # prompt + generated stays inside t_max=64
+    prompts, gens = [], []
+    for i in range(n_requests):
+        if i % 3 == 2:
+            prompts.append(rng.integers(0, vocab,
+                                        int(rng.integers(18, 31))))
+            gens.append(int(rng.integers(2, 4)))
+        else:
+            prompts.append(rng.integers(0, vocab,
+                                        int(rng.integers(2, 5))))
+            gens.append(int(rng.integers(4, max_new + 1)))
+
+    # per-ship exact accounting for the transfer-byte cross-check
+    # (pages, payload bytes, token bytes) — the 'Densifying' gate
+    transport = SerializedKVTransport(per_page=True, record_ships=True)
+    summary = {"seed": seed, "requests": n_requests,
+               "prefill_workers": prefill_workers,
+               "decode_workers": decode_workers}
+    la = LockAudit(patch=True) if lock_audit else None
+    with CompileAudit() as audit, \
+            (la if la is not None else contextlib.nullcontext()):
+        # --- symmetric reference: ONE both-phase paged engine on the
+        # same decoder — ground truth tokens + compile warmup for the
+        # paged prefill buckets / chunk windows / K=1 decode blocks
+        clean = SlotGenerationEngine(net, num_slots=num_slots,
+                                     decoder=dec, paged=True,
+                                     page_size=page_size,
+                                     prefill_chunk=prefill_chunk)
+        clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+        clean.run_until_drained()
+        expected = [r.result(1) for r in clean_reqs]
+        # warm the export/import buckets the handoffs will use (pow2
+        # page counts): a cold kv_export/kv_import lowering during the
+        # FIRST handoffs would stall the serve loop long enough for
+        # the 0.6s heartbeat deadline to declare a healthy worker dead
+        pool_dtype = {n: {kk: clean._caches[n][kk].dtype
+                          for kk in ("k", "v")} for n in clean._caches}
+        for nb in (1, 2, 4, 8):
+            pids = np.zeros(nb, np.int32)
+            dec.kv_export(clean._caches, pids)
+            frames = {n: {kk: np.zeros(
+                (nb,) + tuple(int(x)
+                              for x in clean._caches[n][kk].shape[1:]),
+                pool_dtype[n][kk]) for kk in ("k", "v")}
+                for n in clean._caches}
+            # pools are donated per import: thread the returned ones
+            clean._caches = dec.kv_import(clean._caches, pids, frames)
+
+        # --- chaos schedule: one injected mid-handoff transport
+        # failure (hit 3: the wire eats the frames after the ledger
+        # moved ownership — recovery must re-prefill), then crash
+        # kills of one worker per role once streams are live
+        inj = FaultInjector()
+        inj.raise_once("disagg.ship",
+                       RuntimeError("soak: injected mid-handoff "
+                                    "transport failure"), at=3)
+        router = PhaseRouter(
+            net, prefill_replicas=prefill_workers,
+            decode_replicas=decode_workers, decoder=dec,
+            num_slots=num_slots, page_size=page_size,
+            prefill_chunk=prefill_chunk, transport=transport,
+            fault_injector=inj, max_pending=max(64, n_requests),
+            heartbeat_interval=0.03, monitor_interval=0.03,
+            suspect_after=0.2, dead_after=0.6,
+            recover_beats=3).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        time.sleep(0.15)
+        router.kill_replica("d0")      # decode worker dies holding
+        #                                live streams + queued adoptions
+        time.sleep(0.1)
+        router.kill_replica("p0")      # prefill worker dies holding
+        #                                queued prompts
+        deadline = time.monotonic() + wait_s
+        for fr in frs:
+            fr._done.wait(max(0.0, deadline - time.monotonic()))
+        stranded = [fr for fr in frs if not fr.done()]
+
+        # --- steady state on the survivors: same prompt stream, and
+        # BOTH roles must compile nothing new (export/import buckets
+        # included)
+        inj.clear()
+        survivors = [rid for rid in router.replica_ids()
+                     if router.replica_state(rid) == REPLICA_ALIVE]
+        snap = audit.snapshot()
+        wave = [router.submit(prompts[i % n_requests],
+                              gens[i % n_requests])
+                for _ in survivors for i in range(steady_wave)]
+        wave_deadline = time.monotonic() + 60.0
+        for fr in wave:
+            fr._done.wait(max(0.0, wave_deadline - time.monotonic()))
+        steady_delta = audit.delta(snap)
+        stranded += [fr for fr in wave if not fr.done()]
+
+        # --- accounting before teardown
+        disagg = router.disagg_stats()
+        fleet_table = router.fleet_stats()
+        page_audit = []
+        page_bytes = None
+        for rid, rep in sorted(router._replicas.items()):
+            inner = rep.engine.engine if rep.supervised else rep.engine
+            if getattr(inner, "_pager", None) is not None:
+                page_audit += [f"{rid}: {p}" for p in
+                               inner._pager.audit(inner._slot_pages)]
+                page_bytes = inner._pool_bytes() // inner.num_pages
+        # SLO clock continuity: every completed request's clocks must
+        # be ordered created <= admitted <= first-token even though
+        # admission and first token happened on a PREFILL worker and
+        # completion on a DECODE worker (a reset would re-order them)
+        clock_breaks = 0
+        for fr in frs:
+            inner = fr._inner
+            if inner is None or fr.state != fr.DONE:
+                continue
+            c, a, f = (inner._created_t, inner._admitted_t,
+                       inner._first_token_t)
+            if a is not None and a < c:
+                clock_breaks += 1
+            elif f is not None and a is not None and f < a:
+                clock_breaks += 1
+        router.shutdown()
+        ledger = router._ledger.to_dict()
+        ledger_consistent = (
+            ledger["completed"] ==
+            n_requests + len(wave) - int(router.shed))
+
+    completed = failed = mismatches = 0
+    failure_causes = []
+    for fr, want in zip(frs, expected):
+        if fr.state == fr.DONE:
+            completed += 1
+            if not np.array_equal(fr.result(0), want):
+                mismatches += 1
+        else:
+            failed += 1
+            failure_causes.append(
+                f"{fr.request_id}: {type(fr._error).__name__}: "
+                f"{fr._error}"[:200])
+    for j, fr in enumerate(wave):
+        # the wave re-submits prompt i = j % steady_wave per survivor
+        # (mirrors the submission loop above)
+        want = expected[(j % steady_wave) % n_requests]
+        if fr.state == fr.DONE:
+            completed += 1
+            if not np.array_equal(fr.result(0), want):
+                mismatches += 1
+        else:
+            failed += 1
+            failure_causes.append(
+                f"{fr.request_id}: {type(fr._error).__name__}: "
+                f"{fr._error}"[:200])
+
+    # exact transfer account: every shipped byte is pages x the pool's
+    # per-page bytes plus the context-token payload — measured ==
+    # derived-from-devstats, byte for byte
+    ship_pages = sum(p for p, _, _ in transport.ships)
+    ship_bytes = sum(b for _, b, _ in transport.ships)
+    ship_tok_bytes = sum(t for _, _, t in transport.ships)
+    counters = disagg["handoffs"]
+    transfer_exact = (
+        page_bytes is not None and
+        counters["bytes"] == ship_bytes and
+        counters["pages"] == ship_pages and
+        ship_bytes == ship_pages * page_bytes + ship_tok_bytes)
+    summary.update({
+        "stranded": len(stranded), "mismatches": mismatches,
+        "completed": completed, "failed": failed,
+        "failure_causes": failure_causes,
+        "total": n_requests + len(wave),
+        "shed": int(router.shed),
+        "migrations": int(router.migrations),
+        "handoffs": counters,
+        "transfer": {"pages": ship_pages, "bytes": ship_bytes,
+                     "token_bytes": ship_tok_bytes,
+                     "page_bytes": page_bytes,
+                     "wire_bytes": transport.wire_bytes,
+                     "exact": transfer_exact},
+        "clock_breaks": clock_breaks,
+        "survivors": survivors,
+        "dead": ["d0", "p0"],
+        "page_audit": page_audit,
+        "ledger": ledger, "ledger_consistent": ledger_consistent,
+        "steady_new_compiles": steady_delta,
+        "disagg": disagg, "fleet": fleet_table,
+        "injector": inj.counters(),
+        "metrics": default_registry().snapshot(),
+    })
+    if la is not None:
+        summary["lock_audit"] = _lock_audit_summary(la)
+    summary["ok"] = bool(
+        not stranded and not mismatches and not failed and
+        clock_breaks == 0 and not page_audit and
+        counters["completed"] >= 1 and counters["failed"] >= 1 and
+        ledger["duplicates"] == 0 and ledger_consistent and
+        transfer_exact and not steady_delta and
+        not (summary.get("lock_audit", {}).get("inversions") or
+             summary.get("lock_audit", {}).get("cycles")))
+    return summary
+
+
 def _fleet_scale_ab(replicas: int, n_requests: int = 24,
                     prompt_len: int = 8, gen: int = 16,
                     num_slots: int = 8) -> dict:
@@ -1465,6 +1709,19 @@ def main(argv=None) -> int:
                          "across adaptive-K switching")
     ap.add_argument("--max-replicas", type=int, default=3,
                     help="autoscale soak: fleet size ceiling")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated-tier soak (ISSUE 14): a "
+                         "PhaseRouter fleet (2 prefill + 2 decode "
+                         "workers, serialized per-page KV transport) "
+                         "under a phase-skewed workload, with a "
+                         "mid-handoff transport failure and one worker "
+                         "of EACH role crash-killed — bars: zero lost, "
+                         "zero duplicated (ledger-verified), token-"
+                         "identical vs the symmetric reference, SLO "
+                         "clocks continuous across handoffs, {} steady "
+                         "compiles on both roles, allocator audits "
+                         "clean, and the KV-transfer byte account "
+                         "EXACT against the pool's per-page bytes")
     ap.add_argument("--no-fleet-scale", action="store_true",
                     help="skip the 1->N aggregate-throughput A/B "
                          "(the slowest part of the fleet soak)")
@@ -1587,6 +1844,46 @@ def main(argv=None) -> int:
                       f"steady_new_compiles="
                       f"{s['steady_new_compiles'] if s['steady_new_compiles'] is not None else '?'}"
                       f"{ab} -> {'ok' if s['ok'] else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.disagg:
+        if args.mesh or args.replicas or args.process_kill or \
+                args.autoscale or args.paged:
+            ap.error("--disagg runs its own phase-specialized fleet "
+                     "(always paged); it cannot be combined with "
+                     "--mesh/--replicas/--process-kill/--autoscale/"
+                     "--paged")
+        ok = True
+        for i in range(args.iterations):
+            s = run_disagg_soak(seed=args.seed + i,
+                                n_requests=args.requests,
+                                num_slots=args.slots,
+                                max_new=max(4, args.max_new),
+                                lock_audit=args.lock_audit)
+            ok = ok and s["ok"]
+            if args.json:
+                print(json.dumps(s, default=str))
+            else:
+                led = s["ledger"]
+                ho = s["handoffs"]
+                tx = s["transfer"]
+                print(f"round {i}: disagg seed={s['seed']} "
+                      f"dead=d0,p0 survivors={','.join(s['survivors'])} "
+                      f"completed={s['completed']}/{s['total']} "
+                      f"stranded={s['stranded']} "
+                      f"mismatches={s['mismatches']} "
+                      f"clock_breaks={s['clock_breaks']} "
+                      f"handoffs[ok={ho['completed']} "
+                      f"fenced={ho['fenced']} failed={ho['failed']}] "
+                      f"transfer[{tx['pages']}pg/{tx['bytes']}B "
+                      f"{'exact' if tx['exact'] else 'MISMATCH'}] "
+                      f"ledger[ok={led['completed']} "
+                      f"dup={led['duplicates']}] "
+                      f"page_audit="
+                      f"{'clean' if not s['page_audit'] else 'BAD'} "
+                      f"steady_new_compiles="
+                      f"{s['steady_new_compiles'] or '{}'} "
+                      f"-> {'ok' if s['ok'] else 'FAIL'}")
         return 0 if ok else 1
 
     if args.autoscale:

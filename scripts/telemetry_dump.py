@@ -135,14 +135,15 @@ def pretty_fleet(snapshot: dict, out=sys.stdout) -> int:
         return 2
     for name, src in sorted(fleets.items()):
         w(f"fleet {src.get('fleet', '?')}  (source '{name}')\n")
-        hdr = (f"  {'replica':<9} {'state':<8} {'hb-age':>7} "
+        hdr = (f"  {'replica':<9} {'role':<8} {'state':<8} {'hb-age':>7} "
                f"{'load':>5} {'cap':>4} {'queue':>6} {'active':>7} "
                f"{'sup':>4} {'reach':>6}\n")
         w(hdr)
         for rid, row in sorted(src["replicas"].items()):
             age = row.get("heartbeat_age_s")
             fmt = (lambda v: "-" if v is None else str(v))
-            w(f"  {rid:<9} {row.get('state', '?'):<8} "
+            w(f"  {rid:<9} {fmt(row.get('role')):<8} "
+              f"{row.get('state', '?'):<8} "
               f"{'-' if age is None else f'{age:.3f}s':>7} "
               f"{fmt(row.get('load')):>5} {fmt(row.get('capacity')):>4} "
               f"{fmt(row.get('queue_depth')):>6} "
@@ -152,6 +153,14 @@ def pretty_fleet(snapshot: dict, out=sys.stdout) -> int:
         led = src["ledger"]
         w("  ledger: " + " ".join(f"{k}={led[k]}" for k in sorted(led))
           + "\n")
+        dg = src.get("disagg")
+        if isinstance(dg, dict):
+            ho = dg.get("handoffs") or {}
+            w(f"  disagg: handoffs={ho.get('completed')} "
+              f"fenced={ho.get('fenced')} failed={ho.get('failed')} "
+              f"pages={ho.get('pages')} bytes={ho.get('bytes')} "
+              f"transport="
+              f"{(dg.get('transport') or {}).get('transport')}\n")
         jr = src.get("journal")
         if isinstance(jr, dict):
             w(f"  journal: pending={jr.get('pending')} "
@@ -237,6 +246,38 @@ def _profile_cols(snap: dict):
     return bubble, gbs
 
 
+def _counter_sum(snap: dict, family: str):
+    """Sum a counter family's children from a snapshot's metrics (e.g.
+    ``kv_transfer_bytes_total`` across a replica's fleets); None when
+    the family is absent."""
+    doc = (snap.get("metrics") or {}).get(family) or {}
+    if doc.get("type") != "counter":
+        return None
+    vals = [v for v in (doc.get("values") or {}).values()
+            if isinstance(v, (int, float))]
+    return sum(vals) if vals else None
+
+
+def _role_col(snap: dict):
+    """P / D / P+D from the ``generation_engine_role`` gauge family
+    (disagg tier): which phase roles this replica's engines serve;
+    None for a classic both-phase replica (prints '-')."""
+    doc = (snap.get("metrics") or {}).get("generation_engine_role") or {}
+    if doc.get("type") != "gauge":
+        return None
+    roles = set()
+    for key, v in (doc.get("values") or {}).items():
+        if not v:
+            continue
+        for part in str(key).split(","):
+            if part.startswith("role="):
+                roles.add(part[5:])
+    if not roles:
+        return None
+    short = {"prefill": "P", "decode": "D"}
+    return "+".join(short.get(r, r[:1].upper()) for r in sorted(roles))
+
+
 def _gauge_sum(snap: dict, family: str, label: str = None):
     """Sum a gauge family's children from a snapshot's metrics (e.g.
     ``journal_pending`` across a replica's journals); None when the
@@ -302,6 +343,14 @@ def merge_snapshots(per_url: dict) -> dict:
         # hot-loop profiler (ISSUE 13): decode pipeline bubble-% and
         # best attained decode GB/s per replica
         row["bubble_pct"], row["attained_gbs"] = _profile_cols(snap)
+        # disagg tier (ISSUE 14): phase role (P = prefill worker, D =
+        # decode worker, '-' = classic both-phase) and the measured
+        # KV-handoff transfer account
+        row["role"] = _role_col(snap)
+        xb = _counter_sum(snap, "kv_transfer_bytes_total")
+        row["kv_transfer_mb"] = None if xb is None \
+            else round(xb / 1e6, 2)
+        row["kv_handoffs"] = _counter_sum(snap, "fleet_kv_handoffs_total")
         if target is None and slo.get("target") is not None:
             target = float(slo["target"])
         requests += int(slo.get("requests") or 0)
@@ -331,18 +380,20 @@ def merge_snapshots(per_url: dict) -> dict:
 def pretty_scrape(doc: dict, out=sys.stdout) -> None:
     w = out.write
     w(f"fleet scrape: {doc['up']}/{doc['scraped']} replicas up\n")
-    w(f"  {'replica':<36} {'up':>2} {'uptime':>8} {'att-short':>9} "
+    w(f"  {'replica':<36} {'up':>2} {'role':>4} {'uptime':>8} "
+      f"{'att-short':>9} "
       f"{'att-long':>8} {'burn-sh':>8} {'reqs':>6} {'miss':>5} "
       f"{'hd-p50':>8} {'hd-min':>8} {'kv-bytes':>10} {'pg-free':>7} "
-      f"{'pg-shr':>6} {'j-pend':>6} {'j-deg':>5} {'bub%':>6} "
-      f"{'GB/s':>7}\n")
+      f"{'pg-shr':>6} {'xfer-MB':>8} {'j-pend':>6} {'j-deg':>5} "
+      f"{'bub%':>6} {'GB/s':>7}\n")
     fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
     for base, row in sorted(doc["replicas"].items()):
         if not row.get("up"):
             w(f"  {base:<36}  n  DOWN ({row.get('error', '?')})\n")
             continue
         jd = row.get("journal_degraded")
-        w(f"  {base:<36} {'y':>2} {fmt(row.get('uptime_s')):>8} "
+        w(f"  {base:<36} {'y':>2} {fmt(row.get('role')):>4} "
+          f"{fmt(row.get('uptime_s')):>8} "
           f"{fmt(row.get('attainment_short')):>9} "
           f"{fmt(row.get('attainment_long')):>8} "
           f"{fmt(row.get('burn_short')):>8} "
@@ -352,6 +403,7 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
           f"{fmt(row.get('kv_cache_bytes')):>10} "
           f"{fmt(row.get('kv_pages_free')):>7} "
           f"{fmt(row.get('kv_pages_shared')):>6} "
+          f"{fmt(row.get('kv_transfer_mb')):>8} "
           f"{fmt(row.get('journal_pending')):>6} "
           f"{'-' if jd is None else ('Y' if jd else 'n'):>5} "
           f"{fmt(row.get('bubble_pct')):>6} "

@@ -1,0 +1,643 @@
+"""Disaggregated prefill/decode serving tier (ISSUE 14): page-frame
+serialization round trips (bulk + per-page streaming, CRC/geometry
+error paths), phase-specialized engine modes (prefill handoff export,
+decode adopt import, pool-exhausted receiver), PhaseRouter end-to-end
+token parity with exactly-once ledger-fenced handoffs, kill/transport-
+failure recovery, role-aware elasticity + per-role autoscaling, the
+measured transfer account, and the scrape/fleet observability columns."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import CompileAudit
+from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                       TransformerDecoder, lm_batch,
+                                       transformer_lm_conf)
+from deeplearning4j_tpu.models.paging import (PageFrameError,
+                                              PageFrameSet)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.parallel.faults import (FaultInjector,
+                                                RejectedError)
+from deeplearning4j_tpu.streaming.disagg import (InProcessKVTransport,
+                                                 PhaseAutoscaler,
+                                                 PhaseRouter,
+                                                 SerializedKVTransport)
+
+VOCAB = 12
+PAGE = 8
+
+
+def _tiny_lm(**kw):
+    kw.setdefault("d_model", 32)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("max_length", 32)
+    kw.setdefault("learning_rate", 1e-2)
+    kw.setdefault("seed", 5)
+    return ComputationGraph(transformer_lm_conf(VOCAB, **kw)).init()
+
+
+@pytest.fixture(scope="module")
+def trained_net():
+    rng = np.random.default_rng(4242)
+    net = _tiny_lm()
+    starts = rng.integers(0, VOCAB, (16, 1))
+    seq = (starts + np.arange(17)[None, :]) % VOCAB
+    x, y = lm_batch(seq, VOCAB)
+    ds = DataSet(x, y)
+    for _ in range(120):
+        net.fit_batch(ds)
+    return net
+
+
+@pytest.fixture(scope="module")
+def shared_dec(trained_net):
+    return TransformerDecoder(trained_net)
+
+
+def _workload(seed=0, n=8, gen_lo=2, gen_hi=7):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, VOCAB, int(rng.integers(2, 5)))
+               for _ in range(n)]
+    gens = [int(rng.integers(gen_lo, gen_hi)) for _ in range(n)]
+    return prompts, gens
+
+
+def _expected(net, dec, prompts, gens):
+    eng = SlotGenerationEngine(net, num_slots=2, decoder=dec,
+                               paged=True, page_size=PAGE)
+    reqs = [eng.submit(p, g) for p, g in zip(prompts, gens)]
+    eng.run_until_drained()
+    return [r.result(5) for r in reqs]
+
+
+def _frame_set(n_pages=3, page_size=4, dtype=np.float32, seed=7):
+    rng = np.random.default_rng(seed)
+    layers = {name: {kk: rng.standard_normal(
+        (n_pages, 2, page_size, 8)).astype(dtype)
+        for kk in ("k", "v")} for name in ("attn_a", "attn_b")}
+    tokens = rng.integers(0, 100, n_pages * page_size - 1)
+    return PageFrameSet(page_size, tokens, layers)
+
+
+# ===================================================================
+# PageFrameSet wire encodings (no jax)
+# ===================================================================
+class TestPageFrames:
+    def test_bulk_round_trip_byte_identical(self):
+        st = _frame_set()
+        out = PageFrameSet.from_bytes(st.to_bytes())
+        assert out.page_size == st.page_size
+        assert np.array_equal(out.tokens, st.tokens)
+        for n in st.layers:
+            for kk in ("k", "v"):
+                a, b = st.layers[n][kk], out.layers[n][kk]
+                assert a.dtype == b.dtype
+                assert a.tobytes() == b.tobytes()
+        assert out.nbytes == st.nbytes
+
+    def test_per_page_stream_round_trip(self):
+        st = _frame_set(n_pages=4)
+        frames = st.to_frames()
+        assert len(frames) == st.n_pages + 1     # header + one per page
+        out = PageFrameSet.from_frames(frames)
+        for n in st.layers:
+            for kk in ("k", "v"):
+                assert st.layers[n][kk].tobytes() == \
+                    out.layers[n][kk].tobytes()
+
+    def test_file_round_trip_across_process_boundary(self, tmp_path):
+        # a file is the process-independence surrogate: nothing shared
+        # but the bytes (what a broker hop would carry)
+        st = _frame_set(dtype=np.float16)
+        path = tmp_path / "frames.bin"
+        path.write_bytes(st.to_bytes())
+        out = PageFrameSet.from_bytes(path.read_bytes())
+        assert out.dtype == "float16"
+        for n in st.layers:
+            assert st.layers[n]["v"].tobytes() == \
+                out.layers[n]["v"].tobytes()
+
+    def test_crc_corruption_detected(self):
+        blob = bytearray(_frame_set().to_bytes())
+        blob[-3] ^= 0xFF                         # flip a payload byte
+        with pytest.raises(PageFrameError, match="CRC"):
+            PageFrameSet.from_bytes(bytes(blob))
+
+    def test_truncation_and_bad_magic(self):
+        blob = _frame_set().to_bytes()
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(blob[:len(blob) // 2])
+        with pytest.raises(PageFrameError, match="magic"):
+            PageFrameSet.from_bytes(b"XXXX" + blob[4:])
+
+    def test_frame_count_and_duplicate_index_rejected(self):
+        st = _frame_set(n_pages=3)
+        frames = st.to_frames()
+        with pytest.raises(PageFrameError, match="promises"):
+            PageFrameSet.from_frames(frames[:-1])
+        dup = [frames[0], frames[1], frames[1], frames[2]]
+        with pytest.raises(PageFrameError, match="duplicated"):
+            PageFrameSet.from_frames(dup)
+
+    def test_bad_geometry_rejected_at_construction(self):
+        rng = np.random.default_rng(0)
+        with pytest.raises(PageFrameError, match="expected"):
+            PageFrameSet(4, [1, 2], {"a": {
+                "k": rng.standard_normal((2, 2, 5, 8)),   # page dim 5 != 4
+                "v": rng.standard_normal((2, 2, 5, 8))}})
+
+    def test_serialized_transport_counts_wire(self):
+        st = _frame_set()
+        for per_page in (False, True):
+            tr = SerializedKVTransport(per_page=per_page)
+            out = tr.ship(st)
+            assert out.layers["attn_a"]["k"].tobytes() == \
+                st.layers["attn_a"]["k"].tobytes()
+            assert tr.shipped == 1 and tr.wire_bytes > st.nbytes
+        assert InProcessKVTransport().ship(st) is st
+
+
+# ===================================================================
+# phase-specialized engine modes
+# ===================================================================
+class TestPhaseEngine:
+    def test_phase_needs_paged_and_valid_name(self, trained_net,
+                                              shared_dec):
+        with pytest.raises(ValueError, match="paged=True"):
+            SlotGenerationEngine(trained_net, decoder=shared_dec,
+                                 phase="prefill")
+        with pytest.raises(ValueError, match="phase"):
+            SlotGenerationEngine(trained_net, decoder=shared_dec,
+                                 paged=True, page_size=PAGE,
+                                 phase="router")
+
+    def test_prefill_handoff_to_decode_adopt_parity(self, trained_net,
+                                                    shared_dec):
+        prompts, gens = _workload(seed=3, n=8)
+        expected = _expected(trained_net, shared_dec, prompts, gens)
+        states = []
+        pre = SlotGenerationEngine(
+            trained_net, num_slots=2, decoder=shared_dec, paged=True,
+            page_size=PAGE, phase="prefill",
+            handoff=lambda req, st: states.append((req, st)))
+        de = SlotGenerationEngine(trained_net, num_slots=2,
+                                  decoder=shared_dec, paged=True,
+                                  page_size=PAGE, phase="decode")
+        hs = [pre.submit(p, g) for p, g in zip(prompts, gens)]
+        pre.run_until_drained()
+        assert len(states) == len(prompts)
+        assert pre.stats()["handoffs"] == len(prompts)
+        # the exported frames cover exactly the resume context
+        for req, st in states:
+            assert len(st.tokens) == len(req.prompt) + \
+                len(req.generated) - 1
+            de.adopt(req, st)
+        de.run_until_drained()
+        for h, want in zip(hs, expected):
+            assert np.array_equal(h.result(5), want)
+        assert de.stats()["adopted"] == len(prompts)
+        assert pre._pager.audit(pre._slot_pages) == []
+        assert de._pager.audit(de._slot_pages) == []
+
+    def test_chunked_prefill_hands_off_long_prompts(self, trained_net,
+                                                    shared_dec):
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, VOCAB, 19) for _ in range(2)]
+        gens = [3, 4]
+        expected = _expected(trained_net, shared_dec, prompts, gens)
+        states = []
+        pre = SlotGenerationEngine(
+            trained_net, num_slots=2, decoder=shared_dec, paged=True,
+            page_size=PAGE, phase="prefill", prefill_chunk=PAGE,
+            handoff=lambda req, st: states.append((req, st)))
+        de = SlotGenerationEngine(trained_net, num_slots=2,
+                                  decoder=shared_dec, paged=True,
+                                  page_size=PAGE, phase="decode")
+        hs = [pre.submit(p, g) for p, g in zip(prompts, gens)]
+        pre.run_until_drained()
+        assert pre.stats()["prefill_chunks"] > 0
+        for req, st in states:
+            de.adopt(req, st)
+        de.run_until_drained()
+        for h, want in zip(hs, expected):
+            assert np.array_equal(h.result(5), want)
+
+    def test_adopt_geometry_error_paths(self, trained_net, shared_dec):
+        prompts, gens = _workload(seed=5, n=1)
+        states = []
+        pre = SlotGenerationEngine(
+            trained_net, num_slots=2, decoder=shared_dec, paged=True,
+            page_size=PAGE, phase="prefill",
+            handoff=lambda req, st: states.append((req, st)))
+        pre.submit(prompts[0], gens[0])
+        pre.run_until_drained()
+        req, st = states[0]
+        de = SlotGenerationEngine(trained_net, num_slots=2,
+                                  decoder=shared_dec, paged=True,
+                                  page_size=PAGE, phase="decode")
+        # page_size mismatch (a frame set from a pool with different
+        # geometry — PageFrameSet itself would refuse to mis-shape
+        # frames, so duck-type the wire state another build would send)
+        import types
+        bad = types.SimpleNamespace(page_size=PAGE * 2,
+                                    tokens=st.tokens, layers=st.layers,
+                                    n_pages=st.n_pages)
+        with pytest.raises(ValueError, match="page_size mismatch"):
+            de.adopt(req, bad)
+        # missing layer
+        one = dict(st.layers)
+        missing_name = sorted(one)[0]
+        del one[missing_name]
+        bad2 = PageFrameSet(PAGE, st.tokens, one)
+        with pytest.raises(ValueError, match="missing attention"):
+            de.adopt(req, bad2)
+        # dtype mismatch
+        cast = {n: {kk: np.asarray(kv[kk], np.float16)
+                    for kk in ("k", "v")} for n, kv in st.layers.items()}
+        with pytest.raises(ValueError, match="dtype"):
+            de.adopt(req, PageFrameSet(PAGE, st.tokens, cast))
+        # resume-point mismatch
+        with pytest.raises(ValueError, match="resumes at"):
+            de.adopt(req, PageFrameSet(PAGE, st.tokens[:-1], st.layers))
+        # the real state still adopts and decodes after all rejections
+        de.adopt(req, st)
+        de.run_until_drained()
+        assert req.done() and req._error is None
+        # slab engine cannot adopt
+        slab = SlotGenerationEngine(trained_net, num_slots=2,
+                                    decoder=shared_dec)
+        with pytest.raises(ValueError, match="paged"):
+            slab.adopt(req, st)
+
+    def test_pool_exhausted_receiver_sheds_and_balances(self, trained_net,
+                                                        shared_dec):
+        prompts = [np.arange(10) % VOCAB + i for i in range(1)]
+        states = []
+        pre = SlotGenerationEngine(
+            trained_net, num_slots=2, decoder=shared_dec, paged=True,
+            page_size=PAGE, phase="prefill",
+            handoff=lambda req, st: states.append((req, st)))
+        pre.submit(prompts[0], 6)
+        pre.run_until_drained()
+        req, st = states[0]
+        # receiver pool: 2 usable pages, import needs 10//8+1 = 2 fresh
+        # pages for the context + write cell — but prefix_cache retains
+        # nothing here; use 2 pages so the alloc itself fails (needs 2,
+        # has 2, but register keeps them mapped... use 1 usable page)
+        de = SlotGenerationEngine(trained_net, num_slots=2,
+                                  decoder=shared_dec, paged=True,
+                                  page_size=PAGE, num_pages=2,
+                                  phase="decode", prefix_cache=False)
+        de.adopt(req, st)
+        de.run_until_drained()
+        assert req.done()
+        with pytest.raises(RejectedError, match="pool exhausted"):
+            req.result(0)
+        assert de.stats()["rejected"] == 1
+        assert de._pager.audit(de._slot_pages) == []
+
+    def test_no_sink_prefill_engine_fails_loudly(self, trained_net,
+                                                 shared_dec):
+        pre = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=shared_dec, paged=True,
+                                   page_size=PAGE, phase="prefill")
+        r = pre.submit([1, 2, 3], 4)
+        pre.run_until_drained()
+        with pytest.raises(RuntimeError, match="no handoff sink"):
+            r.result(1)
+        assert pre._pager.audit(pre._slot_pages) == []
+
+    def test_decode_only_rejects_fresh_prompts(self, trained_net,
+                                               shared_dec):
+        de = SlotGenerationEngine(trained_net, num_slots=2,
+                                  decoder=shared_dec, paged=True,
+                                  page_size=PAGE, phase="decode")
+        r = de.submit([1, 2], 4)
+        with pytest.raises(RuntimeError, match="decode-only"):
+            r.result(1)
+
+    def test_adopted_streams_share_prefix_pages(self, trained_net,
+                                                shared_dec):
+        # two streams with one system prompt: the SECOND adoption maps
+        # the first's imported pages read-only instead of re-importing
+        rng = np.random.default_rng(11)
+        sys_p = rng.integers(0, VOCAB, 16)
+        prompts = [np.concatenate([sys_p, rng.integers(0, VOCAB, 3)])
+                   for _ in range(2)]
+        states = []
+        pre = SlotGenerationEngine(
+            trained_net, num_slots=1, decoder=shared_dec, paged=True,
+            page_size=PAGE, phase="prefill",
+            handoff=lambda req, st: states.append((req, st)))
+        hs = [pre.submit(p, 3) for p in prompts]
+        pre.run_until_drained()
+        de = SlotGenerationEngine(trained_net, num_slots=2,
+                                  decoder=shared_dec, paged=True,
+                                  page_size=PAGE, phase="decode")
+        for req, st in states:
+            de.adopt(req, st)
+        de.run_until_drained()
+        for h in hs:
+            assert h.result(5) is not None
+        st_pool = de._pager.stats()
+        assert st_pool["cached"] >= 2       # both full sys-prompt pages
+        assert de._pager.audit(de._slot_pages) == []
+        # prefix-chain hashes are PRESERVED across the handoff: the
+        # receiver's index holds the same content digests the sender
+        # registered for the shared system prompt (same chain function
+        # over the same tokens — the r17 "same content ⇒ same key"
+        # contract crosses the process seam)
+        from deeplearning4j_tpu.models.paging import chain_digests
+        want = set(chain_digests(sys_p, PAGE))
+        assert want <= set(pre._pager._chains)
+        assert want <= set(de._pager._chains)
+
+
+# ===================================================================
+# PhaseRouter end-to-end
+# ===================================================================
+class TestPhaseRouter:
+    def test_end_to_end_parity_exactly_once_and_steady(self, trained_net,
+                                                       shared_dec):
+        prompts, gens = _workload(seed=21, n=10)
+        with CompileAudit() as audit:
+            expected = _expected(trained_net, shared_dec, prompts, gens)
+            router = PhaseRouter(
+                trained_net, prefill_replicas=1, decode_replicas=2,
+                decoder=shared_dec, num_slots=2, page_size=PAGE,
+                transport=SerializedKVTransport(per_page=True),
+                suspect_after=0.5, dead_after=2.0).start()
+            frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+            for fr, want in zip(frs, expected):
+                assert np.array_equal(fr.result(60), want)
+            # kv_handoff span rides the one-trace-per-request timeline
+            tr = frs[0].trace
+            names = [s["name"] for s in tr.to_dict()["spans"]]
+            assert "kv_export" in names and "kv_handoff" in names \
+                and "kv_import" in names
+            # steady state: same stream again compiles NOTHING on
+            # either role (export/import buckets included)
+            snap = audit.snapshot()
+            wave = [router.submit(p, g) for p, g in
+                    zip(prompts[:4], gens[:4])]
+            for fr in wave:
+                fr.result(60)
+            assert audit.delta(snap) == {}
+            st = router.disagg_stats()
+            assert st["handoffs"]["completed"] == len(frs) + len(wave)
+            assert st["handoffs"]["fenced"] == 0
+            led = router._ledger.to_dict()
+            assert led["duplicates"] == 0
+            assert led["completed"] == len(frs) + len(wave)
+            router.shutdown()
+
+    def test_transfer_bytes_match_pool_accounting(self, trained_net,
+                                                  shared_dec):
+        tr = SerializedKVTransport(record_ships=True)
+        ships = tr.ships
+        prompts, gens = _workload(seed=23, n=6)
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             transport=tr, suspect_after=0.5,
+                             dead_after=2.0).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        for fr in frs:
+            fr.result(60)
+        rep = router._replicas[router.role_ids("decode")[0]]
+        page_bytes = rep.engine._pool_bytes() // rep.engine.num_pages
+        st = router.disagg_stats()["handoffs"]
+        router.shutdown()
+        assert st["bytes"] == sum(b for _, b, _ in ships)
+        assert st["pages"] == sum(p for p, _, _ in ships)
+        # measured bytes == pages x devstats' per-page pool bytes +
+        # the token payload, byte for byte (the "Densifying" gate)
+        assert st["bytes"] == st["pages"] * page_bytes + \
+            sum(t for _, _, t in ships)
+
+    def test_decode_worker_kill_recovers_token_identical(self,
+                                                         trained_net,
+                                                         shared_dec):
+        import time
+        prompts, gens = _workload(seed=31, n=10, gen_lo=6, gen_hi=11)
+        expected = _expected(trained_net, shared_dec, prompts, gens)
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=2, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             suspect_after=0.5, dead_after=2.0).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        time.sleep(0.1)
+        router.kill_replica("d0")
+        for fr, want in zip(frs, expected):
+            assert np.array_equal(fr.result(90), want)
+        for rid, rep in router._replicas.items():
+            if getattr(rep.engine, "_pager", None) is not None:
+                assert rep.engine._pager.audit(
+                    rep.engine._slot_pages) == [], rid
+        assert router._ledger.to_dict()["duplicates"] == 0
+        router.shutdown()
+
+    def test_ship_failure_reprefills_exactly_once(self, trained_net,
+                                                  shared_dec):
+        prompts, gens = _workload(seed=37, n=6)
+        expected = _expected(trained_net, shared_dec, prompts, gens)
+        inj = FaultInjector()
+        inj.raise_once("disagg.ship",
+                       RuntimeError("injected wire failure"), at=2)
+        router = PhaseRouter(trained_net, prefill_replicas=2,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             fault_injector=inj, suspect_after=0.5,
+                             dead_after=2.0).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        for fr, want in zip(frs, expected):
+            assert np.array_equal(fr.result(90), want)
+        st = router.disagg_stats()["handoffs"]
+        assert st["failed"] == 1
+        # the re-prefilled request either hands off again (a second
+        # completed handoff) or finishes AT the prefill worker (its
+        # re-prefill emitted the last budgeted token) — both are
+        # exactly-once, both token-identical (asserted above)
+        assert st["completed"] >= len(frs) - 1
+        assert router._ledger.to_dict()["duplicates"] == 0
+        router.shutdown()
+
+    def test_stale_handoff_is_fenced(self, trained_net, shared_dec):
+        prompts, gens = _workload(seed=41, n=1)
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             suspect_after=0.5, dead_after=2.0).start()
+        fr = router.submit(prompts[0], gens[0])
+        fr.result(60)
+        # a zombie's late ship for an id the router no longer tracks
+        inner = fr._inner
+        st = _frame_set()
+        router._do_handoff("p0", inner, st)
+        assert router.disagg_stats()["handoffs"]["fenced"] == 1
+        router.shutdown()
+
+    def test_role_aware_retire_and_add(self, trained_net, shared_dec):
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             suspect_after=0.5, dead_after=2.0).start()
+        with pytest.raises(ValueError, match="last live decode"):
+            router.retire_replica("d0")
+        with pytest.raises(ValueError, match="last live prefill"):
+            router.retire_replica("p0")
+        rid = router.add_replica(role="decode")
+        assert rid == "d1" and router.replica_role(rid) == "decode"
+        # with a second decode worker the first CAN retire
+        out = router.retire_replica("d0", budget=5.0)
+        assert out["replica"] == "d0"
+        assert router.replica_role("d0") is None
+        fr = router.submit([1, 2, 3], 4)
+        assert fr.result(60) is not None
+        router.shutdown()
+
+    def test_fleet_stats_carries_roles_and_disagg_block(self,
+                                                        trained_net,
+                                                        shared_dec):
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             suspect_after=0.5, dead_after=2.0)
+        fs = router.fleet_stats()
+        assert fs["replicas"]["p0"]["role"] == "prefill"
+        assert fs["replicas"]["d0"]["role"] == "decode"
+        assert set(fs["disagg"]["roles"]) == {"prefill", "decode"}
+        assert "handoffs" in fs["disagg"]
+        router.shutdown()
+
+
+# ===================================================================
+# per-role autoscaling
+# ===================================================================
+class TestRoleAutoscaler:
+    def test_role_needs_role_aware_router(self, trained_net, shared_dec):
+        from deeplearning4j_tpu.streaming.autoscale import \
+            BurnRateAutoscaler
+        from deeplearning4j_tpu.streaming.fleet import EngineFleetRouter
+        plain = EngineFleetRouter(trained_net, num_replicas=1,
+                                  decoder=shared_dec, num_slots=2)
+        with pytest.raises(ValueError, match="role-aware"):
+            BurnRateAutoscaler(plain, role="decode")
+        plain.shutdown()
+
+    def test_role_scaler_scales_its_own_pool(self, trained_net,
+                                             shared_dec):
+        from deeplearning4j_tpu.streaming.autoscale import \
+            BurnRateAutoscaler
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             suspect_after=0.5, dead_after=2.0).start()
+        up = BurnRateAutoscaler(router, role="decode", min_replicas=1,
+                                max_replicas=2, up_consecutive=1,
+                                cooldown_s=0.0)
+        sig = {"burn_short": 10.0, "burn_long": 10.0,
+               "utilization": 5.0, "live_replicas": 1}
+        assert up.evaluate_once(signals=sig) == "up"
+        assert router.role_ids("decode") == ["d0", "d1"]
+        assert router.role_ids("prefill") == ["p0"]   # untouched
+        # scale-down victim selection never leaves the role either
+        down = BurnRateAutoscaler(router, role="decode", min_replicas=1,
+                                  max_replicas=2, down_consecutive=1,
+                                  cooldown_s=0.0, drain_budget=5.0)
+        idle = {"burn_short": 0.0, "burn_long": 0.0,
+                "utilization": 0.0, "live_replicas": 2}
+        assert down.evaluate_once(signals=idle) == "down"
+        assert router.role_ids("decode") == ["d0"]
+        assert router.role_ids("prefill") == ["p0"]
+        router.shutdown()
+
+    def test_phase_autoscaler_bundles_both_roles(self, trained_net,
+                                                 shared_dec):
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             suspect_after=0.5, dead_after=2.0)
+        pa = PhaseAutoscaler(router, prefill_max=2, decode_max=2,
+                             up_consecutive=1, cooldown_s=0.0)
+        out = pa.evaluate_once()
+        assert set(out) == {"prefill", "decode"}
+        assert set(pa.stats()) == {"prefill", "decode"}
+        router.shutdown()
+
+    def test_role_utilization_and_burn_split(self, trained_net,
+                                             shared_dec):
+        router = PhaseRouter(trained_net, prefill_replicas=1,
+                             decode_replicas=1, decoder=shared_dec,
+                             num_slots=2, page_size=PAGE,
+                             suspect_after=0.5, dead_after=2.0)
+        assert router.utilization(role="prefill") == 0.0
+        assert router.utilization(role="decode") == 0.0
+        assert router.role_burn_rate("prefill") == 0.0
+        assert router.role_burn_rate("decode") == 0.0
+        router.shutdown()
+
+
+# ===================================================================
+# observability columns
+# ===================================================================
+class TestDisaggScrape:
+    def test_scrape_merge_role_and_transfer_columns(self):
+        from scripts.telemetry_dump import merge_snapshots
+        snap = {"metrics": {
+            "generation_engine_role": {"type": "gauge", "values": {
+                "engine=e0,role=prefill": 1}},
+            "kv_transfer_bytes_total": {"type": "counter", "values": {
+                "fleet=f0,transport=frames": 2_500_000}},
+            "fleet_kv_handoffs_total": {"type": "counter", "values": {
+                "fleet=f0": 42}}},
+            "slo": {}, "uptime_s": 1}
+        doc = merge_snapshots({"http://p0": snap})
+        row = doc["replicas"]["http://p0"]
+        assert row["role"] == "P"
+        assert row["kv_transfer_mb"] == 2.5
+        assert row["kv_handoffs"] == 42
+        assert doc["counters"]["kv_transfer_bytes_total"] == 2_500_000
+        # classic replica: role column degrades to None, not an error
+        doc2 = merge_snapshots({"http://r0": {"metrics": {}, "slo": {},
+                                              "uptime_s": 1}})
+        assert doc2["replicas"]["http://r0"]["role"] is None
+
+    def test_pretty_scrape_renders_disagg_columns(self):
+        import io
+
+        from scripts.telemetry_dump import pretty_scrape
+        doc = {"up": 1, "scraped": 1,
+               "replicas": {"http://p0": {
+                   "up": True, "role": "P", "kv_transfer_mb": 1.2,
+                   "uptime_s": 3}},
+               "slo": {"target": 0.99, "requests": 0, "missed": 0,
+                       "attainment_short": 1.0, "attainment_long": 1.0,
+                       "burn_rate_short": 0.0, "burn_rate_long": 0.0},
+               "counters": {}}
+        buf = io.StringIO()
+        pretty_scrape(doc, out=buf)
+        txt = buf.getvalue()
+        assert "role" in txt and "xfer-MB" in txt
+        assert " P " in txt and "1.2" in txt
+
+
+# ===================================================================
+# static-analysis acceptance: the new tier arrives debt-free
+# ===================================================================
+class TestDisaggLintClean:
+    def test_disagg_modules_are_clean(self):
+        from deeplearning4j_tpu.analysis.lint import lint_paths
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(root, "deeplearning4j_tpu", "streaming",
+                              "disagg.py"),
+                 os.path.join(root, "deeplearning4j_tpu", "models",
+                              "paging.py")]
+        found = lint_paths(paths, repo_root=root,
+                           rules=["GL006", "GL009", "GL010", "GL011",
+                                  "GL012"])
+        assert found == [], "\n".join(str(f) for f in found)
