@@ -145,7 +145,19 @@ class TransformerDecoder:
     misreading two meshes as one blown jit cache."""
 
     def __init__(self, net, t_max: Optional[int] = None, mesh=None,
-                 spec_layout=None):
+                 spec_layout=None, sentinel: bool = False,
+                 logit_bound: Optional[float] = 1e4):
+        # on-device numerics sentinel (ISSUE 15): when enabled, the
+        # serving impls (decode blocks, batched/chunked prefill) fold a
+        # per-row finite/abs-bound check over the logits into their
+        # carries and append the verdict to the SAME array the engine
+        # already reads back — one extra int32 column, zero extra
+        # readbacks, `{}` steady compiles. Opt-in at construction: the
+        # sentinel and non-sentinel programs have different output
+        # shapes, so an engine must match its decoder's setting.
+        self.sentinel = bool(sentinel)
+        self.logit_bound = None if logit_bound is None \
+            else float(logit_bound)
         net._ensure_init()
         self.net = net
         conf = net.conf
@@ -559,6 +571,18 @@ class TransformerDecoder:
                                          axis=-1).astype(jnp.int32)
         return jnp.where(temps <= 0, greedy, sampled)
 
+    # graftlint: traced
+    def _fault_of(self, logits, stop=None):
+        """Per-row sentinel verdict over traced logits (sentinel
+        decoders only): non-finite or out-of-bound rows flag True;
+        frozen lanes (``stop``) are exempt — their overshoot logits are
+        never consumed, so they must not fail a finished request."""
+        from ..observability.integrity import logits_fault
+        bad = logits_fault(logits, self.logit_bound)
+        if stop is not None:
+            bad = bad & ~stop
+        return bad
+
     # ---------------------------------------------------------- jit entry
     def _jit_sharded(self, impl, donate, in_specs=None, out_specs=None):
         """jit with optional NamedSharding-constrained in/out shardings.
@@ -628,7 +652,14 @@ class TransformerDecoder:
                                 (slots[i], z, z, z))
                             for kk in ("k", "v")}
                         for n in self.attn_names}
-                return self._select(logits, temps, key), logits, merged
+                sel = self._select(logits, temps, key)
+                if self.sentinel:
+                    # verdict rides the SAME readback as the sampled
+                    # ids: [M] → [M, 2] (id, fault) — no extra sync
+                    sel = jnp.stack(
+                        [sel, self._fault_of(logits).astype(jnp.int32)],
+                        axis=1)
+                return sel, logits, merged
             # admission buckets (M = pow2 <= num_slots) may undershoot
             # the data axis, so the batch-side inputs stay unconstrained;
             # the SHARED cache keeps its pinned layout through the
@@ -641,7 +672,7 @@ class TransformerDecoder:
             c_len = int(name[1])
 
             def prefill_chunk_impl(params, state, caches, tokens, pos0,
-                                   valid, slot, temps, key):
+                                   valid, slot, temps, key, fault_in):
                 # one slot's [1, C] prompt window prefilled into the
                 # SHARED cache at [pos0, pos0+C): slice the slot row,
                 # run the chunk walk (embed at absolute positions,
@@ -661,7 +692,16 @@ class TransformerDecoder:
                                   (slot[0], z, z, z))
                               for kk in ("k", "v")}
                           for n in self.attn_names}
-                return self._select(logits, temps, key), merged
+                sel = self._select(logits, temps, key)
+                if self.sentinel:
+                    # windowed prefill has no per-window readback — the
+                    # verdict ACCUMULATES on device (fault_in is the
+                    # previous windows' OR) and is fetched only with
+                    # the final window's single readback
+                    fault = fault_in | \
+                        self._fault_of(logits).astype(jnp.int32)
+                    sel = jnp.stack([sel, fault], axis=1)
+                return sel, merged
             # per-chunk-size name, like the per-K decode blocks: two
             # chunk sizes share every input rank and a bare shared name
             # would read as a blown jit cache in the compile audit
@@ -672,20 +712,28 @@ class TransformerDecoder:
             fn = self._jit_sharded(
                 prefill_chunk_impl, donate,
                 in_specs=(psh, None, csh, None, None, None, None, None,
-                          None),
+                          None, None),
                 out_specs=(None, csh))
         elif name == "paged_prefill":
             def paged_prefill_impl(params, state, caches, tokens, pos0,
-                                   valid, ptables, temps, key):
+                                   valid, ptables, temps, key, fault_in):
                 # batched PAGED admission: every row is a tail window
                 # [pos0, pos0+valid) prefilled straight through its page
                 # table — a prefix-cache hit never recomputes the shared
                 # prefix's forward, it only attends its resident pages.
                 # Count and window-length are bucketed by the caller
-                # (pow2), so the signature set is finite.
+                # (pow2), so the signature set is finite. ``fault_in``
+                # [M] is the sentinel's accumulated verdict for chunked
+                # windows (zeros on direct admission; unused — and
+                # DCE'd — on a non-sentinel decoder).
                 logits, caches = self._walk_paged_chunk(
                     params, state, caches, ptables, tokens, pos0, valid)
-                return self._select(logits, temps, key), caches
+                sel = self._select(logits, temps, key)
+                if self.sentinel:
+                    fault = fault_in | \
+                        self._fault_of(logits).astype(jnp.int32)
+                    sel = jnp.stack([sel, fault], axis=1)
+                return sel, caches
             pool_sh = self._pool_shardings()
             # admission buckets may undershoot the data axis, so the
             # batch-side inputs stay unconstrained (like prefill_slots);
@@ -693,7 +741,7 @@ class TransformerDecoder:
             fn = self._jit_sharded(
                 paged_prefill_impl, donate,
                 in_specs=(psh, None, pool_sh, None, None, None, None,
-                          None, None),
+                          None, None, None),
                 out_specs=(None, pool_sh))
         elif isinstance(name, tuple) and name[0] == "paged_block":
             k_steps = int(name[1])
@@ -708,10 +756,12 @@ class TransformerDecoder:
                 # grows them between blocks (lazy page allocation), the
                 # scan itself never re-maps
                 def body(carry, _):
-                    caches, ids, pos, stop, step = carry
+                    caches, ids, pos, stop, fault, step = carry
                     pos_c = jnp.minimum(pos, self.t_max - 1)
                     logits, caches = self._walk_paged_decode(
                         params, state, caches, ptables, ids, pos_c)
+                    if self.sentinel:
+                        fault = fault | self._fault_of(logits, stop)
                     kk = jax.random.fold_in(
                         key, jnp.bitwise_or(key_salt, step + 1))
                     nxt = self._select(logits, temps, kk)
@@ -719,11 +769,19 @@ class TransformerDecoder:
                     hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
                     new_pos = jnp.where(stop, pos, pos + 1)
                     new_stop = stop | hit_eos | (new_pos >= self.t_max)
-                    return (caches, nxt, new_pos, new_stop, step + 1), nxt
-                (caches, ids, positions, stopped, _), toks = jax.lax.scan(
-                    body, (caches, ids, positions, stopped, step0), None,
-                    length=k_steps)
-                return toks.T, ids, positions, stopped, caches
+                    return (caches, nxt, new_pos, new_stop, fault,
+                            step + 1), nxt
+                fault0 = jnp.zeros_like(stopped)
+                (caches, ids, positions, stopped, fault, _), toks = \
+                    jax.lax.scan(
+                        body, (caches, ids, positions, stopped, fault0,
+                               step0), None, length=k_steps)
+                out = toks.T
+                if self.sentinel:
+                    # the verdict column rides the block's ONE readback
+                    out = jnp.concatenate(
+                        [out, fault.astype(jnp.int32)[:, None]], axis=1)
+                return out, ids, positions, stopped, caches
             paged_decode_block_impl.__name__ = \
                 f"paged_decode_block{k_steps}_impl"
             pool_sh = self._pool_shardings()
@@ -767,16 +825,19 @@ class TransformerDecoder:
                                   stopped, temps, eos_ids, key, step0,
                                   key_salt):
                 # K decode steps fused into ONE device program
-                # (lax.scan): cache state, per-row stop flags, and the
-                # absolute step counter ride the carry; only the [B, K]
-                # token matrix ever needs to cross to the host. The key
+                # (lax.scan): cache state, per-row stop flags, the
+                # sentinel's fault accumulator, and the absolute step
+                # counter ride the carry; only the [B, K(+1)] token
+                # matrix ever needs to cross to the host. The key
                 # schedule folds the ABSOLUTE step index, so a given
                 # lane samples identically for every block size.
                 def body(carry, _):
-                    caches, ids, pos, stop, step = carry
+                    caches, ids, pos, stop, fault, step = carry
                     pos_c = jnp.minimum(pos, self.t_max - 1)
                     logits, caches = self._walk_decode(params, state,
                                                        caches, ids, pos_c)
+                    if self.sentinel:
+                        fault = fault | self._fault_of(logits, stop)
                     kk = jax.random.fold_in(
                         key, jnp.bitwise_or(key_salt, step + 1))
                     nxt = self._select(logits, temps, kk)
@@ -787,11 +848,20 @@ class TransformerDecoder:
                     hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
                     new_pos = jnp.where(stop, pos, pos + 1)
                     new_stop = stop | hit_eos | (new_pos >= self.t_max)
-                    return (caches, nxt, new_pos, new_stop, step + 1), nxt
-                (caches, ids, positions, stopped, _), toks = jax.lax.scan(
-                    body, (caches, ids, positions, stopped, step0), None,
-                    length=k_steps)
-                return toks.T, ids, positions, stopped, caches
+                    return (caches, nxt, new_pos, new_stop, fault,
+                            step + 1), nxt
+                fault0 = jnp.zeros_like(stopped)
+                (caches, ids, positions, stopped, fault, _), toks = \
+                    jax.lax.scan(
+                        body, (caches, ids, positions, stopped, fault0,
+                               step0), None, length=k_steps)
+                out = toks.T
+                if self.sentinel:
+                    # one extra int32 column on the SAME readback — the
+                    # ≤1-readback-per-block invariant holds structurally
+                    out = jnp.concatenate(
+                        [out, fault.astype(jnp.int32)[:, None]], axis=1)
+                return out, ids, positions, stopped, caches
             # per-K name: the compile auditor attributes by __name__, and
             # two K values share every input shape — one shared name
             # would read as a blown-cache duplicate-signature compile
@@ -802,6 +872,88 @@ class TransformerDecoder:
                 in_specs=(psh, None, csh, row, row, row, row, row, None,
                           None, None),
                 out_specs=(mat, row, row, row, csh))
+        elif name == "scrub_slot":
+            def scrub_slot_impl(caches, slots):
+                # slab twin of scrub_pages_impl: zero the given slots'
+                # whole cache rows after a sentinel fault. Batched
+                # prefill rewrites [0, tp) on refill, but a CHUNK-
+                # admitted successor writes only its windows — residual
+                # NaN past its fill point would poison it through the
+                # masked probs·V contraction. Pad rows repeat a victim
+                # slot (idempotent zeroing), keeping signatures finite.
+                return {n: {kk: caches[n][kk].at[slots].set(0.0)
+                            for kk in ("k", "v")}
+                        for n in self.attn_names}
+            fn = self._jit_sharded(scrub_slot_impl,
+                                   train_donate_argnums((0,)),
+                                   in_specs=(csh, None),
+                                   out_specs=csh)
+        elif name == "scrub_pages":
+            def scrub_pages_impl(caches, pids):
+                # corruption response (ISSUE 15): zero the given pages
+                # before they re-enter the free list. Freed-page
+                # contents are normally don't-care (masked attention
+                # weights them 0.0), but 0.0 × NaN = NaN — non-finite
+                # residue from a detected fault would poison the NEXT
+                # stream mapped onto the page through the masked
+                # probs·V contraction. pids are pow2-bucketed; pad
+                # rows scrub the null/trash page (harmless by
+                # definition).
+                return {n: {kk: caches[n][kk].at[pids].set(0.0)
+                            for kk in ("k", "v")}
+                        for n in self.attn_names}
+            pool_sh = self._pool_shardings()
+            fn = self._jit_sharded(scrub_pages_impl,
+                                   train_donate_argnums((0,)),
+                                   in_specs=(pool_sh, None),
+                                   out_specs=pool_sh)
+        elif name == "corrupt_page":
+            def corrupt_page_impl(caches, pid, mode):
+                # CHAOS ONLY (device.corrupt_page): scripted silent-
+                # data-corruption of one pool page — NaN fill (mode 0)
+                # or a deterministic value flip (mode 1, sign-negate:
+                # plausible magnitudes, wrong values — exactly what the
+                # content checksums and the golden canary must catch
+                # without the sentinel's finite check ever tripping).
+                # Named + jitted like every impl so the compile auditor
+                # attributes the chaos compile instead of flagging an
+                # anonymous scatter.
+                out = {}
+                for n in self.attn_names:
+                    out[n] = {}
+                    for kk in ("k", "v"):
+                        page = caches[n][kk][pid]
+                        poison = jnp.where(mode == 0,
+                                           jnp.full_like(page, jnp.nan),
+                                           -page)
+                        out[n][kk] = caches[n][kk].at[pid].set(poison)
+                return out
+            pool_sh = self._pool_shardings()
+            fn = self._jit_sharded(corrupt_page_impl,
+                                   train_donate_argnums((0,)),
+                                   in_specs=(pool_sh, None, None),
+                                   out_specs=pool_sh)
+        elif name == "corrupt_cache":
+            def corrupt_cache_impl(caches, slot, pos, mode):
+                # CHAOS ONLY (device.corrupt_logits, slab path): poison
+                # one slot's cache CELL at an always-attended position —
+                # the next decode step's attention reads it and the
+                # logits go non-finite (NaN) or wrong (flip)
+                out = {}
+                for n in self.attn_names:
+                    out[n] = {}
+                    for kk in ("k", "v"):
+                        cell = caches[n][kk][slot, :, pos, :]
+                        poison = jnp.where(mode == 0,
+                                           jnp.full_like(cell, jnp.nan),
+                                           -cell)
+                        out[n][kk] = \
+                            caches[n][kk].at[slot, :, pos, :].set(poison)
+                return out
+            fn = self._jit_sharded(corrupt_cache_impl,
+                                   train_donate_argnums((0,)),
+                                   in_specs=(csh, None, None, None),
+                                   out_specs=csh)
         else:                                 # pragma: no cover
             raise KeyError(name)
         fn = self._with_cost_seam(name, fn)
@@ -816,7 +968,11 @@ class TransformerDecoder:
                 "prefill_slots": "prefill_slots_impl",
                 "paged_prefill": "paged_prefill_impl",
                 "kv_export": "kv_export_impl",
-                "kv_import": "kv_import_impl"}.get(name)
+                "kv_import": "kv_import_impl",
+                "scrub_pages": "scrub_pages_impl",
+                "scrub_slot": "scrub_slot_impl",
+                "corrupt_page": "corrupt_page_impl",
+                "corrupt_cache": "corrupt_cache_impl"}.get(name)
         if base is None and isinstance(name, tuple) and name[0] == "block":
             base = f"decode_block{int(name[1])}_impl"
         if base is None and isinstance(name, tuple) and name[0] == "chunk":
@@ -900,7 +1056,7 @@ class TransformerDecoder:
 
     # ------------------------------------------------------------- paged
     def paged_prefill(self, caches, tokens, pos0, valid, ptables,
-                      temps=None, key=None):
+                      temps=None, key=None, fault_in=None):
         """Batched tail prefill over PAGED pools: tokens [M, C] are
         each row's prompt tail starting at absolute position ``pos0``
         [M] (0 on a prefix-cache miss), ``valid`` [M] real tokens per
@@ -912,11 +1068,13 @@ class TransformerDecoder:
             else np.broadcast_to(np.asarray(temps, np.float32), (m,))
         if key is None:
             key = jax.random.PRNGKey(0)
+        if fault_in is None:
+            fault_in = np.zeros(m, np.int32)
         return self._fn("paged_prefill")(
             self._device_params(), self.net._inference_state(), caches,
             jnp.asarray(tokens, jnp.int32), jnp.asarray(pos0, jnp.int32),
             jnp.asarray(valid, jnp.int32), jnp.asarray(ptables, jnp.int32),
-            jnp.asarray(temps), key)
+            jnp.asarray(temps), key, jnp.asarray(fault_in, jnp.int32))
 
     def paged_decode_block(self, caches, ptables, ids, positions,
                            temps=None, key=None, *, block_size: int,
@@ -960,6 +1118,25 @@ class TransformerDecoder:
         rows target the null/trash page."""
         return self._fn("kv_import")(caches, jnp.asarray(pids, jnp.int32),
                                      frames)
+
+    def corrupt_page(self, caches, pid: int, mode: str = "nan"):
+        """CHAOS ONLY: scripted silent corruption of pool page ``pid``
+        (``device.corrupt_page`` payload) — returns the poisoned pools
+        (old ones donated). ``mode``: "nan" trips the sentinel's
+        finite check; "flip" (sign-negate) leaves plausible magnitudes
+        that only content checksums / the golden canary can catch."""
+        return self._fn("corrupt_page")(
+            caches, jnp.asarray(pid, jnp.int32),
+            jnp.asarray(0 if mode == "nan" else 1, jnp.int32))
+
+    def corrupt_cache(self, caches, slot: int, pos: int,
+                      mode: str = "nan"):
+        """CHAOS ONLY: scripted corruption of one slab cache cell
+        (``device.corrupt_logits`` payload on the slab path)."""
+        return self._fn("corrupt_cache")(
+            caches, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(0 if mode == "nan" else 1, jnp.int32))
 
     # ----------------------------------------------------------- generate
     def generate(self, prompts: Sequence, max_new_tokens: int,
@@ -1059,6 +1236,25 @@ class TransformerDecoder:
         ids_d, pos_d = nxt, jnp.asarray(lengths, jnp.int32)
         stop_d = np.zeros(b, bool)
         n_blocks = -(-n_steps // k)          # ceil
+
+        def fetch_block(dev) -> np.ndarray:
+            # sentinel decoders append the per-row fault verdict as one
+            # extra column on the block matrix (same single readback):
+            # a tripped REAL row fails the whole batch call — this is
+            # the library entry point, with no per-request recovery
+            # seam; the serving engine fails only the tripped request
+            arr = device_fetch(dev, tag="generate.decode")
+            if self.sentinel:
+                bad = np.nonzero(arr[:n_real, -1])[0]
+                if len(bad):
+                    from ..observability.integrity import NumericalFault
+                    raise NumericalFault(
+                        f"numerics sentinel tripped on row(s) "
+                        f"{bad.tolist()}: non-finite or out-of-bound "
+                        "logits in a decode block — tokens dropped")
+                arr = arr[:, :-1]
+            return arr
+
         pending = None
         for blk in range(n_blocks):
             toks, ids_d, pos_d, stop_d, caches = self.decode_block(
@@ -1066,13 +1262,13 @@ class TransformerDecoder:
                 eos_ids=eos_arr, stopped=stop_d, step0=blk * k)
             if pending is not None:
                 # read block t WHILE block t+1 computes (double buffer)
-                consume(device_fetch(pending, tag="generate.decode"))
+                consume(fetch_block(pending))
                 if finished.all():
                     pending = None     # in-flight block is pure overshoot
                     break
             pending = toks
         if pending is not None:
-            consume(device_fetch(pending, tag="generate.decode"))
+            consume(fetch_block(pending))
         return [np.concatenate([p, np.asarray(g, np.int32)])
                 for p, g in zip(prompts[:n_real], gen[:n_real])]
 
@@ -1335,7 +1531,8 @@ class SlotGenerationEngine:
                  num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  profiler=None, profiling: Optional[bool] = None,
-                 phase: str = "both", handoff=None):
+                 phase: str = "both", handoff=None,
+                 integrity=None):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -1345,6 +1542,22 @@ class SlotGenerationEngine:
             raise ValueError("shared decoder was built for a different "
                              "mesh; pass mesh= only when the engine owns "
                              "its decoder")
+        # ---- silent-data-corruption defense (ISSUE 15) ----
+        # integrity=None keeps every legacy path bit-identical. With a
+        # config: the decoder's impls fold the numerics sentinel into
+        # their carries (the engine then must unpack the verdict
+        # column), and paged engines content-verify prefix-cache pages.
+        from ..observability.integrity import (PageVerifier, as_integrity)
+        self._integrity = as_integrity(integrity)
+        want_sentinel = self._integrity is not None and \
+            self._integrity.sentinel
+        if decoder is not None and decoder.sentinel != want_sentinel:
+            raise ValueError(
+                f"shared decoder sentinel={decoder.sentinel} but the "
+                f"engine's integrity config wants {want_sentinel}: the "
+                "sentinel changes the impls' output shapes, so decoder "
+                "and engine must agree (build the shared decoder with "
+                "sentinel=, or drop integrity=)")
         # a shared decoder reuses its jitted prefill/decode programs
         # across engines (the A/B benches build several engines per run,
         # and a supervisor restart MUST reuse it: zero new compiles in
@@ -1352,8 +1565,20 @@ class SlotGenerationEngine:
         # sharded decoder carries its mesh/spec layout with it, so a
         # restart rebuilds the SAME sharded decode path for free
         self.decoder = decoder if decoder is not None \
-            else TransformerDecoder(net, t_max=t_max, mesh=mesh,
-                                    spec_layout=spec_layout)
+            else TransformerDecoder(
+                net, t_max=t_max, mesh=mesh, spec_layout=spec_layout,
+                sentinel=want_sentinel,
+                logit_bound=None if self._integrity is None
+                else self._integrity.logit_bound)
+        self._sentinel_on = want_sentinel
+        # chain-digest-keyed content checksums (recorded at prefix
+        # registration, verified on hits/adopts at the sampled rate)
+        self._kv_verifier = None
+        if self._integrity is not None and self._integrity.kv_verify \
+                and self._integrity.verify_every and paged:
+            self._kv_verifier = PageVerifier()
+        self._kv_hit_ctr = 0
+        self._adopt_ctr = 0
         self.mesh = self.decoder.mesh
         if self.mesh is not None:
             from ..parallel.mesh import validate_decode_mesh
@@ -1597,6 +1822,15 @@ class SlotGenerationEngine:
             "prompt tokens served from shared prefix pages "
             "(prefill compute skipped)",
             ("engine",)).labels(self.engine_id)
+        # SDC defense outcomes (ISSUE 15): sentinel trips and detected
+        # page corruptions, one labeled child per engine — the fleet's
+        # burn-rate quarantine and the scrape columns read these
+        from ..observability.integrity import (KV_CORRUPTION_COUNTER,
+                                               NUMERICAL_FAULT_COUNTER)
+        self._m_numfault = reg.counter(
+            *NUMERICAL_FAULT_COUNTER).labels(self.engine_id)
+        self._m_kv_corrupt = reg.counter(
+            *KV_CORRUPTION_COUNTER).labels(self.engine_id)
         # depth gauges evaluate lazily at collection time through a WEAK
         # reference: the process-default registry must never keep a dead
         # engine (and its device caches) alive
@@ -1686,16 +1920,22 @@ class SlotGenerationEngine:
                deadline: Optional[float] = None,
                route: Optional[str] = None,
                journal_id: Optional[str] = None,
-               _slo_sync_fail: bool = True) -> GenerationRequest:
+               _slo_sync_fail: bool = True,
+               _canary: bool = False) -> GenerationRequest:
         req = GenerationRequest(prompt, max_new_tokens, temperature, eos_id,
                                 deadline=deadline)
         req._engine = self
         # durable id (ISSUE 10): callers may pin one (the fleet router
         # reuses its request id so ledger fencing arbitrates recovery);
-        # otherwise a journaled engine mints a process-unique id
+        # otherwise a journaled engine mints a process-unique id.
+        # _canary=True (ISSUE 15, the fleet's golden-canary prober) is
+        # a synthetic probe: never journaled (a recovery must not
+        # resurrect it) and never SLO-accounted (probe outcomes must
+        # not move attainment) — it takes the REAL serving path
+        # otherwise, which is the whole point of the probe.
         if journal_id is not None:
             req.journal_id = str(journal_id)
-        elif self._journal is not None:
+        elif self._journal is not None and not _canary:
             req.journal_id = uuid.uuid4().hex[:16]
         # the engine opens the request's trace; route-side spans
         # (consume/publish) are appended onto it afterwards. The
@@ -1713,7 +1953,9 @@ class SlotGenerationEngine:
         # once the request is actually accepted (the fleet completion
         # gate accounts any sync failure it ends up propagating).
         req._slo_labels = {"replica": self.slo_label, "route": route}
-        if _slo_sync_fail:
+        if _canary:
+            req._slo_done = True      # SLO sink stays unarmed everywhere
+        elif _slo_sync_fail:
             req._slo = self._slo
         with self._lock:
             dead = self._dead
@@ -1788,8 +2030,10 @@ class SlotGenerationEngine:
                 else:
                     # past every synchronous fast-fail: arm the SLO sink
                     # BEFORE the append (the worker may complete the
-                    # request the instant it is visible in the queue)
-                    req._slo = self._slo
+                    # request the instant it is visible in the queue);
+                    # canary probes stay unarmed (synthetic traffic)
+                    if not _canary:
+                        req._slo = self._slo
                     self._pending.append(req)
         if headroom_shed:
             self._flightrec.record("shed", engine=self.engine_id,
@@ -2035,20 +2279,224 @@ class SlotGenerationEngine:
             self._release_slot_pages(s)
 
     # ------------------------------------------------- disagg handoff
-    def _export_pages(self, pids: List[int]) -> Dict:
+    def _export_pages(self, pids: List[int],
+                      tag: str = "kv_handoff") -> Dict:
         """Gather ``pids``'s page contents to host numpy (pow2-bucketed
         ``kv_export_impl`` dispatch; pad rows gather the trash page and
-        are sliced off). 2·layers readbacks, all under the
-        ``kv_handoff`` transfer tag — a handoff is one export, not one
+        are sliced off). 2·layers readbacks, all under the given
+        transfer tag (``kv_handoff`` for disagg exports,
+        ``integrity.verify`` for content checksums) — neither is a
         decode block, so the ≤1-readback-per-block audit is untouched."""
         nb = _round_up_pow2(len(pids), floor=1)
         pad = np.zeros(nb, np.int32)
         pad[:len(pids)] = pids
         tree = self.decoder.kv_export(self._caches, pad)
-        return {n: {kk: device_fetch(kv[kk],
-                                     tag="kv_handoff")[:len(pids)]
+        return {n: {kk: device_fetch(kv[kk], tag=tag)[:len(pids)]
                     for kk in ("k", "v")}
                 for n, kv in tree.items()}
+
+    # --------------------------------------------- KV content integrity
+    def _page_sums(self, pids: List[int]) -> List[bytes]:
+        """Content checksums for ``pids`` (ISSUE 15): one bucketed
+        export + one blake2b per page, hashing every layer's k then v
+        bytes in sorted-layer order — the SAME recipe PageFrameSet
+        stamps on handoff frames, so the two views of a page agree."""
+        from ..observability.integrity import page_content_checksum
+        frames = self._export_pages(pids, tag="integrity.verify")
+        names = sorted(frames)
+        return [page_content_checksum(
+                    [frames[n][kk][j] for n in names for kk in ("k", "v")])
+                for j in range(len(pids))]
+
+    def _record_page_sums(self, entries: List[Tuple[np.ndarray,
+                                                    int]]) -> None:
+        """Record content references for freshly registered prefix
+        chains. ``entries`` are (ctx, full page count) rows from this
+        wave; the references hash the pages the INDEX retains (the
+        allocator's resident page per digest), deduped by (digest,
+        pid) so each unique content is exported and hashed exactly
+        once for its cached lifetime. Serve-loop thread, no engine
+        lock held — cached pages are never rewritten, so the read is
+        race-free by the prefix cache's own immutability contract."""
+        from .paging import chain_digests
+        need: List[Tuple[bytes, int]] = []
+        seen = set()
+        for ctx, n_full in entries:
+            digests = chain_digests(ctx[:n_full * self.page_size],
+                                    self.page_size)
+            for dg in digests:
+                if dg in seen:
+                    continue
+                seen.add(dg)
+                pid = self._pager.cached_page(dg)
+                if pid is None or \
+                        self._kv_verifier.expected(dg, pid) is not None:
+                    continue
+                need.append((dg, int(pid)))
+        if not need:
+            return
+        sums = self._page_sums([pid for _, pid in need])
+        for (dg, pid), cs in zip(need, sums):
+            self._kv_verifier.record(dg, pid, cs)
+
+    def _verify_matched(self, ctx: np.ndarray,
+                        shared: List[int]) -> Optional[int]:
+        """Sampled content verification of a prefix-cache hit: export
+        the matched pages, hash, and compare against the recorded
+        references. Returns the first corrupt page INDEX (into
+        ``shared``) or None. On corruption: the whole chain from the
+        corrupt page is evicted (no new stream can map it), this
+        match's refs are returned, streams still mapping a corrupt
+        page are preempted to re-prefill (requeue-at-head — the
+        existing exactly-once machinery), and the caller degrades the
+        match to a miss."""
+        from .paging import chain_digests
+        digests = chain_digests(
+            ctx[:len(shared) * self.page_size], self.page_size)
+        sums = self._page_sums(shared)
+        bad = None
+        for j, (dg, pid) in enumerate(zip(digests, shared)):
+            verdict = self._kv_verifier.check(dg, int(pid), sums[j])
+            if verdict is False:
+                bad = j
+                break
+        if bad is None:
+            return None
+        # release THIS match's refs (taken by match_and_ref) and evict
+        # the chain from the corrupt page on — then scrub whatever is
+        # now free (pages a healthy holder still maps keep their bytes
+        # until that holder releases; nothing NEW can map them)
+        for pid in shared:
+            self._pager.unref(pid)
+        evicted = self._pager.evict_digests(digests[bad:])
+        self._kv_verifier.forget(digests[bad:])
+        self._scrub_pages(shared[bad:])
+        self._m_kv_corrupt.inc()
+        self._flightrec.record(
+            "kv_corruption", engine=self.engine_id, page=int(shared[bad]),
+            chain_evicted=evicted, detector="prefix_hit")
+        self._preempt_corrupt_holders(set(shared[bad:]))
+        return bad
+
+    def _preempt_corrupt_holders(self, pids: set) -> None:
+        """Requeue every stream currently mapping a corrupt page: its
+        tokens so far ride the request, re-admission re-prefills them
+        through fresh pages (the poisoned chain is already evicted, so
+        the re-prefill cannot re-map it) — the same exactly-once
+        requeue-at-head path pool-pressure preemption uses."""
+        victims: List[GenerationRequest] = []
+        scrub: List[int] = []
+        with self._lock:
+            for s in range(self.num_slots):
+                if not pids.intersection(self._slot_pages[s]):
+                    continue
+                req = None
+                if self._slots[s] is not None:
+                    req = self._slots[s]
+                    self._slots[s] = None
+                elif s in self._chunking:
+                    req = self._chunking.pop(s)[0]
+                # the victim's PRIVATE tail pages were computed
+                # attending the corrupt chain — scrub them too
+                scrub.extend(self._slot_pages[s])
+                self._release_slot_pages(s)
+                if req is not None and not req.done():
+                    req._running = False
+                    self._pending.appendleft(req)
+                    self._m["page_preempted"].inc()
+                    victims.append(req)
+                self._carry = None   # graftlint: disable=GL006 — under
+                #                      self._lock (the _locked contract)
+        self._scrub_pages(scrub)
+        for req in victims:
+            if req.trace is not None:
+                req.trace.event("kv_corruption_preempt",
+                                engine=self.engine_id,
+                                generated=len(req.generated))
+            self._flightrec.record("page_preempt", engine=self.engine_id,
+                                   reason="kv_corruption",
+                                   generated=len(req.generated))
+            if self._journal is not None and req.journal_id is not None:
+                self._journal.requeued(req)
+
+    def _scrub_pages(self, pids: List[int]) -> None:
+        """Zero pages on device (corruption response — see
+        ``scrub_pages_impl``). Serve-loop thread; pow2-bucketed like
+        every page-indexed dispatch, pad rows target the null page.
+        Safe on already-freed pages: allocation happens only on this
+        thread, so nothing can map them mid-scrub."""
+        if self._pager is None or not pids:
+            return
+        # only truly-free pages are zeroed: a suspect page a HEALTHY
+        # stream still maps keeps its bytes until that holder releases
+        # (its index entry is already evicted, so no new mapper exists)
+        pids = self._pager.free_subset(pids)
+        if not pids:
+            return
+        nb = _round_up_pow2(len(pids), floor=1)
+        pad = np.zeros(nb, np.int32)
+        pad[:len(pids)] = pids
+        self._caches = self.decoder._fn("scrub_pages")(  # graftlint: disable=GL006
+            self._caches, jnp.asarray(pad))
+
+    def _scrub_slots(self, slots: List[int]) -> None:
+        """Slab twin of :meth:`_scrub_pages`: zero faulted slots' cache
+        rows before the refill seam can hand them to a successor (a
+        chunk-admitted tenant writes only its windows, so non-finite
+        residue past its fill point would otherwise poison it)."""
+        if self._pager is not None or not slots:
+            return
+        nb = _round_up_pow2(len(slots), floor=1)
+        pad = np.full(nb, slots[0], np.int32)   # idempotent re-zeroing
+        pad[:len(slots)] = slots
+        self._caches = self.decoder._fn("scrub_slot")(  # graftlint: disable=GL006
+            self._caches, jnp.asarray(pad))
+
+    # ------------------------------------------- scripted corruption
+    def _corrupt_registered_page(self, ctx: np.ndarray,
+                                 mode: str) -> None:
+        """CHAOS ONLY (device.corrupt_page@registered): poison the
+        FIRST cached page of ``ctx``'s prefix chain on device — the
+        at-rest silent-corruption injection the sampled verification
+        and the golden canary must catch. Serve-loop thread; the pools
+        thread through like any dispatch."""
+        from .paging import chain_digests
+        digests = chain_digests(ctx[:self.page_size], self.page_size)
+        pid = None if not digests \
+            else self._pager.cached_page(digests[0])
+        if pid is None:
+            return
+        # serve-loop-owned pools, same single-thread contract as every
+        # dispatch site
+        self._caches = self.decoder.corrupt_page(  # graftlint: disable=GL006
+            self._caches, int(pid), mode)
+        self._flightrec.record(
+            "corruption_injected", engine=self.engine_id,
+            point="device.corrupt_page", where="registered",
+            page=int(pid), mode=mode)
+
+    def _inject_corrupt_logits(self, mode: str, s: int) -> None:
+        """CHAOS ONLY (device.corrupt_logits): poison lane ``s``'s
+        always-attended KV state right before a block dispatch — the
+        block's logits go non-finite (nan) or silently wrong (flip),
+        which is exactly what the sentinel / burn-rate quarantine must
+        detect end-to-end."""
+        detail = {}
+        if self._pager is not None:
+            with self._lock:
+                pages = list(self._slot_pages[s])
+            if not pages:
+                return
+            self._caches = self.decoder.corrupt_page(  # graftlint: disable=GL006
+                self._caches, int(pages[0]), mode)
+            detail["page"] = int(pages[0])
+        else:
+            self._caches = self.decoder.corrupt_cache(  # graftlint: disable=GL006
+                self._caches, int(s), 0, mode)
+            detail["slot"] = int(s)
+        self._flightrec.record(
+            "corruption_injected", engine=self.engine_id,
+            point="device.corrupt_logits", mode=mode, **detail)
 
     def _import_pages(self, pids: List[int], frames: Dict) -> None:
         """Scatter host page frames into this pool at ``pids``
@@ -2092,7 +2540,26 @@ class SlotGenerationEngine:
         t0 = interval_now()
         frames = self._export_pages(pages)
         t1 = interval_now()
-        state = PageFrameSet(ps, ctx, frames)
+        # content checksums are stamped only when the integrity config
+        # arms verification: the integrity-off handoff path must stay
+        # bit-and-cost-identical to r19 (CRC-only)
+        state = PageFrameSet(
+            ps, ctx, frames,
+            checksums=None if self._kv_verifier is not None else False)
+        # scripted MID-HANDOFF corruption (device.corrupt_page, site
+        # "handoff"): flip the host frames AFTER their content
+        # checksums were stamped — every CRC downstream still passes,
+        # only content verification (wire decode / adopt intake) can
+        # catch it
+        plan = self._faults.corruption("device.corrupt_page",
+                                       where="handoff")
+        if plan is not None:
+            from ..observability.integrity import corrupt_host_frames
+            corrupt_host_frames(state, plan["mode"])
+            self._flightrec.record(
+                "corruption_injected", engine=self.engine_id,
+                point="device.corrupt_page", where="handoff",
+                mode=plan["mode"])
         cancelled = req._cancel_requested
         with self._lock:
             if self._quarantined or self._shutdown:
@@ -2167,6 +2634,32 @@ class SlotGenerationEngine:
             raise ValueError(
                 f"frame set covers {len(kv.tokens)} context tokens; the "
                 f"request resumes at {expect}")
+        # sampled CONTENT verification at intake (ISSUE 15): re-hash
+        # the frames against the checksums stamped at export — a flip
+        # anywhere in the export→ship→intake window fails HERE, before
+        # a single corrupt byte is scattered into this pool (the
+        # router's except path re-prefills on a prefill worker, fenced
+        # exactly-once)
+        if self._kv_verifier is not None and hasattr(kv, "verify") and \
+                not getattr(kv, "_verified", False):
+            # _verified: a serialized transport's wire decode already
+            # swept these exact frames — re-hashing here would double
+            # the cost for zero coverage (the in-process handle-passing
+            # path is what this sampled check exists for)
+            with self._lock:
+                self._adopt_ctr += 1
+                due = self._adopt_ctr % self._integrity.verify_every == 0
+            if due:
+                bad = kv.verify()
+                if bad:
+                    from .paging import PageCorruptionError
+                    self._m_kv_corrupt.inc()
+                    self._flightrec.record(
+                        "kv_corruption", engine=self.engine_id,
+                        detector="adopt", pages=len(bad))
+                    raise PageCorruptionError(
+                        f"adopt intake: page content checksum mismatch "
+                        f"on page(s) {bad} — corrupt frames refused")
         if req.trace is not None:
             req.trace.event("adopt", engine=self.engine_id,
                             ctx=len(kv.tokens))
@@ -2323,6 +2816,10 @@ class SlotGenerationEngine:
                     "kv_handoff", engine=self.engine_id, stage="import",
                     pages=len(import_idx), shared=len(shared),
                     ms=round((t1 - t0) * 1e3, 3))
+            if self._kv_verifier is not None:
+                # adopted chains are shareable on THIS pool now: record
+                # their content references like any registration
+                self._record_page_sums([(tokens, n_ctx // ps)])
             if finish is not None:
                 finish._complete()
 
@@ -2430,6 +2927,31 @@ class SlotGenerationEngine:
         return (req.eos_id is not None and tok == req.eos_id) or \
             len(req.generated) >= req.max_new_tokens or \
             len(req.prompt) + len(req.generated) >= self.t_max
+
+    def _fail_faulted(self, faulted: List[GenerationRequest],
+                      where: str) -> None:
+        """Fail sentinel-tripped requests with a typed NumericalFault —
+        outside the engine lock (``_fail`` fires done-callbacks: the
+        fleet's completion gate re-dispatches and may quarantine the
+        replica). The poisoned tokens were already dropped by the
+        caller; the request's ``generated`` holds only clean tokens, so
+        a fleet re-dispatch resumes token-identically elsewhere."""
+        if not faulted:
+            return
+        from ..observability.integrity import NumericalFault
+        for req in faulted:
+            if req.trace is not None:
+                req.trace.event("numerical_fault", engine=self.engine_id,
+                                where=where,
+                                generated=len(req.generated))
+            self._flightrec.record(
+                "numerical_fault", engine=self.engine_id, where=where,
+                generated=len(req.generated))
+            req._fail(NumericalFault(
+                f"numerics sentinel tripped on engine {self.engine_id} "
+                f"({where}): non-finite or out-of-bound logits after "
+                f"{len(req.generated)} clean tokens — the poisoned "
+                "tokens were dropped, nothing was served"))
 
     def _sweep_pending(self):
         """Fail queued requests that were cancelled or ran out of
@@ -2577,7 +3099,12 @@ class SlotGenerationEngine:
         with self._lock:
             if not self._unpark(req):
                 return False
-            self._chunking[s] = [req, ctx, filled]
+            # [request, full context, tokens filled, sentinel fault
+            # accumulator (device [1] int32, None until the first
+            # window — non-final windows never read back, so the
+            # verdict ORs on device and crosses only with the final
+            # window's single readback)]
+            self._chunking[s] = [req, ctx, filled, None]
             # park the lane's decode write-head at the LAST cache cell:
             # a frozen lane re-writes its own cell every block, and a
             # stale position would clobber chunk-prefilled cells
@@ -2672,7 +3199,13 @@ class SlotGenerationEngine:
                                    PREFILL_BATCH_SALT | batch_no))
             toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
             t_pre1 = interval_now()
+            fault_col = None
+            if self._sentinel_on:
+                # verdict packed with the sampled ids: [M, 2] → split
+                fault_col, toks = toks[:, 1], toks[:, 0]
             finishers: List[GenerationRequest] = []
+            faulted: List[GenerationRequest] = []
+            scrub_slots: List[int] = []
             jlog: List[Tuple] = []       # journal appends, written
             #                              OUTSIDE the engine lock below
             with self._lock:
@@ -2687,6 +3220,17 @@ class SlotGenerationEngine:
                     if req not in self._admitting:
                         continue          # pragma: no cover — defensive
                     self._admitting.remove(req)
+                    if fault_col is not None and fault_col[i]:
+                        # sentinel tripped during this row's prefill:
+                        # the first token is suspect — never appended,
+                        # never journaled, the slot stays free (and is
+                        # scrubbed below: the scattered row may carry
+                        # non-finite residue a chunk-admitted successor
+                        # would attend)
+                        scrub_slots.append(s)
+                        self._m_numfault.inc()
+                        faulted.append(req)
+                        continue
                     tok = int(toks[i])
                     req._running = True
                     if self._journal is not None and \
@@ -2733,6 +3277,8 @@ class SlotGenerationEngine:
                 # races ahead of the tokens it summarizes
                 self._journal.retired(jlog)
             t_journal = interval_now() if prof is not None else t_host
+            self._scrub_slots(scrub_slots)
+            self._fail_faulted(faulted, where="prefill")
             for req in finishers:
                 req._complete()
             if prof is not None:
@@ -2806,6 +3352,19 @@ class SlotGenerationEngine:
                 # leave nothing to prefill FROM)
                 shared, start = self._pager.match_and_ref(
                     ctx, max_tokens=len(ctx) - 1)
+                if shared and self._kv_verifier is not None:
+                    # sampled content verification (ISSUE 15): every
+                    # verify_every'th hit re-hashes the matched pages
+                    # against their registration-time checksums; a
+                    # mismatch evicts the chain and degrades THIS
+                    # match to a miss (fresh pages, full prefill)
+                    with self._lock:
+                        self._kv_hit_ctr += 1
+                        due = self._kv_hit_ctr % \
+                            self._integrity.verify_every == 0
+                    if due and \
+                            self._verify_matched(ctx, shared) is not None:
+                        shared, start = [], 0
                 tail = len(ctx) - start
                 chunked = self.prefill_chunk is not None and \
                     tail > self.prefill_chunk
@@ -2891,8 +3450,15 @@ class SlotGenerationEngine:
                                        PREFILL_BATCH_SALT | batch_no))
             toks = device_fetch(nxt, tag="engine.prefill")  # ONE readback
             t_pre1 = interval_now()
+            fault_col = None
+            if self._sentinel_on:
+                fault_col, toks = toks[:, 1], toks[:, 0]
             finishers: List[GenerationRequest] = []
+            faulted: List[GenerationRequest] = []
+            scrub: List[int] = []
             handoffs: List[Tuple[GenerationRequest, int, np.ndarray]] = []
+            to_sum: List[Tuple[np.ndarray, int]] = []
+            registered_ctx: Optional[np.ndarray] = None
             jlog: List[Tuple] = []
             with self._lock:
                 if self._shutdown or self._quarantined:
@@ -2904,6 +3470,22 @@ class SlotGenerationEngine:
                     if req not in self._admitting:
                         continue          # pragma: no cover — defensive
                     self._admitting.remove(req)
+                    if fault_col is not None and fault_col[i]:
+                        # sentinel tripped during this row's prefill:
+                        # never registered into the prefix cache, never
+                        # journaled, pages scrubbed + released, slot
+                        # stays free (matched SHARED pages it attended
+                        # are suspect too — evicted like a decode
+                        # fault's, their checksum references dropped)
+                        scrub.extend(self._slot_pages[s])
+                        dgs = self._pager.evict_pages(
+                            self._slot_pages[s])
+                        if self._kv_verifier is not None:
+                            self._kv_verifier.forget(dgs)
+                        self._release_slot_pages(s)
+                        self._m_numfault.inc()
+                        faulted.append(req)
+                        continue
                     tok = int(toks[i])
                     req._running = True
                     if self._journal is not None and \
@@ -2927,6 +3509,12 @@ class SlotGenerationEngine:
                     # maps these instead of recomputing their forward
                     self._pager.register_chain(
                         ctx, self._slot_pages[s][:len(ctx) // ps])
+                    if len(ctx) // ps:
+                        registered_ctx = ctx
+                    if self._kv_verifier is not None:
+                        # content references recorded OUTSIDE the lock
+                        # below (the export is a device fetch)
+                        to_sum.append((ctx, len(ctx) // ps))
                     if self._req_finished(req, tok):
                         self._m["completed"].inc()
                         finishers.append(req)   # done at the first token
@@ -2958,6 +3546,21 @@ class SlotGenerationEngine:
             if jlog:
                 self._journal.retired(jlog)
             t_journal = interval_now() if prof is not None else t_host
+            self._scrub_pages(scrub)
+            self._fail_faulted(faulted, where="paged_prefill")
+            if to_sum:
+                self._record_page_sums(to_sum)
+            # scripted at-rest corruption (device.corrupt_page, site
+            # "registered"): poison the first page of the chain this
+            # wave just published — the next prefix-cache hit (sampled
+            # verification) or the golden canary must catch it before
+            # any new stream attends the bytes
+            if registered_ctx is not None:
+                plan = self._faults.corruption("device.corrupt_page",
+                                               where="registered")
+                if plan is not None:
+                    self._corrupt_registered_page(registered_ctx,
+                                                  plan["mode"])
             for req in finishers:
                 req._complete()
             if prof is not None:
@@ -3015,7 +3618,7 @@ class SlotGenerationEngine:
             req._fail(exc)
         if entry is None:
             return
-        s, req, ctx, filled = entry
+        s, req, ctx, filled, fdev = entry
         c = self.prefill_chunk
         # the final window may slide LEFT so it always fits the cache
         # depth (rewriting a cell from the same tokens is idempotent up
@@ -3072,12 +3675,15 @@ class SlotGenerationEngine:
             req._admitted_t = t0          # SLO queue-wait ends at the
         #                                   FIRST window's dispatch
         self._faults.fire("engine.prefill")
+        fault_arr = fdev if fdev is not None \
+            else jnp.zeros(1, jnp.int32)
         if self._pager is not None:
             nxt, self._caches = self.decoder.paged_prefill(
                 self._caches, tokens, np.asarray([pos0], np.int32),
                 np.asarray([valid], np.int32), ptab,
                 np.asarray([req.temperature], np.float32),
-                key=jax.random.fold_in(self._key, CHUNK_SALT | chunk_no))
+                key=jax.random.fold_in(self._key, CHUNK_SALT | chunk_no),
+                fault_in=fault_arr)
         else:
             nxt, self._caches = self.decoder._fn(("chunk", c))(
                 self.decoder._device_params(),
@@ -3086,10 +3692,16 @@ class SlotGenerationEngine:
                 jnp.asarray([valid], jnp.int32),
                 jnp.asarray([s], jnp.int32),
                 jnp.asarray([req.temperature], jnp.float32),
-                jax.random.fold_in(self._key, CHUNK_SALT | chunk_no))
+                jax.random.fold_in(self._key, CHUNK_SALT | chunk_no),
+                fault_arr)
         tok = None
+        fault = False
         if final:
-            tok = int(device_fetch(nxt, tag="engine.prefill")[0])
+            arr = device_fetch(nxt, tag="engine.prefill")
+            if self._sentinel_on:
+                tok, fault = int(arr[0, 0]), bool(arr[0, 1])
+            else:
+                tok = int(arr[0])
         t1 = interval_now()
         if self._tracing:
             self._flightrec.record(
@@ -3105,6 +3717,10 @@ class SlotGenerationEngine:
                                     final=final)
         jlog: List[Tuple] = []
         finish = None
+        faulted: List[GenerationRequest] = []
+        scrub: List[int] = []
+        scrub_slots: List[int] = []
+        registered = False
         handoff_entry = None
         with self._lock:
             if self._quarantined or self._shutdown:
@@ -3115,6 +3731,25 @@ class SlotGenerationEngine:
             self._ewma_locked("_est_prefill", t1 - t0)
             if not final:
                 cur[2] = pos0 + valid
+                if self._sentinel_on:
+                    # accumulated verdict stays ON DEVICE between
+                    # windows (a lazy [1] slice, no readback)
+                    cur[3] = nxt[:, 1]
+            elif fault:
+                # sentinel tripped somewhere in the windows: nothing
+                # was emitted or registered — scrub, release, fail typed
+                del self._chunking[s]
+                self._m["host_readbacks"].inc()
+                scrub = list(self._slot_pages[s])
+                if self._pager is not None:
+                    dgs = self._pager.evict_pages(scrub)
+                    if self._kv_verifier is not None:
+                        self._kv_verifier.forget(dgs)
+                else:
+                    scrub_slots.append(s)
+                self._release_slot_pages(s)
+                self._m_numfault.inc()
+                faulted.append(req)
             else:
                 del self._chunking[s]
                 self._m["host_readbacks"].inc()
@@ -3132,6 +3767,7 @@ class SlotGenerationEngine:
                     self._pager.register_chain(
                         ctx, self._slot_pages[s][:len(ctx) //
                                                  self.page_size])
+                    registered = True
                 if self._req_finished(req, tok):
                     self._m["completed"].inc()
                     finish = req
@@ -3157,6 +3793,16 @@ class SlotGenerationEngine:
             # first token journaled before the finisher completes,
             # outside the engine lock (GL010) — same contract as _admit
             self._journal.retired(jlog)
+        self._scrub_pages(scrub)
+        self._scrub_slots(scrub_slots)
+        self._fail_faulted(faulted, where="prefill_chunk")
+        if registered and self._kv_verifier is not None:
+            self._record_page_sums([(ctx, len(ctx) // self.page_size)])
+        if registered:
+            plan = self._faults.corruption("device.corrupt_page",
+                                           where="registered")
+            if plan is not None:
+                self._corrupt_registered_page(ctx, plan["mode"])
         if finish is not None:
             finish._complete()
         if handoff_entry is not None:
@@ -3174,11 +3820,14 @@ class SlotGenerationEngine:
         share the device fairly."""
         if self._chunking:
             self._advance_chunks()
-        if self.block_size > 1 or self._pager is not None:
+        if self.block_size > 1 or self._pager is not None or \
+                self._sentinel_on:
             # paged engines always decode through the block path (K=1
             # blocks included): one paged_decode_block{K}_impl family
             # serves every configuration, and page growth/preemption
-            # has exactly one seam
+            # has exactly one seam. Sentinel engines do too: the
+            # verdict column rides the block impls' readback (the K=1
+            # block is step-for-step identical to the legacy loop).
             return self._step_block()
         self._enforce_slots()
         with self._lock:
@@ -3326,6 +3975,13 @@ class SlotGenerationEngine:
             (ids, pos, stop), step0, temps, eos, ptab, qdepth = dispatch
             if self.adaptive_block:
                 self._m_k.labels(self.engine_id, str(k)).inc()
+            # scripted compute corruption (device.corrupt_logits):
+            # poison an active lane's attended KV state so THIS block's
+            # logits corrupt — the sentinel's verdict column must trip
+            # before any token reaches a caller
+            plan = self._faults.corruption("device.corrupt_logits")
+            if plan is not None:
+                self._inject_corrupt_logits(plan["mode"], snapshot[0][0])
             t_disp = interval_now()
             self._faults.fire("engine.step")
             if self._pager is not None:
@@ -3359,6 +4015,12 @@ class SlotGenerationEngine:
         toks_dev, snapshot, k, t_disp, qdepth = block
         host = device_fetch(toks_dev, tag="engine.decode")
         t_ret = interval_now()
+        fault_col = None
+        if self._sentinel_on:
+            # the sentinel verdict is column K of the SAME fetched
+            # matrix — still exactly one readback per block
+            fault_col = host[:, k]
+            host = host[:, :k]
         with self._lock:
             self._ewma_locked("_est_step", (t_ret - t_disp) / max(1, k))
         if self._tracing:
@@ -3367,6 +4029,9 @@ class SlotGenerationEngine:
                                    k=k, lanes=len(snapshot),
                                    ms=round((t_ret - t_disp) * 1e3, 3))
         finished: List[GenerationRequest] = []
+        faulted: List[GenerationRequest] = []
+        scrub: List[int] = []
+        scrub_slots: List[int] = []
         jlog: List[Tuple] = []
         with self._lock:
             if self._quarantined or self._shutdown:
@@ -3378,6 +4043,30 @@ class SlotGenerationEngine:
                 if req.done() or self._slots[s] is not req:
                     continue   # finished/cancelled since dispatch:
                                # the lane's tokens are overshoot
+                if fault_col is not None and fault_col[s]:
+                    # numerics sentinel tripped on this lane: the whole
+                    # block's tokens are suspect (the first bad step's
+                    # token fed every later one) — DROP them all, free
+                    # the lane, fail the request typed. Nothing from
+                    # this block ever reaches the caller or the journal.
+                    self._slots[s] = None
+                    if self._pager is not None:
+                        # every page the lane mapped is suspect — incl.
+                        # prompt pages it registered: evict them from
+                        # the prefix index (no future stream may map
+                        # suspect bytes), drop their checksum
+                        # references (a stale ref re-fires on pid
+                        # reuse), then scrub before reuse
+                        scrub.extend(self._slot_pages[s])
+                        dgs = self._pager.evict_pages(self._slot_pages[s])
+                        if self._kv_verifier is not None:
+                            self._kv_verifier.forget(dgs)
+                    else:
+                        scrub_slots.append(s)
+                    self._release_slot_pages(s)
+                    self._m_numfault.inc()
+                    faulted.append(req)
+                    continue
                 closed = False
                 took = 0
                 base = len(req.generated)
@@ -3405,7 +4094,7 @@ class SlotGenerationEngine:
                     self._last_ids[s] = int(host[s, k - 1])
             self._m["emitted_tokens"].inc(emitted)
             self._first_step_done = True
-            if finished:
+            if finished or faulted:
                 # freed lanes must not keep decoding from the device
                 # carry: resync (and let _admit refill) next dispatch
                 self._carry = None
@@ -3420,6 +4109,13 @@ class SlotGenerationEngine:
             # one fsync per the journal's policy) per decode block
             self._journal.retired(jlog)
         t_journal = interval_now() if prof is not None else t_host
+        # faulted lanes' pages/cells carry potentially non-finite
+        # residue: zero them before reuse (serve thread — nothing can
+        # map the freed pages / refill the slot until the next
+        # admission on this same thread)
+        self._scrub_pages(scrub)
+        self._scrub_slots(scrub_slots)
+        self._fail_faulted(faulted, where=f"decode_block{k}")
         for req in finished:
             req._complete()
         if prof is not None:
@@ -3524,6 +4220,10 @@ class SlotGenerationEngine:
         out["prefix_cache_hits"] = int(self._m_prefix_hit.value)
         out["prefix_cache_misses"] = int(self._m_prefix_miss.value)
         out["prefix_cache_hit_tokens"] = int(self._m_prefix_tokens.value)
+        # SDC defense outcomes (ISSUE 15): plain ints, merged across
+        # supervisor rebuilds like every other counter
+        out["numerical_faults"] = int(self._m_numfault.value)
+        out["kv_page_corruptions"] = int(self._m_kv_corrupt.value)
         with self._lock:
             # adopted handoffs awaiting a slot ARE queued work: the
             # disagg router's least-loaded decode dispatch reads this

@@ -299,6 +299,61 @@ class PageAllocator:
                 self._mutations += 1
         return added
 
+    def evict_digests(self, digests: Sequence[bytes]) -> int:
+        """Forcibly drop prefix-index entries — the CORRUPTION response
+        (ISSUE 15): a page whose content failed checksum verification
+        evicts its WHOLE chain (itself and every descendant, since a
+        child's chain digest commits to the corrupt prefix), so no new
+        stream can ever map the poisoned bytes. Each evicted entry
+        loses the index's retention ref; pages still mapped by live
+        slots stay alive until their holders release (those streams
+        are the engine's to preempt). Returns the entries dropped."""
+        n = 0
+        with self._lock:
+            for dg in digests:
+                pid = self._chains.pop(dg, None)
+                if pid is None:
+                    continue
+                self._lru.pop(dg, None)
+                self._digest_of.pop(pid, None)
+                self._unref_locked(pid)
+                n += 1
+            if n:
+                self._mutations += 1
+        return n
+
+    def cached_page(self, digest: bytes) -> Optional[int]:
+        """Page id the index currently holds for ``digest`` (None when
+        not resident) — the corruption-injection sites target cached
+        pages through this lookup."""
+        with self._lock:
+            return self._chains.get(digest)
+
+    def evict_pages(self, pids: Sequence[int]) -> List[bytes]:
+        """Drop any prefix-index entry held on one of ``pids`` (the
+        corruption response for a SENTINEL fault: every page a faulted
+        lane mapped is suspect, including prompt pages it registered —
+        future streams must re-prefill rather than map suspect bytes).
+        Returns the evicted chain digests so the caller can drop its
+        checksum references too (a stale reference re-fires on pid
+        reuse)."""
+        with self._lock:
+            dgs = [self._digest_of.get(int(p)) for p in pids]
+        dgs = [d for d in dgs if d is not None]
+        self.evict_digests(dgs)
+        return dgs
+
+    def free_subset(self, pids: Sequence[int]) -> List[int]:
+        """The subset of ``pids`` currently on the free list — the
+        scrub filter: a suspect page still mapped by a HEALTHY stream
+        must not be zeroed under it (that stream keeps its content
+        until it releases; the index entry is already evicted, so no
+        NEW stream maps it)."""
+        with self._lock:
+            return sorted({int(p) for p in pids
+                           if int(p) != NULL_PAGE and
+                           self._refs[int(p)] == 0})
+
     # ------------------------------------------------------ observation
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -370,8 +425,26 @@ class PageAllocator:
 # --------------------------------------------------------- page frames
 class PageFrameError(ValueError):
     """A page-frame payload failed validation (bad magic/version, CRC
-    mismatch, truncated buffer, or geometry that does not match the
-    receiving pool)."""
+    mismatch, truncated buffer, a hostile length prefix, or geometry
+    that does not match the receiving pool)."""
+
+
+class PageCorruptionError(PageFrameError):
+    """A page frame's CONTENT failed checksum verification (ISSUE 15):
+    the bytes arrived intact by CRC but do not hash to the checksum
+    stamped at export — the signature of silent corruption between the
+    sender's export and the receiver's intake (a flipped host buffer, a
+    bad DMA). The disagg tier re-prefills the affected stream on a
+    surviving prefill worker and counts ``kv_page_corruption_total``."""
+
+
+#: header/allocation sanity cap for hostile wire payloads: a decoded
+#: frame set may claim at most this many times the RECEIVED byte count
+#: (the real ratio is ~1 — page frames are raw array bytes), so a
+#: forged 8-byte length prefix or a huge ``n_pages`` header raises
+#: :class:`PageFrameError` instead of driving ``np.zeros`` into a
+#: MemoryError
+_MAX_CLAIM_RATIO = 2
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -433,7 +506,8 @@ class PageFrameSet:
     VERSION = 1
 
     def __init__(self, page_size: int, tokens: Sequence,
-                 layers: Dict[str, Dict[str, np.ndarray]]):
+                 layers: Dict[str, Dict[str, np.ndarray]],
+                 checksums: Optional[Sequence[bytes]] = None):
         self.page_size = int(page_size)
         self.tokens = np.ascontiguousarray(
             np.asarray(tokens, np.int32).reshape(-1))
@@ -454,6 +528,48 @@ class PageFrameSet:
                         f"layer {n!r} {kk} frames have shape "
                         f"{tuple(a.shape)}; expected [{self.n_pages}, H, "
                         f"{self.page_size}, Dh]")
+        # per-page CONTENT checksums (ISSUE 15): stamped at construction
+        # on the SENDER (default), shipped in the header, and
+        # re-verified at deserialization / adopt intake. CRC protects
+        # the wire bytes; these protect the CONTENT across the whole
+        # export→import window (a host buffer flipped after this stamp
+        # fails verification even though every CRC still passes).
+        # ``checksums=False`` skips stamping entirely — the
+        # integrity-off engine path, which must not pay a blake2b
+        # sweep per handoff (legacy wire format, CRC-only protection).
+        if checksums is False:
+            self.page_checksums: Optional[List[bytes]] = None
+        elif checksums is None:
+            self.page_checksums = [
+                self._page_sum(j) for j in range(self.n_pages)]
+        else:
+            self.page_checksums = [bytes(c) for c in checksums]
+            if len(self.page_checksums) != self.n_pages:
+                raise PageFrameError(
+                    f"{len(self.page_checksums)} page checksums for "
+                    f"{self.n_pages} pages")
+
+    def _page_sum(self, j: int) -> bytes:
+        from ..observability.integrity import page_content_checksum
+        return page_content_checksum(
+            [self.layers[n][kk][j] for n in sorted(self.layers)
+             for kk in ("k", "v")])
+
+    def verify(self) -> List[int]:
+        """Re-hash every page's content against the stamped checksums;
+        returns the corrupt page indices (empty = clean; also empty
+        when no checksums were stamped — nothing to verify against).
+        WIRE decode verifies every sum-carrying payload (the transport
+        is the highest-risk window; a corrupt blob must never parse,
+        and the decode marks the set ``_verified`` so the receiver's
+        sampled adopt-intake check skips the redundant re-sweep); the
+        in-process handle-passing path is where the IntegrityConfig
+        sampling rate applies. A full pass costs one blake2b sweep
+        over the payload (~1 GB/s)."""
+        if self.page_checksums is None:
+            return []
+        return [j for j in range(self.n_pages)
+                if self._page_sum(j) != self.page_checksums[j]]
 
     # ------------------------------------------------------------- views
     @property
@@ -466,11 +582,72 @@ class PageFrameSet:
             for kk in ("k", "v"))
 
     def _header(self) -> Dict:
-        return {"v": self.VERSION, "page_size": self.page_size,
+        head = {"v": self.VERSION, "page_size": self.page_size,
                 "n_ctx": len(self.tokens), "n_pages": self.n_pages,
                 "dtype": self.dtype,
                 "layers": {n: list(map(int, kv["k"].shape[1:]))
                            for n, kv in self.layers.items()}}
+        if self.page_checksums is not None:
+            head["sums"] = [c.hex() for c in self.page_checksums]
+        return head
+
+    @classmethod
+    def _validate_header(cls, head: Dict, budget: int):
+        """Harden the decode path against a hostile header/length
+        prefix: every dimension must be a sane positive int and the
+        TOTAL bytes the header claims must fit the bytes actually
+        received (within :data:`_MAX_CLAIM_RATIO`) — a forged
+        ``n_pages``/shape otherwise drives ``np.zeros`` into a
+        MemoryError instead of a typed :class:`PageFrameError`.
+        Returns (dtype, n_pages, n_ctx, claimed shape map)."""
+        try:
+            n_pages = int(head["n_pages"])
+            n_ctx = int(head["n_ctx"])
+            page_size = int(head["page_size"])
+            layer_shapes = {str(n): tuple(int(x) for x in sh)
+                            for n, sh in dict(head["layers"]).items()}
+            dt = _np_dtype(str(head["dtype"]))
+        except PageFrameError:
+            raise
+        except Exception as e:   # noqa: BLE001 — hostile JSON shapes
+            raise PageFrameError(f"malformed page-frame header: {e}")
+        if n_pages < 0 or n_ctx < 0 or page_size < 1 or not layer_shapes:
+            raise PageFrameError(
+                f"page-frame header out of range: n_pages={n_pages} "
+                f"n_ctx={n_ctx} page_size={page_size} "
+                f"layers={len(layer_shapes)}")
+        claimed = n_ctx * 4
+        for n, sh in layer_shapes.items():
+            if len(sh) != 3 or any(x < 1 for x in sh) or \
+                    sh[1] != page_size:
+                raise PageFrameError(
+                    f"layer {n!r} header shape {sh} invalid for "
+                    f"page_size {page_size}")
+            # plain Python ints: np.prod over attacker-controlled dims
+            # would WRAP in int64 and sneak a huge claim past the cap
+            per_page = 1
+            for x in sh:
+                per_page *= int(x)
+            claimed += 2 * n_pages * per_page * int(dt.itemsize)
+        if claimed > max(1024, int(budget)) * _MAX_CLAIM_RATIO:
+            raise PageFrameError(
+                f"page-frame header claims {claimed} bytes against a "
+                f"{budget}-byte payload — hostile length prefix")
+        return dt, n_pages, n_ctx, layer_shapes
+
+    def _checked(self) -> "PageFrameSet":
+        """Post-decode content verification: raise
+        :class:`PageCorruptionError` naming the corrupt pages; a clean
+        set is marked ``_verified`` so adopt intake never re-sweeps
+        frames that cannot have changed since this decode."""
+        bad = self.verify()
+        if bad:
+            raise PageCorruptionError(
+                f"page content checksum mismatch on page(s) {bad} — "
+                "silent corruption between export and intake (every "
+                "CRC passed)")
+        self._verified = True
+        return self
 
     # ------------------------------------------------------ bulk encoding
     def to_bytes(self) -> bytes:
@@ -484,30 +661,52 @@ class PageFrameSet:
 
     @classmethod
     def _parse_header(cls, data: bytes, magic: bytes) -> Tuple[Dict, int]:
+        if len(data) < 12:
+            raise PageFrameError("page frame truncated in magic/version")
         if data[:4] != magic:
             raise PageFrameError(f"bad page-frame magic {data[:4]!r}")
         ver, hlen = struct.unpack_from("<II", data, 4)
         if ver != cls.VERSION:
             raise PageFrameError(f"page-frame version {ver} unsupported "
                                  f"(this build speaks {cls.VERSION})")
+        if 12 + hlen > len(data):
+            raise PageFrameError("page frame truncated in header "
+                                 "(hostile header length)")
         try:
             head = json.loads(data[12:12 + hlen])
         except ValueError as e:
             raise PageFrameError(f"unparseable page-frame header: {e}")
+        if not isinstance(head, dict):
+            raise PageFrameError("page-frame header is not an object")
         return head, 12 + hlen
+
+    @staticmethod
+    def _header_sums(head: Dict, n_pages: int
+                     ) -> Optional[List[bytes]]:
+        sums = head.get("sums")
+        if sums is None:            # pre-r20 sender: no content sums —
+            return None             # CRC-only protection, like before
+        try:
+            out = [bytes.fromhex(str(s)) for s in sums]
+        except (TypeError, ValueError) as e:   # hostile "sums": 123
+            raise PageFrameError(f"malformed page checksums: {e}")
+        if len(out) != n_pages:
+            raise PageFrameError(f"{len(out)} page checksums for "
+                                 f"{n_pages} pages")
+        return out
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "PageFrameSet":
         head, off = cls._parse_header(data, cls.MAGIC)
-        dt = _np_dtype(head["dtype"])
+        dt, n_pages, n_ctx, layer_shapes = cls._validate_header(
+            head, len(data))
         raw, off = _unpack_buf(data, off)
         tokens = np.frombuffer(raw, np.int32)
-        if len(tokens) != int(head["n_ctx"]):
+        if len(tokens) != n_ctx:
             raise PageFrameError("token buffer does not match header")
         layers = {}
-        for n in sorted(head["layers"]):
-            shape = (int(head["n_pages"]),) + \
-                tuple(int(x) for x in head["layers"][n])
+        for n in sorted(layer_shapes):
+            shape = (n_pages,) + layer_shapes[n]
             kv = {}
             for kk in ("k", "v"):
                 raw, off = _unpack_buf(data, off)
@@ -518,7 +717,10 @@ class PageFrameSet:
                         f"shape {shape}")
                 kv[kk] = arr.reshape(shape)
             layers[n] = kv
-        return cls(int(head["page_size"]), tokens, layers)
+        sums = cls._header_sums(head, n_pages)
+        out = cls(int(head["page_size"]), tokens, layers,
+                  checksums=sums if sums is not None else False)
+        return out._checked() if sums is not None else out
 
     # ------------------------------------------------- per-page streaming
     def to_frames(self) -> List[bytes]:
@@ -542,21 +744,25 @@ class PageFrameSet:
         if not frames:
             raise PageFrameError("empty page-frame stream")
         head, off = cls._parse_header(frames[0], cls.MAGIC)
-        dt = _np_dtype(head["dtype"])
+        # allocation budget = bytes actually on the wire: a forged
+        # header (huge n_pages / shape) raises HERE, before np.zeros
+        # can turn the 8-byte length field into a MemoryError
+        dt, n_pages, n_ctx, layer_shapes = cls._validate_header(
+            head, sum(len(f) for f in frames))
         raw, _ = _unpack_buf(frames[0], off)
         tokens = np.frombuffer(raw, np.int32)
-        n_pages = int(head["n_pages"])
+        if len(tokens) != n_ctx:
+            raise PageFrameError("token buffer does not match header")
         if len(frames) != n_pages + 1:
             raise PageFrameError(f"page-frame stream carries "
                                  f"{len(frames) - 1} pages; header "
                                  f"promises {n_pages}")
-        layers = {n: {kk: np.zeros((n_pages,) + tuple(int(x) for x in sh),
-                                   dt)
+        layers = {n: {kk: np.zeros((n_pages,) + sh, dt)
                       for kk in ("k", "v")}
-                  for n, sh in head["layers"].items()}
+                  for n, sh in layer_shapes.items()}
         seen = set()
         for fr in frames[1:]:
-            if fr[:4] != cls.FRAME_MAGIC:
+            if len(fr) < 8 or fr[:4] != cls.FRAME_MAGIC:
                 raise PageFrameError(f"bad page frame magic {fr[:4]!r}")
             (j,) = struct.unpack_from("<I", fr, 4)
             if j >= n_pages or j in seen:
@@ -564,7 +770,7 @@ class PageFrameSet:
                                      "or duplicated")
             seen.add(j)
             off = 8
-            for n in sorted(head["layers"]):
+            for n in sorted(layer_shapes):
                 for kk in ("k", "v"):
                     raw, off = _unpack_buf(fr, off)
                     page = layers[n][kk][j]
@@ -574,4 +780,7 @@ class PageFrameSet:
                             f"page {j} layer {n!r} {kk} buffer size "
                             "mismatch")
                     layers[n][kk][j] = arr.reshape(page.shape)
-        return cls(int(head["page_size"]), tokens, layers)
+        sums = cls._header_sums(head, n_pages)
+        out = cls(int(head["page_size"]), tokens, layers,
+                  checksums=sums if sums is not None else False)
+        return out._checked() if sums is not None else out

@@ -59,6 +59,8 @@ jit-traced code.
 from .devstats import (DeviceStats, device_memory_snapshot,
                        impl_cost_analysis, kv_cache_stats)
 from .flightrec import FlightRecorder, default_flight_recorder
+from .integrity import (GoldenCanary, IntegrityConfig, NumericalFault,
+                        PageVerifier)
 from .metrics import (Counter, DEFAULT_LATENCY_BUCKETS, Gauge, Histogram,
                       MetricsRegistry, default_registry, percentiles)
 from .profiler import (EngineChannel, PhaseProfiler, PhaseTimeline,
@@ -77,5 +79,6 @@ __all__ = [
     "DeviceStats", "device_memory_snapshot", "impl_cost_analysis",
     "kv_cache_stats",
     "FlightRecorder", "default_flight_recorder",
+    "GoldenCanary", "IntegrityConfig", "NumericalFault", "PageVerifier",
     "TelemetryServer",
 ]

@@ -47,7 +47,10 @@ EVENT_KINDS = ("admission", "block_retire", "shed", "takeover",
                "replica_dead", "postmortem", "journal", "recovered",
                "preempt", "prefill_chunk", "scale_up", "descale",
                "autoscale", "page_preempt", "kv_handoff",
-               "handoff_fenced", "handoff_failed")
+               "handoff_fenced", "handoff_failed",
+               # SDC defense (ISSUE 15)
+               "numerical_fault", "kv_corruption", "corruption_injected",
+               "replica_corrupt", "canary")
 
 
 class FlightRecorder:

@@ -1,0 +1,312 @@
+"""Silent-data-corruption defense (ISSUE 15): on-device numerics
+sentinel, KV-page content verification, and the corrupt-replica
+quarantine vocabulary.
+
+The stack survives every fault it can SEE — SIGKILL, partitions,
+preemption, mid-handoff death — but at TPU-fleet scale the dominant
+unhandled failure is the one it can't: a chip or memory path that
+silently computes wrong values. CRC protects journal and wire BYTES;
+nothing checked computed CONTENT. Three layers close that gap:
+
+1. **On-device numerics sentinel** — :func:`logits_fault` folds a
+   per-row finite/abs-bound check over the logits INSIDE the jitted
+   decode-block / batched-prefill / chunk programs
+   (``models/generation.py``). The verdict rides the existing block
+   readback as one extra int32 column on the token matrix, so the
+   ≤1-readback-per-block invariant and ``{}`` steady compiles are
+   preserved structurally. A tripped row fails its request with a
+   typed :class:`NumericalFault` — the tokens of the poisoned block
+   are DROPPED on host, so a NaN'd logit can never stream garbage to
+   a client.
+
+2. **KV-page content verification** — :class:`PageVerifier` keys
+   16-byte blake2b content checksums by the prefix cache's own CHAIN
+   DIGEST (same content ⇒ same digest ⇒ same expected bytes, so the
+   table needs no eviction hooks and is valid across engines sharing
+   one decoder). The engine records checksums when pages are
+   registered into the prefix index and re-verifies them — sampled,
+   rate-configurable — on ``match_and_ref`` hits and ``adopt()``
+   intake. A mismatch evicts the whole chain
+   (:meth:`~..models.paging.PageAllocator.evict_digests`), counts
+   ``kv_page_corruption_total``, and the affected streams re-prefill
+   through the existing exactly-once machinery.
+
+3. **Corrupt-replica quarantine** — :class:`GoldenCanary` (a fixed
+   prompt whose greedy token sequence is recorded on the first clean
+   probe and compared forever after, run through the REAL engine
+   path) plus a :class:`NumericalFault` burn-rate threshold drive the
+   fleet's new ``CORRUPT`` health class (``streaming/fleet.py``): the
+   router stops dispatch, FleetLedger-fenced migration re-prefills
+   the replica's streams token-identically on healthy replicas, and
+   the quarantined worker is replaced.
+
+Everything is chaos-drivable: the ``device.corrupt_logits`` /
+``device.corrupt_page`` fault points (``parallel/faults.py``) script
+NaN/bit-flip injection into real device state and real host frames,
+and ``scripts/chaos_soak.py --corruption`` proves every injected
+corruption is detected before any client sees it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: shared metric family specs — ONE definition so every registration
+#: site (engine, fleet router, disagg router) presents the identical
+#: schema to the registry's idempotency check
+NUMERICAL_FAULT_COUNTER = (
+    "numerical_fault_total",
+    "requests failed by the on-device numerics sentinel (non-finite or "
+    "out-of-bound logits — the block's tokens were dropped, never "
+    "served)", ("engine",))
+KV_CORRUPTION_COUNTER = (
+    "kv_page_corruption_total",
+    "KV pages whose content failed checksum verification (prefix-cache "
+    "hit, adopt intake, or wire decode) — chain evicted / handoff "
+    "re-prefilled, corrupt bytes never attended by a new stream",
+    ("component",))
+
+
+class NumericalFault(RuntimeError):
+    """The on-device numerics sentinel tripped: a request's logits went
+    non-finite (NaN/inf) or exceeded the configured absolute bound —
+    the signature of silent device corruption, not of any valid model
+    state. The engine drops the poisoned block's tokens and fails the
+    request with this error; the fleet router treats it as a
+    corruption signal (re-dispatch elsewhere, burn-rate quarantine),
+    so with healthy replicas available a caller never observes it."""
+
+
+@dataclass(frozen=True)
+class IntegrityConfig:
+    """Knobs for the three defense layers. ``integrity=True`` anywhere
+    an engine/router accepts the config means this default instance.
+
+    - ``sentinel`` / ``logit_bound``: per-row finite check over the
+      decode/prefill logits, plus ``|logit| <= logit_bound`` when the
+      bound is set (None = finite-only). The bound should sit far
+      above any trained model's dynamic range — it exists to catch
+      e.g. an exponent bit flip, not to police calibration.
+    - ``kv_verify`` / ``kv_verify_rate``: content-checksum KV pages at
+      prefix-cache registration (always, deduped by chain digest —
+      once per unique content) and verify on match_and_ref hits and
+      adopt() intake at this sampled rate (1.0 = every hit; 0.25 =
+      every 4th — the readback cost scales with the rate).
+    - ``canary_period`` (fleet): seconds between golden-canary probe
+      rounds; None disables the prober. ``canary_tokens`` greedy
+      tokens per probe (prefill-only workers probe with 1 —
+      finish-at-first-token is their whole local path).
+    - ``fault_threshold`` / ``fault_window``: NumericalFaults observed
+      from one replica within the window before the router declares it
+      CORRUPT (1 = quarantine on the first fault; SDC is not a
+      transient to wait out).
+    - ``replace_corrupt``: the router immediately grows a replacement
+      replica after a corrupt quarantine (when it can build engines);
+      the autoscaler's min-replica clamp is the backstop either way.
+    """
+
+    sentinel: bool = True
+    logit_bound: Optional[float] = 1e4
+    kv_verify: bool = True
+    kv_verify_rate: float = 0.25
+    canary_period: Optional[float] = None
+    canary_tokens: int = 4
+    canary_prompt: Optional[Tuple[int, ...]] = None
+    canary_deadline: float = 30.0
+    fault_threshold: int = 1
+    fault_window: float = 60.0
+    replace_corrupt: bool = True
+
+    @property
+    def verify_every(self) -> int:
+        """Sampling stride for hit/adopt verification: every Nth
+        candidate is verified (deterministic counter sampling, so soak
+        schedules reproduce bit-for-bit)."""
+        rate = max(0.0, min(1.0, float(self.kv_verify_rate)))
+        if rate <= 0.0:
+            return 0            # verification armed off
+        return max(1, int(round(1.0 / rate)))
+
+
+def as_integrity(cfg) -> Optional[IntegrityConfig]:
+    """Normalize an ``integrity=`` argument: None stays None (defense
+    off, legacy bit-preserved), True means the defaults, a config
+    passes through."""
+    if cfg is None or isinstance(cfg, IntegrityConfig):
+        return cfg
+    if cfg is True:
+        return IntegrityConfig()
+    raise TypeError(f"integrity= wants IntegrityConfig, True or None; "
+                    f"got {type(cfg).__name__}")
+
+
+# graftlint: traced
+def logits_fault(logits, bound: Optional[float]):
+    """Per-row sentinel verdict over ``logits`` [B, V] → bool [B]:
+    True where any logit is non-finite, or (with a bound) where the
+    absolute max exceeds it. Pure traced math — it runs INSIDE the
+    jitted decode/prefill programs, so the verdict costs one reduction
+    per row and rides the carry to the existing block readback (no
+    extra device→host sync, nothing recorded in traced context)."""
+    import jax.numpy as jnp
+    bad = ~jnp.all(jnp.isfinite(logits), axis=-1)
+    if bound is not None:
+        # bound is a static Python float baked into the trace
+        bad = bad | (jnp.max(jnp.abs(logits), axis=-1) > bound)
+    return bad
+
+
+# ------------------------------------------------------------ checksums
+def page_content_checksum(arrays: Sequence) -> bytes:
+    """16-byte blake2b over a page's KV content — every layer's k then
+    v bytes in the caller's (sorted-layer) order. Used identically for
+    device-exported pages (engine verification) and host page frames
+    (handoff intake), so the two views of one page hash equal."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in arrays:
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.digest()
+
+
+class PageVerifier:
+    """Chain-digest-keyed content checksum table (bounded, LRU-ish by
+    insertion: silently forgets the oldest entries past ``capacity`` —
+    a forgotten reference degrades to re-recording on next sight,
+    never to a false corruption verdict).
+
+    Keyed by the prefix cache's CHAIN DIGEST, with each reference
+    pinned to the PHYSICAL page id it was recorded from: a chain
+    evicted and later re-registered lands on a fresh page whose bytes
+    may differ at float level (a different prefill bucket reorders
+    reductions), so a stale reference refreshes instead of firing a
+    false corruption verdict. Byte comparison is therefore always
+    page-against-its-own-earlier-export — exact by construction, since
+    registered pages are never rewritten. Thread-safe; reads and
+    writes are single dict ops under one lock."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._sums: Dict[bytes, Tuple[int, bytes]] = {}
+        self.capacity = int(capacity)
+        self.recorded = 0
+        self.mismatches = 0
+
+    def expected(self, digest: bytes, pid: int) -> Optional[bytes]:
+        """The reference checksum for ``digest`` as held on page
+        ``pid`` — None when unrecorded OR recorded from a different
+        physical page (stale: caller should re-record)."""
+        with self._lock:
+            ref = self._sums.get(digest)
+            if ref is None or ref[0] != int(pid):
+                return None
+            return ref[1]
+
+    def record(self, digest: bytes, pid: int, checksum: bytes) -> None:
+        with self._lock:
+            if digest not in self._sums:
+                self.recorded += 1
+            self._sums[digest] = (int(pid), checksum)
+            while len(self._sums) > self.capacity:
+                self._sums.pop(next(iter(self._sums)))
+
+    def check(self, digest: bytes, pid: int, checksum: bytes
+              ) -> Optional[bool]:
+        """True = match, False = CORRUPT, None = no valid reference
+        (unrecorded or stale pid — ``checksum`` becomes the new
+        reference via :meth:`record`)."""
+        with self._lock:
+            ref = self._sums.get(digest)
+            if ref is not None and ref[0] == int(pid):
+                if ref[1] == checksum:
+                    return True
+                self.mismatches += 1
+                return False
+        self.record(digest, pid, checksum)
+        return None
+
+    def forget(self, digests: Sequence[bytes]) -> None:
+        """Drop references (chain evicted for corruption: the NEXT
+        registration of this content records fresh sums)."""
+        with self._lock:
+            for dg in digests:
+                self._sums.pop(dg, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sums)
+
+
+def corrupt_host_frames(state, mode: str = "nan", page: int = 0) -> None:
+    """Scripted MID-HANDOFF corruption (chaos only): mutate one page of
+    a host-side frame set IN PLACE, after its content checksums were
+    stamped at export — exactly the corruption window CRC framing
+    cannot see (the CRC is computed over the already-corrupt bytes).
+    ``state`` duck-types :class:`~..models.paging.PageFrameSet`."""
+    j = int(page) % max(1, int(state.n_pages))
+    for n in sorted(state.layers):
+        for kk in ("k", "v"):
+            arr = state.layers[n][kk]
+            if not arr.flags.writeable:      # np.frombuffer views
+                arr = arr.copy()
+                state.layers[n][kk] = arr
+            if mode == "nan":
+                arr[j] = np.asarray(float("nan"), arr.dtype)
+            else:
+                arr[j] = -arr[j]
+
+
+# --------------------------------------------------------------- canary
+class GoldenCanary:
+    """Fixed prompt → recorded greedy token sequence, compared probe
+    after probe. The golden sequence is recorded from the FIRST clean
+    probe per token budget (all replicas share one decoder, so one
+    recording serves the fleet); any later divergence on any replica is
+    a corruption verdict — the model, params, and jitted programs never
+    change under serving, so only broken hardware (or a broken cache
+    path) can move the output."""
+
+    def __init__(self, prompt: Sequence[int]):
+        self.prompt = tuple(int(t) for t in prompt)
+        if not self.prompt:
+            raise ValueError("canary prompt must be non-empty")
+        self._lock = threading.Lock()
+        self._golden: Dict[int, Tuple[int, ...]] = {}
+
+    @staticmethod
+    def default_prompt(vocab_size: int,
+                       length: int = 6) -> Tuple[int, ...]:
+        """Deterministic low-token prompt inside any vocab: spreads
+        over the first min(vocab, 64) ids so the probe exercises more
+        than one embedding row."""
+        lim = max(2, min(int(vocab_size), 64))
+        return tuple((7 * i + 3) % lim for i in range(max(1, length)))
+
+    def golden(self, n_tokens: int) -> Optional[Tuple[int, ...]]:
+        with self._lock:
+            return self._golden.get(int(n_tokens))
+
+    def observe(self, n_tokens: int, output: Sequence[int]
+                ) -> Optional[bool]:
+        """Compare one probe's full output (prompt + generated) against
+        the recorded golden run. True = match, False = MISMATCH
+        (corruption), None = first clean probe (recorded as golden)."""
+        got = tuple(int(t) for t in output)
+        with self._lock:
+            want = self._golden.get(int(n_tokens))
+            if want is None:
+                self._golden[int(n_tokens)] = got
+                return None
+            return got == want
+
+
+__all__ = [
+    "IntegrityConfig", "NumericalFault", "PageVerifier", "GoldenCanary",
+    "as_integrity", "logits_fault", "page_content_checksum",
+    "corrupt_host_frames",
+    "NUMERICAL_FAULT_COUNTER", "KV_CORRUPTION_COUNTER",
+]

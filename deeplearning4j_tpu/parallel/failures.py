@@ -323,7 +323,12 @@ class EngineSupervisor(HeartbeatMonitor):
             # prefill work re-prefills and hands off again, adopted
             # decode work re-prefills locally (the documented recovery
             # escape hatch)
-            phase=old.phase, handoff=old._handoff)
+            phase=old.phase, handoff=old._handoff,
+            # SDC defense (ISSUE 15): the sentinel rides the SHARED
+            # decoder (its impls carry the verdict column), so the
+            # rebuilt engine must keep the matching integrity config —
+            # a restart never downgrades the corruption defense
+            integrity=old._integrity)
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)

@@ -89,17 +89,38 @@ class RejectedError(RuntimeError):
 #: moves any byte (raise = mid-handoff transport failure → the request
 #: re-prefills on a surviving prefill worker, exactly-once under the
 #: ledger fence).
+#: The durability tier (streaming/journal.py) fires ``journal.write``
+#: once per append ATTEMPT (the retry loop re-fires) — raise an OSError
+#: to drive the WAL's degraded mode (retry → ``journal_degraded`` gauge
+#: → heal on the next clean write) from the injector instead of
+#: unit-level monkeypatching.
+#: The integrity tier (ISSUE 15) polls two CORRUPTION points through
+#: :meth:`FaultInjector.corruption` (scripted NaN/bit-flip payloads,
+#: not raises): ``device.corrupt_logits`` per decode-block dispatch
+#: (poisons an active lane's attended KV state so the block's logits
+#: go non-finite — the on-device numerics sentinel must trip) and
+#: ``device.corrupt_page`` with a ``where=`` site — ``"registered"``
+#: (flip a page just published into the prefix cache: at-rest silent
+#: corruption, caught by sampled content verification or the golden
+#: canary) or ``"handoff"`` (flip exported frames after their content
+#: checksums were stamped: mid-handoff corruption that CRC alone
+#: cannot see, caught at deserialization/adopt intake).
 POINTS = ("engine.step", "engine.prefill", "broker.send", "broker.recv",
           "route.publish", "route.consume", "fleet.dispatch",
-          "fleet.heartbeat", "replica.kill", "disagg.ship")
+          "fleet.heartbeat", "replica.kill", "disagg.ship",
+          "journal.write", "device.corrupt_logits", "device.corrupt_page")
 
 
 class _NullInjector:
     """Inert injector: the default wired into every component. ``fire``
-    never raises, never sleeps, never drops."""
+    never raises, never sleeps, never drops; ``corruption`` never
+    corrupts."""
 
     def fire(self, point: str) -> bool:
         return False
+
+    def corruption(self, point: str, where: str = "") -> Optional[dict]:
+        return None
 
 
 NULL_INJECTOR = _NullInjector()
@@ -182,17 +203,77 @@ class FaultInjector:
                 {"kind": "drop", "at": int(at), "remaining": int(n)})
         return self
 
+    def corrupt(self, point: str, mode: str = "nan", n: int = 1,
+                at: int = 1, where: str = "") -> "FaultInjector":
+        """Arm a scripted data CORRUPTION (ISSUE 15): the call site polls
+        :meth:`corruption` and, when a plan is due, applies the payload
+        itself — NaN-fill (``mode="nan"``, the sentinel-trip drive) or a
+        deterministic value flip (``mode="flip"``, silent wrong-value
+        corruption the canary/content checksums must catch). ``where``
+        scopes the plan to one poll site of a multi-site point (e.g.
+        ``device.corrupt_page`` polls at ``"registered"`` and
+        ``"handoff"``); each (point, where) pair keeps its OWN hit
+        counter, so multi-site schedules stay deterministic."""
+        if mode not in ("nan", "flip"):
+            raise ValueError(f"corrupt mode must be 'nan' or 'flip', "
+                             f"got {mode!r}")
+        with self._lock:
+            self._plans[self._ckey(point, where)].append(
+                {"kind": "corrupt", "at": int(at), "remaining": int(n),
+                 "mode": str(mode)})
+        return self
+
     def clear(self, point: Optional[str] = None) -> None:
+        """Disarm all plans, or one point's — including any site-scoped
+        corruption plans living under the point's composite
+        ``point@where`` keys."""
         with self._lock:
             if point is None:
                 self._plans.clear()
             else:
                 self._plans.pop(point, None)
+                prefix = point + "@"
+                for key in [k for k in self._plans
+                            if k.startswith(prefix)]:
+                    self._plans.pop(key, None)
+
+    @staticmethod
+    def _ckey(point: str, where: str) -> str:
+        """Composite plan/hit key for site-scoped corruption points —
+        ``point`` alone when ``where`` is empty."""
+        return f"{point}@{where}" if where else point
 
     # ------------------------------------------------------------ firing
+    def corruption(self, point: str, where: str = "") -> Optional[dict]:
+        """Poll a corruption point (counts a hit under the (point,
+        where) pair); returns the due plan's payload dict ({"mode":
+        "nan"|"flip"}) or None. Never raises, never sleeps — the call
+        site applies the corruption itself (a device poke, a host
+        buffer flip), so the injector stays a pure scheduler."""
+        key = self._ckey(point, where)
+        due = None
+        with self._lock:
+            self._hits[key] += 1
+            hit = self._hits[key]
+            for plan in self._plans.get(key, ()):
+                if plan["kind"] != "corrupt" or plan["remaining"] <= 0 \
+                        or hit < plan["at"]:
+                    continue
+                plan["remaining"] -= 1
+                self._fired[key] += 1
+                due = {"mode": plan["mode"]}
+                break
+        if due is not None:
+            self._m_fired.labels(key).inc()
+            self._flightrec.record("fault", point=key, hit=hit,
+                                   mode=f"corrupt:{due['mode']}")
+        return due
+
     def fire(self, point: str) -> bool:
         """Execute the point's due plans. Returns True iff the caller
-        should drop the operation; raise plans raise instead."""
+        should drop the operation; raise plans raise instead.
+        (``corrupt`` plans are polled via :meth:`corruption`, never
+        executed here.)"""
         hang_s = 0.0
         drop = False
         raise_exc = None
@@ -201,7 +282,8 @@ class FaultInjector:
             self._hits[point] += 1
             hit = self._hits[point]
             for plan in self._plans.get(point, ()):
-                if plan["remaining"] <= 0 or hit < plan["at"]:
+                if plan["kind"] == "corrupt" or plan["remaining"] <= 0 \
+                        or hit < plan["at"]:
                     continue
                 plan["remaining"] -= 1
                 self._fired[point] += 1

@@ -46,6 +46,9 @@ from ..observability.metrics import default_registry
 #: health states a replica may count toward capacity (import-light copy
 #: of streaming/fleet.py's vocabulary)
 _DEAD = "DEAD"
+#: CORRUPT replicas (ISSUE 15 quarantine) are equally non-live: they
+#: never count toward capacity and are never picked as descale victims
+_CORRUPT = "CORRUPT"
 
 
 class BurnRateAutoscaler:
@@ -134,7 +137,8 @@ class BurnRateAutoscaler:
         loads = self.router.replica_loads()
         rids = self._role_rids()
         live = sum(1 for rid, (_, _, st) in loads.items()
-                   if st != _DEAD and (rids is None or rid in rids))
+                   if st not in (_DEAD, _CORRUPT) and
+                   (rids is None or rid in rids))
         if self.role is None:
             util = self.router.utilization()
             burn_s = self.tracker.burn_rate(self.tracker.short_window)
@@ -231,7 +235,8 @@ class BurnRateAutoscaler:
         loads = self.router.replica_loads()
         rids = self._role_rids()
         live = [(ld, rid) for rid, (ld, _, st) in loads.items()
-                if st != _DEAD and (rids is None or rid in rids)]
+                if st not in (_DEAD, _CORRUPT) and
+                (rids is None or rid in rids)]
         if len(live) <= self.min_replicas:
             return None
         live.sort(key=lambda p: (p[0], -int(p[1].lstrip("rpd") or 0)

@@ -74,9 +74,11 @@ import queue
 import threading
 from typing import Dict, List, Optional
 
-from ..models.paging import PageFrameSet
+from ..models.paging import PageCorruptionError, PageFrameSet
+from ..observability.integrity import KV_CORRUPTION_COUNTER, as_integrity
 from ..observability.tracing import interval_now
-from .fleet import (EngineFleetRouter, EngineReplica, REPLICA_DEAD)
+from .fleet import (EngineFleetRouter, EngineReplica, REPLICA_CORRUPT,
+                    REPLICA_DEAD)
 
 #: disagg roles (the third role, the router, is this module's
 #: PhaseRouter itself)
@@ -162,6 +164,9 @@ class SerializedKVTransport(KVTransport):
                 self.wire_frames += 1
                 self.wire_bytes += len(blob)
                 out = PageFrameSet.from_bytes(blob)
+        except PageCorruptionError:
+            raise        # typed through: the router counts CONTENT
+        #                  corruption separately from framing failures
         except ValueError as e:
             raise KVTransportError(f"KV frame encoding failed: {e}")
         self.shipped += 1
@@ -222,7 +227,9 @@ class PhaseRouter(EngineFleetRouter):
                  decode_pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  profiler=None, profiling: Optional[bool] = None,
-                 handoff_threads: int = 1):
+                 handoff_threads: int = 1,
+                 integrity=None):
+        icfg = as_integrity(integrity)
         if net is None:
             raise ValueError("PhaseRouter builds its own role-"
                              "specialized replicas and needs net=")
@@ -245,7 +252,10 @@ class PhaseRouter(EngineFleetRouter):
         flight_recorder = flight_recorder if flight_recorder is not None \
             else default_flight_recorder()
         if decoder is None:
-            decoder = TransformerDecoder(net, t_max=t_max)
+            decoder = TransformerDecoder(
+                net, t_max=t_max,
+                sentinel=icfg is not None and icfg.sentinel,
+                logit_bound=None if icfg is None else icfg.logit_bound)
         self._transport = transport if transport is not None \
             else InProcessKVTransport()
         prefill_slots = int(num_slots if prefill_slots is None
@@ -293,7 +303,8 @@ class PhaseRouter(EngineFleetRouter):
                 phase=role,
                 handoff=(None if role != ROLE_PREFILL else
                          (lambda req, st, _rid=rid:
-                          self._enqueue_handoff(_rid, req, st))))
+                          self._enqueue_handoff(_rid, req, st))),
+                integrity=icfg)
             if supervised:
                 from ..parallel.failures import EngineSupervisor
                 eng = EngineSupervisor(
@@ -327,7 +338,7 @@ class PhaseRouter(EngineFleetRouter):
             trace_store=trace_store, tracing=tracing,
             slo_tracker=slo_tracker, flight_recorder=flight_recorder,
             postmortem_dir=postmortem_dir, journal=journal,
-            paged=True, page_size=page_size)
+            paged=True, page_size=page_size, integrity=icfg)
         # KV-handoff accounting (the "Densifying" gate: measured, never
         # assumed): exact payload bytes + pages per handoff, wall-time
         # histogram, and the exactly-once outcome counters
@@ -358,6 +369,11 @@ class PhaseRouter(EngineFleetRouter):
             "kv_transfer_seconds",
             "wall time per KV handoff, export-done to adopt-enqueued",
             ("fleet",)).labels(self.fleet_id)
+        # content corruption detected AT the handoff seam (wire decode
+        # or adopt intake) — same family the engines count under, one
+        # child per component
+        self._m_kv_corrupt = reg.counter(
+            *KV_CORRUPTION_COUNTER).labels(self.fleet_id)
 
     def _mint_rid(self, role: str) -> str:
         prefix = "p" if role == ROLE_PREFILL else "d"
@@ -392,7 +408,8 @@ class PhaseRouter(EngineFleetRouter):
                            if self._roles.get(rid) == role}
         load = slots = 0
         for rid, (ld, _, state) in self.replica_loads().items():
-            if rid not in slot_counts or state == REPLICA_DEAD:
+            if rid not in slot_counts or \
+                    state in (REPLICA_DEAD, REPLICA_CORRUPT):
                 continue
             load += ld
             slots += slot_counts.get(rid, 0)
@@ -464,7 +481,8 @@ class PhaseRouter(EngineFleetRouter):
                 peers = [r for r in self._roles
                          if r != rid and self._roles.get(r) == role and
                          r in self._health and
-                         self._health[r]["state"] != REPLICA_DEAD]
+                         self._health[r]["state"] not in
+                         (REPLICA_DEAD, REPLICA_CORRUPT)]
             if not peers:
                 raise ValueError(
                     f"cannot retire {rid}: last live {role} worker — "
@@ -472,6 +490,15 @@ class PhaseRouter(EngineFleetRouter):
         out = super().retire_replica(rid, budget=budget, reason=reason)
         self._roles.pop(rid, None)
         return out
+
+    def _replace_replica(self, rid: str) -> Optional[str]:
+        """Corrupt-quarantine replacement preserves the ROLE pool: a
+        quarantined decode worker is replaced by a decode worker (the
+        fleet must not silently lose a phase)."""
+        role = self._roles.get(rid)
+        if role is None:
+            return super()._replace_replica(rid)
+        return self.add_replica(role=role)
 
     # ------------------------------------------------------------ handoff
     def _enqueue_handoff(self, src_rid: str, req, state: PageFrameSet
@@ -579,6 +606,14 @@ class PhaseRouter(EngineFleetRouter):
             dst.adopt(req, shipped)
         except Exception as exc:   # noqa: BLE001 — transport/geometry
             self._m_handoff["failed"].inc()
+            if isinstance(exc, PageCorruptionError):
+                # content checksum caught a mid-handoff flip the CRCs
+                # could not see — counted as corruption, recovered the
+                # same way: re-prefill on a prefill worker
+                self._m_kv_corrupt.inc()
+                self._flightrec.record(
+                    "kv_corruption", fleet=self.fleet_id,
+                    detector="handoff", src=src_rid)
             self._flightrec.record(
                 "handoff_failed", fleet=self.fleet_id, src=src_rid,
                 dst=dst.replica_id,
@@ -670,7 +705,8 @@ class PhaseRouter(EngineFleetRouter):
             rids = self.role_ids(role)
             with self._lock:
                 alive = [r for r in rids if r in self._health and
-                         self._health[r]["state"] != REPLICA_DEAD]
+                         self._health[r]["state"] not in
+                         (REPLICA_DEAD, REPLICA_CORRUPT)]
             roles[role] = {
                 "replicas": rids, "alive": len(alive),
                 "utilization": round(self.utilization(role=role), 4),

@@ -62,22 +62,31 @@ import itertools
 import json
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..observability.flightrec import default_flight_recorder
+from ..observability.integrity import (GoldenCanary, NumericalFault,
+                                       as_integrity)
 from ..observability.metrics import default_registry
 from ..observability.slo import default_slo_tracker
 from ..observability.tracing import (default_trace_ring,
                                      interval_now)
 from ..parallel.faults import NULL_INJECTOR, RejectedError
 
-#: replica health states (the membership protocol's vocabulary)
+#: replica health states (the membership protocol's vocabulary).
+#: CORRUPT (ISSUE 15) is the silent-data-corruption quarantine class: a
+#: replica whose NumericalFault burn rate crossed the threshold or
+#: whose golden-canary probe diverged — reachable (unlike DEAD-by-
+#: partition) but never dispatched to again; its streams migrate to
+#: healthy replicas under the same ledger fence as replica death, and
+#: the worker is replaced.
 REPLICA_ALIVE = "ALIVE"
 REPLICA_SUSPECT = "SUSPECT"
 REPLICA_DEAD = "DEAD"
+REPLICA_CORRUPT = "CORRUPT"
 
 _FLEET_SEQ = itertools.count()
 _FLEET_REQ_SEQ = itertools.count(1)
@@ -99,6 +108,9 @@ _FLEET_COUNTERS = {
     "scale_ups": "replicas added live (autoscaler or operator)",
     "scale_downs": "replicas retired live through the graceful "
                    "preemption drain (autoscaler or operator)",
+    "corrupt_quarantines": "replicas quarantined as CORRUPT (numerics-"
+                           "fault burn rate or golden-canary mismatch); "
+                           "their streams migrated to healthy replicas",
 }
 
 
@@ -733,9 +745,21 @@ class EngineFleetRouter:
                  profiler=None, profiling: Optional[bool] = None,
                  sticky_page_size: Optional[int] = None,
                  engine_factory=None,
-                 replica_ids: Optional[List[str]] = None):
+                 replica_ids: Optional[List[str]] = None,
+                 integrity=None):
         self.fleet_id = fleet_id if fleet_id is not None \
             else f"fleet{next(_FLEET_SEQ)}"
+        # ---- silent-data-corruption defense (ISSUE 15) ----
+        # threaded to every replica engine (sentinel + page
+        # verification); at fleet level it arms the NumericalFault
+        # burn-rate quarantine, the golden-canary prober, and
+        # corrupt-replica replacement
+        self._integrity = as_integrity(integrity)
+        self._fault_times: Dict[str, deque] = {}
+        self._canary: Optional[GoldenCanary] = None
+        self._canary_ok: Dict[str, float] = {}
+        self._canary_thread: Optional[threading.Thread] = None
+        self._stop_canary = threading.Event()
         self._registry = registry if registry is not None \
             else default_registry()
         self._trace_store = trace_store if trace_store is not None \
@@ -785,7 +809,15 @@ class EngineFleetRouter:
             from ..models.generation import (SlotGenerationEngine,
                                              TransformerDecoder)
             if decoder is None:
-                decoder = TransformerDecoder(net, t_max=t_max)
+                # sentinel decoders carry the verdict column in their
+                # impls — ONE shared decoder means every replica (built
+                # now or grown later) runs the same defended programs
+                icfg = self._integrity
+                decoder = TransformerDecoder(
+                    net, t_max=t_max,
+                    sentinel=icfg is not None and icfg.sentinel,
+                    logit_bound=None if icfg is None
+                    else icfg.logit_bound)
             shared_decoder = decoder
 
             def _build_engine(rid: str, fault_injector=None):
@@ -813,7 +845,8 @@ class EngineFleetRouter:
                     # other sink — replica channels key on rid (the
                     # slo_label), so one injected profiler carries the
                     # whole fleet's phase account
-                    profiler=profiler, profiling=profiling)
+                    profiler=profiler, profiling=profiling,
+                    integrity=self._integrity)
                 if supervised:
                     from ..parallel.failures import EngineSupervisor
                     eng = EngineSupervisor(
@@ -883,6 +916,17 @@ class EngineFleetRouter:
         self._g_replicas = reg.gauge(
             "fleet_replicas", "fleet replicas by health state",
             ("fleet", "state"))
+        # canary visibility (ISSUE 15): probe outcomes + per-replica
+        # staleness — `telemetry_dump --scrape` surfaces the age column
+        self._m_canary = reg.counter(
+            "integrity_canary_probes_total",
+            "golden-canary probes, by outcome "
+            "(ok / mismatch / fault / skipped)",
+            ("fleet", "outcome"))
+        self._g_canary_age = reg.gauge(
+            "integrity_canary_age_seconds",
+            "seconds since the replica's last CLEAN golden-canary probe",
+            ("fleet", "replica"))
         self._update_gauges_locked_init()
 
     def _update_gauges_locked_init(self) -> None:
@@ -891,7 +935,8 @@ class EngineFleetRouter:
 
     def _update_gauges_locked(self) -> None:
         # caller holds self._lock
-        counts = {REPLICA_ALIVE: 0, REPLICA_SUSPECT: 0, REPLICA_DEAD: 0}
+        counts = {REPLICA_ALIVE: 0, REPLICA_SUSPECT: 0,
+                  REPLICA_DEAD: 0, REPLICA_CORRUPT: 0}
         for h in self._health.values():
             counts[h["state"]] += 1
         for state, n in counts.items():
@@ -1048,8 +1093,8 @@ class EngineFleetRouter:
                     if rid in allowed}
         loads: Dict[str, int] = {}
         for rid, rep in reps.items():
-            if states[rid] == REPLICA_DEAD:
-                continue
+            if states[rid] in (REPLICA_DEAD, REPLICA_CORRUPT):
+                continue      # a CORRUPT replica never takes dispatch
             ld = rep.load()
             if ld is None:
                 ld = beat_loads.get(rid)      # fall back to last beat
@@ -1114,6 +1159,30 @@ class EngineFleetRouter:
             err = inner._error
             rid = fr.replica_id
             cancelled = fr._cancel_requested
+        if err is not None and not cancelled and \
+                isinstance(err, NumericalFault) and \
+                fr.migrations < len(self._replicas):
+            # silent-data-corruption verdict (ISSUE 15): the engine
+            # dropped the poisoned tokens and failed the request typed.
+            # Fleet response: account the replica's fault burn (which
+            # may CORRUPT-quarantine it, migrating every live stream
+            # incl. this one), then make sure THIS request resumes on
+            # a healthy replica — a caller sees a NumericalFault only
+            # when no survivor exists.
+            with self._lock:
+                stopping = self._shutdown_flag
+            rep = self._replicas.get(rid)
+            if not stopping and rep is not None:
+                self._note_numerical_fault(rid, err)
+                with self._migrate_lock:
+                    if self._redispatch(fr, rep, err):
+                        self._m["migrations"].inc()
+                        return
+                if fr.done():
+                    return      # settled while deciding (no-survivor)
+                # else: the quarantine's victim loop already migrated
+                # it — fall through; the inner-identity gate below
+                # classifies this stale handle as fenced
         if err is not None and not cancelled \
                 and not isinstance(err, RejectedError) \
                 and fr.migrations < len(self._replicas):
@@ -1267,7 +1336,8 @@ class EngineFleetRouter:
             if rep is None:
                 raise KeyError(f"unknown replica {rid!r}")
             survivors = [r for r, h in self._health.items()
-                         if r != rid and h["state"] != REPLICA_DEAD]
+                         if r != rid and h["state"] not in
+                         (REPLICA_DEAD, REPLICA_CORRUPT)]
             if not survivors:
                 raise ValueError(f"cannot retire {rid}: no surviving "
                                  "replica to absorb its work")
@@ -1348,28 +1418,32 @@ class EngineFleetRouter:
                            for rid in self._replicas}
         load = slots = 0
         for rid, (ld, _, state) in self.replica_loads().items():
-            if state == REPLICA_DEAD:
+            if state in (REPLICA_DEAD, REPLICA_CORRUPT):
                 continue
             load += ld
             slots += slot_counts.get(rid, 0)
         return 0.0 if slots == 0 else load / slots
 
-    def _migrate(self, rid: str, cause: BaseException) -> None:
-        """Retire ``rid`` and re-dispatch its non-terminal requests to
-        survivors exactly once. Serialized globally: concurrent death
-        reports (crash callback vs monitor scan vs chaos kill) collapse
-        to one migration per replica."""
+    def _migrate(self, rid: str, cause: BaseException,
+                 state: str = REPLICA_DEAD,
+                 kind: str = "replica_dead") -> bool:
+        """Retire ``rid`` into ``state`` and re-dispatch its
+        non-terminal requests to survivors exactly once. Serialized
+        globally: concurrent death reports (crash callback vs monitor
+        scan vs chaos kill vs corrupt quarantine) collapse to one
+        migration per replica. Returns True iff THIS call performed
+        the retirement."""
         with self._migrate_lock:
             with self._lock:
                 if rid in self._dead_handled:
-                    return
+                    return False
                 self._dead_handled.add(rid)
                 self._death_cause[rid] = cause
                 rep = self._replicas.get(rid)
                 if rep is None:
-                    return
+                    return False
                 h = self._health[rid]
-                h["state"] = REPLICA_DEAD
+                h["state"] = state
                 self._update_gauges_locked()
             rep.stop_heartbeat()
             self._membership.leave(rid)
@@ -1381,7 +1455,7 @@ class EngineFleetRouter:
                 except Exception:   # noqa: BLE001 — treat as unreachable
                     rep.reachable = False
             self._flightrec.record(
-                "replica_dead", fleet=self.fleet_id, replica=rid,
+                kind, fleet=self.fleet_id, replica=rid,
                 reachable=rep.reachable,
                 cause=f"{type(cause).__name__}: {cause}"[:200])
             with self._lock:
@@ -1411,6 +1485,61 @@ class EngineFleetRouter:
                 self._m["migrations"].inc(moved)
                 self._flightrec.record("migration", fleet=self.fleet_id,
                                        src=rid, moved=moved)
+        return True
+
+    # -------------------------------------------- corruption quarantine
+    def _note_numerical_fault(self, rid: str,
+                              exc: BaseException) -> None:
+        """Fold one NumericalFault observation into the replica's burn
+        window; crossing ``fault_threshold`` within ``fault_window``
+        quarantines the replica as CORRUPT. With no integrity config a
+        fault is just a failure — legacy behaviour."""
+        cfg = self._integrity
+        if cfg is None:
+            return
+        now = interval_now()
+        with self._lock:
+            dq = self._fault_times.setdefault(rid, deque())
+            dq.append(now)
+            while dq and now - dq[0] > cfg.fault_window:
+                dq.popleft()
+            n = len(dq)
+        if n >= max(1, int(cfg.fault_threshold)):
+            self.quarantine_corrupt(rid, exc)
+
+    def quarantine_corrupt(self, rid: str,
+                           cause: BaseException) -> bool:
+        """Quarantine ``rid`` as CORRUPT (ISSUE 15): the router stops
+        dispatching to it, its streams migrate to healthy replicas
+        token-identically under the FleetLedger fence (the replica is
+        REACHABLE, so the quarantine-harvest path requeues the same
+        request objects), and — when the router can build engines and
+        ``replace_corrupt`` is on — a replacement replica grows
+        immediately (the autoscaler's min-replica clamp is the backstop
+        otherwise). Idempotent per replica; returns True iff this call
+        performed the quarantine."""
+        if not self._migrate(rid, cause, state=REPLICA_CORRUPT,
+                             kind="replica_corrupt"):
+            return False
+        self._m["corrupt_quarantines"].inc()
+        cfg = self._integrity
+        if cfg is not None and cfg.replace_corrupt:
+            with self._lock:
+                stopping = self._shutdown_flag
+            if not stopping:
+                try:
+                    self._replace_replica(rid)
+                except Exception:   # noqa: BLE001 — no factory / raced
+                    pass            # shutdown: autoscaler backstop
+        return True
+
+    def _replace_replica(self, rid: str) -> Optional[str]:
+        """Grow a replacement for a quarantined worker (subclasses
+        preserve role pools); None when the router cannot build
+        engines."""
+        if self._engine_factory is None:
+            return None
+        return self.add_replica()
 
     def _redispatch(self, fr: FleetRequest, src: EngineReplica,
                     cause: BaseException) -> bool:
@@ -1523,6 +1652,103 @@ class EngineFleetRouter:
         while not self._stop_monitor.wait(self.monitor_interval):
             self._scan_once()
 
+    # ------------------------------------------------------ golden canary
+    def _canary_loop(self) -> None:
+        period = float(self._integrity.canary_period)
+        while not self._stop_canary.wait(period):
+            try:
+                self._canary_round()
+            except Exception as exc:   # noqa: BLE001 — a probe bug must
+                self._flightrec.record(   # not kill the prober
+                    "canary", fleet=self.fleet_id, outcome="error",
+                    cause=f"{type(exc).__name__}: {exc}"[:160])
+
+    def canary_round(self) -> Dict[str, str]:
+        """Run one golden-canary probe round NOW (the background loop
+        calls this on ``canary_period``; tests and the soak drive it
+        directly). Returns rid → outcome."""
+        return self._canary_round()
+
+    def _canary_round(self) -> Dict[str, str]:
+        with self._lock:
+            targets = [(rid, self._replicas[rid])
+                       for rid, h in self._health.items()
+                       if h["state"] in (REPLICA_ALIVE, REPLICA_SUSPECT)
+                       and rid in self._replicas]
+        out: Dict[str, str] = {}
+        for rid, rep in targets:
+            outcome = self._probe_replica(rid, rep)
+            if outcome is None:
+                # not probed BY DESIGN (decode-phase worker): publish
+                # no age gauge — a forever-growing age here would be a
+                # permanent false alarm on every disagg fleet
+                out[rid] = "not_probed"
+                continue
+            # a replica that has NEVER probed clean ages from its first
+            # probe attempt — the worst case (never clean) must read as
+            # the STALEST age, not as a fresh 0.0
+            self._canary_ok.setdefault(rid, interval_now())
+            out[rid] = outcome
+            self._m_canary.labels(self.fleet_id, outcome).inc()
+            if outcome == "ok":
+                self._canary_ok[rid] = interval_now()
+            self._g_canary_age.labels(self.fleet_id, rid).set(
+                round(interval_now() - self._canary_ok[rid], 3))
+        return out
+
+    def _probe_replica(self, rid: str,
+                       rep: EngineReplica) -> Optional[str]:
+        """One golden-canary probe through the replica's REAL engine
+        path (submit → prefill → decode blocks → sentinel → result).
+        Probes are never journaled or SLO-accounted (``_canary=True``).
+        A decode-only worker is NOT probed (returns None: fresh prompts
+        belong on prefill workers; its corruption surface is covered by
+        the sentinel + adopt-intake verification, and it must not
+        publish a forever-stale age); a prefill-only worker probes with
+        a 1-token budget — finish-at-first-token IS its whole local
+        path. "skipped" means a probe was ATTEMPTED and couldn't get
+        through (busy/shedding/restarting) — its age keeps growing,
+        which is the signal."""
+        cfg = self._integrity
+        inner = rep.engine.engine if rep.supervised else rep.engine
+        phase = getattr(inner, "phase", "both")
+        if phase == "decode":
+            return None
+        if self._canary is None:
+            prompt = cfg.canary_prompt
+            if prompt is None:
+                prompt = GoldenCanary.default_prompt(
+                    int(inner.decoder.vocab_size))
+            self._canary = GoldenCanary(prompt)
+        n_tok = 1 if phase == "prefill" else max(1, int(cfg.canary_tokens))
+        try:
+            req = rep.submit(list(self._canary.prompt), n_tok,
+                             temperature=0.0,
+                             deadline=cfg.canary_deadline, _canary=True)
+            got = req.result(cfg.canary_deadline + 5.0)
+        except NumericalFault as exc:
+            # the probe itself tripped the sentinel: strongest possible
+            # corruption signal — burn-account it (threshold may
+            # quarantine the replica right here)
+            self._flightrec.record("canary", fleet=self.fleet_id,
+                                   replica=rid, outcome="fault")
+            self._note_numerical_fault(rid, exc)
+            return "fault"
+        except Exception:   # noqa: BLE001 — busy/shedding/restarting
+            return "skipped"   # replica: not a corruption signal
+        verdict = self._canary.observe(n_tok, got)
+        if verdict is False:
+            # silent wrong-value corruption: the model, params, and
+            # programs never change under serving — only broken
+            # hardware moves a greedy output. Quarantine.
+            self._flightrec.record("canary", fleet=self.fleet_id,
+                                   replica=rid, outcome="mismatch")
+            self.quarantine_corrupt(rid, NumericalFault(
+                f"golden-canary mismatch on replica {rid}: recorded "
+                f"sequence diverged — silent corruption"))
+            return "mismatch"
+        return "ok"
+
     def _scan_once(self) -> None:
         """One membership scan: age beats into health transitions.
         SUSPECT → ALIVE needs ``recover_beats`` consecutive fresh scans
@@ -1533,8 +1759,8 @@ class EngineFleetRouter:
         with self._lock:
             for rid, rep in self._replicas.items():
                 h = self._health[rid]
-                if h["state"] == REPLICA_DEAD:
-                    continue
+                if h["state"] in (REPLICA_DEAD, REPLICA_CORRUPT):
+                    continue   # quarantined: never ages back to life
                 gave_up = rep.given_up()
                 if gave_up is not None:
                     to_kill.append((rid, gave_up))
@@ -1584,6 +1810,13 @@ class EngineFleetRouter:
                                          daemon=True,
                                          name=f"{self.fleet_id}-monitor")
         self._monitor.start()
+        if self._integrity is not None and \
+                self._integrity.canary_period is not None:
+            self._stop_canary.clear()
+            self._canary_thread = threading.Thread(
+                target=self._canary_loop, daemon=True,
+                name=f"{self.fleet_id}-canary")
+            self._canary_thread.start()
         return self
 
     def shutdown(self) -> None:
@@ -1593,9 +1826,13 @@ class EngineFleetRouter:
             self._shutdown_flag = True
             reps = list(self._replicas.values())
         self._stop_monitor.set()
+        self._stop_canary.set()
         mon = self._monitor
         if mon is not None and mon is not threading.current_thread():
             mon.join(timeout=2)
+        can = self._canary_thread
+        if can is not None and can is not threading.current_thread():
+            can.join(timeout=2)
         for rep in reps:
             rep.stop_heartbeat()
         for rep in reps:
@@ -1643,13 +1880,14 @@ class EngineFleetRouter:
                     out[k] = out.get(k, 0) + v
         with self._lock:
             counts = {REPLICA_ALIVE: 0, REPLICA_SUSPECT: 0,
-                      REPLICA_DEAD: 0}
+                      REPLICA_DEAD: 0, REPLICA_CORRUPT: 0}
             for h in self._health.values():
                 counts[h["state"]] += 1
         out["replicas"] = len(self._replicas)
         out["replicas_alive"] = counts[REPLICA_ALIVE]
         out["replicas_suspect"] = counts[REPLICA_SUSPECT]
         out["replicas_dead"] = counts[REPLICA_DEAD]
+        out["replicas_corrupt"] = counts[REPLICA_CORRUPT]
         for key in _FLEET_COUNTERS:
             out[key] = int(self._m[key].value)
         return out

@@ -317,7 +317,7 @@ class RequestJournal:
                  fsync_n: int = 256, fsync_interval: float = 0.05,
                  segment_bytes: int = 1 << 20, retries: int = 3,
                  retry_backoff: float = 0.01, registry=None,
-                 flight_recorder=None):
+                 flight_recorder=None, fault_injector=None):
         if fsync not in ("always", "every_n", "interval"):
             raise ValueError(f"fsync policy '{fsync}' not in "
                              "('always', 'every_n', 'interval')")
@@ -331,6 +331,17 @@ class RequestJournal:
         self.journal_id = f"j{next(_JOURNAL_SEQ)}"
         self._flightrec = flight_recorder if flight_recorder is not None \
             else default_flight_recorder()
+        # ``journal.write`` fault point (ISSUE 15 satellite): fires once
+        # per append ATTEMPT inside the retry loop, so chaos_soak can
+        # drive the WAL's whole degraded lifecycle (retry → backoff →
+        # journal_degraded gauge → drop-count → heal) from the injector
+        # instead of unit-level monkeypatching. Arm with OSError; any
+        # other injected exception type is coerced so the degraded
+        # contract (serving NEVER fails on journal I/O) cannot be
+        # broken by a mis-armed plan.
+        from ..parallel.faults import NULL_INJECTOR
+        self._faults = fault_injector if fault_injector is not None \
+            else NULL_INJECTOR
         self._lock = threading.Lock()
         self._fh = None                    # active segment file object
         self._seg_seq = 0
@@ -430,6 +441,14 @@ class RequestJournal:
         attempts = None
         for attempt in range(64):       # bound: attempts resolves to
             try:                        # <= retries+1 on first entry
+                try:
+                    # outside the journal lock, once per attempt — a
+                    # raise IS this attempt's I/O failure
+                    self._faults.fire("journal.write")
+                except OSError:
+                    raise
+                except Exception as exc:   # noqa: BLE001 — coerce a
+                    raise OSError(str(exc))   # mis-armed plan to I/O
                 cleared = False
                 with self._lock:
                     if self._closed:

@@ -1111,6 +1111,295 @@ def run_disagg_soak(seed: int = 0, prefill_workers: int = 2,
     return summary
 
 
+def run_corruption_soak(seed: int = 0, n_requests: int = 12,
+                        num_slots: int = 2, max_new: int = 6,
+                        vocab: int = 12, wait_s: float = 120.0) -> dict:
+    """One silent-data-corruption soak round (``--corruption``,
+    ISSUE 15): every scripted corruption must be DETECTED before a
+    client sees a byte of it. Four phases, one summary:
+
+    A. **logits NaN** (``device.corrupt_logits``) on replica r0 of a
+       3-replica paged+sentinel fleet under load: the sentinel's
+       verdict column trips, the block's tokens are dropped, r0 is
+       CORRUPT-quarantined on the NumericalFault burn, its streams
+       migrate token-identically, a replacement replica grows — bars:
+       zero stranded, zero garbage (every result token-identical to
+       the clean reference), ledger-verified exactly-once, allocator
+       audits clean on every replica, and ``{}`` steady compiles on a
+       post-quarantine wave pinned to each survivor.
+    B. **at-rest page flip** (``device.corrupt_page@registered``,
+       mode=flip): a registered shared-prefix page is sign-flipped on
+       device; the next prefix-cache hit's sampled content
+       verification (rate 1.0 here) catches it, evicts the chain, and
+       the request re-prefills fresh — token-identical output,
+       ``kv_page_corruption_total`` counted, allocator audit clean.
+    C. **canary quarantine**: with verification OFF, the same flip
+       poisons the canary prompt's cached page on r0 of a 2-replica
+       fleet; the next golden-canary probe round detects the silent
+       wrong-value divergence, quarantines r0 as CORRUPT, and a
+       replacement grows.
+    D. **mid-handoff flip** (``device.corrupt_page@handoff``) on a
+       1P+1D disagg fleet over the per-page wire transport: the host
+       frames are flipped AFTER their content checksums were stamped —
+       every CRC passes, the content check at wire decode refuses the
+       frames, the handoff re-prefills on the prefill worker, and the
+       stream completes token-identically.
+    E. **journal.write degraded drive**: an injector-armed OSError
+       burst flips ``journal_degraded`` mid-serving and the WAL heals
+       on the next clean write — zero serving failures throughout.
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                      TransformerDecoder)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.integrity import (IntegrityConfig,
+                                                            NumericalFault)
+    from deeplearning4j_tpu.observability.metrics import default_registry
+    from deeplearning4j_tpu.parallel.faults import FaultInjector
+    from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                    REPLICA_ALIVE,
+                                                    REPLICA_CORRUPT)
+
+    assert max_new <= 11, "max_new > 11 would leave the tp=16 bucket"
+    rng = np.random.default_rng(seed)
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=32, num_heads=2, num_layers=2, max_length=32,
+        learning_rate=1e-2, seed=5)).init()
+    cfg = IntegrityConfig(kv_verify_rate=1.0, fault_threshold=1)
+    dec = TransformerDecoder(net, sentinel=True,
+                             logit_bound=cfg.logit_bound)
+    ps = 8
+    prompts = [rng.integers(0, vocab, int(rng.integers(2, 5)))
+               for _ in range(n_requests)]
+    gens = [int(rng.integers(2, max_new + 1))
+            for _ in range(n_requests)]
+    summary = {"seed": seed, "requests": n_requests}
+
+    with CompileAudit() as audit:
+        # ---- clean sentinel reference: ground truth + compile warmup
+        clean = SlotGenerationEngine(net, num_slots=num_slots,
+                                     decoder=dec, block_size=4,
+                                     paged=True, page_size=ps,
+                                     integrity=cfg)
+        clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+        clean.run_until_drained()
+        expected = [r.result(1) for r in clean_reqs]
+
+        # ---------------- phase A: logits NaN → sentinel → quarantine
+        per_rep = max(1, (sum(gens) // max(1, num_slots)) // 3)
+        nan_hit = int(rng.integers(1, max(2, per_rep)))
+        injs = [FaultInjector() for _ in range(3)]
+        injs[0].corrupt("device.corrupt_logits", mode="nan", at=nan_hit)
+        router = EngineFleetRouter(
+            net, num_replicas=3, decoder=dec, num_slots=num_slots,
+            block_size=4, paged=True, page_size=ps, integrity=cfg,
+            replica_injectors=injs, heartbeat_interval=0.03,
+            monitor_interval=0.03, suspect_after=0.25,
+            dead_after=1.0).start()
+        # warm the chaos impls (corrupt/scrub compile on first fire)
+        # BEFORE the steady snapshot: the steady bar measures serving
+        # compiles, not the injector's own one-time lowerings
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + wait_s
+        for fr in frs:
+            fr._done.wait(max(0.0, deadline - time.monotonic()))
+        stranded = [fr for fr in frs if not fr.done()]
+        mismatches = sum(
+            1 for fr, want in zip(frs, expected)
+            if fr.done() and fr.state == fr.DONE and
+            not np.array_equal(fr.result(0), want))
+        failed = sum(1 for fr in frs
+                     if fr.done() and fr.state != fr.DONE)
+        states = {rid: router.replica_state(rid)
+                  for rid in router.replica_ids()}
+        # post-quarantine steady wave pinned to each live replica
+        for inj in injs:
+            inj.clear()
+        survivors = [rid for rid, st in states.items()
+                     if st == REPLICA_ALIVE]
+        snap = audit.snapshot()
+        wave = [router.submit(prompts[i % n_requests],
+                              gens[i % n_requests], replica_id=rid)
+                for rid in survivors for i in range(2)]
+        wave_deadline = time.monotonic() + 60.0
+        for fr in wave:
+            fr._done.wait(max(0.0, wave_deadline - time.monotonic()))
+        steady_delta = audit.delta(snap)
+        stranded += [fr for fr in wave if not fr.done()]
+        page_audit = []
+        for rid, rep in sorted(router._replicas.items()):
+            inner = rep.engine.engine if rep.supervised else rep.engine
+            if getattr(inner, "_pager", None) is not None:
+                page_audit += [f"{rid}: {p}" for p in
+                               inner._pager.audit(inner._slot_pages)]
+        router.shutdown()
+        ledger = router._ledger.to_dict()
+        summary["phase_a"] = {
+            "nan_hit": nan_hit,
+            "stranded": len(stranded), "mismatches": mismatches,
+            "failed": failed, "states": states,
+            "corrupt_quarantines": int(router.corrupt_quarantines),
+            "migrations": int(router.migrations),
+            "replacement_grown": len(survivors) >= 3,
+            "ledger": ledger,
+            "steady_new_compiles": steady_delta,
+            "page_audit": page_audit,
+        }
+        a_ok = (not stranded and not mismatches and not failed and
+                REPLICA_CORRUPT in states.values() and
+                int(router.corrupt_quarantines) == 1 and
+                len(survivors) >= 3 and ledger["duplicates"] == 0 and
+                not steady_delta and not page_audit)
+
+        # -------- phase B: at-rest flip → sampled verification catches
+        inj_b = FaultInjector()
+        eng_b = SlotGenerationEngine(net, num_slots=num_slots,
+                                     decoder=dec, block_size=4,
+                                     paged=True, page_size=ps,
+                                     num_pages=64, integrity=cfg,
+                                     fault_injector=inj_b)
+        sys_prompt = rng.integers(0, vocab, 2 * ps + 1)  # 2 full pages
+        r1 = eng_b.submit(sys_prompt, 4)
+        eng_b.run_until_drained()
+        want_b = r1.result(1)
+        # next registration event fires the flip on the cached chain
+        inj_b.corrupt("device.corrupt_page", mode="flip", at=1,
+                      where="registered")
+        r2 = eng_b.submit(np.concatenate([sys_prompt, [1]]), 4)
+        eng_b.run_until_drained()
+        r2.result(1)
+        # prefix-cache hit on the flipped page → verify (rate 1.0)
+        r3 = eng_b.submit(sys_prompt, 4)
+        eng_b.run_until_drained()
+        out_b = r3.result(1)
+        b_corruptions = int(eng_b.stats()["kv_page_corruptions"])
+        b_audit = eng_b._pager.audit(eng_b._slot_pages)
+        eng_b.shutdown()
+        summary["phase_b"] = {
+            "detected": b_corruptions,
+            "token_identical": bool(np.array_equal(out_b, want_b)),
+            "page_audit": b_audit,
+        }
+        b_ok = (b_corruptions >= 1 and
+                np.array_equal(out_b, want_b) and not b_audit)
+
+        # ------------- phase C: canary catches a silent flip (verify
+        # OFF — the flip changes values, not finiteness: only the
+        # recorded golden sequence can see it)
+        cfg_c = IntegrityConfig(kv_verify=False, fault_threshold=1,
+                                canary_tokens=4)
+        dec_c = TransformerDecoder(net, sentinel=True,
+                                   logit_bound=cfg_c.logit_bound)
+        injs_c = [FaultInjector(), FaultInjector()]
+        router_c = EngineFleetRouter(
+            net, num_replicas=2, decoder=dec_c, num_slots=num_slots,
+            block_size=4, paged=True, page_size=4, integrity=cfg_c,
+            replica_injectors=injs_c, heartbeat_interval=0.03,
+            monitor_interval=0.03).start()
+        round1 = router_c.canary_round()       # golden recorded, pages
+        #                                        registered on each pool
+        injs_c[0].corrupt("device.corrupt_page", mode="flip", at=1,
+                          where="registered")
+        # the flip targets the FIRST page of the next chain registered
+        # on r0 — a filler prompt EXTENDING the canary prompt shares
+        # the canary's first page (same chain prefix ⇒ same cached
+        # page), so the flip lands exactly on the page the next probe
+        # attends
+        from deeplearning4j_tpu.observability.integrity import \
+            GoldenCanary
+        canary_prompt = list(GoldenCanary.default_prompt(vocab))
+        filler = router_c.submit(canary_prompt + [1, 1], 2,
+                                 replica_id="r0")
+        filler.result(30)
+        round2 = router_c.canary_round()       # r0's canary page is
+        #                                        flipped → mismatch
+        states_c = {rid: router_c.replica_state(rid)
+                    for rid in router_c.replica_ids()}
+        quarantines_c = int(router_c.corrupt_quarantines)
+        router_c.shutdown()
+        summary["phase_c"] = {
+            "round1": round1, "round2": round2, "states": states_c,
+            "corrupt_quarantines": quarantines_c,
+        }
+        c_ok = (states_c.get("r0") == REPLICA_CORRUPT and
+                quarantines_c >= 1 and
+                any(st == REPLICA_ALIVE for st in states_c.values()))
+
+        # ------------------ phase D: mid-handoff flip over the wire
+        from deeplearning4j_tpu.streaming.disagg import (
+            PhaseRouter, SerializedKVTransport)
+        inj_d = [FaultInjector(), FaultInjector()]
+        inj_d[0].corrupt("device.corrupt_page", mode="flip", at=1,
+                         where="handoff")
+        router_d = PhaseRouter(
+            net, prefill_replicas=1, decode_replicas=1, decoder=dec,
+            transport=SerializedKVTransport(per_page=True),
+            num_slots=num_slots, block_size=4, page_size=ps,
+            integrity=cfg, replica_injectors=inj_d,
+            heartbeat_interval=0.03, monitor_interval=0.03).start()
+        frs_d = [router_d.submit(p, g)
+                 for p, g in zip(prompts[:6], gens[:6])]
+        d_deadline = time.monotonic() + wait_s
+        for fr in frs_d:
+            fr._done.wait(max(0.0, d_deadline - time.monotonic()))
+        d_stranded = sum(1 for fr in frs_d if not fr.done())
+        d_mismatch = sum(
+            1 for fr, want in zip(frs_d, expected[:6])
+            if fr.done() and fr.state == fr.DONE and
+            not np.array_equal(fr.result(0), want))
+        d_failed = sum(1 for fr in frs_d
+                       if fr.done() and fr.state != fr.DONE)
+        d_corrupt = int(router_d._m_kv_corrupt.value)
+        d_handoff_failed = int(router_d._m_handoff["failed"].value)
+        router_d.shutdown()
+        summary["phase_d"] = {
+            "stranded": d_stranded, "mismatches": d_mismatch,
+            "failed": d_failed, "kv_corruptions": d_corrupt,
+            "handoffs_failed": d_handoff_failed,
+        }
+        d_ok = (not d_stranded and not d_mismatch and not d_failed and
+                d_corrupt >= 1 and d_handoff_failed >= 1)
+
+        # --------------- phase E: journal.write degraded mode → heal
+        import tempfile
+        from deeplearning4j_tpu.streaming.journal import RequestJournal
+        inj_e = FaultInjector()
+        inj_e.raise_n("journal.write", OSError, n=4, at=3)
+        jdir = tempfile.mkdtemp(prefix="dl4j-corruption-soak-")
+        jr = RequestJournal(jdir, fsync="always", retries=1,
+                            retry_backoff=0.001, fault_injector=inj_e)
+        eng_e = SlotGenerationEngine(net, num_slots=num_slots,
+                                     decoder=dec, block_size=4,
+                                     paged=True, page_size=ps,
+                                     integrity=cfg, journal=jr)
+        reqs_e = [eng_e.submit(p, g) for p, g in zip(prompts, gens)]
+        eng_e.run_until_drained()
+        e_results_ok = all(
+            np.array_equal(r.result(1), want)
+            for r, want in zip(reqs_e, expected))
+        e_stats = jr.stats()
+        e_healed = not jr.degraded
+        eng_e.shutdown()
+        jr.close()
+        summary["phase_e"] = {
+            "results_ok": e_results_ok, "healed": e_healed,
+            "dropped_records": int(e_stats.get("dropped_records", 0)),
+            "io_errors": int(e_stats.get("io_errors", 0)),
+        }
+        e_ok = (e_results_ok and e_healed and
+                int(e_stats.get("io_errors", 0)) >= 1)
+
+    reg = default_registry().snapshot()
+    summary["metrics"] = reg
+    summary["ok"] = bool(a_ok and b_ok and c_ok and d_ok and e_ok)
+    summary["phase_ok"] = {"a": a_ok, "b": b_ok, "c": c_ok,
+                           "d": d_ok, "e": e_ok}
+    return summary
+
+
 def _fleet_scale_ab(replicas: int, n_requests: int = 24,
                     prompt_len: int = 8, gen: int = 16,
                     num_slots: int = 8) -> dict:
@@ -1709,6 +1998,16 @@ def main(argv=None) -> int:
                          "across adaptive-K switching")
     ap.add_argument("--max-replicas", type=int, default=3,
                     help="autoscale soak: fleet size ceiling")
+    ap.add_argument("--corruption", action="store_true",
+                    help="silent-data-corruption defense round (ISSUE "
+                         "15): injected logits NaN, at-rest page flip, "
+                         "canary-detected silent flip, mid-handoff "
+                         "frame flip, and a journal.write degraded "
+                         "drive — every corruption must be detected "
+                         "before any client sees it (zero garbage "
+                         "tokens, zero lost/dup, corrupt replica "
+                         "quarantined + replaced, allocator audits "
+                         "clean, {} steady compiles)")
     ap.add_argument("--disagg", action="store_true",
                     help="disaggregated-tier soak (ISSUE 14): a "
                          "PhaseRouter fleet (2 prefill + 2 decode "
@@ -1844,6 +2143,50 @@ def main(argv=None) -> int:
                       f"steady_new_compiles="
                       f"{s['steady_new_compiles'] if s['steady_new_compiles'] is not None else '?'}"
                       f"{ab} -> {'ok' if s['ok'] else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.corruption:
+        if args.mesh or args.replicas or args.process_kill or \
+                args.autoscale or args.paged or args.disagg:
+            ap.error("--corruption runs its own phased fleets (paged + "
+                     "sentinel + disagg); it cannot be combined with "
+                     "--mesh/--replicas/--process-kill/--autoscale/"
+                     "--paged/--disagg")
+        ok = True
+        for i in range(args.iterations):
+            s = run_corruption_soak(seed=args.seed + i,
+                                    n_requests=args.requests,
+                                    num_slots=args.slots,
+                                    max_new=args.max_new)
+            ok = ok and s["ok"]
+            if args.json:
+                print(json.dumps(s, default=str))
+            else:
+                a, b = s["phase_a"], s["phase_b"]
+                c, d, e = s["phase_c"], s["phase_d"], s["phase_e"]
+                po = s["phase_ok"]
+                print(
+                    f"round {i}: corruption seed={s['seed']} "
+                    f"A[nan@{a['nan_hit']} stranded={a['stranded']} "
+                    f"garbage={a['mismatches']} "
+                    f"quarantined={a['corrupt_quarantines']} "
+                    f"replaced={'y' if a['replacement_grown'] else 'N'} "
+                    f"dup={a['ledger']['duplicates']} "
+                    f"steady={a['steady_new_compiles'] or '{}'} "
+                    f"audit={'clean' if not a['page_audit'] else 'BAD'}"
+                    f":{'ok' if po['a'] else 'FAIL'}] "
+                    f"B[flip detected={b['detected']} "
+                    f"identical={'y' if b['token_identical'] else 'N'}"
+                    f":{'ok' if po['b'] else 'FAIL'}] "
+                    f"C[canary r0={c['states'].get('r0')}"
+                    f":{'ok' if po['c'] else 'FAIL'}] "
+                    f"D[handoff kv_corrupt={d['kv_corruptions']} "
+                    f"garbage={d['mismatches']}"
+                    f":{'ok' if po['d'] else 'FAIL'}] "
+                    f"E[journal io_err={e['io_errors']} "
+                    f"healed={'y' if e['healed'] else 'N'}"
+                    f":{'ok' if po['e'] else 'FAIL'}] "
+                    f"-> {'ok' if s['ok'] else 'FAIL'}")
         return 0 if ok else 1
 
     if args.disagg:

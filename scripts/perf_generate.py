@@ -408,6 +408,99 @@ def shared_prefix_sweep(gate: float = None) -> int:
     return 0 if ok else 1
 
 
+def integrity_ab(gate: float = None) -> int:
+    """Sentinel + sampled-verification overhead A/B (ISSUE 15): the
+    SDC defense on vs off at the K=4 soak shape (the chaos_soak model:
+    tiny LM, paged ps=8, 2 slots, fused K=4 blocks, a mixed stream
+    with a shared system prompt so prefix-cache hits — and therefore
+    sampled content verification — land inside the timed region).
+    Interleaved best-of reps, same noise policy as the journal A/B.
+    ``--gate [PCT]`` (default 2.0) exits non-zero when the measured
+    overhead exceeds PCT, or when the timed region compiled anything
+    new on either arm (the sentinel must ride the EXISTING programs:
+    its verdict column changes shapes at construction, never at
+    steady state)."""
+    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                           TransformerDecoder,
+                                           transformer_lm_conf)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.integrity import IntegrityConfig
+
+    vocab, slots, k, ps = 12, 2, 4, 8
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=32, num_heads=2, num_layers=2, max_length=32,
+        learning_rate=1e-2, seed=5)).init()
+    cfg = IntegrityConfig(kv_verify_rate=0.25)
+    dec_on = TransformerDecoder(net, sentinel=True,
+                                logit_bound=cfg.logit_bound)
+    dec_off = TransformerDecoder(net)
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(0, vocab, 2 * ps + 1)
+    reqs = []
+    for i in range(48):
+        if i % 2 == 0:      # half the stream shares the system prompt:
+            p = np.concatenate(      # hits drive sampled verification
+                [sys_prompt, rng.integers(0, vocab, 2)])
+        else:
+            p = rng.integers(0, vocab, int(rng.integers(2, 5)))
+        reqs.append((p, int(rng.integers(4, 10))))
+
+    def drain(on: bool) -> float:
+        eng = SlotGenerationEngine(
+            net, num_slots=slots, decoder=dec_on if on else dec_off,
+            block_size=k, paged=True, page_size=ps, num_pages=96,
+            tracing=False, max_pending=len(reqs) + 1,
+            integrity=cfg if on else None)
+        for p, g in reqs:
+            eng.submit(p, g)
+        t0 = time.perf_counter()
+        eng.run_until_drained()
+        return eng.emitted_tokens / (time.perf_counter() - t0)
+
+    drain(True)                              # warm both arms' compiles
+    drain(False)
+    reps = int(os.environ.get("GEN_RUNS", "3"))
+    on, off = [], []
+    with CompileAudit() as audit:
+        snap = audit.snapshot()
+        for r in range(reps):
+            # alternate the pair order (drift must not masquerade as
+            # defense overhead — same policy as the journal A/B)
+            if r % 2 == 0:
+                on.append(drain(True))
+                off.append(drain(False))
+            else:
+                off.append(drain(False))
+                on.append(drain(True))
+        steady_delta = audit.delta(snap)
+    on_best, off_best = float(max(on)), float(max(off))
+    overhead = round(100.0 * (1.0 - on_best / off_best), 2) \
+        if off_best else None
+    doc = {
+        "integrity_ab": {
+            "shape": {"slots": slots, "block": k, "page_size": ps,
+                      "requests": len(reqs),
+                      "verify_rate": cfg.kv_verify_rate},
+            "integrity_on_tok_s": round(on_best, 1),
+            "integrity_off_tok_s": round(off_best, 1),
+            "integrity_on_tok_s_median": round(float(np.median(on)), 1),
+            "integrity_off_tok_s_median": round(float(np.median(off)),
+                                                1),
+            "integrity_overhead_pct": overhead,
+            "steady_new_compiles": steady_delta,
+        }}
+    ok = True
+    if gate is not None:
+        gate_ok = overhead is not None and overhead <= gate
+        doc["integrity_ab"]["gate_pct"] = gate
+        doc["integrity_ab"]["gate_ok"] = bool(gate_ok and
+                                              not steady_delta)
+        ok = bool(gate_ok and not steady_delta)
+    print(json.dumps(doc), flush=True)
+    return 0 if ok else 1
+
+
 def main() -> int:
     import jax.numpy as jnp
 
@@ -538,4 +631,12 @@ if __name__ == "__main__":
             _gate = float(_nxt) if _nxt.replace(
                 ".", "", 1).isdigit() else 5.0
         sys.exit(shared_prefix_sweep(gate=_gate))
+    if "--integrity-ab" in sys.argv[1:]:
+        _gate = None
+        if "--gate" in sys.argv[1:]:
+            _i = sys.argv.index("--gate")
+            _nxt = sys.argv[_i + 1] if _i + 1 < len(sys.argv) else ""
+            _gate = float(_nxt) if _nxt.replace(
+                ".", "", 1).isdigit() else 2.0
+        sys.exit(integrity_ab(gate=_gate))
     sys.exit(main())

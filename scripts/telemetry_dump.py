@@ -293,6 +293,17 @@ def _gauge_sum(snap: dict, family: str, label: str = None):
     return sum(vals) if vals else None
 
 
+def _gauge_max(snap: dict, family: str):
+    """Max across a gauge family's children (e.g. the STALEST canary
+    age across a replica's fleets); None when absent."""
+    doc = (snap.get("metrics") or {}).get(family) or {}
+    if doc.get("type") != "gauge":
+        return None
+    vals = [v for v in (doc.get("values") or {}).values()
+            if isinstance(v, (int, float))]
+    return max(vals) if vals else None
+
+
 def merge_snapshots(per_url: dict) -> dict:
     """Merge N ``/snapshot`` documents (keyed by replica URL) into the
     fleet summary — pure dict math, reused by the one-shot scrape, the
@@ -351,6 +362,17 @@ def merge_snapshots(per_url: dict) -> dict:
         row["kv_transfer_mb"] = None if xb is None \
             else round(xb / 1e6, 2)
         row["kv_handoffs"] = _counter_sum(snap, "fleet_kv_handoffs_total")
+        # SDC defense (ISSUE 15): sentinel trips + detected page
+        # corruptions per replica, and the golden canary's staleness
+        # (max across the replica's fleets = its stalest canary — a
+        # growing age means the prober can no longer get a clean probe
+        # through, which deserves the same attention as a missed SLO)
+        row["numerical_faults"] = _counter_sum(snap,
+                                               "numerical_fault_total")
+        row["kv_corruptions"] = _counter_sum(snap,
+                                             "kv_page_corruption_total")
+        row["canary_age_s"] = _gauge_max(snap,
+                                         "integrity_canary_age_seconds")
         if target is None and slo.get("target") is not None:
             target = float(slo["target"])
         requests += int(slo.get("requests") or 0)
@@ -385,7 +407,8 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
       f"{'att-long':>8} {'burn-sh':>8} {'reqs':>6} {'miss':>5} "
       f"{'hd-p50':>8} {'hd-min':>8} {'kv-bytes':>10} {'pg-free':>7} "
       f"{'pg-shr':>6} {'xfer-MB':>8} {'j-pend':>6} {'j-deg':>5} "
-      f"{'bub%':>6} {'GB/s':>7}\n")
+      f"{'bub%':>6} {'GB/s':>7} {'numflt':>6} {'kv-cor':>6} "
+      f"{'canary':>7}\n")
     fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
     for base, row in sorted(doc["replicas"].items()):
         if not row.get("up"):
@@ -407,7 +430,10 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
           f"{fmt(row.get('journal_pending')):>6} "
           f"{'-' if jd is None else ('Y' if jd else 'n'):>5} "
           f"{fmt(row.get('bubble_pct')):>6} "
-          f"{fmt(row.get('attained_gbs')):>7}\n")
+          f"{fmt(row.get('attained_gbs')):>7} "
+          f"{fmt(row.get('numerical_faults')):>6} "
+          f"{fmt(row.get('kv_corruptions')):>6} "
+          f"{fmt(row.get('canary_age_s')):>7}\n")
     hits = doc["counters"].get("prefix_cache_hit_total")
     misses = doc["counters"].get("prefix_cache_miss_total")
     if hits is not None or misses is not None:

@@ -1,0 +1,747 @@
+"""Silent-data-corruption defense (ISSUE 15): the on-device numerics
+sentinel (verdict rides the block readback — parity, overhead
+invariants, typed NumericalFault on injected NaN, incl. on a 2x1 GSPMD
+mesh), KV-page content verification (registration checksums, sampled
+hit/adopt verification, whole-chain eviction with balanced refcounts),
+PageFrameSet content checksums + hostile-length-prefix hardening, the
+fleet's CORRUPT quarantine (burn-rate + golden canary + replacement),
+and the ``journal.write`` fault point's degraded-mode drive."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import CompileAudit, TransferAudit
+from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                       TransformerDecoder, lm_batch,
+                                       transformer_lm_conf)
+from deeplearning4j_tpu.models.paging import (PageAllocator,
+                                              PageCorruptionError,
+                                              PageFrameError,
+                                              PageFrameSet, chain_digests)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.observability.integrity import (
+    GoldenCanary, IntegrityConfig, NumericalFault, PageVerifier,
+    corrupt_host_frames, page_content_checksum)
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.parallel.faults import FaultInjector
+from deeplearning4j_tpu.parallel.mesh import generation_mesh
+
+VOCAB = 12
+CFG = IntegrityConfig(kv_verify_rate=1.0, fault_threshold=1)
+
+
+def _tiny_lm(**kw):
+    kw.setdefault("d_model", 32)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("max_length", 32)
+    kw.setdefault("learning_rate", 1e-2)
+    kw.setdefault("seed", 5)
+    return ComputationGraph(transformer_lm_conf(VOCAB, **kw)).init()
+
+
+@pytest.fixture(scope="module")
+def trained_net():
+    rng = np.random.default_rng(4242)
+    net = _tiny_lm()
+    starts = rng.integers(0, VOCAB, (16, 1))
+    seq = (starts + np.arange(17)[None, :]) % VOCAB
+    x, y = lm_batch(seq, VOCAB)
+    ds = DataSet(x, y)
+    for _ in range(120):
+        net.fit_batch(ds)
+    return net
+
+
+@pytest.fixture(scope="module")
+def decoders(trained_net):
+    """(plain, sentinel) decoder pair sharing one net — every engine in
+    this module reuses these jit caches."""
+    return (TransformerDecoder(trained_net),
+            TransformerDecoder(trained_net, sentinel=True,
+                               logit_bound=CFG.logit_bound))
+
+
+def _prompts(rng, n, lo=2, hi=5):
+    return [rng.integers(0, VOCAB, int(rng.integers(lo, hi)))
+            for _ in range(n)]
+
+
+def _run(engine, prompts, gens, temps=None):
+    temps = temps or [0.0] * len(prompts)
+    reqs = [engine.submit(p, g, temperature=t)
+            for p, g, t in zip(prompts, gens, temps)]
+    engine.run_until_drained()
+    return reqs
+
+
+def _results(reqs):
+    return [r.result(5) for r in reqs]
+
+
+# ===================================================================
+# injector corruption plans (no jax)
+# ===================================================================
+class TestInjectorCorruptPlans:
+    def test_corruption_fires_by_site_scoped_hits(self):
+        inj = FaultInjector()
+        inj.corrupt("device.corrupt_page", mode="flip", at=2,
+                    where="registered")
+        # the "handoff" site keeps its OWN hit counter: polling it
+        # never advances the "registered" schedule
+        assert inj.corruption("device.corrupt_page",
+                              where="handoff") is None
+        assert inj.corruption("device.corrupt_page",
+                              where="registered") is None   # hit 1
+        due = inj.corruption("device.corrupt_page", where="registered")
+        assert due == {"mode": "flip"}                      # hit 2
+        assert inj.corruption("device.corrupt_page",
+                              where="registered") is None   # exhausted
+
+    def test_fire_skips_corrupt_plans_and_modes_validate(self):
+        inj = FaultInjector()
+        inj.corrupt("engine.step", mode="nan")
+        assert inj.fire("engine.step") is False     # never raises/drops
+        assert inj.corruption("engine.step") == {"mode": "nan"}
+        with pytest.raises(ValueError):
+            inj.corrupt("engine.step", mode="zero")
+
+    def test_clear_point_disarms_site_scoped_plans(self):
+        inj = FaultInjector()
+        inj.corrupt("device.corrupt_page", mode="nan",
+                    where="registered")
+        inj.corrupt("device.corrupt_page", mode="nan", where="handoff")
+        inj.clear("device.corrupt_page")
+        assert inj.corruption("device.corrupt_page",
+                              where="registered") is None
+        assert inj.corruption("device.corrupt_page",
+                              where="handoff") is None
+
+
+# ===================================================================
+# PageVerifier (no jax)
+# ===================================================================
+class TestPageVerifier:
+    def test_record_check_pid_staleness_forget(self):
+        pv = PageVerifier(capacity=4)
+        a, b = b"digestA", b"digestB"
+        assert pv.check(a, 3, b"sum1") is None      # first sight records
+        assert pv.check(a, 3, b"sum1") is True
+        assert pv.check(a, 3, b"sum2") is False     # corrupt
+        assert pv.mismatches == 1
+        # re-registration on a NEW pid refreshes instead of firing
+        assert pv.check(a, 9, b"sum3") is None
+        assert pv.check(a, 9, b"sum3") is True
+        pv.forget([a])
+        assert pv.expected(a, 9) is None
+        assert pv.check(b, 1, b"x") is None
+        assert len(pv) <= 4
+
+    def test_page_content_checksum_is_order_sensitive(self):
+        x = np.arange(8, dtype=np.float32)
+        y = np.arange(8, dtype=np.float32) + 1
+        assert page_content_checksum([x, y]) != page_content_checksum(
+            [y, x])
+        assert page_content_checksum([x, y]) == page_content_checksum(
+            [x.copy(), y.copy()])
+
+
+# ===================================================================
+# PageFrameSet: content checksums + hostile-length hardening
+# ===================================================================
+def _frame_set(ps=4, n_pages=2, h=2, dh=3, n_ctx=7, seed=0):
+    rng = np.random.default_rng(seed)
+    layers = {f"attn{i}": {kk: rng.normal(size=(n_pages, h, ps, dh))
+                           .astype(np.float32) for kk in ("k", "v")}
+              for i in range(2)}
+    return PageFrameSet(ps, rng.integers(0, 50, n_ctx), layers)
+
+
+class TestPageFrameIntegrity:
+    @pytest.mark.parametrize("wire", ["bytes", "frames"])
+    def test_checksummed_round_trip(self, wire):
+        st = _frame_set()
+        if wire == "bytes":
+            out = PageFrameSet.from_bytes(st.to_bytes())
+        else:
+            out = PageFrameSet.from_frames(st.to_frames())
+        assert out.page_checksums == st.page_checksums
+        assert out.verify() == []
+        for n in st.layers:
+            for kk in ("k", "v"):
+                np.testing.assert_array_equal(st.layers[n][kk],
+                                              out.layers[n][kk])
+
+    def test_post_stamp_flip_is_caught_where_crc_is_not(self):
+        """The mid-handoff window: mutate the arrays AFTER construction
+        (checksums stamped) — every CRC downstream is computed over the
+        corrupt bytes and passes; only content verification sees it."""
+        st = _frame_set()
+        corrupt_host_frames(st, mode="flip", page=1)
+        assert st.verify() == [1]
+        with pytest.raises(PageCorruptionError):
+            PageFrameSet.from_bytes(st.to_bytes())
+        with pytest.raises(PageCorruptionError):
+            PageFrameSet.from_frames(st.to_frames())
+
+    def test_nan_flip_detected_too(self):
+        st = _frame_set()
+        corrupt_host_frames(st, mode="nan", page=0)
+        assert 0 in st.verify()
+
+    def test_hostile_n_pages_raises_typed_not_memoryerror(self):
+        """A forged header claiming ~2^40 pages must raise
+        PageFrameError BEFORE np.zeros can allocate (satellite: cap the
+        8-byte length field against the received payload)."""
+        import json as _json
+        import struct as _struct
+        st = _frame_set()
+        frames = st.to_frames()
+        head, off = PageFrameSet._parse_header(frames[0],
+                                               PageFrameSet.MAGIC)
+        head["n_pages"] = 1 << 40
+        blob = _json.dumps(head, sort_keys=True).encode()
+        forged = (PageFrameSet.MAGIC +
+                  _struct.pack("<II", PageFrameSet.VERSION, len(blob)) +
+                  blob + frames[0][off:])
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_frames([forged] + list(frames[1:]))
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(forged)
+
+    def test_int64_wrapping_dims_still_raise_typed(self):
+        """A forged layer shape whose product WRAPS int64 (np.prod
+        would return 0 and sneak past the byte cap) must still raise
+        PageFrameError — the claim math uses plain Python ints."""
+        import json as _json
+        import struct as _struct
+        st = _frame_set()
+        blob = st.to_bytes()
+        head, off = PageFrameSet._parse_header(blob, PageFrameSet.MAGIC)
+        head["layers"]["attn0"] = [1 << 61, st.page_size, 4]
+        hb = _json.dumps(head, sort_keys=True).encode()
+        forged = (PageFrameSet.MAGIC +
+                  _struct.pack("<II", PageFrameSet.VERSION, len(hb)) +
+                  hb + blob[off:])
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(forged)
+
+    def test_hostile_sums_field_raises_typed(self):
+        import json as _json
+        import struct as _struct
+        st = _frame_set()
+        blob = st.to_bytes()
+        head, off = PageFrameSet._parse_header(blob, PageFrameSet.MAGIC)
+        head["sums"] = 123                   # non-iterable JSON number
+        hb = _json.dumps(head, sort_keys=True).encode()
+        forged = (PageFrameSet.MAGIC +
+                  _struct.pack("<II", PageFrameSet.VERSION, len(hb)) +
+                  hb + blob[off:])
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(forged)
+
+    def test_wire_decode_marks_verified_for_adopt_skip(self):
+        st = _frame_set()
+        out = PageFrameSet.from_bytes(st.to_bytes())
+        assert getattr(out, "_verified", False)
+        assert not getattr(st, "_verified", False)   # handle-passing
+        #                         path: sampled adopt verify still runs
+
+    def test_hostile_buffer_length_prefix(self):
+        """A forged 8-byte buffer length larger than the payload must
+        raise the existing CRC-layer error, never overread."""
+        import struct as _struct
+        blob = bytearray(_frame_set().to_bytes())
+        head, off = PageFrameSet._parse_header(bytes(blob),
+                                               PageFrameSet.MAGIC)
+        _struct.pack_into("<Q", blob, off, 1 << 62)
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(bytes(blob))
+
+    def test_truncated_and_malformed_headers(self):
+        st = _frame_set()
+        blob = st.to_bytes()
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(blob[:8])
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(b"XXXX" + blob[4:])
+        # header length pointing past the buffer
+        import struct as _struct
+        forged = bytearray(blob)
+        _struct.pack_into("<I", forged, 8, len(blob) + 100)
+        with pytest.raises(PageFrameError):
+            PageFrameSet.from_bytes(bytes(forged))
+
+    def test_legacy_sumless_blob_still_decodes(self):
+        """Pre-r20 senders ship no "sums" header: the decode must
+        degrade to CRC-only protection, not refuse the handoff."""
+        import json as _json
+        import struct as _struct
+        st = _frame_set()
+        blob = st.to_bytes()
+        head, off = PageFrameSet._parse_header(blob, PageFrameSet.MAGIC)
+        del head["sums"]
+        hb = _json.dumps(head, sort_keys=True).encode()
+        legacy = (PageFrameSet.MAGIC +
+                  _struct.pack("<II", PageFrameSet.VERSION, len(hb)) +
+                  hb + blob[off:])
+        out = PageFrameSet.from_bytes(legacy)
+        assert out.n_pages == st.n_pages
+        # no sums → no hashing at decode and nothing to verify
+        assert out.page_checksums is None and out.verify() == []
+        # the integrity-off sender path: stamping skipped entirely
+        off = PageFrameSet(st.page_size, st.tokens, st.layers,
+                           checksums=False)
+        assert off.page_checksums is None
+        assert "sums" not in off._header()
+
+
+# ===================================================================
+# allocator chain eviction (no jax)
+# ===================================================================
+class TestAllocatorEviction:
+    def test_evict_digests_drops_retention_refs_balanced(self):
+        pa = PageAllocator(8, 4)
+        toks = np.arange(8, dtype=np.int32)
+        pages = pa.alloc(2)
+        pa.register_chain(toks, pages)
+        dgs = chain_digests(toks, 4)
+        assert pa.cached_page(dgs[0]) == pages[0]
+        # a mapped page survives eviction until its holder releases
+        assert pa.evict_digests(dgs) == 2
+        assert pa.cached_page(dgs[0]) is None
+        assert pa.audit([pages]) == []           # mapping refs intact
+        for pid in pages:
+            pa.unref(pid)
+        assert pa.audit([]) == []                # fully freed, balanced
+
+    def test_evict_pages_and_free_subset(self):
+        pa = PageAllocator(8, 4)
+        toks = np.arange(8, dtype=np.int32)
+        pages = pa.alloc(2)
+        pa.register_chain(toks, pages)
+        dgs = pa.evict_pages(pages)              # by pid, not digest
+        assert sorted(dgs) == sorted(chain_digests(toks, 4))
+        assert pa.free_subset(pages) == []       # still slot-mapped
+        for pid in pages:
+            pa.unref(pid)
+        assert pa.free_subset(pages) == sorted(pages)
+        assert pa.audit([]) == []
+
+
+# ===================================================================
+# numerics sentinel: parity + detection
+# ===================================================================
+class TestSentinelEngine:
+    @pytest.mark.parametrize("paged", [False, True])
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_clean_parity_steady_compiles_and_readbacks(
+            self, trained_net, decoders, paged, k):
+        """Sentinel ON changes no token (greedy AND sampled), adds no
+        readbacks (≤1 per block), and a second engine over the same
+        sentinel decoder compiles NOTHING — the verdict column rides
+        the existing programs."""
+        dec, dec_s = decoders
+        rng = np.random.default_rng(7)
+        prompts = _prompts(rng, 8)
+        gens = [int(rng.integers(3, 8)) for _ in range(8)]
+        temps = [0.0, 0.9] * 4
+        pg = {"paged": True, "page_size": 8} if paged else {}
+        ref = SlotGenerationEngine(trained_net, num_slots=2, decoder=dec,
+                                   block_size=k, seed=3, **pg)
+        want = _results(_run(ref, prompts, gens, temps))
+        with CompileAudit() as audit, TransferAudit() as tr:
+            eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                       decoder=dec_s, block_size=k,
+                                       seed=3, integrity=CFG, **pg)
+            got = _results(_run(eng, prompts, gens, temps))
+            for a, b in zip(want, got):
+                np.testing.assert_array_equal(a, b)
+            assert eng.stats()["numerical_faults"] == 0
+            snap = audit.snapshot()
+            eng2 = SlotGenerationEngine(trained_net, num_slots=2,
+                                        decoder=dec_s, block_size=k,
+                                        seed=3, integrity=CFG, **pg)
+            got2 = _results(_run(eng2, prompts, gens, temps))
+            for a, b in zip(want, got2):
+                np.testing.assert_array_equal(a, b)
+            assert audit.delta(snap) == {}, "sentinel steady compiles"
+            blocks = eng2.decode_blocks
+            assert tr.fetches("engine.decode") <= 2 * blocks
+
+    def test_nan_injection_fails_typed_never_streams(self, trained_net,
+                                                     decoders):
+        """device.corrupt_logits (paged): exactly the poisoned lane
+        fails with NumericalFault, every other request stays
+        token-identical, allocator refcounts balance."""
+        _, dec_s = decoders
+        rng = np.random.default_rng(0)
+        prompts = _prompts(rng, 6)
+        gens = [5] * 6
+        ref = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=CFG)
+        want = _results(_run(ref, prompts, gens))
+        inj = FaultInjector()
+        inj.corrupt("device.corrupt_logits", mode="nan", at=1)
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=CFG, fault_injector=inj)
+        reqs = _run(eng, prompts, gens)
+        faults = 0
+        for r, w in zip(reqs, want):
+            try:
+                np.testing.assert_array_equal(r.result(5), w)
+            except NumericalFault:
+                faults += 1
+        assert faults == 1
+        assert eng.stats()["numerical_faults"] == 1
+        assert eng._pager.audit(eng._slot_pages) == []
+
+    def test_nan_injection_slab_path(self, trained_net, decoders):
+        """The slab variant poisons a cache CELL (corrupt_cache_impl);
+        sentinel engines route K=1 through the block path so the
+        verdict column exists."""
+        _, dec_s = decoders
+        rng = np.random.default_rng(2)
+        prompts = _prompts(rng, 4)
+        inj = FaultInjector()
+        inj.corrupt("device.corrupt_logits", mode="nan", at=1)
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=1,
+                                   integrity=CFG, fault_injector=inj)
+        reqs = _run(eng, prompts, [5] * 4)
+        faults = sum(1 for r in reqs
+                     if r.state == r.FAILED and
+                     isinstance(r._error, NumericalFault))
+        assert faults >= 1
+        assert eng.stats()["numerical_faults"] == faults
+
+    def test_chunked_prefill_carries_fault_accumulator(self, trained_net,
+                                                       decoders):
+        """Long prompts prefill in windows with the verdict ORed on
+        device (no per-window readback); a clean chunked run stays
+        token-identical to the unchunked sentinel run."""
+        dec, dec_s = decoders
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, VOCAB, 20) for _ in range(3)]
+        gens = [4] * 3
+        ref = SlotGenerationEngine(trained_net, num_slots=2, decoder=dec)
+        want = _results(_run(ref, prompts, gens))
+        with TransferAudit() as tr:
+            eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                       decoder=dec_s, prefill_chunk=8,
+                                       integrity=CFG)
+            got = _results(_run(eng, prompts, gens))
+            for a, b in zip(want, got):
+                np.testing.assert_array_equal(a, b)
+            assert eng.prefill_chunks >= 6       # really chunked
+            # non-final windows never synced: prefill readbacks stay
+            # one per FINAL window / admission wave
+            assert tr.fetches("engine.prefill") <= eng.prefills + \
+                eng.prefill_batches
+
+    def test_mismatched_decoder_engine_config_rejected(self, trained_net,
+                                                       decoders):
+        dec, dec_s = decoders
+        with pytest.raises(ValueError):
+            SlotGenerationEngine(trained_net, decoder=dec_s)   # no cfg
+        with pytest.raises(ValueError):
+            SlotGenerationEngine(trained_net, decoder=dec,
+                                 integrity=CFG)                # no col
+
+    def test_generate_raises_on_sentinel_trip(self, trained_net):
+        """TransformerDecoder.generate (library path): a sentinel
+        decoder surfaces the typed fault instead of returning NaN-era
+        garbage tokens."""
+        dec_s = TransformerDecoder(trained_net, sentinel=True,
+                                   logit_bound=1e-9)   # everything trips
+        with pytest.raises(NumericalFault):
+            dec_s.generate([[1, 2, 3]], 6, block_size=4)
+
+
+class TestSentinelMesh:
+    def test_mesh_sharded_detection_and_parity(self, trained_net):
+        """Satellite: corruption injected on a 2x1 GSPMD mesh is
+        detected; the clean mesh run stays token-identical to the
+        unsharded sentinel run; refcounts balance after the fault."""
+        mesh = generation_mesh(2, 1)   # conftest's 8-virtual-device CPU
+        dec_s1 = TransformerDecoder(trained_net, sentinel=True,
+                                    logit_bound=CFG.logit_bound)
+        dec_sm = TransformerDecoder(trained_net, mesh=mesh, sentinel=True,
+                                    logit_bound=CFG.logit_bound)
+        rng = np.random.default_rng(9)
+        prompts = _prompts(rng, 6)
+        gens = [5] * 6
+        ref = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s1, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=CFG)
+        want = _results(_run(ref, prompts, gens))
+        clean = SlotGenerationEngine(trained_net, num_slots=2,
+                                     decoder=dec_sm, block_size=4,
+                                     paged=True, page_size=8,
+                                     integrity=CFG)
+        got = _results(_run(clean, prompts, gens))
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+        inj = FaultInjector()
+        inj.corrupt("device.corrupt_logits", mode="nan", at=1)
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_sm, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=CFG, fault_injector=inj)
+        reqs = _run(eng, prompts, gens)
+        faults = sum(1 for r in reqs
+                     if r.state == r.FAILED and
+                     isinstance(r._error, NumericalFault))
+        assert faults >= 1, "mesh-sharded sentinel missed the NaN"
+        assert eng._pager.audit(eng._slot_pages) == []
+
+
+# ===================================================================
+# KV-page content verification
+# ===================================================================
+class TestKVVerification:
+    def test_shared_prefix_flip_detected_chain_evicted_balanced(
+            self, trained_net, decoders):
+        """Satellite: corruption inside a SHARED prefix page is caught
+        by the sampled hit verification (rate 1.0), the whole chain
+        evicts, the hit degrades to a miss (token-identical fresh
+        re-prefill), and allocator refcounts balance afterwards."""
+        _, dec_s = decoders
+        rng = np.random.default_rng(11)
+        sys_prompt = rng.integers(0, VOCAB, 17)     # 2 full ps=8 pages
+        inj = FaultInjector()
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=4,
+                                   paged=True, page_size=8, num_pages=64,
+                                   integrity=CFG, fault_injector=inj)
+        first = _run(eng, [sys_prompt], [4])[0]
+        want = first.result(5)
+        # next registration event fires the at-rest flip on the chain
+        inj.corrupt("device.corrupt_page", mode="flip", at=1,
+                    where="registered")
+        _run(eng, [np.concatenate([sys_prompt, [2]])], [3])
+        before = eng.stats()["kv_page_corruptions"]
+        again = _run(eng, [sys_prompt], [4])[0]     # hit → verify
+        assert eng.stats()["kv_page_corruptions"] == before + 1
+        np.testing.assert_array_equal(again.result(5), want)
+        assert eng._pager.audit(eng._slot_pages) == []
+        # the evicted chain re-registers cleanly: the NEXT hit verifies
+        before_hits = eng.stats()["prefix_cache_hits"]
+        third = _run(eng, [sys_prompt], [4])[0]
+        np.testing.assert_array_equal(third.result(5), want)
+        assert eng.stats()["prefix_cache_hits"] > before_hits
+        assert eng.stats()["kv_page_corruptions"] == before + 1
+
+    def test_adopt_intake_refuses_tampered_frames(self, trained_net,
+                                                  decoders):
+        """The handoff receive path: frames flipped after their
+        checksums were stamped raise PageCorruptionError at adopt()
+        BEFORE a byte lands in the pool; refcounts stay balanced."""
+        _, dec_s = decoders
+        captured = []
+        pre = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, paged=True,
+                                   page_size=8, integrity=CFG,
+                                   phase="prefill",
+                                   handoff=lambda r, st:
+                                   captured.append((r, st)))
+        rng = np.random.default_rng(3)
+        req = pre.submit(rng.integers(0, VOCAB, 10), 5)
+        pre.run_until_drained()
+        assert captured and not req.done()
+        r0, state = captured[0]
+        corrupt_host_frames(state, mode="flip", page=0)
+        dec_eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                       decoder=dec_s, paged=True,
+                                       page_size=8, integrity=CFG,
+                                       phase="decode")
+        with pytest.raises(PageCorruptionError):
+            dec_eng.adopt(r0, state)
+        assert dec_eng.stats()["kv_page_corruptions"] == 1
+        assert dec_eng._pager.audit(dec_eng._slot_pages) == []
+        pre.shutdown()
+        dec_eng.shutdown()
+
+
+# ===================================================================
+# fleet: CORRUPT quarantine + canary + replacement
+# ===================================================================
+class TestFleetCorruptQuarantine:
+    def test_nan_burn_quarantines_migrates_and_replaces(self,
+                                                        trained_net):
+        import time
+        from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                        REPLICA_CORRUPT)
+        cfg = IntegrityConfig(kv_verify_rate=1.0, fault_threshold=1)
+        dec_s = TransformerDecoder(trained_net, sentinel=True,
+                                   logit_bound=cfg.logit_bound)
+        rng = np.random.default_rng(21)
+        prompts = _prompts(rng, 10)
+        gens = [int(rng.integers(3, 7)) for _ in range(10)]
+        ref = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=cfg)
+        want = _results(_run(ref, prompts, gens))
+        injs = [FaultInjector() for _ in range(3)]
+        injs[0].corrupt("device.corrupt_logits", mode="nan", at=2)
+        router = EngineFleetRouter(
+            trained_net, num_replicas=3, decoder=dec_s, num_slots=2,
+            block_size=4, paged=True, page_size=8, integrity=cfg,
+            replica_injectors=injs, heartbeat_interval=0.03,
+            monitor_interval=0.03).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + 60
+        for fr in frs:
+            fr._done.wait(max(0.0, deadline - time.monotonic()))
+        for fr, w in zip(frs, want):
+            assert fr.done() and fr.state == fr.DONE, repr(fr)
+            np.testing.assert_array_equal(fr.result(0), w)
+        states = {rid: router.replica_state(rid)
+                  for rid in router.replica_ids()}
+        assert states.get("r0") == REPLICA_CORRUPT
+        assert router.corrupt_quarantines == 1
+        assert sum(1 for s in states.values() if s == "ALIVE") >= 3
+        assert router._ledger.to_dict()["duplicates"] == 0
+        router.shutdown()
+
+    def test_high_threshold_redispatches_without_quarantine(self,
+                                                            trained_net):
+        """fault_threshold above the injected burn: the faulted request
+        re-dispatches to a healthy replica (token-identical), the
+        replica stays in rotation — the burn-rate knob really gates."""
+        import time
+        from deeplearning4j_tpu.streaming.fleet import EngineFleetRouter
+        cfg = IntegrityConfig(kv_verify_rate=1.0, fault_threshold=100)
+        dec_s = TransformerDecoder(trained_net, sentinel=True,
+                                   logit_bound=cfg.logit_bound)
+        rng = np.random.default_rng(22)
+        prompts = _prompts(rng, 6)
+        gens = [5] * 6
+        ref = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=cfg)
+        want = _results(_run(ref, prompts, gens))
+        injs = [FaultInjector(), FaultInjector()]
+        injs[0].corrupt("device.corrupt_logits", mode="nan", at=1)
+        router = EngineFleetRouter(
+            trained_net, num_replicas=2, decoder=dec_s, num_slots=2,
+            block_size=4, paged=True, page_size=8, integrity=cfg,
+            replica_injectors=injs, heartbeat_interval=0.03,
+            monitor_interval=0.03).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + 60
+        for fr in frs:
+            fr._done.wait(max(0.0, deadline - time.monotonic()))
+        for fr, w in zip(frs, want):
+            assert fr.done() and fr.state == fr.DONE, repr(fr)
+            np.testing.assert_array_equal(fr.result(0), w)
+        assert router.corrupt_quarantines == 0
+        assert all(router.replica_state(rid) == "ALIVE"
+                   for rid in router.replica_ids())
+        router.shutdown()
+
+    def test_canary_mismatch_quarantines(self, trained_net):
+        """Golden canary: a silent FLIP of the canary's cached prefix
+        page (verification off — nothing else can see it) diverges the
+        probe and quarantines the replica."""
+        from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                        REPLICA_CORRUPT)
+        cfg = IntegrityConfig(kv_verify=False, fault_threshold=1,
+                              canary_tokens=4)
+        dec_s = TransformerDecoder(trained_net, sentinel=True,
+                                   logit_bound=cfg.logit_bound)
+        injs = [FaultInjector(), FaultInjector()]
+        router = EngineFleetRouter(
+            trained_net, num_replicas=2, decoder=dec_s, num_slots=2,
+            block_size=4, paged=True, page_size=4, integrity=cfg,
+            replica_injectors=injs, heartbeat_interval=0.03,
+            monitor_interval=0.03).start()
+        round1 = router.canary_round()
+        assert set(round1.values()) <= {"ok"}
+        injs[0].corrupt("device.corrupt_page", mode="flip", at=1,
+                        where="registered")
+        # a filler EXTENDING the canary prompt shares its first page —
+        # the flip lands on the exact page the next probe attends
+        canary = list(GoldenCanary.default_prompt(VOCAB))
+        router.submit(canary + [1, 1], 2, replica_id="r0").result(30)
+        round2 = router.canary_round()
+        assert round2.get("r0") == "mismatch"
+        assert router.replica_state("r0") == REPLICA_CORRUPT
+        assert router.corrupt_quarantines == 1
+        router.shutdown()
+
+
+# ===================================================================
+# journal.write fault point
+# ===================================================================
+class TestJournalWriteFault:
+    def test_injector_drives_degraded_then_heals(self, tmp_path):
+        from deeplearning4j_tpu.streaming.journal import RequestJournal
+        inj = FaultInjector()
+        inj.raise_n("journal.write", OSError, n=6, at=2)
+        jr = RequestJournal(str(tmp_path), fsync="always", retries=1,
+                            retry_backoff=0.001, fault_injector=inj)
+        assert jr._append([{"k": "sub", "id": "a", "prompt": [1],
+                            "params": {}, "t": 0.0}])
+        assert not jr._append([{"k": "ret", "id": "a", "off": 0,
+                                "toks": [5]}])
+        assert jr.degraded
+        for _ in range(8):
+            jr._append([{"k": "ret", "id": "a", "off": 1, "toks": [6]}])
+        assert not jr.degraded               # healed on a clean write
+        st = jr.stats()
+        assert st["io_errors"] >= 6 and st["dropped_records"] >= 1
+        jr.close()
+
+    def test_serving_never_fails_through_degraded_journal(
+            self, trained_net, decoders, tmp_path):
+        _, dec_s = decoders
+        inj = FaultInjector()
+        inj.raise_n("journal.write", OSError, n=4, at=2)
+        from deeplearning4j_tpu.streaming.journal import RequestJournal
+        jr = RequestJournal(str(tmp_path), fsync="always", retries=1,
+                            retry_backoff=0.001, fault_injector=inj)
+        rng = np.random.default_rng(31)
+        prompts = _prompts(rng, 6)
+        gens = [4] * 6
+        ref = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=CFG)
+        want = _results(_run(ref, prompts, gens))
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec_s, block_size=4,
+                                   paged=True, page_size=8,
+                                   integrity=CFG, journal=jr)
+        got = _results(_run(eng, prompts, gens))
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+        assert not jr.degraded
+        jr.close()
+
+
+# ===================================================================
+# lint acceptance
+# ===================================================================
+class TestIntegrityLintClean:
+    def test_integrity_module_is_clean(self):
+        """GL006/GL009-GL012 stay clean over the new integrity module
+        and the corruption seams — zero findings, zero new baselined
+        keys (the repo-wide --fail-on-new gate covers the rest)."""
+        from deeplearning4j_tpu.analysis.lint import lint_paths
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "deeplearning4j_tpu")
+        paths = [os.path.join(pkg, "observability", "integrity.py")]
+        found = lint_paths(paths, repo_root=root,
+                           rules=["GL006", "GL009", "GL010", "GL011",
+                                  "GL012"])
+        assert found == [], "\n".join(str(f) for f in found)
