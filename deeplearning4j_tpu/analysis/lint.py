@@ -78,7 +78,7 @@ PER_FILE_RULES = frozenset({"GL001", "GL002", "GL003", "GL004", "GL005",
 PACKAGE_RULES = frozenset({"GL009", "GL010", "GL011", "GL012"})
 
 #: bump to invalidate cached per-file results when any pass changes
-LINT_VERSION = 14
+LINT_VERSION = 15
 
 #: wrappers whose function arguments are traced when called
 _TRACE_WRAPPERS = {
@@ -136,7 +136,8 @@ _GL015_REGISTRY_HINTS = ("registry", "reg")
 #: shard_map/pjit regions.
 _GL016_NAME_HINTS = ("profiler", "prof", "phase", "timeline")
 _GL016_RECORD_METHODS = {"record_block", "record_admission",
-                         "record_chunk", "channel", "attach_decoder"}
+                         "record_chunk", "record_spec", "channel",
+                         "attach_decoder"}
 #: callees whose results are NOT "just-dispatched device work" for GL007:
 #: python builtins and host-side helpers a loop legitimately materializes
 _GL007_SAFE_CALLEES = {"range", "len", "list", "tuple", "dict", "set",
@@ -637,11 +638,16 @@ class ModuleLint:
         device_get) of a name assigned from a call INSIDE the same loop,
         in hot modules — the dispatch-then-immediately-sync pattern that
         serializes XLA dispatch with host RTT once per iteration. The
-        sanctioned crossings are (a) one audited ``device_fetch`` per
-        decode BLOCK and (b) fetching the PREVIOUS dispatch's result
-        after launching the next (double buffering) — both restructure
-        the loop rather than silence the rule. Traced functions are
-        GL001's domain and are skipped here."""
+        receiver may hide behind a subscript: a per-lane
+        ``toks[s].item()`` on a just-dispatched verify/decode result is
+        B repeated syncs where ONE fused readback of the whole
+        ``[B, K+1]`` block was owed (the speculative retire contract).
+        The sanctioned crossings are (a) one audited ``device_fetch``
+        per decode/verify BLOCK (its result is a host array — indexing
+        it is free and exempt) and (b) fetching the PREVIOUS dispatch's
+        result after launching the next (double buffering) — both
+        restructure the loop rather than silence the rule. Traced
+        functions are GL001's domain and are skipped here."""
         if "GL007" not in enabled:
             return
         if not any(f"/{d}/" in f"/{self.relpath}" for d in _HOT_DIRS):
@@ -675,17 +681,14 @@ class ModuleLint:
                     f = n.func
                     target = None
                     np_fn = _is_np_call(f)
-                    if np_fn in ("asarray", "array") and n.args and \
-                            isinstance(n.args[0], ast.Name):
-                        target = n.args[0].id
+                    if np_fn in ("asarray", "array") and n.args:
+                        target = self._gl007_base_name(n.args[0])
                     elif isinstance(f, ast.Attribute) and f.attr in (
-                            "item", "tolist", "block_until_ready") and \
-                            isinstance(f.value, ast.Name):
-                        target = f.value.id
+                            "item", "tolist", "block_until_ready"):
+                        target = self._gl007_base_name(f.value)
                     elif _dotted_name(f) in ("jax.device_get",
-                                             "device_get") and n.args and \
-                            isinstance(n.args[0], ast.Name):
-                        target = n.args[0].id
+                                             "device_get") and n.args:
+                        target = self._gl007_base_name(n.args[0])
                     if target in dispatched:
                         flagged.add(n.lineno)
                         self._emit(out, "GL007", n, qual,
@@ -750,6 +753,17 @@ class ModuleLint:
                        f"{node.func.attr} family {name!r} must end "
                        f"{want} (Prometheus unit conventions; the "
                        "fleet-scrape aggregator sums by suffix)")
+
+    @staticmethod
+    def _gl007_base_name(node: ast.AST) -> Optional[str]:
+        """The base Name of a readback receiver: a bare name or a
+        (possibly nested) subscript of one — ``toks`` in
+        ``toks[s].item()``. Per-lane element syncs hide the device
+        handle behind the subscript; the base name is what the loop's
+        dispatch assigned."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
 
     @staticmethod
     def _gl007_safe_call(call: ast.Call) -> bool:

@@ -60,6 +60,7 @@ from ..ops.platform import train_donate_argnums
 from ..ops.transfer import device_fetch
 from ..parallel.faults import (Cancelled, DeadlineExceeded, NULL_INJECTOR,
                                RejectedError)
+from .speculative import NGramDrafter
 
 #: decode-block key-schedule salts: the engine's sampling keys must never
 #: collide with TransformerDecoder.generate's (legacy: 1 << 20 | step_no)
@@ -104,6 +105,14 @@ _ENGINE_COUNTERS = {
                 "leaves through the handoff sink)",
     "adopted": "requests adopted with imported KV state (decode-only "
                "engines: the disaggregated handoff receive path)",
+    "spec_blocks": "speculative verify blocks dispatched (ISSUE 16)",
+    "spec_drafted": "candidate tokens drafted for speculative "
+                    "verification",
+    "spec_accepted_tokens": "drafted tokens accepted by the verify "
+                            "forward (the per-length account is "
+                            "generation_spec_accepted_total{len=})",
+    "spec_fallbacks": "decode blocks dispatched by the low-acceptance "
+                      "adaptive fallback while speculation is enabled",
 }
 #: unique per-engine metric label values (e0, e1, ...)
 _ENGINE_SEQ = itertools.count()
@@ -496,6 +505,72 @@ class TransformerDecoder:
         return logits.astype(jnp.float32), new_caches
 
     # graftlint: traced
+    def _walk_verify(self, params, state, caches, tokens, pos0, valid):
+        """Speculative verify window (ISSUE 16): tokens [B, C] are each
+        lane's last emitted token + its C-1 drafted candidates, forward
+        at absolute positions pos0 + [0, C) with PER-CELL masked cache
+        writes (``valid`` [B] — a frozen lane writes nothing, a lane at
+        the context edge writes only what fits). Unlike the chunk walk,
+        the output layer projects ALL window positions — acceptance
+        needs every position's next-token distribution. Rejected cells
+        are overwritten by the next dispatch before anything attends
+        them (write-before-attend), which is what makes the slab rewind
+        a pure position-clamp. Returns (logits [B, C, V] f32, caches)."""
+        conf = self.net.conf
+        acts = {self.input_name: tokens}
+        new_caches = {}
+        logits = None
+        for name in conf.topological_order:
+            v = conf.vertices[name]
+            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            if isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, TokenAndPositionEmbedding):
+                acts[name] = v.layer.embed_chunk(params[name], xs[0], pos0)
+            elif isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, SelfAttentionLayer):
+                acts[name], new_caches[name] = v.layer.chunk_forward(
+                    params[name], xs[0], caches[name], pos0, valid)
+            elif name == self.output_name:
+                # ALL positions' logits: [B, C, V] — C = K+1 stays
+                # single-digit, so the full projection is small
+                logits = v.layer.preoutput(params[name], xs[0])
+            else:
+                y, _ = v.forward(params[name], state[name], xs, train=False,
+                                 rng=None, masks=[None] * len(xs))
+                acts[name] = y
+        return logits.astype(jnp.float32), new_caches
+
+    # graftlint: traced
+    def _walk_paged_verify(self, params, state, caches, ptables, tokens,
+                           pos0, valid):
+        """Paged twin of :meth:`_walk_verify`: the window's writes ride
+        :meth:`paged_chunk_forward`'s existing ``valid`` null-page
+        redirect (invalid cells land in trash, shared prefix pages stay
+        read-only), and all C window positions project to logits."""
+        conf = self.net.conf
+        acts = {self.input_name: tokens}
+        new_caches = {}
+        logits = None
+        for name in conf.topological_order:
+            v = conf.vertices[name]
+            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            if isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, TokenAndPositionEmbedding):
+                acts[name] = v.layer.embed_chunk(params[name], xs[0], pos0)
+            elif isinstance(v, LayerVertex) and \
+                    isinstance(v.layer, SelfAttentionLayer):
+                acts[name], new_caches[name] = v.layer.paged_chunk_forward(
+                    params[name], xs[0], caches[name], ptables, pos0,
+                    valid)
+            elif name == self.output_name:
+                logits = v.layer.preoutput(params[name], xs[0])
+            else:
+                y, _ = v.forward(params[name], state[name], xs, train=False,
+                                 rng=None, masks=[None] * len(xs))
+                acts[name] = y
+        return logits.astype(jnp.float32), new_caches
+
+    # graftlint: traced
     def _walk_recompute(self, params, state, tokens, lengths):
         """Full teacher-forced forward over the padded context + gather of
         the last real position's logits — the per-token program of the
@@ -582,6 +657,67 @@ class TransformerDecoder:
         if stop is not None:
             bad = bad & ~stop
         return bad
+
+    # graftlint: traced
+    def _verify_accept(self, logits, ids, positions, draft, stopped,
+                       temps, eos_ids, key, step0, key_salt):
+        """Device-side acceptance for the verify impls (ISSUE 16):
+        ``logits`` [B, K+1, V] are the drafted window's per-position
+        next-token distributions, ``draft`` [B, K] the candidates.
+        Selection replays the EXACT per-step machinery — same
+        :meth:`_select` (greedy raw-f32 argmax, sampled from
+        bf16-rounded logits per r12), same absolute-step key fold — so
+        position j's selection is bitwise what ``decode_block`` would
+        have emitted there. Acceptance is exact-match longest-prefix:
+        every accepted token equals the model's own selection, so the
+        output stream is IDENTICAL to non-speculative decoding (greedy
+        provably; fixed-seed sampling by the same determinism the r12
+        parity suites gate), and each verified block always emits at
+        least the bonus token at the first mismatch. Emission is cut at
+        the first emitted eos and at the context edge, and frozen lanes
+        emit nothing. Returns (out [B, K+1 tokens | emit | (fault)],
+        new_ids, new_positions, new_stopped)."""
+        kq = logits.shape[1]                       # K+1 window positions
+        kd = kq - 1
+        sels = []
+        for j in range(kq):                        # static unroll: small K
+            kk = jax.random.fold_in(
+                key, jnp.bitwise_or(key_salt, step0 + j + 1))
+            sels.append(self._select(logits[:, j], temps, kk))
+        sel = jnp.stack(sels, axis=1)              # [B, K+1]
+        idxs = jnp.arange(kq, dtype=jnp.int32)[None, :]
+        match = jnp.cumprod((sel[:, :kd] == draft).astype(jnp.int32),
+                            axis=1)
+        emit = jnp.sum(match, axis=1).astype(jnp.int32) + 1   # + bonus
+        hit = jnp.logical_and(eos_ids[:, None] >= 0,
+                              sel == eos_ids[:, None])
+        first_eos = jnp.min(jnp.where(hit, idxs, kq),
+                            axis=1).astype(jnp.int32)
+        emit = jnp.minimum(emit, first_eos + 1)    # eos ends the stream
+        emit = jnp.minimum(emit, jnp.clip(self.t_max - positions, 0, kq))
+        emit = jnp.where(stopped, 0, emit)
+        new_pos = positions + emit
+        last = jnp.take_along_axis(
+            sel, jnp.clip(emit - 1, 0, kq - 1)[:, None], axis=1)[:, 0]
+        new_ids = jnp.where(emit > 0, last, ids)
+        # emit == first_eos + 1 can only hold with first_eos < kq
+        # (emit <= kq), and whichever cut produced it, the final
+        # emitted token IS the eos — freeze the lane
+        new_stop = stopped | (emit == first_eos + 1) | \
+            (new_pos >= self.t_max)
+        out = jnp.concatenate([sel, emit[:, None]], axis=1)
+        if self.sentinel:
+            # only the positions whose selections are actually EMITTED
+            # can fault a request: rejected-tail logits are garbage by
+            # construction (they conditioned on a rejected draft), and
+            # frozen lanes are exempt exactly like decode_block
+            faults = jnp.stack(
+                [self._fault_of(logits[:, j], stopped)
+                 for j in range(kq)], axis=1)
+            fault = jnp.any(faults & (idxs < emit[:, None]), axis=1)
+            out = jnp.concatenate(
+                [out, fault.astype(jnp.int32)[:, None]], axis=1)
+        return out, new_ids, new_pos, new_stop
 
     # ---------------------------------------------------------- jit entry
     def _jit_sharded(self, impl, donate, in_specs=None, out_specs=None):
@@ -872,6 +1008,69 @@ class TransformerDecoder:
                 in_specs=(psh, None, csh, row, row, row, row, row, None,
                           None, None),
                 out_specs=(mat, row, row, row, csh))
+        elif isinstance(name, tuple) and name[0] == "verify":
+            k_draft = int(name[1])
+
+            def verify_block_impl(params, state, caches, ids, positions,
+                                  draft, stopped, temps, eos_ids, key,
+                                  step0, key_salt):
+                # speculative verify (ISSUE 16): ONE cache-aware forward
+                # over the window [last id | K drafted candidates] scores
+                # all K+1 next-token positions — roughly the memory
+                # traffic of decoding ONE token (the r18 roofline
+                # motivation) — then device-side longest-prefix
+                # acceptance. Write validity clamps to the context edge
+                # and zeroes for frozen lanes; rejected cells are
+                # rewritten before ever attended, so rewind is the
+                # returned position itself (host clamps nothing extra).
+                window = jnp.concatenate([ids[:, None], draft], axis=1)
+                wvalid = jnp.where(stopped, 0,
+                                   jnp.clip(self.t_max - positions, 0,
+                                            k_draft + 1))
+                logits, caches = self._walk_verify(
+                    params, state, caches, window, positions, wvalid)
+                out, ids, positions, stopped = self._verify_accept(
+                    logits, ids, positions, draft, stopped, temps,
+                    eos_ids, key, step0, key_salt)
+                return out, ids, positions, stopped, caches
+            # per-K name, like the decode blocks: the compile auditor
+            # attributes by __name__ and two K values share input ranks
+            verify_block_impl.__name__ = f"verify_block{k_draft}_impl"
+            fn = self._jit_sharded(
+                verify_block_impl, donate,
+                in_specs=(psh, None, csh, row, row, mat, row, row, row,
+                          None, None, None),
+                out_specs=(mat, row, row, row, csh))
+        elif isinstance(name, tuple) and name[0] == "paged_verify":
+            k_draft = int(name[1])
+
+            def paged_verify_block_impl(params, state, caches, ptables,
+                                        ids, positions, draft, stopped,
+                                        temps, eos_ids, key, step0,
+                                        key_salt):
+                # paged twin of verify_block_impl: window writes ride
+                # the paged chunk path's null-page redirect, and the
+                # HOST rewinds the page tables afterwards (truncate +
+                # refcount release) — the device program never re-maps
+                window = jnp.concatenate([ids[:, None], draft], axis=1)
+                wvalid = jnp.where(stopped, 0,
+                                   jnp.clip(self.t_max - positions, 0,
+                                            k_draft + 1))
+                logits, caches = self._walk_paged_verify(
+                    params, state, caches, ptables, window, positions,
+                    wvalid)
+                out, ids, positions, stopped = self._verify_accept(
+                    logits, ids, positions, draft, stopped, temps,
+                    eos_ids, key, step0, key_salt)
+                return out, ids, positions, stopped, caches
+            paged_verify_block_impl.__name__ = \
+                f"paged_verify_block{k_draft}_impl"
+            pool_sh = self._pool_shardings()
+            fn = self._jit_sharded(
+                paged_verify_block_impl, donate,
+                in_specs=(psh, None, pool_sh, mat, row, row, mat, row,
+                          row, row, None, None, None),
+                out_specs=(mat, row, row, row, pool_sh))
         elif name == "scrub_slot":
             def scrub_slot_impl(caches, slots):
                 # slab twin of scrub_pages_impl: zero the given slots'
@@ -980,6 +1179,11 @@ class TransformerDecoder:
         if base is None and isinstance(name, tuple) and \
                 name[0] == "paged_block":
             base = f"paged_decode_block{int(name[1])}_impl"
+        if base is None and isinstance(name, tuple) and name[0] == "verify":
+            base = f"verify_block{int(name[1])}_impl"
+        if base is None and isinstance(name, tuple) and \
+                name[0] == "paged_verify":
+            base = f"paged_verify_block{int(name[1])}_impl"
         return (base or str(name)) + self._impl_suffix
 
     def _with_cost_seam(self, name, jitted):
@@ -1099,6 +1303,61 @@ class TransformerDecoder:
             self._device_params(), self.net._inference_state(), caches,
             jnp.asarray(ptables, jnp.int32), jnp.asarray(ids, jnp.int32),
             jnp.asarray(positions, jnp.int32),
+            jnp.asarray(stopped, jnp.bool_), jnp.asarray(temps),
+            jnp.asarray(eos), key, jnp.asarray(step0, jnp.int32),
+            jnp.asarray(key_salt, jnp.int32))
+
+    def verify_block(self, caches, ids, positions, draft, temps=None,
+                     key=None, *, eos_ids=None, stopped=None, step0=0,
+                     key_salt: int = 0):
+        """Speculatively verify ``draft`` [B, K] candidate tokens in ONE
+        cache-aware forward over the K+1 window [last id | draft]
+        (ISSUE 16). Returns ``(out [B, K+1 tokens | emit col |
+        (fault col)] int32, ids [B], positions [B], stopped [B],
+        caches)``: row b emits ``out[b, :out[b, K+1]]`` — the accepted
+        draft prefix plus the model's own token at the first mismatch —
+        and the returned carry is already REWOUND to the accepted
+        length (a position clamp; paged callers additionally truncate
+        their page tables). ``step0``/``key_salt`` follow
+        :meth:`decode_block`'s absolute-step key schedule, so emitted
+        tokens are exactly what the non-speculative path would emit."""
+        b = np.shape(ids)[0]
+        draft = np.asarray(draft, np.int32)
+        temps = np.zeros(b, np.float32) if temps is None \
+            else np.broadcast_to(np.asarray(temps, np.float32), (b,))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        eos = np.full(b, -1, np.int32) if eos_ids is None \
+            else np.broadcast_to(np.asarray(eos_ids, np.int32), (b,))
+        if stopped is None:
+            stopped = np.zeros(b, bool)
+        return self._fn(("verify", int(draft.shape[1])))(
+            self._device_params(), self.net._inference_state(), caches,
+            jnp.asarray(ids, jnp.int32), jnp.asarray(positions, jnp.int32),
+            jnp.asarray(draft), jnp.asarray(stopped, jnp.bool_),
+            jnp.asarray(temps), jnp.asarray(eos), key,
+            jnp.asarray(step0, jnp.int32), jnp.asarray(key_salt, jnp.int32))
+
+    def paged_verify_block(self, caches, ptables, ids, positions, draft,
+                           temps=None, key=None, *, eos_ids=None,
+                           stopped=None, step0=0, key_salt: int = 0):
+        """Paged twin of :meth:`verify_block` — same window, same
+        acceptance, same rewound carry; ``ptables`` [B, NP] ride as a
+        per-dispatch input exactly like :meth:`paged_decode_block`."""
+        b = np.shape(ids)[0]
+        draft = np.asarray(draft, np.int32)
+        temps = np.zeros(b, np.float32) if temps is None \
+            else np.broadcast_to(np.asarray(temps, np.float32), (b,))
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        eos = np.full(b, -1, np.int32) if eos_ids is None \
+            else np.broadcast_to(np.asarray(eos_ids, np.int32), (b,))
+        if stopped is None:
+            stopped = np.zeros(b, bool)
+        return self._fn(("paged_verify", int(draft.shape[1])))(
+            self._device_params(), self.net._inference_state(), caches,
+            jnp.asarray(ptables, jnp.int32), jnp.asarray(ids, jnp.int32),
+            jnp.asarray(positions, jnp.int32), jnp.asarray(draft),
             jnp.asarray(stopped, jnp.bool_), jnp.asarray(temps),
             jnp.asarray(eos), key, jnp.asarray(step0, jnp.int32),
             jnp.asarray(key_salt, jnp.int32))
@@ -1532,7 +1791,10 @@ class SlotGenerationEngine:
                  prefix_cache: bool = True,
                  profiler=None, profiling: Optional[bool] = None,
                  phase: str = "both", handoff=None,
-                 integrity=None):
+                 integrity=None, speculative: bool = False,
+                 spec_k: Optional[int] = None, spec_ngram: int = 3,
+                 spec_threshold: float = 0.35,
+                 spec_probe_every: int = 16):
         if decoder is not None and t_max is not None and \
                 decoder.t_max != t_max:
             raise ValueError(f"shared decoder has t_max {decoder.t_max}, "
@@ -1627,6 +1889,27 @@ class SlotGenerationEngine:
         self.block_size = max(self.block_ladder) if self.adaptive_block \
             else max(1, int(block_size))
         self.block_latency_target = float(block_latency_target)
+        # ---- speculative decoding (ISSUE 16) ----
+        # draft/verify over the fused-block machinery: a host-side
+        # prompt-lookup drafter (models/speculative.py — zero new
+        # params) proposes spec_k candidates per lane, ONE cache-aware
+        # verify forward scores the whole K+1 window, and rejection
+        # rewinds the write-head (position clamp on the slab;
+        # page-table truncate + refcount release when paged). Greedy
+        # output is token-for-token identical to spec-off. When the
+        # rolling acceptance EWMA drops below spec_threshold the loop
+        # falls back to the already-compiled decode_block rungs
+        # (switching compiles NOTHING) and probes speculation again
+        # every spec_probe_every fallback blocks.
+        self.speculative = bool(speculative)
+        self.spec_k = max(1, int(spec_k)) if spec_k is not None \
+            else max(self.block_size, 4)
+        self.spec_ngram = max(1, int(spec_ngram))
+        self.spec_threshold = float(spec_threshold)
+        self.spec_probe_every = max(1, int(spec_probe_every))
+        self._spec_ewma: Optional[float] = None   # rolling acceptance
+        self._spec_cool = 0       # fallback blocks until the next probe
+        self._drafters: Dict[int, "NGramDrafter"] = {}
         # latency account the policies read: EWMA seconds per decode
         # step and per prefill dispatch, written under the engine lock
         self._est_step: Optional[float] = None
@@ -1804,6 +2087,20 @@ class SlotGenerationEngine:
             "generation_adaptive_k_total",
             "decode blocks dispatched, by adaptively chosen K",
             ("engine", "k"))
+        # speculative-decoding visibility (ISSUE 16): the acceptance-
+        # length distribution (one count per retired verify block per
+        # lane, labeled by how many of its K drafts were accepted) and
+        # the host-side drafting cost — the scrape view's spec-acc
+        # column and the A/B bench read these
+        self._m_spec_len = reg.counter(
+            "generation_spec_accepted_total",
+            "speculative verify lanes retired, by accepted draft "
+            "length (0..K)",
+            ("engine", "len"))
+        self._h_spec_draft = reg.histogram(
+            "generation_spec_draft_seconds",
+            "host wall time drafting candidates per speculative block",
+            ("engine",)).labels(self.engine_id)
         # prefix-cache visibility (ISSUE 12): hit/miss per admitted
         # request plus the prompt tokens whose prefill compute the
         # shared pages saved — the SAME content hash keys the fleet's
@@ -1887,10 +2184,13 @@ class SlotGenerationEngine:
         # (t_max-1), so the warmup writes only cells the decode
         # write-head overwrites before they are ever attended; caches
         # are donated per dispatch, so the returned ones thread through.
-        if self.adaptive_block:
+        if self.adaptive_block or self.speculative:
             w_ids = np.zeros(self.num_slots, np.int32)
             w_pos = np.full(self.num_slots, self.t_max - 1, np.int32)
             w_stop = np.ones(self.num_slots, bool)
+            # a speculative engine warms its fallback rungs too: the
+            # low-acceptance switch to plain decode blocks must cost
+            # zero compiles even on a non-adaptive engine
             for k in self.block_ladder:
                 if self._pager is not None:
                     # all-zero page tables: every frozen warmup write
@@ -1903,6 +2203,20 @@ class SlotGenerationEngine:
                     _, _, _, _, self._caches = self.decoder.decode_block(
                         self._caches, w_ids, w_pos, stopped=w_stop,
                         block_size=k)
+        if self.speculative:
+            # the verify impl warms at construction for the same
+            # reason: a supervisor restart's post-recovery steady state
+            # must add ZERO compiles (the chaos bar), and the first
+            # spec block under a burst must not stall the loop. Frozen
+            # lanes carry write-validity 0 — the warmup writes nothing.
+            w_draft = np.zeros((self.num_slots, self.spec_k), np.int32)
+            if self._pager is not None:
+                _, _, _, _, self._caches = self.decoder.paged_verify_block(
+                    self._caches, self._ptables, w_ids, w_pos, w_draft,
+                    stopped=w_stop)
+            else:
+                _, _, _, _, self._caches = self.decoder.verify_block(
+                    self._caches, w_ids, w_pos, w_draft, stopped=w_stop)
         # mesh topology gauges (r12): one child per mesh axis so the
         # telemetry endpoint can chart per-axis sizes; set once — the
         # mesh never changes for an engine's lifetime
@@ -2914,6 +3228,9 @@ class SlotGenerationEngine:
             if kind == "block":
                 key = ("paged_block" if self._pager is not None
                        else "block", int(k))
+            elif kind == "verify":
+                key = ("paged_verify" if self._pager is not None
+                       else "verify", int(k))
             elif kind == "prefill":
                 key = "paged_prefill" if self._pager is not None \
                     else "prefill_slots"
@@ -3820,6 +4137,20 @@ class SlotGenerationEngine:
         share the device fairly."""
         if self._chunking:
             self._advance_chunks()
+        if self.speculative and self.phase != "prefill":
+            # speculative draft/verify (ISSUE 16). Low acceptance arms
+            # a cooldown that routes through the plain (pipelined,
+            # already-compiled) decode rungs; a probe block every
+            # spec_probe_every fallback blocks re-measures acceptance
+            # so a workload shift back to draftable text recovers.
+            with self._lock:
+                cooling = self._spec_cool > 0
+                if cooling:
+                    self._spec_cool -= 1
+            if cooling:
+                self._m["spec_fallbacks"].inc()
+                return self._step_block()
+            return self._step_spec()
         if self.block_size > 1 or self._pager is not None or \
                 self._sentinel_on:
             # paged engines always decode through the block path (K=1
@@ -4007,6 +4338,251 @@ class SlotGenerationEngine:
         # (every snapshot request finished/cancelled) — dropped unread.
         if prev is not None and dispatch is not None:
             self._retire_block(prev)
+
+    # ------------------------------------------- speculative decoding
+    def _draft_locked(self, snapshot) -> np.ndarray:
+        """Build this spec block's [S, spec_k] draft matrix (caller
+        holds the engine lock): each occupied lane's per-slot drafter
+        syncs to its request's full context — the sync is incremental
+        in steady state and rebuilds transparently when the slot's
+        occupant changed (refill, requeue after a takeover, fleet
+        migration, disagg adoption) — then proposes spec_k candidates.
+        Unoccupied/chunking lanes keep zero drafts: they dispatch
+        frozen and emit nothing."""
+        draft = np.zeros((self.num_slots, self.spec_k), np.int32)
+        for s, req in snapshot:
+            d = self._drafters.get(s)
+            if d is None or d.max_n != self.spec_ngram:
+                d = self._drafters[s] = NGramDrafter(self.spec_ngram)
+            d.sync(req, req.prompt, req.generated)
+            draft[s] = d.draft(self.spec_k)
+        return draft
+
+    def _rewind_slot_pages_locked(self, s: int) -> None:
+        """Page-table rewind (caller holds the engine lock): truncate
+        slot ``s``'s mapping to exactly cover its retired position.
+        The verify dispatch grew the table over the full K+1 window;
+        pages past the accepted length are unmapped — table entries
+        redirected to the null page, one unref per page back to the
+        pool, so the allocator audit stays balanced and a stale frozen
+        write can never land in a page the allocator re-hands out.
+        Rejected cells inside KEPT pages need no scrub: the next
+        dispatch rewrites them before anything attends them (the same
+        write-before-attend argument as the slab position clamp)."""
+        pos = int(self._positions[s])
+        keep = max(1, (pos + self.page_size - 1) // self.page_size)
+        pages = self._slot_pages[s]
+        if len(pages) <= keep:
+            return
+        drop, self._slot_pages[s] = pages[keep:], pages[:keep]
+        self._ptables[s, keep:] = 0
+        for pid in drop:
+            self._pager.unref(pid)
+
+    def _step_spec(self):
+        """One speculative draft/verify block (ISSUE 16). Speculation
+        is inherently serial — the drafter extends the lane's LAST
+        retired suffix — so this path trades the decode pipeline's
+        double buffering for K-fold emission on acceptance: any
+        in-flight fallback block retires first (host state becomes
+        authoritative), drafting + dispatch run from host state, and
+        the single fused readback ([S, K+1 tokens | emit | (fault)])
+        is fetched immediately. One readback per block, same as the
+        pipelined path."""
+        kd = self.spec_k
+        self._enforce_slots()
+        # drain the pipeline boundary: a fallback block may still be in
+        # flight from the cooldown cycles — retire it so the host
+        # positions/ids this dispatch reads are caught up
+        with self._lock:
+            stale, self._inflight = self._inflight, None
+            self._carry = None
+        if stale is not None:
+            self._retire_block(stale)
+        preempted: List[GenerationRequest] = []
+        with self._lock:
+            if self._pager is not None and \
+                    not (self._quarantined or self._shutdown):
+                # cover the window's furthest write (position + kd);
+                # the pipeline is drained, so there is no lead
+                preempted = self._ensure_decode_pages_locked(kd + 1)
+        for req in preempted:
+            if req.trace is not None:
+                req.trace.event("page_preempt", engine=self.engine_id,
+                                generated=len(req.generated))
+            self._flightrec.record("page_preempt", engine=self.engine_id,
+                                   generated=len(req.generated))
+            if self._journal is not None and req.journal_id is not None:
+                self._journal.requeued(req)
+        t_draft = interval_now()
+        dispatch = None
+        with self._lock:
+            if self._quarantined or self._shutdown:
+                return
+            snapshot = [(s, self._slots[s]) for s in range(self.num_slots)
+                        if self._slots[s] is not None]
+            if snapshot:
+                draft = self._draft_locked(snapshot)
+                self._step_no += kd + 1
+                self._m["decode_steps"].inc(kd + 1)
+                self._m["decode_blocks"].inc()
+                self._m["spec_blocks"].inc()
+                self._m["spec_drafted"].inc(kd * len(snapshot))
+                stop = np.asarray([self._slots[s] is None
+                                   for s in range(self.num_slots)], bool)
+                dispatch = (draft, self._last_ids.copy(),
+                            self._positions.copy(), stop,
+                            self._step_no - (kd + 1), self._temps.copy(),
+                            self._eos_ids.copy(),
+                            None if self._pager is None
+                            else self._ptables.copy(),
+                            len(self._pending))
+        if dispatch is None:
+            return
+        draft, ids, pos, stop, step0, temps, eos, ptab, qdepth = dispatch
+        # scripted compute corruption (device.corrupt_logits): poison an
+        # active lane's attended KV so THIS verify forward's logits
+        # corrupt — the sentinel verdict riding the readback must trip
+        # before any drafted token reaches a caller
+        plan = self._faults.corruption("device.corrupt_logits")
+        if plan is not None:
+            self._inject_corrupt_logits(plan["mode"], snapshot[0][0])
+        t_disp = interval_now()
+        self._faults.fire("engine.step")
+        if self._pager is not None:
+            toks, _, _, _, self._caches = self.decoder.paged_verify_block(
+                self._caches, ptab, ids, pos, draft, temps,
+                key=self._key, eos_ids=eos, stopped=stop, step0=step0,
+                key_salt=ENGINE_KEY_SALT)
+        else:
+            toks, _, _, _, self._caches = self.decoder.verify_block(
+                self._caches, ids, pos, draft, temps, key=self._key,
+                eos_ids=eos, stopped=stop, step0=step0,
+                key_salt=ENGINE_KEY_SALT)
+        self._retire_spec(toks, snapshot, kd, t_draft, t_disp, qdepth)
+
+    def _retire_spec(self, toks_dev, snapshot, kd, t_draft, t_disp,
+                     qdepth):
+        """Ragged retire of one verify block: fetch the fused [S, K+1
+        tokens | emit | (fault)] matrix (ONE host readback) and append
+        each lane's accepted prefix — per-lane VARIABLE lengths, with
+        the journal's absolute-offset ``ret`` contract intact because
+        each frame's base is the lane's own generated-length at append
+        time. Open lanes' positions advance by exactly what they
+        emitted (the slab rewind IS this clamp); paged lanes then
+        truncate their page tables back to the accepted length."""
+        host = device_fetch(toks_dev, tag="engine.decode")
+        t_ret = interval_now()
+        fault_col = host[:, kd + 2] if self._sentinel_on else None
+        emit_col = host[:, kd + 1]
+        if self._tracing:
+            self._h_block.observe(t_ret - t_disp)
+            self._flightrec.record("block_retire", engine=self.engine_id,
+                                   k=kd + 1, lanes=len(snapshot),
+                                   spec=True,
+                                   ms=round((t_ret - t_disp) * 1e3, 3))
+        finished: List[GenerationRequest] = []
+        faulted: List[GenerationRequest] = []
+        scrub: List[int] = []
+        scrub_slots: List[int] = []
+        jlog: List[Tuple] = []
+        drafted = accepted = 0
+        with self._lock:
+            if self._quarantined or self._shutdown:
+                return   # the drain owns the requests; recovery
+                         # re-prefills and regenerates these tokens
+            self._m["host_readbacks"].inc()
+            emitted = 0
+            for s, req in snapshot:
+                if req.done() or self._slots[s] is not req:
+                    continue   # finished/cancelled since dispatch
+                if fault_col is not None and fault_col[s]:
+                    # sentinel tripped inside the emitted window: every
+                    # token of this block is suspect — same quarantine
+                    # path as the pipelined retire
+                    self._slots[s] = None
+                    if self._pager is not None:
+                        scrub.extend(self._slot_pages[s])
+                        dgs = self._pager.evict_pages(self._slot_pages[s])
+                        if self._kv_verifier is not None:
+                            self._kv_verifier.forget(dgs)
+                    else:
+                        scrub_slots.append(s)
+                    self._release_slot_pages(s)
+                    self._m_numfault.inc()
+                    faulted.append(req)
+                    continue
+                take = int(emit_col[s])
+                drafted += kd
+                acc = max(0, take - 1)
+                accepted += acc
+                self._m_spec_len.labels(self.engine_id, str(acc)).inc()
+                closed = False
+                took = 0
+                base = len(req.generated)
+                for c in range(take):
+                    tok = int(host[s, c])
+                    req.generated.append(tok)
+                    emitted += 1
+                    took += 1
+                    if self._req_finished(req, tok):
+                        self._slots[s] = None
+                        self._release_slot_pages(s)
+                        self._m["completed"].inc()
+                        finished.append(req)
+                        closed = True
+                        break
+                if self._journal is not None and \
+                        req.journal_id is not None and took:
+                    jlog.append((req.journal_id, base,
+                                 req.generated[base:base + took]))
+                if req.trace is not None:
+                    req.trace.add_span("verify_block", t_disp, t_ret,
+                                       k=kd, tokens=took)
+                if not closed:
+                    # the accepted length IS the rewind on the slab:
+                    # rejected cells sit past the new write-head and are
+                    # rewritten before ever attended
+                    self._positions[s] += took
+                    self._last_ids[s] = int(host[s, took - 1])
+                    if self._pager is not None:
+                        self._rewind_slot_pages_locked(s)
+            self._m["spec_accepted_tokens"].inc(accepted)
+            self._m["emitted_tokens"].inc(emitted)
+            self._first_step_done = True
+            # rolling acceptance drives the adaptive fallback: below
+            # threshold, route the next spec_probe_every blocks through
+            # the plain pipelined rungs, then probe again
+            if drafted:
+                rate = accepted / drafted
+                self._spec_ewma = rate if self._spec_ewma is None else \
+                    0.7 * self._spec_ewma + 0.3 * rate
+                if self._spec_ewma < self.spec_threshold:
+                    self._spec_cool = self.spec_probe_every
+            # per-emitted-token cost estimate: speculation's whole point
+            # is that the divisor grows with acceptance
+            self._ewma_locked("_est_step",
+                              (t_ret - t_disp) / max(1, emitted))
+        t_rewind = interval_now()
+        prof = self._prof
+        t_host = interval_now() if prof is not None else t_rewind
+        if jlog:
+            self._journal.retired(jlog)
+        t_journal = interval_now() if prof is not None else t_host
+        self._scrub_pages(scrub)
+        self._scrub_slots(scrub_slots)
+        self._fail_faulted(faulted, where=f"verify_block{kd}")
+        for req in finished:
+            req._complete()
+        if self._tracing:
+            self._h_spec_draft.observe(max(0.0, t_disp - t_draft))
+        if prof is not None:
+            prof.record_spec(
+                impl=self._prof_impl("verify", kd), k=kd,
+                lanes=len(snapshot), queued=qdepth, accepted=accepted,
+                drafted=drafted, t_draft=t_draft, t_dispatch=t_disp,
+                t_fetched=t_ret, t_rewind=t_rewind, t_host=t_host,
+                t_journal=t_journal, t_publish=interval_now())
 
     def _retire_block(self, block):
         """Fetch one block's [S, K] token matrix (ONE host readback) and

@@ -1,0 +1,123 @@
+"""Prompt-lookup speculative drafter (ISSUE 16).
+
+Zero new parameters: the drafter is a host-side per-slot suffix index
+over each stream's own context (prompt + generated so far), the
+prompt-lookup / n-gram flavour of speculative decoding. At each spec
+block the engine asks for K candidate continuations of the lane's
+current suffix; the verify forward (models/generation.py
+``verify_block{K}_impl``) scores all K+1 positions in ONE cache-aware
+dispatch and accepts the longest prefix the model itself would have
+emitted — so a wrong draft costs one block's worth of compute headroom
+on a memory-bound loop, and a right draft makes K tokens nearly free
+(the r18 roofline motivation).
+
+The index maps every n-gram (n = 1..max_n) of the stream to its two
+most recent END positions. Drafting looks up the current suffix from
+the longest gram down; the most recent occurrence that is NOT the
+suffix itself supplies the continuation. Maintenance is incremental
+(O(max_n) dict writes per retired token) and self-healing: ``sync``
+rebuilds from scratch whenever the slot's occupant or its token
+history diverges from what the index saw — requeue after an engine
+crash, fleet migration, and disagg adoption all land as "different
+owner / shorter history" without any per-site hook.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["NGramDrafter"]
+
+
+class NGramDrafter:
+    """Per-slot prompt-lookup drafter over one stream's context."""
+
+    __slots__ = ("max_n", "_owner", "_tokens", "_index")
+
+    def __init__(self, max_n: int = 3):
+        self.max_n = max(1, int(max_n))
+        self._owner: Optional[object] = None
+        self._tokens: List[int] = []
+        #: gram -> (most recent end position, previous end position);
+        #: "end" points one past the gram, i.e. at its continuation
+        self._index: Dict[Tuple[int, ...], Tuple[int, int]] = {}
+
+    def __len__(self) -> int:
+        return len(self._tokens)
+
+    @staticmethod
+    def _tok(prompt, generated, i: int) -> int:
+        return int(prompt[i]) if i < len(prompt) \
+            else int(generated[i - len(prompt)])
+
+    def sync(self, owner: object, prompt, generated) -> None:
+        """Bring the index up to date with ``owner``'s full context
+        (``prompt`` + ``generated``, passed separately so steady-state
+        maintenance never concatenates the context). Same owner +
+        append-only growth extends incrementally; anything else (new
+        occupant, replayed/truncated history after a migration)
+        rebuilds from scratch — identity is the ``owner`` object,
+        compared by ``is``."""
+        total = len(prompt) + len(generated)
+        n = len(self._tokens)
+        if owner is not self._owner or total < n or \
+                (n > 0 and
+                 self._tok(prompt, generated, n - 1) != self._tokens[n - 1]):
+            self._owner = owner
+            self._tokens = []
+            self._index = {}
+            n = 0
+        for i in range(n, total):
+            self._extend(self._tok(prompt, generated, i))
+
+    def _extend(self, tok: int) -> None:
+        toks = self._tokens
+        toks.append(tok)
+        e = len(toks)
+        for n in range(1, self.max_n + 1):
+            if e < n:
+                break
+            gram = tuple(toks[e - n:e])
+            cur = self._index.get(gram)
+            self._index[gram] = (e, cur[0] if cur is not None else -1)
+
+    def draft(self, k: int) -> np.ndarray:
+        """Propose ``k`` candidate continuation tokens ([k] int32).
+        Longest-suffix match first (n = max_n down to 1); the matched
+        occurrence's continuation window supplies the candidates. The
+        match at lag ``d = ln - src`` predicts token ``i`` as token
+        ``i - d``, so when the window runs past the end of history it
+        wraps by the lag — the draft keeps extending periodic text
+        instead of stalling at the final token, which is what lets a
+        K much larger than the repeat period stay fully accepted.
+        With no prior occurrence at any n the draft degrades to
+        repeat-last — acceptance (not the drafter) is the correctness
+        gate, so a bad guess only costs speculation headroom."""
+        out = np.zeros(k, np.int32)
+        toks = self._tokens
+        ln = len(toks)
+        if ln == 0:
+            return out
+        src = -1
+        for n in range(min(self.max_n, ln), 0, -1):
+            ent = self._index.get(tuple(toks[ln - n:ln]))
+            if ent is None:
+                continue
+            # the suffix gram itself ends at ln — skip to the previous
+            # occurrence when the most recent one IS the suffix
+            e = ent[0] if ent[0] < ln else ent[1]
+            if 0 <= e < ln:
+                src = e
+                break
+        if src < 0:
+            out[:] = toks[-1]
+            return out
+        d = ln - src
+        for j in range(k):
+            i = src + j
+            while i >= ln:
+                i -= d
+            out[j] = toks[i]
+        return out

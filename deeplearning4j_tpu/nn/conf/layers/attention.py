@@ -203,7 +203,7 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         return self._project_out(params, out.astype(x.dtype)), new_cache
 
     # graftlint: traced
-    def chunk_forward(self, params, x, cache: Dict, pos0):
+    def chunk_forward(self, params, x, cache: Dict, pos0, valid=None):
         """Chunked-prefill step (µ-cuDNN-style micro-batching of a long
         prompt): x [B, C, n_in] is a WINDOW of C prompt tokens whose
         first token sits at absolute position ``pos0`` ([B] int32).
@@ -218,29 +218,55 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
         the window always fits the cache depth (the caller may slide the
         final window left over already-filled cells; rewriting a cell
         from the same tokens is idempotent up to float reassociation).
-        Returns (out [B, C, n_out], new_cache)."""
+
+        ``valid`` ([B] int32, default the full window) switches the
+        write to a PER-CELL masked scatter: only cells [pos0, pos0 +
+        valid) are written, everything else (including the whole row
+        when valid == 0) is dropped. Speculative verify windows need
+        this — a frozen/parked lane must write NOTHING (its parked cell
+        holds real prompt KV a chunk admission is still filling), and a
+        lane near the context edge must not slide its window left over
+        accepted history. valid=None keeps the original path
+        bit-identical. Returns (out [B, C, n_out], new_cache)."""
         q, k, v = self._project_qkv(params, x)         # [B, C, H, Dh]
         c = x.shape[1]
         t_max = cache["k"].shape[2]
-        p0 = jnp.clip(jnp.asarray(pos0, jnp.int32).reshape(-1), 0,
-                      max(t_max - c, 0))
-        zero = jnp.zeros((), jnp.int32)
-        upd = lambda cc, u, p: jax.lax.dynamic_update_slice(
-            cc, u, (zero, p, zero))
-        new_cache = {
-            "k": jax.vmap(upd)(cache["k"],
-                               k.transpose(0, 2, 1, 3).astype(
-                                   cache["k"].dtype), p0),
-            "v": jax.vmap(upd)(cache["v"],
-                               v.transpose(0, 2, 1, 3).astype(
-                                   cache["v"].dtype), p0)}
+        if valid is None:
+            p0 = jnp.clip(jnp.asarray(pos0, jnp.int32).reshape(-1), 0,
+                          max(t_max - c, 0))
+            zero = jnp.zeros((), jnp.int32)
+            upd = lambda cc, u, p: jax.lax.dynamic_update_slice(
+                cc, u, (zero, p, zero))
+            new_cache = {
+                "k": jax.vmap(upd)(cache["k"],
+                                   k.transpose(0, 2, 1, 3).astype(
+                                       cache["k"].dtype), p0),
+                "v": jax.vmap(upd)(cache["v"],
+                                   v.transpose(0, 2, 1, 3).astype(
+                                       cache["v"].dtype), p0)}
+            qpos = p0[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+        else:
+            p0 = jnp.asarray(pos0, jnp.int32).reshape(-1)   # UNclamped
+            vcount = jnp.asarray(valid, jnp.int32).reshape(-1)
+            w = p0[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+            keep_w = (jnp.arange(c, dtype=jnp.int32)[None, :] <
+                      vcount[:, None]) & (w < t_max)
+            # invalid cells index past the cache depth and are DROPPED
+            # (the slab twin of the paged path's null-page redirect)
+            wpos = jnp.where(keep_w, w, t_max)
+            rows = jnp.arange(x.shape[0], dtype=jnp.int32)[:, None]
+            new_cache = {
+                "k": cache["k"].at[rows, :, wpos, :].set(
+                    k.astype(cache["k"].dtype), mode="drop"),
+                "v": cache["v"].at[rows, :, wpos, :].set(
+                    v.astype(cache["v"].dtype), mode="drop")}
+            qpos = w
         ck, cv = new_cache["k"], new_cache["v"]
         hs = self._head_size()
         scale = 1.0 / math.sqrt(hs)          # math.sqrt: GL004 (x64)
         logits = jnp.einsum("bqhd,bhtd->bhqt", q, ck,
                             preferred_element_type=jnp.float32) * scale
         kpos = jnp.arange(t_max, dtype=jnp.int32)
-        qpos = p0[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
         keep = kpos[None, None, :] <= qpos[:, :, None]     # [B, C, T]
         logits = jnp.where(keep[:, None, :, :], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)            # f32

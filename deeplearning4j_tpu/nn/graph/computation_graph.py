@@ -707,6 +707,10 @@ class ComputationGraph:
             lmasks = [ds.labels_mask]
         labels += [None] * (n_out - len(labels))
         lmasks += [None] * (n_out - len(lmasks))
+        # materialize host-side HERE so the eval loop hands evaluators
+        # plain numpy without any per-element sync of its own
+        labels = [None if l is None else np.asarray(l) for l in labels]
+        lmasks = [None if m is None else np.asarray(m) for m in lmasks]
         return labels, lmasks
 
     def do_evaluation(self, data, evaluations: Dict):
@@ -720,16 +724,19 @@ class ComputationGraph:
         self._ensure_init()
         from ...datasets.iterators import as_iterator
         out_names = self.conf.network_outputs
+        from ...ops.transfer import device_fetch
         for ds in as_iterator(data):
             outs = self.output(ds.features)
             labels, lmasks = self._eval_batch_parts(ds)
+            # one audited fused readback per output head — the whole
+            # [B, ...] array at once, never per-element syncs inside
+            # the evaluator loop
+            outs = [device_fetch(o, tag="graph.eval") for o in outs]
             for i, name in enumerate(out_names):
                 ev = evaluations.get(name)
                 if ev is None or labels[i] is None:
                     continue
-                ev.eval(np.asarray(labels[i]), np.asarray(outs[i]),
-                        mask=None if lmasks[i] is None
-                        else np.asarray(lmasks[i]))
+                ev.eval(labels[i], outs[i], mask=lmasks[i])
         return evaluations
 
     def evaluate_outputs(self, data) -> Dict[str, object]:

@@ -160,6 +160,10 @@ class EngineChannel:
         # per-impl measured steady durations:
         # impl -> [n, total_s, min_s, steps_per_dispatch]
         self._impl: Dict[str, List[float]] = {}
+        # speculative-decode account (ISSUE 16): verify blocks, token
+        # outcomes, and the draft/verify/rewind sub-phase sums
+        self._spec = {"blocks": 0, "accepted": 0, "drafted": 0,
+                      "draft_s": 0.0, "verify_s": 0.0, "rewind_s": 0.0}
         self._decoders: List[weakref.ref] = []
         reg = profiler.registry
         self._h_phase = {
@@ -167,14 +171,17 @@ class EngineChannel:
                 "profiler_phase_seconds",
                 "decode-cycle phase decomposition (device/host/journal/"
                 "publish sum to block wall time; bubble = device idle "
-                "gap before dispatch)", ("engine", "phase"),
+                "gap before dispatch; draft/verify/rewind ride "
+                "alongside, attributing speculative blocks)",
+                ("engine", "phase"),
                 buckets=PHASE_BUCKETS).labels(self.name, p)
-            for p in PHASES + ("bubble",)}
+            for p in PHASES + ("bubble", "draft", "verify", "rewind")}
         m_blocks = reg.counter(
             "profiler_records_total", "phase-profiled cycles, by kind",
             ("engine", "kind"))
         self._m_kind = {kind: m_blocks.labels(self.name, kind)
-                        for kind in ("block", "admission", "chunk")}
+                        for kind in ("block", "admission", "chunk",
+                                     "spec")}
 
     def attach_decoder(self, decoder) -> None:
         """Weakly remember a decoder whose ``_cost_seam`` the roofline
@@ -243,6 +250,78 @@ class EngineChannel:
             "engine": self.name, "kind": "block", "impl": impl,
             "k": k, "lanes": lanes, "queued": queued,
             "t": t_dispatch, "bubble_ms": bubble * 1e3,
+            "phases_ms": {p: v * 1e3 for p, v in phases.items()},
+        })
+
+    def record_spec(self, *, impl: str, k: int, lanes: int, queued: int,
+                    accepted: int, drafted: int, t_draft: float,
+                    t_dispatch: float, t_fetched: float, t_rewind: float,
+                    t_host: float, t_journal: float,
+                    t_publish: float) -> None:
+        """One retired speculative verify block (ISSUE 16). The generic
+        telescoping account is unchanged — device/host/journal/publish
+        still sum to ``t_publish - t_dispatch`` exactly, so every
+        consumer of the classic decomposition reads spec blocks like any
+        other block. The spec-specific attribution rides alongside
+        (like ``bubble``): ``draft`` is the host-side drafting span
+        BEFORE dispatch (``t_dispatch - t_draft``), ``verify`` the
+        device span of the fused K+1-position forward, ``rewind`` the
+        page-table/position rollback sub-span of host (``t_rewind -
+        t_fetched``). Drafting is real work, not device idle: the
+        bubble anchor compares against ``t_draft``."""
+        phases = {"device": t_fetched - t_dispatch,
+                  "host": t_host - t_fetched,
+                  "journal": t_journal - t_host,
+                  "publish": t_publish - t_journal}
+        draft_s = max(0.0, t_dispatch - t_draft)
+        rewind_s = max(0.0, t_rewind - t_fetched)
+        with self._lock:
+            bubble = 0.0 if self._last_done is None else \
+                max(0.0, t_draft - self._last_done)
+            self._last_done = t_fetched
+            for p, v in phases.items():
+                self._phase_s[p] += v
+            self._bubble_s += bubble
+            self._blocks += 1
+            self._spec["blocks"] += 1
+            self._spec["accepted"] += int(accepted)
+            self._spec["drafted"] += int(drafted)
+            self._spec["draft_s"] += draft_s
+            self._spec["verify_s"] += max(0.0, phases["device"])
+            self._spec["rewind_s"] += rewind_s
+            span = max(0.0, phases["device"])
+            lanes = min(int(lanes), self.num_slots)
+            self._lane_total_s += self.num_slots * span
+            self._lane_busy_s += lanes * span
+            if queued > 0:
+                self._lane_idle_queued_s += (self.num_slots - lanes) * span
+            # the spec path never pipelines (the drafter needs the
+            # retired suffix), so the dispatch→ready delta IS the steady
+            # device duration — no retire-spacing correction needed
+            steady = max(span, 1e-9)
+            self._last_retire[impl] = t_fetched
+            ent = self._impl.get(impl)
+            if ent is None:
+                # first observation absorbs the verify jit compile —
+                # excluded from the steady aggregate like record_block
+                self._impl[impl] = [0, 0.0, steady, max(1, int(k) + 1)]
+            else:
+                ent[0] += 1
+                ent[1] += steady
+                ent[2] = min(ent[2], steady)
+        for p, v in phases.items():
+            self._h_phase[p].observe(max(0.0, v))
+        self._h_phase["bubble"].observe(bubble)
+        self._h_phase["draft"].observe(draft_s)
+        self._h_phase["verify"].observe(max(0.0, phases["device"]))
+        self._h_phase["rewind"].observe(rewind_s)
+        self._m_kind["spec"].inc()
+        self._profiler.timeline.add({
+            "engine": self.name, "kind": "spec", "impl": impl,
+            "k": k, "lanes": lanes, "queued": queued,
+            "accepted": int(accepted), "drafted": int(drafted),
+            "t": t_dispatch, "bubble_ms": bubble * 1e3,
+            "draft_ms": draft_s * 1e3, "rewind_ms": rewind_s * 1e3,
             "phases_ms": {p: v * 1e3 for p, v in phases.items()},
         })
 
@@ -321,6 +400,7 @@ class EngineChannel:
             lane_idle_q = self._lane_idle_queued_s
             lane_total = self._lane_total_s
             impl = {k: list(v) for k, v in self._impl.items()}
+            spec = dict(self._spec)
         device_s = phase_s["device"]
         total_s = sum(phase_s.values())
         out = {
@@ -344,6 +424,20 @@ class EngineChannel:
                        "steps_per_dispatch": int(k)}
                 for name, (n, tot, mn, k) in sorted(impl.items())},
         }
+        if spec["blocks"]:
+            # speculative-decode headline (ISSUE 16): acceptance rate is
+            # THE observable — the fleet scrape's spec-acc column
+            out["spec"] = {
+                "blocks": spec["blocks"],
+                "accepted": spec["accepted"],
+                "drafted": spec["drafted"],
+                "acceptance_rate": round(
+                    spec["accepted"] / spec["drafted"], 4)
+                if spec["drafted"] else 0.0,
+                "draft_seconds": round(spec["draft_s"], 6),
+                "verify_seconds": round(spec["verify_s"], 6),
+                "rewind_seconds": round(spec["rewind_s"], 6),
+            }
         return out
 
     def _measured_impls(self) -> Dict[str, List[float]]:

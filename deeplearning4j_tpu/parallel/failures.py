@@ -328,7 +328,17 @@ class EngineSupervisor(HeartbeatMonitor):
             # decoder (its impls carry the verdict column), so the
             # rebuilt engine must keep the matching integrity config —
             # a restart never downgrades the corruption defense
-            integrity=old._integrity)
+            integrity=old._integrity,
+            # speculative decoding (ISSUE 16): the shared decoder keeps
+            # the compiled verify impls, so the rebuilt engine resumes
+            # drafting with zero new compiles; per-slot drafters and
+            # the acceptance EWMA start fresh (requeued requests
+            # re-prefill, and the drafters rebuild from their contexts
+            # on the first spec block)
+            speculative=old.speculative, spec_k=old.spec_k,
+            spec_ngram=old.spec_ngram,
+            spec_threshold=old.spec_threshold,
+            spec_probe_every=old.spec_probe_every)
         for req in recoverable:      # harvest order: admitting, slots,
             new.requeue(req)         # queue — deterministic resumption
         self.recovered_requests += len(recoverable)

@@ -228,7 +228,10 @@ class PhaseRouter(EngineFleetRouter):
                  prefix_cache: bool = True,
                  profiler=None, profiling: Optional[bool] = None,
                  handoff_threads: int = 1,
-                 integrity=None):
+                 integrity=None, speculative: bool = False,
+                 spec_k: Optional[int] = None, spec_ngram: int = 3,
+                 spec_threshold: float = 0.35,
+                 spec_probe_every: int = 16):
         icfg = as_integrity(integrity)
         if net is None:
             raise ValueError("PhaseRouter builds its own role-"
@@ -292,6 +295,18 @@ class PhaseRouter(EngineFleetRouter):
                                else None),
                 adaptive_block=(adaptive_block if role == ROLE_DECODE
                                 else False),
+                # speculation is a DECODE-phase policy (like adaptive
+                # blocks): prefill workers hand off before ever
+                # decoding, so arming them would only warm unused
+                # verify programs. Decode workers draft over adopted
+                # contexts — the drafter rebuilds its suffix index
+                # from prompt+generated on the first spec block after
+                # adoption, no handoff payload changes
+                speculative=(speculative if role == ROLE_DECODE
+                             else False),
+                spec_k=spec_k, spec_ngram=spec_ngram,
+                spec_threshold=spec_threshold,
+                spec_probe_every=spec_probe_every,
                 block_ladder=block_ladder,
                 block_latency_target=block_latency_target,
                 paged=True, page_size=page_size,

@@ -746,7 +746,10 @@ class EngineFleetRouter:
                  sticky_page_size: Optional[int] = None,
                  engine_factory=None,
                  replica_ids: Optional[List[str]] = None,
-                 integrity=None):
+                 integrity=None, speculative: bool = False,
+                 spec_k: Optional[int] = None, spec_ngram: int = 3,
+                 spec_threshold: float = 0.35,
+                 spec_probe_every: int = 16):
         self.fleet_id = fleet_id if fleet_id is not None \
             else f"fleet{next(_FLEET_SEQ)}"
         # ---- silent-data-corruption defense (ISSUE 15) ----
@@ -846,7 +849,17 @@ class EngineFleetRouter:
                     # slo_label), so one injected profiler carries the
                     # whole fleet's phase account
                     profiler=profiler, profiling=profiling,
-                    integrity=self._integrity)
+                    integrity=self._integrity,
+                    # speculative decoding (ISSUE 16): every replica —
+                    # built now or grown later — drafts against the
+                    # SAME shared decoder's verify impls, so migration
+                    # stays token-identical (acceptance is exact-match
+                    # against the model's own selections) and a grown
+                    # replica's spec steady state compiles nothing
+                    speculative=speculative, spec_k=spec_k,
+                    spec_ngram=spec_ngram,
+                    spec_threshold=spec_threshold,
+                    spec_probe_every=spec_probe_every)
                 if supervised:
                     from ..parallel.failures import EngineSupervisor
                     eng = EngineSupervisor(

@@ -1400,6 +1400,246 @@ def run_corruption_soak(seed: int = 0, n_requests: int = 12,
     return summary
 
 
+def run_spec_soak(seed: int = 0, n_requests: int = 16,
+                  num_slots: int = 2, vocab: int = 12,
+                  wait_s: float = 120.0) -> dict:
+    """One speculative-decoding chaos round (``--spec``, ISSUE 16):
+    every recovery seam must hold while the draft/verify pipeline is
+    the hot path. The model is cyclic-trained and the prompts cyclic,
+    so the prompt-lookup drafter predicts near-perfectly and (almost)
+    every decode dispatch IS a verify block — injected faults land
+    mid-verify by construction, not by luck. Three phases:
+
+    A. **kill/restart mid-verify**: an injected ``engine.step`` crash
+       under an EngineSupervisor — the takeover requeues in-flight
+       streams and replays them token-identically against the
+       non-speculative reference (journal-backed position rewind);
+       bars: zero stranded, zero mismatches, >=1 restart, spec blocks
+       actually flowed, allocator refcounts balanced, ``{}`` steady
+       compiles on a post-restart wave (the shared decoder's compiled
+       verify rungs survive the engine rebuild).
+    B. **fleet-migrate mid-verify**: replica r0 of a 3-replica
+       speculative fleet crash-dies mid-verify; its streams migrate
+       to the survivors — bars: zero lost, ledger-verified
+       exactly-once (zero duplicates), token-identical, ``{}`` steady
+       compiles pinned to each survivor, page audits clean.
+    C. **sentinel trips on NaN in the verify forward**: injected
+       logits NaN on r0 of a sentinel-armed speculative fleet — the
+       verdict column rides the verify dispatch, the block's tokens
+       are dropped before any client sees a byte, r0 is CORRUPT-
+       quarantined on the NumericalFault burn, and the streams finish
+       token-identically elsewhere.
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.analysis.compile_audit import CompileAudit
+    from deeplearning4j_tpu.models import lm_batch, transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                      TransformerDecoder)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.observability.integrity import IntegrityConfig
+    from deeplearning4j_tpu.ops.dataset import DataSet
+    from deeplearning4j_tpu.parallel.failures import EngineSupervisor
+    from deeplearning4j_tpu.parallel.faults import FaultInjector
+    from deeplearning4j_tpu.streaming.fleet import (EngineFleetRouter,
+                                                    REPLICA_ALIVE,
+                                                    REPLICA_CORRUPT)
+
+    rng = np.random.default_rng(seed)
+    net = ComputationGraph(transformer_lm_conf(
+        vocab, d_model=32, num_heads=2, num_layers=2, max_length=64,
+        learning_rate=1e-2, seed=5)).init()
+    # cyclic training -> greedy continuation IS the cycle -> near-1.0
+    # acceptance, same honest high-acceptance regime as the perf A/B
+    starts = rng.integers(0, vocab, (16, 1))
+    cyc = (starts + np.arange(17)[None, :]) % vocab
+    x, y = lm_batch(cyc, vocab)
+    ds = DataSet(x, y)
+    for _ in range(150):
+        net.fit_batch(ds)
+    cfg = IntegrityConfig(kv_verify_rate=1.0, fault_threshold=1)
+    dec = TransformerDecoder(net, sentinel=True,
+                             logit_bound=cfg.logit_bound)
+    ps, sk = 8, 8
+    spec_kw = {"paged": True, "page_size": ps, "integrity": cfg,
+               "block_size": 4}
+    prompts = [((int(rng.integers(0, vocab)) + np.arange(16)) % vocab)
+               .astype(np.int32) for _ in range(n_requests)]
+    # prompt 16 + gen <= 16 + verify window sk+1 stays inside
+    # max_length=64 with headroom for the recovery re-prefill
+    gens = [int(rng.integers(8, 17)) for _ in range(n_requests)]
+    summary = {"seed": seed, "requests": n_requests}
+
+    def _spec_blocks(router) -> int:
+        total = 0
+        for rep in router._replicas.values():
+            inner = rep.engine.engine if rep.supervised else rep.engine
+            total += int(inner.stats()["spec_blocks"])
+        return total
+
+    def _page_audit(router) -> list:
+        bad = []
+        for rid, rep in sorted(router._replicas.items()):
+            inner = rep.engine.engine if rep.supervised else rep.engine
+            if getattr(inner, "_pager", None) is not None:
+                bad += [f"{rid}: {p}" for p in
+                        inner._pager.audit(inner._slot_pages)]
+        return bad
+
+    with CompileAudit() as audit:
+        # ---- clean NON-speculative reference: ground truth + warmup
+        clean = SlotGenerationEngine(net, num_slots=num_slots,
+                                     decoder=dec, **spec_kw)
+        clean_reqs = [clean.submit(p, g) for p, g in zip(prompts, gens)]
+        clean.run_until_drained()
+        expected = [r.result(1) for r in clean_reqs]
+
+        # -------- phase A: supervised kill/restart mid-verify block
+        inj = FaultInjector()
+        # with ~sk+1 tokens retiring per verify, each lane sees only a
+        # handful of dispatches — land the crash early so it fires
+        crash_at = int(rng.integers(2, 5))
+        inj.raise_once("engine.step",
+                       RuntimeError(f"spec soak: injected crash at "
+                                    f"step hit {crash_at}"), at=crash_at)
+        eng = SlotGenerationEngine(net, num_slots=num_slots, decoder=dec,
+                                   speculative=True, spec_k=sk,
+                                   fault_injector=inj, **spec_kw)
+        sup = EngineSupervisor(eng, timeout=2.0, interval=0.1,
+                               max_restarts=4).start()
+        reqs = [sup.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + wait_s
+        for r in reqs:
+            r._done.wait(max(0.0, deadline - time.monotonic()))
+        a_stranded = [r for r in reqs if not r.done()]
+        a_mismatch = sum(
+            1 for r, want in zip(reqs, expected)
+            if r.done() and r.state == r.DONE and
+            not np.array_equal(r.result(0), want))
+        a_failed = sum(1 for r in reqs
+                       if r.done() and r.state != r.DONE)
+        inj.clear()
+        snap = audit.snapshot()
+        wave = [sup.submit(p, g)
+                for p, g in zip(prompts[:4], gens[:4])]
+        wave_deadline = time.monotonic() + 60.0
+        for r in wave:
+            r._done.wait(max(0.0, wave_deadline - time.monotonic()))
+        a_steady = audit.delta(snap)
+        a_stranded += [r for r in wave if not r.done()]
+        fin = sup._engine
+        a_spec_blocks = int(fin.stats()["spec_blocks"])
+        a_audit = fin._pager.audit(fin._slot_pages)
+        stats = sup.stats()
+        sup.stop()
+        summary["phase_a"] = {
+            "crash_at": crash_at, "stranded": len(a_stranded),
+            "mismatches": a_mismatch, "failed": a_failed,
+            "restarts": stats["restarts"],
+            "recovered_requests": stats["recovered_requests"],
+            "spec_blocks": a_spec_blocks,
+            "steady_new_compiles": a_steady, "page_audit": a_audit,
+        }
+        a_ok = (not a_stranded and not a_mismatch and not a_failed and
+                stats["restarts"] >= 1 and a_spec_blocks > 0 and
+                not a_steady and not a_audit)
+
+        # ------------- phase B: fleet replica crash mid-verify block
+        injs = [FaultInjector() for _ in range(3)]
+        injs[0].raise_once("engine.step",
+                           RuntimeError("spec soak: replica kill"), at=3)
+        router = EngineFleetRouter(
+            net, num_replicas=3, decoder=dec, num_slots=num_slots,
+            speculative=True, spec_k=sk, replica_injectors=injs,
+            heartbeat_interval=0.03, monitor_interval=0.03,
+            suspect_after=0.25, dead_after=1.0, **spec_kw).start()
+        frs = [router.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + wait_s
+        for fr in frs:
+            fr._done.wait(max(0.0, deadline - time.monotonic()))
+        b_stranded = [fr for fr in frs if not fr.done()]
+        b_mismatch = sum(
+            1 for fr, want in zip(frs, expected)
+            if fr.done() and fr.state == fr.DONE and
+            not np.array_equal(fr.result(0), want))
+        b_failed = sum(1 for fr in frs
+                       if fr.done() and fr.state != fr.DONE)
+        for i2 in injs:
+            i2.clear()
+        states = {rid: router.replica_state(rid)
+                  for rid in router.replica_ids()}
+        survivors = [rid for rid, st in states.items()
+                     if st == REPLICA_ALIVE]
+        snap = audit.snapshot()
+        wave = [router.submit(prompts[i % n_requests],
+                              gens[i % n_requests], replica_id=rid)
+                for rid in survivors for i in range(2)]
+        wave_deadline = time.monotonic() + 60.0
+        for fr in wave:
+            fr._done.wait(max(0.0, wave_deadline - time.monotonic()))
+        b_steady = audit.delta(snap)
+        b_stranded += [fr for fr in wave if not fr.done()]
+        b_spec_blocks = _spec_blocks(router)
+        b_audit = _page_audit(router)
+        b_migrations = int(router.migrations)
+        router.shutdown()
+        ledger_b = router._ledger.to_dict()
+        summary["phase_b"] = {
+            "stranded": len(b_stranded), "mismatches": b_mismatch,
+            "failed": b_failed, "states": states,
+            "migrations": b_migrations,
+            "survivors": survivors,
+            "spec_blocks": b_spec_blocks, "ledger": ledger_b,
+            "steady_new_compiles": b_steady, "page_audit": b_audit,
+        }
+        b_ok = (not b_stranded and not b_mismatch and not b_failed and
+                b_migrations >= 1 and len(survivors) >= 2 and
+                b_spec_blocks > 0 and ledger_b["duplicates"] == 0 and
+                not b_steady and not b_audit)
+
+        # ------ phase C: sentinel trips on NaN in the verify forward
+        injs_c = [FaultInjector() for _ in range(3)]
+        injs_c[0].corrupt("device.corrupt_logits", mode="nan", at=2)
+        router_c = EngineFleetRouter(
+            net, num_replicas=3, decoder=dec, num_slots=num_slots,
+            speculative=True, spec_k=sk, replica_injectors=injs_c,
+            heartbeat_interval=0.03, monitor_interval=0.03,
+            suspect_after=0.25, dead_after=1.0, **spec_kw).start()
+        frs_c = [router_c.submit(p, g) for p, g in zip(prompts, gens)]
+        deadline = time.monotonic() + wait_s
+        for fr in frs_c:
+            fr._done.wait(max(0.0, deadline - time.monotonic()))
+        c_stranded = sum(1 for fr in frs_c if not fr.done())
+        c_mismatch = sum(
+            1 for fr, want in zip(frs_c, expected)
+            if fr.done() and fr.state == fr.DONE and
+            not np.array_equal(fr.result(0), want))
+        c_failed = sum(1 for fr in frs_c
+                       if fr.done() and fr.state != fr.DONE)
+        states_c = {rid: router_c.replica_state(rid)
+                    for rid in router_c.replica_ids()}
+        c_quarantines = int(router_c.corrupt_quarantines)
+        c_spec_blocks = _spec_blocks(router_c)
+        c_audit = _page_audit(router_c)
+        router_c.shutdown()
+        ledger_c = router_c._ledger.to_dict()
+        summary["phase_c"] = {
+            "stranded": c_stranded, "mismatches": c_mismatch,
+            "failed": c_failed, "states": states_c,
+            "corrupt_quarantines": c_quarantines,
+            "spec_blocks": c_spec_blocks, "ledger": ledger_c,
+            "page_audit": c_audit,
+        }
+        c_ok = (not c_stranded and not c_mismatch and not c_failed and
+                REPLICA_CORRUPT in states_c.values() and
+                c_quarantines >= 1 and c_spec_blocks > 0 and
+                ledger_c["duplicates"] == 0 and not c_audit)
+
+    summary["ok"] = bool(a_ok and b_ok and c_ok)
+    summary["phase_ok"] = {"a": a_ok, "b": b_ok, "c": c_ok}
+    return summary
+
+
 def _fleet_scale_ab(replicas: int, n_requests: int = 24,
                     prompt_len: int = 8, gen: int = 16,
                     num_slots: int = 8) -> dict:
@@ -2008,6 +2248,17 @@ def main(argv=None) -> int:
                          "tokens, zero lost/dup, corrupt replica "
                          "quarantined + replaced, allocator audits "
                          "clean, {} steady compiles)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding chaos round (ISSUE 16): "
+                         "a cyclic-trained model keeps the draft/verify "
+                         "pipeline hot so a supervised kill/restart and "
+                         "a fleet replica crash both land mid-verify, "
+                         "and an injected logits NaN must trip the "
+                         "sentinel riding the verify forward — bars: "
+                         "zero lost/dup (ledger-verified), token-"
+                         "identical replay vs the non-speculative "
+                         "reference, corrupt replica quarantined, "
+                         "allocator audits clean, {} steady compiles")
     ap.add_argument("--disagg", action="store_true",
                     help="disaggregated-tier soak (ISSUE 14): a "
                          "PhaseRouter fleet (2 prefill + 2 decode "
@@ -2186,6 +2437,46 @@ def main(argv=None) -> int:
                     f"E[journal io_err={e['io_errors']} "
                     f"healed={'y' if e['healed'] else 'N'}"
                     f":{'ok' if po['e'] else 'FAIL'}] "
+                    f"-> {'ok' if s['ok'] else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.spec:
+        if args.mesh or args.replicas or args.process_kill or \
+                args.autoscale or args.paged or args.disagg:
+            ap.error("--spec runs its own speculative fleets (paged + "
+                     "sentinel); it cannot be combined with --mesh/"
+                     "--replicas/--process-kill/--autoscale/--paged/"
+                     "--disagg")
+        ok = True
+        for i in range(args.iterations):
+            s = run_spec_soak(seed=args.seed + i,
+                              n_requests=args.requests,
+                              num_slots=args.slots)
+            ok = ok and s["ok"]
+            if args.json:
+                print(json.dumps(s, default=str))
+            else:
+                a, b, c = s["phase_a"], s["phase_b"], s["phase_c"]
+                po = s["phase_ok"]
+                print(
+                    f"round {i}: spec seed={s['seed']} "
+                    f"A[crash@{a['crash_at']} "
+                    f"restarts={a['restarts']} "
+                    f"spec_blocks={a['spec_blocks']} "
+                    f"stranded={a['stranded']} "
+                    f"mismatches={a['mismatches']} "
+                    f"steady={a['steady_new_compiles'] or '{}'}"
+                    f":{'ok' if po['a'] else 'FAIL'}] "
+                    f"B[migrations={b['migrations']} "
+                    f"spec_blocks={b['spec_blocks']} "
+                    f"dup={b['ledger']['duplicates']} "
+                    f"survivors={len(b['survivors'])} "
+                    f"steady={b['steady_new_compiles'] or '{}'}"
+                    f":{'ok' if po['b'] else 'FAIL'}] "
+                    f"C[nan quarantined={c['corrupt_quarantines']} "
+                    f"garbage={c['mismatches']} "
+                    f"spec_blocks={c['spec_blocks']}"
+                    f":{'ok' if po['c'] else 'FAIL'}] "
                     f"-> {'ok' if s['ok'] else 'FAIL'}")
         return 0 if ok else 1
 

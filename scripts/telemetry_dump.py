@@ -367,6 +367,14 @@ def merge_snapshots(per_url: dict) -> dict:
         # (max across the replica's fleets = its stalest canary — a
         # growing age means the prober can no longer get a clean probe
         # through, which deserves the same attention as a missed SLO)
+        # speculative decoding (ISSUE 16): rolling acceptance rate per
+        # replica — accepted drafted tokens over proposed, summed
+        # across the replica's engines; None (prints '-') when the
+        # replica never speculated
+        acc = _counter_sum(snap, "generation_spec_accepted_tokens_total")
+        drafted = _counter_sum(snap, "generation_spec_drafted_total")
+        row["spec_acc"] = None if not drafted \
+            else round((acc or 0) / drafted, 3)
         row["numerical_faults"] = _counter_sum(snap,
                                                "numerical_fault_total")
         row["kv_corruptions"] = _counter_sum(snap,
@@ -407,8 +415,8 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
       f"{'att-long':>8} {'burn-sh':>8} {'reqs':>6} {'miss':>5} "
       f"{'hd-p50':>8} {'hd-min':>8} {'kv-bytes':>10} {'pg-free':>7} "
       f"{'pg-shr':>6} {'xfer-MB':>8} {'j-pend':>6} {'j-deg':>5} "
-      f"{'bub%':>6} {'GB/s':>7} {'numflt':>6} {'kv-cor':>6} "
-      f"{'canary':>7}\n")
+      f"{'bub%':>6} {'GB/s':>7} {'spec-acc':>8} {'numflt':>6} "
+      f"{'kv-cor':>6} {'canary':>7}\n")
     fmt = (lambda v, spec="": "-" if v is None else format(v, spec))
     for base, row in sorted(doc["replicas"].items()):
         if not row.get("up"):
@@ -431,6 +439,7 @@ def pretty_scrape(doc: dict, out=sys.stdout) -> None:
           f"{'-' if jd is None else ('Y' if jd else 'n'):>5} "
           f"{fmt(row.get('bubble_pct')):>6} "
           f"{fmt(row.get('attained_gbs')):>7} "
+          f"{fmt(row.get('spec_acc')):>8} "
           f"{fmt(row.get('numerical_faults')):>6} "
           f"{fmt(row.get('kv_corruptions')):>6} "
           f"{fmt(row.get('canary_age_s')):>7}\n")

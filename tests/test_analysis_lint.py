@@ -287,6 +287,52 @@ class TestHostLoopSyncRule:
         """, rel="deeplearning4j_tpu/models/mod.py", rules=["GL007"])
         assert out == []
 
+    def test_per_lane_item_on_subscript_flags(self, tmp_path):
+        """The speculative-retire anti-pattern: per-lane ``.item()``
+        syncs on a just-dispatched verify result — B blocking syncs
+        where ONE fused [B, K+1] readback was owed."""
+        out = _lint_src(tmp_path, """
+            def retire(dec, caches, ids, pos, draft):
+                emitted = []
+                while True:
+                    toks, caches = dec.verify_block(caches, ids, pos,
+                                                    draft)
+                    for s in range(4):
+                        emitted.append(toks[s].item())
+        """, rel="deeplearning4j_tpu/models/mod.py", rules=["GL007"])
+        assert len(out) == 1 and out[0].rule == "GL007"
+        assert "toks" in out[0].message
+
+    def test_asarray_of_subscript_flags(self, tmp_path):
+        out = _lint_src(tmp_path, """
+            import numpy as np
+            def retire(dec, caches, ids, pos):
+                rows = []
+                for _ in range(8):
+                    toks, caches = dec.decode_block(caches, ids, pos)
+                    rows.append(np.asarray(toks[0]))
+                return rows
+        """, rel="deeplearning4j_tpu/models/mod.py", rules=["GL007"])
+        assert len(out) == 1 and out[0].rule == "GL007"
+
+    def test_indexing_fetched_host_array_is_fine(self, tmp_path):
+        """The sanctioned verify retire: ONE audited device_fetch of
+        the whole [B, K+1] block, then free host-side indexing of the
+        result (device_fetch returns numpy — not a dispatch)."""
+        out = _lint_src(tmp_path, """
+            from deeplearning4j_tpu.ops.transfer import device_fetch
+            def retire(dec, caches, ids, pos, draft):
+                emitted = []
+                for blk in range(4):
+                    toks, caches = dec.verify_block(caches, ids, pos,
+                                                    draft)
+                    host = device_fetch(toks, tag="engine.decode")
+                    for s in range(4):
+                        emitted.append(host[s, -1].item())
+                return emitted
+        """, rel="deeplearning4j_tpu/models/mod.py", rules=["GL007"])
+        assert out == []
+
     def test_host_helper_results_are_fine(self, tmp_path):
         """Results of np.*/builtins are host values, not dispatches."""
         out = _lint_src(tmp_path, """

@@ -1,0 +1,336 @@
+"""Speculative decoding (ISSUE 16): prompt-lookup drafter semantics,
+greedy token-for-token parity spec-on vs spec-off across K x {paged,
+contiguous} x mesh shapes, mid-block eos/cancel/deadline inside an
+accepted window, page-table rewind refcount balance, and the
+adversarial drafter (0% and 100% acceptance) paths — with zero
+steady-state compiles and the <=1-readback-per-block budget riding the
+verify path."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import CompileAudit, TransferAudit
+from deeplearning4j_tpu.models import (SlotGenerationEngine,
+                                       TransformerDecoder, lm_batch,
+                                       transformer_lm_conf)
+from deeplearning4j_tpu.models.speculative import NGramDrafter
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.ops.dataset import DataSet
+from deeplearning4j_tpu.parallel.mesh import generation_mesh
+
+VOCAB = 12
+#: acceptance bar (ISSUE 16): parity across these shapes x these Ks
+MESH_SHAPES = [(1, 1), (2, 1), (1, 2)]
+SPEC_KS = [1, 4, 8]
+
+
+def _tiny_lm(**kw):
+    kw.setdefault("d_model", 32)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("max_length", 32)
+    kw.setdefault("learning_rate", 1e-2)
+    kw.setdefault("seed", 5)
+    return ComputationGraph(transformer_lm_conf(VOCAB, **kw)).init()
+
+
+@pytest.fixture(scope="module")
+def trained_net():
+    # cyclic training -> the model's greedy continuation IS the cycle,
+    # so cyclic prompts are the honest high-acceptance (prompt-echo)
+    # regime and random prompts exercise real rejections
+    rng = np.random.default_rng(4242)
+    net = _tiny_lm()
+    starts = rng.integers(0, VOCAB, (16, 1))
+    seq = (starts + np.arange(17)[None, :]) % VOCAB
+    x, y = lm_batch(seq, VOCAB)
+    ds = DataSet(x, y)
+    for _ in range(120):
+        net.fit_batch(ds)
+    return net
+
+
+def _prompts(rng, n=8):
+    """Half cyclic (draftable — length 13 covers the full period so
+    the suffix index has a prior occurrence to match), half random
+    (reject-heavy)."""
+    out = []
+    for i in range(n):
+        if i % 2 == 0:
+            out.append(((int(rng.integers(0, VOCAB)) + np.arange(13))
+                        % VOCAB).astype(np.int32))
+        else:
+            out.append(rng.integers(0, VOCAB,
+                                    int(rng.integers(3, 7))))
+    return out
+
+
+def _run(engine, prompts, gens, **submit_kw):
+    reqs = [engine.submit(p, g, **submit_kw)
+            for p, g in zip(prompts, gens)]
+    engine.run_until_drained()
+    return [r.result(5) for r in reqs]
+
+
+def _bad_draft(self, kk):
+    # -1 is out-of-vocab: never equals a greedy selection, so every
+    # draft is rejected and the adaptive fallback arms (0% acceptance)
+    return np.full(kk, -1, np.int32)
+
+
+# ===================================================================
+# NGramDrafter (no jax involved)
+# ===================================================================
+class TestNGramDrafter:
+    def test_empty_and_repeat_last_fallback(self):
+        d = NGramDrafter(max_n=3)
+        assert list(d.draft(3)) == [0, 0, 0]          # no history
+        d.sync(self, [1, 2, 3], [])
+        assert list(d.draft(2)) == [3, 3]             # no prior suffix
+
+    def test_suffix_match_continues_history(self):
+        d = NGramDrafter(max_n=3)
+        d.sync(self, [5, 6, 7, 9, 5, 6, 7], [])
+        # suffix (5,6,7) last occurred at the start; continuation is 9,
+        # then the lag-4 wrap keeps extending the period
+        assert list(d.draft(3)) == [9, 5, 6]
+
+    def test_lag_wrap_extends_periodic_text(self):
+        """K far beyond the repeat period must stay fully drafted from
+        the cycle (the wrap is what makes spec_k >> period viable)."""
+        d = NGramDrafter(max_n=3)
+        cyc = [(3 + i) % VOCAB for i in range(16)]    # period 12
+        d.sync(self, cyc, [])
+        want = [(3 + 16 + j) % VOCAB for j in range(20)]
+        assert list(d.draft(20)) == want
+
+    def test_owner_change_and_truncation_rebuild(self):
+        d = NGramDrafter(max_n=3)
+        d.sync(self, [1, 2, 3], [4, 5])
+        assert len(d) == 5
+        d.sync(self, [1, 2, 3], [4])                  # truncated: rebuild
+        assert len(d) == 4
+        other = object()
+        d.sync(other, [9, 9], [])                     # new owner: rebuild
+        assert len(d) == 2
+
+    def test_incremental_extend_matches_rebuild(self):
+        rng = np.random.default_rng(7)
+        toks = list(rng.integers(0, VOCAB, 40))
+        inc, scratch = NGramDrafter(3), NGramDrafter(3)
+        for i in range(10, 41):
+            inc.sync(self, toks[:5], toks[5:i])
+        scratch.sync(self, toks[:5], toks[5:])
+        assert list(inc.draft(6)) == list(scratch.draft(6))
+
+
+# ===================================================================
+# Greedy parity spec-on vs spec-off: K-sweep x {slab, paged}
+# ===================================================================
+class TestSpecParity:
+    def test_k_sweep_slab_and_paged(self, trained_net):
+        rng = np.random.default_rng(9)
+        prompts = _prompts(rng)
+        gens = [int(rng.integers(3, 9)) for _ in prompts]
+        dec = TransformerDecoder(trained_net)
+        expected = _run(SlotGenerationEngine(trained_net, num_slots=2,
+                                             decoder=dec, block_size=4),
+                        prompts, gens)
+        for k in SPEC_KS:
+            for paged in (False, True):
+                kw = {"paged": True, "page_size": 8} if paged else {}
+                eng = SlotGenerationEngine(
+                    trained_net, num_slots=2, decoder=dec,
+                    block_size=min(k, 4), speculative=True, spec_k=k,
+                    **kw)
+                got = _run(eng, prompts, gens)
+                for a, b in zip(expected, got):
+                    np.testing.assert_array_equal(
+                        a, b, err_msg=f"K={k} paged={paged}")
+                st = eng.stats()
+                assert st["spec_blocks"] > 0, f"K={k} paged={paged}"
+                assert st["spec_accepted_tokens"] > 0
+                if paged:
+                    # page-table rewind left every refcount balanced
+                    assert eng._pager.audit(eng._slot_pages) == []
+
+    def test_acceptance_observable_in_stats(self, trained_net):
+        """Pure-cyclic workload: the drafter predicts the model's own
+        continuation exactly -> 100% acceptance, observable end-to-end
+        through the stats/metrics seam."""
+        rng = np.random.default_rng(11)
+        prompts = [((int(rng.integers(0, VOCAB)) + np.arange(13))
+                    % VOCAB).astype(np.int32) for _ in range(6)]
+        gens = [8] * 6
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   speculative=True, spec_k=4,
+                                   paged=True, page_size=8)
+        _run(eng, prompts, gens)
+        st = eng.stats()
+        assert st["spec_drafted"] > 0
+        assert st["spec_accepted_tokens"] == st["spec_drafted"]
+        assert st["spec_fallbacks"] == 0
+
+
+# ===================================================================
+# Mesh parity + steady compiles + readback budget
+# ===================================================================
+class TestSpecMesh:
+    def test_parity_across_meshes_audited(self, trained_net):
+        rng = np.random.default_rng(13)
+        prompts = _prompts(rng)
+        gens = [int(rng.integers(3, 9)) for _ in prompts]
+        ref_dec = TransformerDecoder(trained_net)
+        expected = _run(SlotGenerationEngine(trained_net, num_slots=2,
+                                             decoder=ref_dec,
+                                             block_size=4),
+                        prompts, gens)
+        for data, tp in MESH_SHAPES:
+            mesh = None if (data, tp) == (1, 1) \
+                else generation_mesh(data, tp)
+            dec = ref_dec if mesh is None \
+                else TransformerDecoder(trained_net, mesh=mesh)
+            with CompileAudit() as audit, TransferAudit() as tr:
+                eng = SlotGenerationEngine(
+                    trained_net, num_slots=2, decoder=dec, block_size=4,
+                    speculative=True, spec_k=4, paged=True, page_size=8)
+                got = _run(eng, prompts, gens)          # warm run
+                for a, b in zip(expected, got):
+                    np.testing.assert_array_equal(
+                        a, b, err_msg=f"mesh={data}x{tp}")
+                assert eng._pager.audit(eng._slot_pages) == []
+                # steady state: a SECOND engine over the same decoder
+                # re-serves the stream compiling NOTHING (the verify
+                # rungs live in the shared decoder's cache)
+                snap = audit.snapshot()
+                eng2 = SlotGenerationEngine(
+                    trained_net, num_slots=2, decoder=dec, block_size=4,
+                    speculative=True, spec_k=4, paged=True, page_size=8)
+                got2 = _run(eng2, prompts, gens)
+                for a, b in zip(expected, got2):
+                    np.testing.assert_array_equal(a, b)
+                assert audit.delta(snap) == {}, \
+                    f"steady compiles mesh={data}x{tp}"
+                # verify path rides the existing budget: ONE fused
+                # [B, K+2] readback per block, no per-lane syncs
+                blocks = eng.decode_blocks + eng2.decode_blocks
+                assert tr.fetches("engine.decode") <= blocks
+
+
+# ===================================================================
+# Mid-block eos / cancel / deadline inside an accepted window
+# ===================================================================
+class TestMidBlock:
+    def test_eos_inside_accepted_window(self, trained_net):
+        """eos landing mid-window: emission cuts at first eos
+        (inclusive), token-identical to the non-speculative engine."""
+        rng = np.random.default_rng(17)
+        prompts = [((int(rng.integers(0, VOCAB)) + np.arange(13))
+                    % VOCAB).astype(np.int32) for _ in range(4)]
+        gens = [10] * 4
+        dec = TransformerDecoder(trained_net)
+        # the cyclic continuation visits every token: each stream hits
+        # its eos a few tokens in, well inside the K=8 window
+        eos = [int((int(p[-1]) + 4) % VOCAB) for p in prompts]
+        expected = [
+            _run(SlotGenerationEngine(trained_net, num_slots=2,
+                                      decoder=dec, block_size=4),
+                 [p], [g], eos_id=e)[0]
+            for p, g, e in zip(prompts, gens, eos)]
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec, block_size=4,
+                                   speculative=True, spec_k=8,
+                                   paged=True, page_size=8)
+        reqs = [eng.submit(p, g, eos_id=e)
+                for p, g, e in zip(prompts, gens, eos)]
+        eng.run_until_drained()
+        for r, p, want, e in zip(reqs, prompts, expected, eos):
+            got = r.result(5)
+            np.testing.assert_array_equal(got, want)
+            # cut mid-window: eos emitted, budget left unspent
+            assert got[-1] == e and len(got) - len(p) < 10
+        assert eng._pager.audit(eng._slot_pages) == []
+
+    def test_cancel_and_deadline_inside_block(self, trained_net):
+        """A deadline expiring / cancel arriving while a verify block
+        is in flight frees the slot at the next boundary; survivors
+        keep decoding token-identically."""
+        from deeplearning4j_tpu.parallel.faults import (Cancelled,
+                                                        DeadlineExceeded,
+                                                        FaultInjector)
+        rng = np.random.default_rng(19)
+        cyc = ((int(rng.integers(0, VOCAB)) + np.arange(13))
+               % VOCAB).astype(np.int32)
+        dec = TransformerDecoder(trained_net)
+        want = _run(SlotGenerationEngine(trained_net, num_slots=3,
+                                         decoder=dec, block_size=4),
+                    [cyc], [6])[0]
+        inj = FaultInjector()
+        inj.hang_for("engine.step", seconds=0.4, at=2)
+        eng = SlotGenerationEngine(trained_net, num_slots=3,
+                                   block_size=4, decoder=dec,
+                                   speculative=True, spec_k=4,
+                                   paged=True, page_size=8,
+                                   fault_injector=inj).start()
+        try:
+            doomed = eng.submit([1, 2], 14, deadline=0.15)
+            victim = eng.submit([2, 3], 14)
+            ok = eng.submit(cyc, 6)
+            victim.cancel()
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(30)
+            with pytest.raises(Cancelled):
+                victim.result(30)
+            np.testing.assert_array_equal(ok.result(30), want)
+            assert eng._pager.audit(eng._slot_pages) == []
+        finally:
+            eng.shutdown()
+
+
+# ===================================================================
+# Adversarial drafter: 0% acceptance + fallback arming
+# ===================================================================
+class TestAdversarialDrafter:
+    def test_zero_acceptance_parity_and_rewind_balance(
+            self, trained_net, monkeypatch):
+        rng = np.random.default_rng(23)
+        prompts = _prompts(rng)
+        gens = [int(rng.integers(3, 9)) for _ in prompts]
+        dec = TransformerDecoder(trained_net)
+        expected = _run(SlotGenerationEngine(trained_net, num_slots=2,
+                                             decoder=dec, block_size=4),
+                        prompts, gens)
+        monkeypatch.setattr(NGramDrafter, "draft", _bad_draft)
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec, block_size=4,
+                                   speculative=True, spec_k=4,
+                                   spec_probe_every=2,
+                                   paged=True, page_size=8)
+        got = _run(eng, prompts, gens)
+        for a, b in zip(expected, got):
+            np.testing.assert_array_equal(a, b)
+        st = eng.stats()
+        assert st["spec_blocks"] > 0            # probes kept firing
+        assert st["spec_accepted_tokens"] == 0  # every draft rejected
+        assert st["spec_fallbacks"] > 0         # cooldown armed
+        # every rejected window was rewound: refcounts balanced
+        assert eng._pager.audit(eng._slot_pages) == []
+
+    def test_zero_acceptance_contiguous_position_clamp(
+            self, trained_net, monkeypatch):
+        rng = np.random.default_rng(29)
+        prompts = _prompts(rng, n=4)
+        gens = [int(rng.integers(3, 7)) for _ in prompts]
+        dec = TransformerDecoder(trained_net)
+        expected = _run(SlotGenerationEngine(trained_net, num_slots=2,
+                                             decoder=dec, block_size=4),
+                        prompts, gens)
+        monkeypatch.setattr(NGramDrafter, "draft", _bad_draft)
+        eng = SlotGenerationEngine(trained_net, num_slots=2,
+                                   decoder=dec, block_size=4,
+                                   speculative=True, spec_k=4,
+                                   spec_probe_every=2)
+        got = _run(eng, prompts, gens)
+        for a, b in zip(expected, got):
+            np.testing.assert_array_equal(a, b)
+        assert eng.stats()["spec_accepted_tokens"] == 0
