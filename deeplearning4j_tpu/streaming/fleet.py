@@ -298,11 +298,26 @@ class KVFleetMembership:
 
     def __init__(self, client, fleet_id: str = "fleet0",
                  epoch: Optional[int] = None, prune_keep: int = 4,
-                 prune_every: int = 50):
+                 prune_every: int = 50, scan_retries: int = 3,
+                 retry_base: float = 0.05, registry=None):
         self._client = client
         self.fleet_id = str(fleet_id)
         self._prefix = f"dl4j/fleet/{self.fleet_id}/"
         self._lock = threading.Lock()
+        # coordinator-unreachability hardening (ISSUE 18 satellite):
+        # transient scan/beat failures retry with short backoff; when
+        # every attempt fails the store is DEGRADED — the gauge flips
+        # to 1, ages() keeps growing from the local cache (members age
+        # toward SUSPECT, never silently fresh) and the next successful
+        # round heals the gauge back to 0.
+        self.scan_retries = max(1, int(scan_retries))
+        self.retry_base = float(retry_base)
+        reg = registry if registry is not None else default_registry()
+        self._g_degraded = reg.gauge(
+            "membership_degraded",
+            "1 while the coordinator KV store is unreachable "
+            "(membership running on the local cache)",
+            ("fleet",)).labels(self.fleet_id)
         # boot id: unique per incarnation (ms wall clock — collisions
         # would need two boots of the SAME replica id within 1ms). A
         # host whose clock stepped BACKWARD across the restart (pre-NTP
@@ -325,10 +340,33 @@ class KVFleetMembership:
     def register(self, replica_id: str) -> None:
         self.beat(replica_id, 0)
 
+    @property
+    def degraded(self) -> bool:
+        return bool(self._g_degraded.value)
+
+    def _scan_with_retry(self):
+        """One coordinator dir scan, retried ``scan_retries`` times with
+        exponential backoff on ANY failure. Success heals the degraded
+        gauge; total failure trips it and returns None (callers fall
+        back to the local cache). Never raises — a scan exception must
+        not kill the router's monitor thread."""
+        delay = self.retry_base
+        for attempt in range(self.scan_retries):
+            try:
+                entries = self._client.key_value_dir_get(self._prefix)
+            except Exception:   # noqa: BLE001 — unreachable coordinator
+                if attempt + 1 < self.scan_retries:
+                    time.sleep(delay)
+                    delay *= 2
+                continue
+            self._g_degraded.set(0)
+            return entries
+        self._g_degraded.set(1)
+        return None
+
     def _max_observed_epoch(self) -> int:
-        try:
-            entries = self._client.key_value_dir_get(self._prefix)
-        except Exception:   # noqa: BLE001 — no scan, trust wall clock
+        entries = self._scan_with_retry()
+        if entries is None:          # no scan: trust the wall clock
             return -1
         mx = -1
         for key, _ in entries:
@@ -359,12 +397,24 @@ class KVFleetMembership:
             self._seq[replica_id] = self._seq.get(replica_id, 0) + 1
             seq = self._seq[replica_id]
         payload = json.dumps({"load": int(load), "epoch": self.epoch})
-        try:
-            self._client.key_value_set(
-                f"{self._prefix}{replica_id}/{self.epoch:016d}-{seq:08d}",
-                payload)
-        except Exception:   # noqa: BLE001 — a dup key (two beaters
-            pass            # sharing an epoch) is a missed beat, not fatal
+        key = f"{self._prefix}{replica_id}/{self.epoch:016d}-{seq:08d}"
+        delay = self.retry_base
+        for attempt in range(self.scan_retries):
+            try:
+                self._client.key_value_set(key, payload)
+                self._g_degraded.set(0)
+                return
+            except (OSError, ConnectionError):
+                # coordinator unreachable: retry the SAME key with
+                # backoff, then count the beat as missed and flip the
+                # degraded gauge (members age toward SUSPECT — honest)
+                if attempt + 1 < self.scan_retries:
+                    time.sleep(delay)
+                    delay *= 2
+            except Exception:   # noqa: BLE001 — a dup key (two beaters
+                return          # sharing an epoch) is a missed beat,
+                                # not unreachability: no retry, no gauge
+        self._g_degraded.set(1)
 
     def leave(self, replica_id: str) -> None:
         try:
@@ -374,10 +424,9 @@ class KVFleetMembership:
             pass
 
     def ages(self) -> Dict[str, Tuple[float, int]]:
-        try:
-            entries = self._client.key_value_dir_get(self._prefix)
-        except Exception:   # noqa: BLE001 — coordinator hiccup: ages
-            entries = None  # keep growing from the local cache
+        # retried scan; on total failure ages keep growing from the
+        # local cache and the degraded gauge reads 1 until a scan lands
+        entries = self._scan_with_retry()
         now = time.monotonic()
         prune: Optional[Dict[str, List]] = None
         with self._lock:
@@ -1663,7 +1712,15 @@ class EngineFleetRouter:
     # --------------------------------------------------------- monitoring
     def _monitor_loop(self) -> None:
         while not self._stop_monitor.wait(self.monitor_interval):
-            self._scan_once()
+            try:
+                self._scan_once()
+            except Exception as exc:   # noqa: BLE001 — a scan bug or a
+                # coordinator outage outlasting the membership tier's
+                # own retries must NOT kill the monitor: a fleet that
+                # stops aging its members can never declare anyone DEAD
+                self._flightrec.record(
+                    "monitor_scan_error", fleet=self.fleet_id,
+                    cause=f"{type(exc).__name__}: {exc}"[:160])
 
     # ------------------------------------------------------ golden canary
     def _canary_loop(self) -> None:

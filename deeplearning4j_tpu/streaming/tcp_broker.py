@@ -302,13 +302,27 @@ class TcpMessageBroker(MessageBroker):
     semantics) and the retry itself is counted in ``publish_retries``.
     ``fault_injector`` arms ``broker.send`` / ``broker.recv``
     (parallel/faults.py): an injected raise exercises exactly the
-    reconnect/retry paths a real dead socket would."""
+    reconnect/retry paths a real dead socket would.
+
+    Partition hardening (ISSUE 18): a black-holed peer — SIGSTOP'd
+    process or silently dropped packets, NOT an RST — lets the TCP
+    buffer fill and then wedges ``sendall`` forever. ``publish_deadline``
+    bounds that: it arms a kernel-level ``SO_SNDTIMEO`` on every socket
+    (send-side only, so the reader's blocking recv is untouched) and
+    acts as a wall budget per publish() call; on expiry the frame is
+    DROPPED and counted in ``broker_publish_drops_total`` — the
+    documented at-most-once degradation — and the poisoned socket (a
+    timed-out sendall may have written a partial frame) is shut down so
+    the reader reconnects. ``connect_timeout`` bounds the initial dial
+    and every reconnect dial."""
 
     def __init__(self, host: str, port: int, capacity: int = 1024,
                  reconnect: bool = True, max_reconnect_attempts: int = 20,
                  backoff_base: float = 0.05, backoff_cap: float = 2.0,
                  publish_max_retries: int = 8, fault_injector=None,
-                 registry=None, flight_recorder=None):
+                 registry=None, flight_recorder=None,
+                 connect_timeout: float = 10.0,
+                 publish_deadline: Optional[float] = 5.0):
         super().__init__(capacity)
         self.host, self.port = host, int(port)
         self.reconnect = bool(reconnect)
@@ -316,6 +330,9 @@ class TcpMessageBroker(MessageBroker):
         self.backoff_base = float(backoff_base)
         self.backoff_cap = float(backoff_cap)
         self.publish_max_retries = int(publish_max_retries)
+        self.connect_timeout = float(connect_timeout)
+        self.publish_deadline = None if publish_deadline is None \
+            else float(publish_deadline)
         self._faults = fault_injector if fault_injector is not None \
             else NULL_INJECTOR
         # reconnect breadcrumbs land on the flight recorder (ISSUE 9) —
@@ -323,8 +340,10 @@ class TcpMessageBroker(MessageBroker):
         # sees broker flaps on the same timeline as the crash they often
         # precede; lazily defaulted so construction stays import-light
         self._flightrec = flight_recorder
-        self._sock = socket.create_connection((host, port), timeout=10)
+        self._sock = socket.create_connection((host, port),
+                                              timeout=self.connect_timeout)
         self._sock.settimeout(None)
+        self._arm_send_deadline(self._sock)
         self._send_lock = threading.Lock()
         # guards the self._sock REFERENCE only (reconnect swap vs close
         # teardown) — never held across I/O, so close() can always take
@@ -349,6 +368,11 @@ class TcpMessageBroker(MessageBroker):
             "broker_publish_retries_total",
             "publishes that had to wait/retry through an outage",
             ("broker",)).labels(label)
+        self._m_publish_drops = reg.counter(
+            "broker_publish_drops_total",
+            "frames dropped at the publish wall deadline (black-holed "
+            "peer or outage outlasting the budget)",
+            ("broker",)).labels(label)
         # deterministic jitter stream: chaos runs stay reproducible
         self._jitter = random.Random(0xC0FFEE ^ self.port)
         self._conn_ok = threading.Event()   # cleared while reconnecting
@@ -357,9 +381,25 @@ class TcpMessageBroker(MessageBroker):
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
+    def _arm_send_deadline(self, sock: socket.socket) -> None:
+        """Kernel-level SO_SNDTIMEO: bounds a single sendall against a
+        black-holed peer WITHOUT settimeout(), which would also flip the
+        reader's recv on the same socket to non-blocking semantics."""
+        if self.publish_deadline is None:
+            return
+        sec = int(self.publish_deadline)
+        usec = int(round((self.publish_deadline - sec) * 1e6))
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            struct.pack("ll", sec, usec))
+        except (OSError, AttributeError):
+            pass    # platform without SO_SNDTIMEO: wall check still holds
+
     # MessageBroker surface -------------------------------------------------
     def publish(self, topic: str, payload: bytes) -> None:
         attempts = 0
+        wall = None if self.publish_deadline is None \
+            else time.monotonic() + self.publish_deadline
         while True:
             try:
                 if self._faults.fire("broker.send"):
@@ -367,15 +407,35 @@ class TcpMessageBroker(MessageBroker):
                 _send_frame(self._sock, self._send_lock, b"P", topic,
                             payload)
                 return
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError) as e:
                 if self._closed.is_set() or not self.reconnect:
                     raise
+                timed_out = isinstance(e, (socket.timeout,
+                                           BlockingIOError,
+                                           InterruptedError))
+                if timed_out:
+                    # SO_SNDTIMEO fired mid-sendall: a partial frame may
+                    # be on the wire, so the socket's framing is poisoned
+                    # — kill it; the reader's recv fails and reconnects
+                    with self._sock_lock:
+                        sock = self._sock
+                    _shutdown_close(sock)
                 attempts += 1
                 self._m_publish_retries.inc()
-                if attempts > self.publish_max_retries:
+                over_wall = wall is not None and time.monotonic() >= wall
+                if attempts > self.publish_max_retries or over_wall:
+                    if over_wall:
+                        # wall deadline: degrade to a counted drop (the
+                        # documented at-most-once loss) instead of
+                        # wedging the pump thread for the whole outage
+                        self._m_publish_drops.inc()
+                        return
                     raise
                 backoff = min(self.backoff_base * (2 ** attempts),
                               self.backoff_cap)
+                if wall is not None:
+                    backoff = min(backoff, max(wall - time.monotonic(),
+                                               0.01))
                 if self._conn_ok.is_set():
                     # the reader hasn't observed the outage yet (or the
                     # fault was injected on a healthy socket): waiting on
@@ -452,8 +512,9 @@ class TcpMessageBroker(MessageBroker):
                 return False
             try:
                 s = socket.create_connection((self.host, self.port),
-                                             timeout=5)
+                                             timeout=self.connect_timeout)
                 s.settimeout(None)
+                self._arm_send_deadline(s)
             except OSError:
                 time.sleep(min(delay, self.backoff_cap) *
                            (1.0 + 0.25 * self._jitter.random()))
@@ -513,6 +574,10 @@ class TcpMessageBroker(MessageBroker):
     @property
     def publish_retries(self) -> int:
         return int(self._m_publish_retries.value)
+
+    @property
+    def publish_drops(self) -> int:
+        return int(self._m_publish_drops.value)
 
     def close(self) -> None:
         self._closed.set()

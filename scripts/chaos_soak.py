@@ -2201,6 +2201,564 @@ def _process_kill_child(workdir: str, incarnation: int,
     return 0
 
 
+# ----------------------------------------------------- remote fleet soak
+def _remote_requests(seed: int, n_requests: int, vocab: int,
+                     max_new: int) -> list:
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [{"id": f"req-{i:03d}",
+             "prompt": [int(t) for t in
+                        rng.integers(0, vocab, int(rng.integers(2, 5)))],
+             "gen": int(rng.integers(2, max_new + 1))}
+            for i in range(n_requests)]
+
+
+def _remote_reference(model: dict, reqs: list, num_slots: int,
+                      block_size: int) -> dict:
+    """In-process uninterrupted ground truth: id → full token array.
+    Deterministic greedy decode, so every remote round — migrated,
+    handed off, or re-served after a router restart — must reproduce
+    these tokens bit-exactly."""
+    from deeplearning4j_tpu.models import transformer_lm_conf
+    from deeplearning4j_tpu.models.generation import (SlotGenerationEngine,
+                                                      TransformerDecoder)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = ComputationGraph(transformer_lm_conf(
+        model["vocab"], d_model=model["d_model"],
+        num_heads=model["num_heads"], num_layers=model["num_layers"],
+        max_length=model["max_length"], learning_rate=1e-2,
+        seed=model["seed"])).init()
+    eng = SlotGenerationEngine(net, num_slots=num_slots,
+                               decoder=TransformerDecoder(net),
+                               block_size=block_size)
+    handles = [eng.submit(r["prompt"], r["gen"]) for r in reqs]
+    eng.run_until_drained()
+    return {r["id"]: h.result(1) for r, h in zip(reqs, handles)}
+
+
+def run_remote_soak(seed: int = 0, n_requests: int = 10,
+                    num_slots: int = 2, max_new: int = 6,
+                    vocab: int = 12, block_size: int = 4,
+                    slow: float = 0.05, round_wait_s: float = 300.0,
+                    workdir: str = None) -> dict:
+    """Multi-process fleet soak (``--remote``, ISSUE 18): every replica
+    is its own OS process behind a :class:`FleetEndpoint` (TCP broker
+    RPC + coordinator-KV heartbeats + supervised respawn).
+
+    Round A — SIGKILL a worker process mid-stream: survivors absorb the
+    migrated streams, the launcher respawns the corpse, the respawned
+    incarnation is re-adopted under the same replica id.
+    Round B — role-split fleet (1 prefill + 2 decode): the KV handoff
+    crosses the wire as serialized CRC-framed pages; a decode worker is
+    SIGKILLed with handoffs in flight (reprefill/migration path), and
+    the wire byte account is checked against the prefill process's own
+    transport counters.
+    Round C — partition: SIGSTOP a worker (beats stop, sockets
+    black-hole, process does NOT die). The router must age it
+    ALIVE→SUSPECT→DEAD and clone-migrate its streams; on SIGCONT the
+    zombie's late publishes must be fenced, never double-served.
+    Round D — router restart: the ENDPOINT process (broker + ledger +
+    launcher) is SIGKILLed mid-serve in a child; orphaned workers are
+    reaped, a fresh endpoint re-serves whatever has no durable result
+    line (first-line-wins dedup on the shared results.jsonl).
+
+    Bars: zero lost, zero duplicated (ledger-verified), token-identical
+    vs the in-process reference, ``{}`` steady compiles on every ALIVE
+    worker post-recovery, wire transfer bytes exact (no fences) or
+    bounded (fenced handoffs accounted)."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.streaming.remote import FleetEndpoint
+
+    assert max_new <= 11, "max_new > 11 would leave the tp=16 bucket"
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="remote-soak-")
+    os.makedirs(workdir, exist_ok=True)
+    model = {"vocab": vocab, "d_model": 32, "num_heads": 2,
+             "num_layers": 2, "max_length": 32, "seed": 5}
+    reqs = _remote_requests(seed, n_requests, vocab, max_new)
+    expected = _remote_reference(model, reqs, num_slots, block_size)
+    eng_cfg = {"num_slots": num_slots, "block_size": block_size}
+    env_slow = {"DL4J_SOAK_SLOW": str(slow)}
+
+    def wait_done(frs, at_least, timeout):
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            n = sum(1 for fr in frs.values() if fr.done())
+            if n >= at_least:
+                return n
+            time.sleep(0.05)
+        return sum(1 for fr in frs.values() if fr.done())
+
+    def drain(frs, timeout):
+        end = time.monotonic() + timeout
+        for fr in frs.values():
+            fr._done.wait(max(0.0, end - time.monotonic()))
+        return sum(1 for fr in frs.values() if fr.done())
+
+    def check(frs):
+        lost = failures = mismatches = 0
+        for rid, fr in frs.items():
+            if not fr.done():
+                lost += 1
+                continue
+            try:
+                out = fr.result(timeout=0)
+            except Exception:   # noqa: BLE001 — typed failure counted
+                failures += 1
+                continue
+            if not np.array_equal(np.asarray(out, np.int64),
+                                  np.asarray(expected[rid], np.int64)):
+                mismatches += 1
+        return {"lost": lost, "failures": failures,
+                "mismatches": mismatches,
+                "completed": sum(1 for fr in frs.values() if fr.done())}
+
+    def steady_check(ep, sample, pin=True, wait_s=120.0):
+        """{} new compiles per ALIVE worker AFTER a warm wave — a
+        respawned process legitimately recompiles once; the bar is that
+        the wave after it compiles NOTHING. ``pin=False`` routes waves
+        through normal dispatch (role-split fleets, where a fresh
+        prompt cannot be pinned onto a decode-only worker)."""
+        table = ep.fleet_stats()["replicas"]
+        alive = [rid for rid, row in table.items()
+                 if row["state"] == "ALIVE"]
+        deltas = {}
+
+        def wave(rid=None):
+            frs = [ep.submit(r["prompt"], r["gen"], replica_id=rid)
+                   for r in sample]
+            end = time.monotonic() + wait_s
+            for fr in frs:
+                fr._done.wait(max(0.0, end - time.monotonic()))
+
+        try:
+            if pin:
+                for rid in alive:
+                    wave(rid)
+                for rid in alive:
+                    ep._proxies[rid].audit_mark()
+                for rid in alive:
+                    wave(rid)
+            else:
+                wave()
+                wave()
+                for rid in alive:
+                    ep._proxies[rid].audit_mark()
+                wave()
+                wave()
+            for rid in alive:
+                deltas[rid] = ep._proxies[rid].audit_delta(timeout=30.0)
+        except Exception as e:   # noqa: BLE001 — a dead/retired worker
+            deltas["error"] = f"{type(e).__name__}: {e}"
+        return deltas
+
+    def steady_ok(deltas):
+        return bool(deltas) and "error" not in deltas and \
+            all(d == {} for d in deltas.values())
+
+    summary = {"seed": seed, "requests": n_requests, "workdir": workdir}
+
+    # ---- round A: SIGKILL a worker mid-stream ---------------------------
+    row_a = {}
+    ep = FleetEndpoint(os.path.join(workdir, "a"), model,
+                       workers={"w0": "both", "w1": "both"},
+                       engine=eng_cfg, fleet_id=f"ra{seed}",
+                       env=env_slow, hello_deadline=180.0)
+    try:
+        ep.start()
+        frs = {r["id"]: ep.submit(r["prompt"], r["gen"]) for r in reqs}
+        row_a["results_at_kill"] = wait_done(
+            frs, max(2, n_requests // 4), round_wait_s)
+        ep.kill_worker("w0")
+        drain(frs, round_wait_s)
+        row_a.update(check(frs))
+        row_a["respawn_epoch"] = ep.launcher.epoch("w0")
+        led = ep.fleet_stats()["ledger"]
+        row_a["ledger"] = led
+        row_a["steady"] = steady_check(ep, reqs[:2])
+        row_a["ok"] = bool(
+            not row_a["lost"] and not row_a["failures"]
+            and not row_a["mismatches"] and led["duplicates"] == 0
+            and 0 < row_a["results_at_kill"] < n_requests
+            and row_a["respawn_epoch"] >= 2
+            and steady_ok(row_a["steady"]))
+    except Exception as e:   # noqa: BLE001 — a wedged round is a FAIL row
+        row_a["error"] = f"{type(e).__name__}: {e}"
+        row_a["ok"] = False
+    finally:
+        ep.shutdown()
+    summary["round_a"] = row_a
+
+    # ---- round B: role-split fleet, SIGKILL decode mid-handoff ----------
+    row_b = {}
+    ep = FleetEndpoint(os.path.join(workdir, "b"), model,
+                       workers={"p0": "prefill", "d0": "decode",
+                                "d1": "decode"},
+                       engine=eng_cfg, fleet_id=f"rb{seed}",
+                       env=env_slow, hello_deadline=240.0)
+    try:
+        ep.start()
+        frs = {r["id"]: ep.submit(r["prompt"], r["gen"]) for r in reqs}
+        end = time.monotonic() + round_wait_s
+        while time.monotonic() < end:
+            if ep.stats().get("wire_handoffs", 0) >= 2:
+                break
+            time.sleep(0.05)
+        ep.kill_worker("d0")
+        drain(frs, round_wait_s)
+        row_b.update(check(frs))
+        s = ep.stats()
+        row_b["wire"] = {k: s[k] for k in (
+            "wire_handoffs", "wire_handoffs_fenced",
+            "wire_handoff_reprefills", "wire_transfer_bytes",
+            "wire_transfer_wire_bytes", "wire_transfer_pages",
+            "wire_kv_corruption")}
+        # the byte account: what p0's transport SHIPPED must equal what
+        # the router received and forwarded — exactly when nothing was
+        # fenced, as an upper bound when a kill raced a handoff
+        shipped = int(ep._proxies["p0"].refresh_stats(
+            timeout=15.0).get("kv_wire_bytes", -1))
+        row_b["shipped_wire_bytes"] = shipped
+        fenced = row_b["wire"]["wire_handoffs_fenced"]
+        exact = shipped == row_b["wire"]["wire_transfer_wire_bytes"]
+        row_b["transfer_exact"] = exact
+        led = ep.fleet_stats()["ledger"]
+        row_b["ledger"] = led
+        row_b["steady"] = steady_check(ep, reqs[:2], pin=False)
+        row_b["ok"] = bool(
+            not row_b["lost"] and not row_b["failures"]
+            and not row_b["mismatches"] and led["duplicates"] == 0
+            and row_b["wire"]["wire_handoffs"] >= 2
+            and row_b["wire"]["wire_kv_corruption"] == 0
+            and (exact if fenced == 0 else
+                 row_b["wire"]["wire_transfer_wire_bytes"] <= shipped)
+            and steady_ok(row_b["steady"]))
+    except Exception as e:   # noqa: BLE001
+        row_b["error"] = f"{type(e).__name__}: {e}"
+        row_b["ok"] = False
+    finally:
+        ep.shutdown()
+    summary["round_b"] = row_b
+
+    # ---- round C: partition (SIGSTOP) → DEAD → zombie fenced ------------
+    row_c = {}
+    ep = FleetEndpoint(os.path.join(workdir, "c"), model,
+                       workers={"w0": "both", "w1": "both"},
+                       engine=eng_cfg, fleet_id=f"rc{seed}",
+                       env=env_slow, hello_deadline=180.0)
+    try:
+        ep.start()
+        frs = {r["id"]: ep.submit(r["prompt"], r["gen"]) for r in reqs}
+        wait_done(frs, 1, round_wait_s)
+        ep.partition_worker("w0")      # black hole, NOT a death
+        drain(frs, round_wait_s)       # DEAD aging + clone migration
+        row_c.update(check(frs))
+        ep.heal_worker("w0")           # the zombie returns...
+        time.sleep(2.0)                # ...and its late publishes land
+        prox = ep._proxies.get("w0")
+        row_c["zombie_fenced"] = {
+            "proxy_fenced_results":
+                None if prox is None else prox.counters["fenced_results"],
+            "stale_epoch":
+                None if prox is None else prox.counters["stale_epoch"]}
+        led = ep.fleet_stats()["ledger"]
+        row_c["ledger"] = led
+        s = ep.stats()
+        row_c["migrations"] = s.get("migrations")
+        row_c["steady"] = steady_check(ep, reqs[:2])
+        row_c["ok"] = bool(
+            not row_c["lost"] and not row_c["failures"]
+            and not row_c["mismatches"] and led["duplicates"] == 0
+            and steady_ok(row_c["steady"]))
+    except Exception as e:   # noqa: BLE001
+        row_c["error"] = f"{type(e).__name__}: {e}"
+        row_c["ok"] = False
+    finally:
+        try:
+            ep.heal_worker("w0")       # never leave a SIGSTOP'd orphan
+        except Exception:   # noqa: BLE001
+            pass
+        ep.shutdown()
+    summary["round_c"] = row_c
+
+    # ---- round D: router (endpoint process) SIGKILL + restart -----------
+    row_d = {}
+    dwd = os.path.join(workdir, "d")
+    os.makedirs(dwd, exist_ok=True)
+    with open(os.path.join(dwd, "manifest.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"model": model, "requests": reqs, "engine": eng_cfg},
+                  f)
+    results_path = os.path.join(dwd, "results.jsonl")
+
+    def spawn_router(incarnation: int, paced: bool):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("DL4J_SOAK_SLOW", None)
+        if paced:
+            env["DL4J_SOAK_SLOW"] = str(slow)
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--remote-router-child", dwd,
+             "--incarnation", str(incarnation)],
+            env=env, cwd=REPO_ROOT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    try:
+        proc = spawn_router(0, paced=True)
+        end = time.monotonic() + round_wait_s
+        while time.monotonic() < end:
+            if len(_valid_result_lines(results_path)["by_id"]) >= 2:
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        n0 = len(_valid_result_lines(results_path)["by_id"])
+        row_d["results_at_kill"] = n0
+        if proc.poll() is None:
+            proc.kill()                # the whole routing tier dies
+        proc.wait(timeout=30)
+        # reap the orphaned worker processes the dead launcher left
+        reaped = 0
+        try:
+            with open(os.path.join(dwd, "pids.json"),
+                      encoding="utf-8") as f:
+                orphan_pids = json.load(f)
+        except (OSError, ValueError):
+            orphan_pids = {}
+        for pid in orphan_pids.values():
+            try:
+                os.kill(int(pid), _signal.SIGKILL)
+                reaped += 1
+            except (OSError, ValueError):
+                pass
+        row_d["orphans_reaped"] = reaped
+        proc = spawn_router(1, paced=False)
+        try:
+            rc = proc.wait(timeout=round_wait_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+            rc = -9
+        row_d["final_exit_code"] = rc
+        res = _valid_result_lines(results_path)
+        by_id = res["by_id"]
+        # parent-side ledger: the FIRST durable line per id claims the
+        # one completion; every extra line must fence
+        from deeplearning4j_tpu.streaming.fleet import FleetLedger
+        ledger = FleetLedger()
+        for r in reqs:
+            ledger.assign(r["id"], "router")
+        duplicates = mismatches = failures = 0
+        for rid, doc in by_id.items():
+            if rid not in expected:
+                continue
+            if ledger.try_complete(rid, "router") != "ok":
+                duplicates += 1
+            if doc.get("failed"):
+                failures += 1
+            elif not np.array_equal(
+                    np.asarray(doc.get("out", []), np.int64),
+                    np.asarray(expected[rid], np.int64)):
+                mismatches += 1
+        for doc in res["extra"]:
+            if ledger.try_complete(str(doc.get("id")),
+                                   "router") != "ok":
+                duplicates += 1
+        lost = sorted(set(expected) - set(by_id))
+        try:
+            with open(os.path.join(dwd, "report-d-1.json"),
+                      encoding="utf-8") as f:
+                rep1 = json.load(f)
+        except (OSError, ValueError):
+            rep1 = {}
+        row_d.update({
+            "lost": len(lost), "lost_ids": lost,
+            "duplicates": duplicates, "mismatches": mismatches,
+            "failures": failures, "completed": len(by_id),
+            "steady": rep1.get("steady_new_compiles"),
+            "ledger": ledger.to_dict()})
+        row_d["ok"] = bool(
+            rc == 0 and not lost and not duplicates and not mismatches
+            and not failures
+            and isinstance(row_d["steady"], dict)
+            and all(d == {} for d in row_d["steady"].values()))
+    except Exception as e:   # noqa: BLE001
+        row_d["error"] = f"{type(e).__name__}: {e}"
+        row_d["ok"] = False
+    summary["round_d"] = row_d
+
+    summary["ok"] = bool(row_a["ok"] and row_b["ok"] and row_c["ok"]
+                         and row_d["ok"])
+    if own_workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+        summary.pop("workdir", None)
+    return summary
+
+
+def _remote_router_child(workdir: str, incarnation: int) -> int:
+    """The routing-tier process of ``--remote`` round D: one
+    FleetEndpoint serving the manifest. Resume-aware — ids that already
+    have a durable result line are NOT resubmitted (first line wins on
+    the parent side); worker pids are journaled to ``pids.json`` on
+    every (re)spawn so a parent can reap orphans after SIGKILLing this
+    process."""
+    from deeplearning4j_tpu.streaming.remote import FleetEndpoint
+
+    with open(os.path.join(workdir, "manifest.json"),
+              encoding="utf-8") as f:
+        manifest = json.load(f)
+    results_path = os.path.join(workdir, "results.jsonl")
+    have = set(_valid_result_lines(results_path)["by_id"])
+    todo = [r for r in manifest["requests"] if r["id"] not in have]
+
+    ep = FleetEndpoint(os.path.join(workdir, f"fleet-{incarnation}"),
+                       manifest["model"],
+                       workers={"w0": "both", "w1": "both"},
+                       engine=manifest.get("engine"),
+                       fleet_id=f"rd{incarnation}",
+                       hello_deadline=180.0)
+
+    pids_path = os.path.join(workdir, "pids.json")
+
+    def dump_pids(*_a):
+        tmp = pids_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(ep.launcher.pids(), f)
+        os.replace(tmp, pids_path)
+
+    ep.launcher.on_spawn = dump_pids
+    try:
+        ep.start()
+        dump_pids()
+        rf = open(results_path, "a", encoding="utf-8")
+        frs = {r["id"]: ep.submit(r["prompt"], r["gen"]) for r in todo}
+        pending = dict(frs)
+        while pending:
+            for rid, fr in list(pending.items()):
+                if not fr.done():
+                    continue
+                del pending[rid]
+                if rid in have:
+                    continue
+                have.add(rid)
+                try:
+                    out = [int(t) for t in fr.result(0)]
+                    doc = {"id": rid, "inc": incarnation, "out": out}
+                except Exception as e:   # noqa: BLE001
+                    doc = {"id": rid, "inc": incarnation,
+                           "failed": f"{type(e).__name__}: {e}"}
+                rf.write(json.dumps(doc) + "\n")
+                rf.flush()
+            time.sleep(0.02)
+        rf.close()
+        # steady-compile report: warm wave per worker, mark, wave, delta
+        sample = manifest["requests"][:2]
+        steady = {}
+        for rid in list(ep._proxies):
+            try:
+                warm = [ep.submit(r["prompt"], r["gen"], replica_id=rid)
+                        for r in sample]
+                for fr in warm:
+                    fr._done.wait(60.0)
+                ep._proxies[rid].audit_mark()
+                wave = [ep.submit(r["prompt"], r["gen"], replica_id=rid)
+                        for r in sample]
+                for fr in wave:
+                    fr._done.wait(60.0)
+                steady[rid] = ep._proxies[rid].audit_delta(timeout=30.0)
+            except Exception as e:   # noqa: BLE001
+                steady[rid] = {"error": f"{type(e).__name__}: {e}"}
+        with open(os.path.join(workdir,
+                               f"report-d-{incarnation}.json"),
+                  "w", encoding="utf-8") as f:
+            json.dump({"incarnation": incarnation,
+                       "served": len(todo),
+                       "steady_new_compiles": steady}, f, default=str)
+    finally:
+        ep.shutdown()
+    return 0
+
+
+def run_remote_scale_ab(seed: int = 0, n_requests: int = 48,
+                        num_slots: int = 2, max_new: int = 8,
+                        vocab: int = 12, block_size: int = 4,
+                        slow: float = 0.4, workers: int = 3,
+                        wait_s: float = 900.0) -> dict:
+    """1-process vs N-process aggregate tok/s A/B (``--remote-scale``).
+
+    On a 1-core CI host real compute cannot scale, so the engine step is
+    PACED (``DL4J_SOAK_SLOW``, the soak's standard accelerator-bound
+    stand-in): each worker's step blocks in a sleep exactly as it would
+    block on a device, sleeps overlap across processes, and the measured
+    ratio is then an honest account of the dispatch/wire/routing
+    overhead the multi-process tier adds — the quantity ISSUE 18 gates
+    (>= 2.4x at 3 processes where the GIL-shared single-process fleet
+    cannot scale). The pace must DOMINATE the host-side step cost for
+    the stand-in to be faithful (this box: ~0.08s/step of real CPU
+    compute vs the 0.4s pace — at 0.05s the A/B honestly reports ~1x,
+    because then the shared core, not the "device", is the bottleneck
+    in both arms)."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.streaming.remote import FleetEndpoint
+
+    model = {"vocab": vocab, "d_model": 32, "num_heads": 2,
+             "num_layers": 2, "max_length": 32, "seed": 5}
+    # Uniform streams (every request generates exactly max_new tokens,
+    # a whole number of decode blocks): a throughput A/B wants full
+    # block steps and an even token split across workers. The failure
+    # rounds keep the ragged random workload — here raggedness only
+    # adds half-empty paced steps and worker imbalance, which measures
+    # the workload, not the multi-process tier.
+    reqs = _remote_requests(seed, n_requests, vocab, max_new)
+    for r in reqs:
+        r["gen"] = max_new
+    eng_cfg = {"num_slots": num_slots, "block_size": block_size}
+    gen_total = sum(r["gen"] for r in reqs)
+
+    def run(n_workers: int) -> float:
+        wd = tempfile.mkdtemp(prefix=f"remote-ab{n_workers}-")
+        ep = FleetEndpoint(
+            wd, model,
+            workers={f"w{i}": "both" for i in range(n_workers)},
+            engine=eng_cfg, fleet_id=f"ab{seed}x{n_workers}",
+            env={"DL4J_SOAK_SLOW": str(slow)}, hello_deadline=300.0)
+        try:
+            ep.start()
+            # warm every worker (compile) OUTSIDE the measured window
+            for i in range(n_workers):
+                warm = [ep.submit(r["prompt"], r["gen"],
+                                  replica_id=f"w{i}")
+                        for r in reqs[:2]]
+                for fr in warm:
+                    fr.result(timeout=wait_s)
+            t0 = time.monotonic()
+            frs = [ep.submit(r["prompt"], r["gen"]) for r in reqs]
+            for fr in frs:
+                fr.result(timeout=wait_s)
+            return gen_total / (time.monotonic() - t0)
+        finally:
+            ep.shutdown()
+            shutil.rmtree(wd, ignore_errors=True)
+
+    tps1 = run(1)
+    tpsN = run(workers)
+    ratio = tpsN / tps1 if tps1 else 0.0
+    return {"seed": seed, "requests": n_requests,
+            "generated_tokens": gen_total, "pace_s": slow,
+            "tokens_per_sec_1p": round(tps1, 2),
+            f"tokens_per_sec_{workers}p": round(tpsN, 2),
+            "scaling_x": round(ratio, 3),
+            "ok": bool(ratio >= 2.4)}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -2334,12 +2892,102 @@ def main(argv=None) -> int:
                     metavar="WORKDIR", help=argparse.SUPPRESS)
     ap.add_argument("--incarnation", type=int, default=0,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--remote", action="store_true",
+                    help="multi-process fleet soak (ISSUE 18): every "
+                         "replica is its own OS process behind a "
+                         "FleetEndpoint; rounds = worker SIGKILL "
+                         "mid-stream, role-split wire handoff + decode "
+                         "kill, SIGSTOP partition with zombie fencing, "
+                         "and router-process SIGKILL + orphan reap + "
+                         "restart — zero lost / zero dup / "
+                         "token-identical / {} steady compiles")
+    ap.add_argument("--remote-scale", action="store_true",
+                    help="1-process vs 3-process paced tok/s A/B over "
+                         "the remote fleet tier (gate: >= 2.4x)")
+    ap.add_argument("--remote-router-child", default=None,
+                    metavar="WORKDIR", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.process_kill_child:
         return _process_kill_child(args.process_kill_child,
                                    args.incarnation,
                                    args.drain_deadline)
+
+    if args.remote_router_child:
+        return _remote_router_child(args.remote_router_child,
+                                    args.incarnation)
+
+    if args.remote:
+        if args.mesh or args.replicas or args.paged or args.disagg \
+                or args.process_kill:
+            ap.error("--remote runs its own multi-process fleets; it "
+                     "cannot be combined with --mesh/--replicas/"
+                     "--paged/--disagg/--process-kill")
+        ok = True
+        for i in range(args.iterations):
+            s = run_remote_soak(seed=args.seed + i,
+                                n_requests=args.requests,
+                                num_slots=args.slots,
+                                max_new=args.max_new)
+            ok = ok and s["ok"]
+            if args.json:
+                print(json.dumps(s, default=str))
+            else:
+                for rk in ("round_a", "round_b", "round_c", "round_d"):
+                    r = s[rk]
+                    if "error" in r:
+                        print(f"round {i}: remote {rk[-1]} "
+                              f"seed={s['seed']} "
+                              f"error={r['error']} -> FAIL")
+                        continue
+                    extra = ""
+                    if rk == "round_b":
+                        w = r["wire"]
+                        extra = (f" handoffs={w['wire_handoffs']}"
+                                 f"(fenced={w['wire_handoffs_fenced']})"
+                                 f" wire_bytes="
+                                 f"{w['wire_transfer_wire_bytes']}"
+                                 f"{'=' if r['transfer_exact'] else '<='}"
+                                 f"{r['shipped_wire_bytes']}"
+                                 f" corrupt={w['wire_kv_corruption']}")
+                    elif rk == "round_c":
+                        zf = r["zombie_fenced"]
+                        extra = (f" zombie_fenced="
+                                 f"{zf['proxy_fenced_results']}"
+                                 f"/{zf['stale_epoch']}")
+                    elif rk == "round_d":
+                        extra = (f" orphans_reaped="
+                                 f"{r['orphans_reaped']} "
+                                 f"rc={r['final_exit_code']}")
+                    print(f"round {i}: remote {rk[-1]} "
+                          f"seed={s['seed']} "
+                          f"completed={r['completed']}/{s['requests']} "
+                          f"lost={r['lost']} "
+                          f"dup={r['ledger']['duplicates']} "
+                          f"mismatches={r['mismatches']}{extra} "
+                          f"-> {'ok' if r['ok'] else 'FAIL'}")
+        return 0 if ok else 1
+
+    if args.remote_scale:
+        if args.mesh or args.replicas or args.paged or args.disagg \
+                or args.process_kill:
+            ap.error("--remote-scale runs its own multi-process "
+                     "fleets; it cannot be combined with --mesh/"
+                     "--replicas/--paged/--disagg/--process-kill")
+        # fixed workload: the A/B needs enough requests that the
+        # admission ramp and straggler tail amortize against the paced
+        # steady state — the generic --requests/--max-new defaults are
+        # sized for the failure rounds, not for a throughput measure
+        s = run_remote_scale_ab(seed=args.seed)
+        if args.json:
+            print(json.dumps(s, default=str))
+        else:
+            print(f"remote-scale seed={s['seed']} "
+                  f"1p={s['tokens_per_sec_1p']}tok/s "
+                  f"3p={s['tokens_per_sec_3p']}tok/s "
+                  f"scaling={s['scaling_x']}x "
+                  f"-> {'ok' if s['ok'] else 'FAIL'}")
+        return 0 if s["ok"] else 1
 
     if args.mesh:
         # XLA_FLAGS must land before jax initializes (run_soak performs

@@ -135,7 +135,11 @@ def normalize_record(doc: dict) -> Dict[str, float]:
     a flat ``{metric_key: value}`` record. Unknown/error-shaped side
     metrics are skipped — normalization must survive five generations
     of protocol drift."""
+    if not isinstance(doc, dict):
+        return {}
     doc = doc.get("parsed", doc) or {}
+    if not isinstance(doc, dict):
+        return {}
     out: Dict[str, float] = {}
     metric = doc.get("metric")
     v = _num(doc.get("value"))
@@ -165,8 +169,14 @@ def record_fingerprint(doc: dict) -> Optional[str]:
     when the document carries no generate config (pre-r6 rounds,
     training-only runs) -- None fences only against other None
     rounds."""
+    if not isinstance(doc, dict):
+        return None
     doc = doc.get("parsed", doc) or {}
+    if not isinstance(doc, dict):
+        return None
     side = doc.get("side_metrics") or {}
+    if not isinstance(side, dict):
+        return None
     cfg = side.get("config")
     if not isinstance(cfg, dict):
         lm = side.get("lm_generate")
@@ -189,6 +199,11 @@ def load_history(pattern: str
             with open(path, "r", encoding="utf-8") as f:
                 doc = json.load(f)
         except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            # a sparse/re-anchored history can contain stubs or
+            # foreign-shaped JSON (a bare list, a string): skip, never
+            # traceback — absent history is a verdict, not an error
             continue
         parsed = doc.get("parsed", doc)
         rec = parsed.get("history_record") \
@@ -297,6 +312,9 @@ def _print_report(rep: dict, out=sys.stdout) -> None:
     if rep["regressions"]:
         w(f"  FAIL: {len(rep['regressions'])} regression(s): "
           f"{', '.join(rep['regressions'])}\n")
+    elif not rep["rounds"]:
+        w("  OK: no usable bench history — nothing to gate "
+          "(trajectory empty or no round normalized)\n")
     else:
         w("  OK: no gated regressions\n")
 
